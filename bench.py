@@ -1,0 +1,62 @@
+"""Benchmark driver — prints ONE JSON line.
+
+Measures LeNet-MNIST training throughput through MultiLayerNetwork.fit()
+(BASELINE.md config #1; ResNet-50 ComputationGraph lands next) on whatever
+accelerator jax exposes (TPU chip under axon; CPU fallback).
+
+vs_baseline: the reference publishes no numbers (BASELINE.md). The north-star
+target is "≥ nd4j-cuda V100 images/sec". We use 3000 images/sec as the
+stand-in V100 LeNet-MNIST figure for dl4j-0.6-era nd4j-cuda (conservative
+estimate for a 2016 JVM framework driving cuDNN at batch 64; to be replaced by
+a measured number when the reference can be run).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMAGES_PER_SEC = 3000.0
+
+
+def main():
+    import jax
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.zoo.lenet import lenet_conf
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    platform = jax.devices()[0].platform
+    batch = 256
+    net = MultiLayerNetwork(lenet_conf(data_type="bfloat16",
+                                       updater="nesterovs")).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.random((batch, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+    ds = DataSet(x, y)
+
+    # warmup (compile)
+    for _ in range(3):
+        net.fit(ds)
+    jax.block_until_ready(net._params)
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        net.fit(ds)
+    jax.block_until_ready(net._params)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * iters / dt
+    print(json.dumps({
+        "metric": f"LeNet-MNIST train images/sec (batch {batch}, bf16, {platform})",
+        "value": round(images_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

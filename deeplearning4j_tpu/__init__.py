@@ -1,0 +1,28 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of
+deeplearning4j (reference: OkSerIous/deeplearning4j @ 0.6.1-SNAPSHOT):
+layer-based networks (MultiLayerNetwork), DAG networks (ComputationGraph),
+configuration DSL with JSON round-trip, data-parallel + sharded training over
+TPU meshes, embedding models (Word2Vec family), Keras import, evaluation,
+early stopping, checkpointing, and a training UI.
+
+Execution model: whole training steps compile to single XLA programs
+(forward + autodiff backward + optimizer, buffers donated); multi-chip
+scaling uses jax.sharding.Mesh + XLA collectives over ICI rather than the
+reference's parameter-averaging threads / Spark / Aeron parameter server.
+"""
+
+__version__ = "0.1.0"
+
+from .nn.conf.input_type import InputType
+from .nn.conf.neural_net_configuration import (MultiLayerConfiguration,
+                                               NeuralNetConfiguration)
+from .nn.multilayer import MultiLayerNetwork
+
+__all__ = [
+    "InputType",
+    "MultiLayerConfiguration",
+    "NeuralNetConfiguration",
+    "MultiLayerNetwork",
+]

@@ -1,0 +1,88 @@
+"""DataSet / MultiDataSet containers.
+
+TPU-native equivalent of ND4J's DataSet/MultiDataSet API types used throughout
+the reference (reference: org.nd4j.linalg.dataset.DataSet, consumed by
+MultiLayerNetwork.fit(DataSetIterator) — MultiLayerNetwork.java:978).
+
+Arrays are numpy on host; device transfer happens at the jit boundary (the
+async prefetch pipeline stages host->HBM copies, see iterators.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class DataSet:
+    def __init__(self, features, labels, features_mask=None, labels_mask=None):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels) if labels is not None else None
+        self.features_mask = (np.asarray(features_mask)
+                              if features_mask is not None else None)
+        self.labels_mask = (np.asarray(labels_mask)
+                            if labels_mask is not None else None)
+
+    def num_examples(self):
+        return int(self.features.shape[0])
+
+    def get_features(self):
+        return self.features
+
+    def get_labels(self):
+        return self.labels
+
+    def split_test_and_train(self, n_train):
+        tr = DataSet(self.features[:n_train], self.labels[:n_train])
+        te = DataSet(self.features[n_train:], self.labels[n_train:])
+        return tr, te
+
+    def shuffle(self, seed=None):
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        if self.labels is not None:
+            self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+
+    def batch_by(self, batch_size):
+        n = self.num_examples()
+        for i in range(0, n, batch_size):
+            yield DataSet(
+                self.features[i:i + batch_size],
+                self.labels[i:i + batch_size] if self.labels is not None else None,
+                self.features_mask[i:i + batch_size] if self.features_mask is not None else None,
+                self.labels_mask[i:i + batch_size] if self.labels_mask is not None else None,
+            )
+
+    @staticmethod
+    def merge(datasets):
+        feats = np.concatenate([d.features for d in datasets], axis=0)
+        labels = (np.concatenate([d.labels for d in datasets], axis=0)
+                  if datasets[0].labels is not None else None)
+        return DataSet(feats, labels)
+
+
+class MultiDataSet:
+    """Multi-input / multi-output container (reference: ND4J MultiDataSet,
+    consumed by ComputationGraph.fit)."""
+
+    def __init__(self, features, labels, features_masks=None, labels_masks=None):
+        self.features = [np.asarray(f) for f in _as_list(features)]
+        self.labels = [np.asarray(l) for l in _as_list(labels)]
+        self.features_masks = ([np.asarray(m) if m is not None else None
+                                for m in features_masks]
+                               if features_masks else None)
+        self.labels_masks = ([np.asarray(m) if m is not None else None
+                              for m in labels_masks]
+                             if labels_masks else None)
+
+    def num_examples(self):
+        return int(self.features[0].shape[0])
+
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]

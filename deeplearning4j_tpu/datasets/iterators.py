@@ -1,0 +1,236 @@
+"""DataSetIterator family.
+
+TPU-native equivalent of the reference's iterator stack:
+- DataSetIterator protocol (ND4J API type, used by MultiLayerNetwork.fit —
+  MultiLayerNetwork.java:978)
+- AsyncDataSetIterator (reference: datasets/iterator/AsyncDataSetIterator.java:36
+  — background prefetch thread; here the thread stages the *next* batch to
+  device while the current step runs, overlapping host->HBM DMA with compute)
+- ListDataSetIterator, IteratorDataSetIterator, MultipleEpochsIterator,
+  SamplingDataSetIterator (reference: datasets/iterator/*.java)
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from .dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterator protocol. Subclasses implement next_batch()/reset()/has_next()."""
+
+    def has_next(self):
+        raise NotImplementedError
+
+    def next_batch(self):
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+    def batch(self):
+        return -1
+
+    def total_outcomes(self):
+        return -1
+
+    def input_columns(self):
+        return -1
+
+    # python iteration sugar
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_batch()
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate over a list of pre-batched DataSets (reference:
+    datasets/iterator/impl/ListDataSetIterator.java)."""
+
+    def __init__(self, dataset_or_list, batch_size=None):
+        if isinstance(dataset_or_list, DataSet):
+            if batch_size is None:
+                batch_size = dataset_or_list.num_examples()
+            self._batches = list(dataset_or_list.batch_by(batch_size))
+        else:
+            self._batches = list(dataset_or_list)
+        self._batch_size = batch_size or (
+            self._batches[0].num_examples() if self._batches else 0)
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._batches)
+
+    def next_batch(self):
+        b = self._batches[self._pos]
+        self._pos += 1
+        return b
+
+    def reset(self):
+        self._pos = 0
+
+    def batch(self):
+        return self._batch_size
+
+    def total_outcomes(self):
+        b = self._batches[0]
+        return int(b.labels.shape[-1]) if b.labels is not None else -1
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """Wrap a python iterable of DataSets (reference:
+    datasets/iterator/IteratorDataSetIterator.java)."""
+
+    def __init__(self, make_iter):
+        self._make = make_iter if callable(make_iter) else (lambda: iter(list(make_iter)))
+        self._it = self._make()
+        self._next = None
+        self._advance()
+
+    def _advance(self):
+        try:
+            self._next = next(self._it)
+        except StopIteration:
+            self._next = None
+
+    def has_next(self):
+        return self._next is not None
+
+    def next_batch(self):
+        b = self._next
+        self._advance()
+        return b
+
+    def reset(self):
+        self._it = self._make()
+        self._advance()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Repeat an underlying iterator N epochs (reference:
+    datasets/iterator/MultipleEpochsIterator.java)."""
+
+    def __init__(self, num_epochs, underlying):
+        self.num_epochs = int(num_epochs)
+        self.underlying = underlying
+        self._epoch = 0
+
+    def has_next(self):
+        if self.underlying.has_next():
+            return True
+        if self._epoch + 1 < self.num_epochs:
+            self._epoch += 1
+            self.underlying.reset()
+            return self.underlying.has_next()
+        return False
+
+    def next_batch(self):
+        return self.underlying.next_batch()
+
+    def reset(self):
+        self._epoch = 0
+        self.underlying.reset()
+
+    def batch(self):
+        return self.underlying.batch()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Random-with-replacement sampling from a DataSet (reference:
+    datasets/iterator/SamplingDataSetIterator.java)."""
+
+    def __init__(self, dataset, batch_size, total_samples, seed=42):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.total = int(total_samples)
+        self._seed = seed
+        self._rng = np.random.default_rng(seed)
+        self._emitted = 0
+
+    def has_next(self):
+        return self._emitted < self.total
+
+    def next_batch(self):
+        n = self.dataset.num_examples()
+        idx = self._rng.integers(0, n, size=self.batch_size)
+        self._emitted += self.batch_size
+        return DataSet(self.dataset.features[idx],
+                       self.dataset.labels[idx] if self.dataset.labels is not None else None)
+
+    def reset(self):
+        self._emitted = 0
+        self._rng = np.random.default_rng(self._seed)
+
+    def batch(self):
+        return self.batch_size
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch, the host side of the TPU input pipeline.
+
+    reference: datasets/iterator/AsyncDataSetIterator.java:36 (queue capacity
+    `queueSize`, prefetch thread pinned to consumer device :75-76). Here the
+    prefetch thread also calls `device_put` on the batch so host->HBM transfer
+    overlaps the previous training step (double buffering); device pinning is
+    implicit in jax's default device.
+    """
+
+    def __init__(self, underlying, queue_size=2, device_put=True):
+        self.underlying = underlying
+        self.queue_size = max(1, int(queue_size))
+        self._device_put = device_put
+        self._q = None
+        self._thread = None
+        self._sentinel = object()
+        self._start()
+
+    def _start(self):
+        self._q = queue.Queue(maxsize=self.queue_size)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+        self._next = self._q.get()
+
+    def _worker(self):
+        try:
+            while self.underlying.has_next():
+                ds = self.underlying.next_batch()
+                if self._device_put:
+                    ds = self._stage(ds)
+                self._q.put(ds)
+        finally:
+            self._q.put(self._sentinel)
+
+    @staticmethod
+    def _stage(ds):
+        import jax
+        staged = DataSet.__new__(DataSet)
+        staged.features = jax.device_put(ds.features)
+        staged.labels = (jax.device_put(ds.labels)
+                         if ds.labels is not None else None)
+        staged.features_mask = (jax.device_put(ds.features_mask)
+                                if ds.features_mask is not None else None)
+        staged.labels_mask = (jax.device_put(ds.labels_mask)
+                              if ds.labels_mask is not None else None)
+        return staged
+
+    def has_next(self):
+        return self._next is not self._sentinel
+
+    def next_batch(self):
+        b = self._next
+        self._next = self._q.get()
+        return b
+
+    def reset(self):
+        # drain and restart
+        while self._next is not self._sentinel:
+            self._next = self._q.get()
+        self.underlying.reset()
+        self._start()
+
+    def batch(self):
+        return self.underlying.batch()

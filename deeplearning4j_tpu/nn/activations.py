@@ -1,0 +1,133 @@
+"""Activation functions.
+
+TPU-native equivalent of the reference's ND4J ``IActivation`` registry
+(reference: deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/conf/NeuralNetConfiguration.java:479
+selects the default activation; the activation set mirrors ND4J's Activation enum).
+
+Activations are pure jax functions ``f(x) -> y``; backward passes come from
+autodiff rather than the reference's hand-written ``backprop`` methods — XLA
+fuses these elementwise ops into adjacent matmuls on the MXU/VPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_E = 1e-7
+
+
+def identity(x):
+    return x
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def leakyrelu(x, alpha: float = 0.01):
+    return jax.nn.leaky_relu(x, alpha)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def cube(x):
+    return x ** 3
+
+
+def rationaltanh(x):
+    # Reference ND4J ActivationRationalTanh: 1.7159 * tanh_approx(2x/3)
+    a = 0.6666667 * x
+    tanh_approx = jnp.sign(a) * (1.0 - 1.0 / (1.0 + jnp.abs(a) + a ** 2 + 1.41645 * a ** 4))
+    return 1.7159 * tanh_approx
+
+
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def swish(x):
+    return jax.nn.swish(x)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def threshold_relu(x, theta: float = 1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+ACTIVATIONS = {
+    "identity": identity,
+    "linear": identity,
+    "sigmoid": sigmoid,
+    "tanh": tanh,
+    "relu": relu,
+    "leakyrelu": leakyrelu,
+    "elu": elu,
+    "selu": selu,
+    "gelu": gelu,
+    "softplus": softplus,
+    "softsign": softsign,
+    "hardtanh": hardtanh,
+    "hardsigmoid": hardsigmoid,
+    "relu6": relu6,
+    "cube": cube,
+    "rationaltanh": rationaltanh,
+    "rectifiedtanh": rectifiedtanh,
+    "softmax": softmax,
+    "swish": swish,
+    "mish": mish,
+}
+
+
+def get(name):
+    """Resolve an activation by name (case-insensitive) or pass through a callable."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(f"Unknown activation '{name}'. Known: {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[key]

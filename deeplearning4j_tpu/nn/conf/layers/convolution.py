@@ -1,0 +1,220 @@
+"""Convolutional layer configs: Convolution, Subsampling (pooling), ZeroPadding.
+
+TPU-native equivalents of reference nn/conf/layers/{ConvolutionLayer,
+SubsamplingLayer}.java with impls nn/layers/convolution/ConvolutionLayer.java
+(:172-193 im2col->gemm forward) and the cuDNN helpers
+(deeplearning4j-cuda/.../CudnnConvolutionHelper.java:49).
+
+TPU-first redesign: no im2col and no helper seam — `lax.conv_general_dilated`
+IS the accelerated path; XLA lowers it straight onto the MXU with NHWC layout
+and fuses bias+activation. The reference's AlgoMode/workspace knobs
+(nn/conf/layers/ConvolutionLayer.java:32-35) have no TPU equivalent and are
+accepted-but-ignored for config compat.
+
+ConvolutionMode semantics: 'truncate' == VALID-with-truncation (the reference's
+default strict/truncate behavior), 'same' == SAME padding.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ... import activations, weights
+from ..input_type import ConvolutionalInputType, InputType
+from .base import LayerConf, apply_input_dropout, register_layer
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def _conv_out_size(size, k, s, p, mode):
+    if mode == "same":
+        return -(-size // s)  # ceil
+    return (size + 2 * p - k) // s + 1
+
+
+@register_layer("convolution")
+@dataclass
+class ConvolutionLayer(LayerConf):
+    """2-D convolution. Kernel layout HWIO ([kh, kw, inC, outC]) — the XLA/TPU
+    native filter layout (reference uses [outC, inC, kh, kw] NCHW)."""
+    n_in: int = None          # input channels
+    n_out: int = None         # output channels
+    kernel_size: tuple = (5, 5)
+    stride: tuple = (1, 1)
+    padding: tuple = (0, 0)
+    convolution_mode: str = "truncate"   # 'truncate' | 'same'
+    cudnn_algo_mode: str = None          # accepted for config compat; ignored
+
+    def __post_init__(self):
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+
+    def set_n_in(self, input_type, override=True):
+        if isinstance(input_type, ConvolutionalInputType):
+            if self.n_in is None or override:
+                self.n_in = input_type.channels
+
+    def get_output_type(self, input_type):
+        if not isinstance(input_type, ConvolutionalInputType):
+            raise ValueError(f"ConvolutionLayer needs CNN input, got {input_type}")
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        mode = str(self.convolution_mode).lower()
+        oh = _conv_out_size(input_type.height, kh, sh, ph, mode)
+        ow = _conv_out_size(input_type.width, kw, sw, pw, mode)
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        fan_in = self.n_in * kh * kw
+        fan_out = self.n_out * kh * kw
+        w = weights.init(key, (kh, kw, self.n_in, self.n_out), fan_in, fan_out,
+                         self.weight_init, self.dist, dtype)
+        b = jnp.full((self.n_out,), float(self.bias_init or 0.0), dtype)
+        return {"W": w, "b": b}
+
+    def _padding_spec(self):
+        if str(self.convolution_mode).lower() == "same":
+            return "SAME"
+        ph, pw = self.padding
+        return [(ph, ph), (pw, pw)]
+
+    def preout(self, params, x, *, train=False, rng=None):
+        x = apply_input_dropout(self, x, train, rng)
+        y = lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=self.stride,
+            padding=self._padding_spec(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return y + params["b"]
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
+        return activations.get(self.activation)(
+            self.preout(params, x, train=train, rng=rng))
+
+
+@register_layer("subsampling")
+@dataclass
+class SubsamplingLayer(LayerConf):
+    """Pooling: MAX / AVG / SUM / PNORM.
+    reference: nn/conf/layers/SubsamplingLayer.java; impl
+    nn/layers/convolution/subsampling/SubsamplingLayer.java +
+    CudnnSubsamplingHelper. `lax.reduce_window` is the XLA-native pooling op.
+    """
+    pooling_type: str = "max"
+    kernel_size: tuple = (2, 2)
+    stride: tuple = (2, 2)
+    padding: tuple = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def __post_init__(self):
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+
+    def get_output_type(self, input_type):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        mode = str(self.convolution_mode).lower()
+        oh = _conv_out_size(input_type.height, kh, sh, ph, mode)
+        ow = _conv_out_size(input_type.width, kw, sw, pw, mode)
+        return InputType.convolutional(oh, ow, input_type.channels)
+
+    def _padding_spec(self):
+        if str(self.convolution_mode).lower() == "same":
+            return "SAME"
+        ph, pw = self.padding
+        return [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        dims = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        pad = self._padding_spec()
+        pt = str(self.pooling_type).lower()
+        if pt == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            return lax.reduce_window(x, init, lax.max, dims, strides, pad)
+        if pt in ("avg", "average", "mean"):
+            s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+            if pad == "SAME":
+                ones = jnp.ones_like(x)
+                counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
+                return s / counts
+            return s / (kh * kw)
+        if pt == "sum":
+            return lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+        if pt == "pnorm":
+            p = float(self.pnorm)
+            s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, dims, strides, pad)
+            return s ** (1.0 / p)
+        raise ValueError(f"Unknown pooling type {self.pooling_type}")
+
+
+@register_layer("zeropadding")
+@dataclass
+class ZeroPaddingLayer(LayerConf):
+    """Explicit zero padding (present in later reference versions; used by
+    resnet-style zoo models)."""
+    pad: tuple = (1, 1)
+
+    def __post_init__(self):
+        self.pad = _pair(self.pad)
+
+    def get_output_type(self, input_type):
+        ph, pw = self.pad
+        return InputType.convolutional(input_type.height + 2 * ph,
+                                       input_type.width + 2 * pw,
+                                       input_type.channels)
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
+        ph, pw = self.pad
+        return jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+
+
+@register_layer("globalpooling")
+@dataclass
+class GlobalPoolingLayer(LayerConf):
+    """Global pooling over spatial or time dims (reference:
+    nn/conf/layers/GlobalPoolingLayer.java — later version; included for zoo
+    models). Works on [B,H,W,C] -> [B,C] or [B,T,F] -> [B,F]."""
+    pooling_type: str = "avg"
+
+    def get_output_type(self, input_type):
+        if isinstance(input_type, ConvolutionalInputType):
+            return InputType.feed_forward(input_type.channels)
+        from ..input_type import RecurrentInputType
+        if isinstance(input_type, RecurrentInputType):
+            return InputType.feed_forward(input_type.size)
+        return input_type
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
+        axes = tuple(range(1, x.ndim - 1))
+        pt = str(self.pooling_type).lower()
+        if pt == "max":
+            if mask is not None and x.ndim == 3:
+                x = jnp.where(mask[:, :, None] > 0, x, -jnp.inf)
+            return jnp.max(x, axis=axes)
+        if pt in ("avg", "average", "mean"):
+            if mask is not None and x.ndim == 3:
+                m = mask[:, :, None]
+                return jnp.sum(x * m, axis=axes) / jnp.maximum(jnp.sum(m, axis=1), 1e-9)
+            return jnp.mean(x, axis=axes)
+        if pt == "sum":
+            if mask is not None and x.ndim == 3:
+                x = x * mask[:, :, None]
+            return jnp.sum(x, axis=axes)
+        raise ValueError(f"Unknown pooling type {self.pooling_type}")

@@ -1,0 +1,230 @@
+"""Feed-forward layer configs: Dense, Output, Loss, Activation, Dropout, Embedding.
+
+TPU-native equivalents of the reference's
+nn/conf/layers/{DenseLayer,OutputLayer,LossLayer,ActivationLayer,DropoutLayer,
+EmbeddingLayer}.java with impls from nn/layers/feedforward/.
+
+Forward math: preOutput = x @ W + b (reference BaseLayer.preOutput), activation
+applied on top. XLA maps the matmul to the MXU; bias-add and activation fuse
+into the same kernel.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ... import activations, losses, weights
+from ..input_type import (ConvolutionalFlatInputType, FeedForwardInputType,
+                          InputType, RecurrentInputType)
+from .base import LayerConf, apply_input_dropout, register_layer
+
+
+@register_layer("dense")
+@dataclass
+class DenseLayer(LayerConf):
+    """reference: nn/conf/layers/DenseLayer.java; impl nn/layers/feedforward/dense/DenseLayer.java"""
+    n_in: int = None
+    n_out: int = None
+
+    def set_n_in(self, input_type, override=True):
+        if self.n_in is None or override:
+            self.n_in = _ff_size(input_type)
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, key, dtype=jnp.float32):
+        w = weights.init(key, (self.n_in, self.n_out), self.n_in, self.n_out,
+                         self.weight_init, self.dist, dtype)
+        b = jnp.full((self.n_out,), float(self.bias_init or 0.0), dtype)
+        return {"W": w, "b": b}
+
+    def preout(self, params, x, *, train=False, rng=None):
+        x = apply_input_dropout(self, x, train, rng)
+        return x @ params["W"] + params["b"]
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
+        return activations.get(self.activation)(self.preout(params, x, train=train, rng=rng))
+
+
+@register_layer("output")
+@dataclass
+class OutputLayer(DenseLayer):
+    """Dense + loss head. reference: nn/conf/layers/OutputLayer.java (extends
+    BaseOutputLayer); score path MultiLayerNetwork.java:1840."""
+    loss_function: str = "mcxent"
+
+    def compute_score_per_example(self, params, x, labels, *, train=False, rng=None, mask=None):
+        pre = self.preout(params, x, train=train, rng=rng)
+        return losses.get(self.loss_function)(labels, pre, self.activation, mask)
+
+
+@register_layer("loss")
+@dataclass
+class LossLayer(LayerConf):
+    """Parameterless loss head (activation + loss only).
+    reference: nn/conf/layers/LossLayer.java"""
+    loss_function: str = "mcxent"
+
+    def set_n_in(self, input_type, override=True):
+        return
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
+        return activations.get(self.activation)(x)
+
+    def preout(self, params, x, *, train=False, rng=None):
+        return x
+
+    def compute_score_per_example(self, params, x, labels, *, train=False, rng=None, mask=None):
+        return losses.get(self.loss_function)(labels, x, self.activation, mask)
+
+
+@register_layer("rnnoutput")
+@dataclass
+class RnnOutputLayer(OutputLayer):
+    """Output layer over [batch, time, size] sequences.
+    reference: nn/conf/layers/RnnOutputLayer.java; impl applies the dense head
+    per timestep (FeedForwardToRnnPreProcessor handles the reshape in the
+    reference; here the matmul broadcasts over the time axis directly)."""
+
+    def set_n_in(self, input_type, override=True):
+        if isinstance(input_type, RecurrentInputType):
+            if self.n_in is None or override:
+                self.n_in = input_type.size
+        else:
+            super().set_n_in(input_type, override)
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_out)
+
+    def compute_score_per_example(self, params, x, labels, *, train=False, rng=None, mask=None):
+        pre = self.preout(params, x, train=train, rng=rng)   # [B, T, nOut]
+        if mask is not None and mask.ndim == 2:
+            mask = mask[:, :, None]
+        per = losses.get(self.loss_function)(labels, pre, self.activation, mask)
+        return per
+
+
+@register_layer("activation")
+@dataclass
+class ActivationLayer(LayerConf):
+    """reference: nn/conf/layers/ActivationLayer.java"""
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
+        return activations.get(self.activation)(x)
+
+
+@register_layer("dropoutlayer")
+@dataclass
+class DropoutLayer(LayerConf):
+    """Standalone dropout layer. reference: nn/conf/layers/DropoutLayer.java"""
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
+        return apply_input_dropout(self, x, train, rng)
+
+
+@register_layer("embedding")
+@dataclass
+class EmbeddingLayer(LayerConf):
+    """Integer-index lookup table layer; input [batch] or [batch, 1] of ids.
+    reference: nn/conf/layers/EmbeddingLayer.java; impl
+    nn/layers/feedforward/embedding/EmbeddingLayer.java (no bias in lookup? the
+    reference DOES add bias + activation — matched here).
+
+    TPU note: lookup is a one-hot matmul for tiny vocab or jnp.take for large —
+    take lowers to dynamic-gather which XLA handles natively on TPU.
+    """
+    n_in: int = None   # vocab size
+    n_out: int = None
+
+    def set_n_in(self, input_type, override=True):
+        if self.n_in is None or override:
+            self.n_in = _ff_size(input_type)
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, key, dtype=jnp.float32):
+        w = weights.init(key, (self.n_in, self.n_out), self.n_in, self.n_out,
+                         self.weight_init, self.dist, dtype)
+        b = jnp.full((self.n_out,), float(self.bias_init or 0.0), dtype)
+        return {"W": w, "b": b}
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
+        idx = x
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        idx = idx.astype(jnp.int32)
+        emb = jnp.take(params["W"], idx, axis=0) + params["b"]
+        return activations.get(self.activation)(emb)
+
+
+@register_layer("autoencoder")
+@dataclass
+class AutoEncoder(LayerConf):
+    """Denoising autoencoder (pretrain layer).
+    reference: nn/conf/layers/AutoEncoder.java; impl
+    nn/layers/feedforward/autoencoder/AutoEncoder.java (encode W,b; decode W^T, vb;
+    corruption level = corruptionLevel)."""
+    n_in: int = None
+    n_out: int = None
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    loss_function: str = "mse"
+
+    def set_n_in(self, input_type, override=True):
+        if self.n_in is None or override:
+            self.n_in = _ff_size(input_type)
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, key, dtype=jnp.float32):
+        w = weights.init(key, (self.n_in, self.n_out), self.n_in, self.n_out,
+                         self.weight_init, self.dist, dtype)
+        return {"W": w, "b": jnp.zeros((self.n_out,), dtype),
+                "vb": jnp.zeros((self.n_in,), dtype)}
+
+    def encode(self, params, x):
+        return activations.get(self.activation)(x @ params["W"] + params["b"])
+
+    def decode(self, params, h):
+        return activations.get(self.activation)(h @ params["W"].T + params["vb"])
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
+        return self.encode(params, x)
+
+    def pretrain_loss(self, params, x, *, rng=None):
+        """Reconstruction loss with input corruption (denoising AE)."""
+        xc = x
+        if rng is not None and self.corruption_level > 0:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            xc = x * keep
+        recon = self.decode(params, self.encode(params, xc))
+        from ... import losses as _losses
+        per = _losses.get(self.loss_function)(x, recon, "identity", None)
+        return jnp.mean(per)
+
+
+def _ff_size(input_type):
+    if isinstance(input_type, FeedForwardInputType):
+        return input_type.size
+    if isinstance(input_type, ConvolutionalFlatInputType):
+        return input_type.flattened_size
+    if isinstance(input_type, RecurrentInputType):
+        return input_type.size
+    from ..input_type import ConvolutionalInputType
+    if isinstance(input_type, ConvolutionalInputType):
+        return input_type.height * input_type.width * input_type.channels
+    raise ValueError(f"Cannot infer feed-forward size from {input_type}")

@@ -1,0 +1,116 @@
+"""Normalization layers: BatchNormalization, LocalResponseNormalization.
+
+TPU-native equivalents of reference nn/conf/layers/BatchNormalization.java +
+impl nn/layers/normalization/BatchNormalization.java (452 LoC) and
+LocalResponseNormalization.java, plus the cuDNN helpers
+(CudnnBatchNormalizationHelper.java:48, CudnnLocalResponseNormalizationHelper.java:46).
+
+BatchNorm carries non-trainable running statistics; in this functional design
+those live in the layer `state` pytree threaded through the jitted train step
+(forward_with_state) — the TPU-idiomatic replacement for the reference's
+mutable global-mean/var INDArrays. Training uses batch stats + EMA update with
+`decay`; inference uses running stats (reference useBatchMean/global stats
+semantics).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ... import activations
+from ..input_type import ConvolutionalInputType, FeedForwardInputType, InputType
+from .base import LayerConf, register_layer
+
+
+@register_layer("batchnorm")
+@dataclass
+class BatchNormalization(LayerConf):
+    decay: float = 0.9
+    eps: float = 1e-5
+    is_mini_batch: bool = True
+    lock_gamma_beta: bool = False
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+    n_out: int = None  # feature count, inferred
+
+    def set_n_in(self, input_type, override=True):
+        if self.n_out is None or override:
+            if isinstance(input_type, ConvolutionalInputType):
+                self.n_out = input_type.channels
+            elif isinstance(input_type, FeedForwardInputType):
+                self.n_out = input_type.size
+            else:
+                from ..input_type import RecurrentInputType
+                if isinstance(input_type, RecurrentInputType):
+                    self.n_out = input_type.size
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def init_params(self, key, dtype=jnp.float32):
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": jnp.full((self.n_out,), float(self.gamma_init), dtype),
+                "beta": jnp.full((self.n_out,), float(self.beta_init), dtype)}
+
+    def has_state(self):
+        return True
+
+    def init_state(self):
+        return {"mean": jnp.zeros((self.n_out,), jnp.float32),
+                "var": jnp.ones((self.n_out,), jnp.float32)}
+
+    def forward_with_state(self, params, x, state, *, train=False, rng=None,
+                           mask=None):
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
+        if train:
+            # compute stats in >= f32 (stability under bf16 compute)
+            xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        mean = mean.astype(x.dtype)
+        var = var.astype(x.dtype)
+        xn = (x - mean) / jnp.sqrt(var + self.eps)
+        if not self.lock_gamma_beta and params:
+            xn = xn * params["gamma"] + params["beta"]
+        return activations.get(self.activation or "identity")(xn), new_state
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
+        out, _ = self.forward_with_state(params, x, state or self.init_state(),
+                                         train=train, rng=rng, mask=mask)
+        return out
+
+
+@register_layer("lrn")
+@dataclass
+class LocalResponseNormalization(LayerConf):
+    """Across-channel LRN (AlexNet-style).
+    reference: nn/layers/normalization/LocalResponseNormalization.java —
+    out = x / (k + alpha * sum_{j in window} x_j^2)^beta over channel axis."""
+    k: float = 2.0
+    n: float = 5.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
+        half = int(self.n) // 2
+        sq = x * x
+        c = x.shape[-1]
+        # pad channel axis, windowed sum via static slicing (unrolled — n is
+        # tiny and static, XLA fuses this into one kernel)
+        pad_width = [(0, 0)] * (x.ndim - 1) + [(half, half)]
+        padded = jnp.pad(sq, pad_width)
+        acc = sum(padded[..., i:i + c] for i in range(int(self.n)))
+        denom = (self.k + self.alpha * acc) ** self.beta
+        return x / denom

@@ -1,0 +1,196 @@
+"""Input preprocessors — shape adapters between layer families.
+
+TPU-native equivalent of reference nn/conf/preprocessor/
+(CnnToFeedForwardPreProcessor, FeedForwardToCnnPreProcessor,
+FeedForwardToRnnPreProcessor, RnnToFeedForwardPreProcessor,
+CnnToRnnPreProcessor, RnnToCnnPreProcessor, ReshapePreProcessor,
+ComposableInputPreProcessor).
+
+Only the forward `pre_process` is needed — `backprop` in the reference reverses
+the reshape for the epsilon; jax autodiff handles that automatically.
+
+Layouts (see input_type.py): CNN=NHWC, RNN=[batch, time, size].
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .input_type import InputType
+
+PREPROC_REGISTRY = {}
+
+
+def register_preproc(name):
+    def deco(cls):
+        PREPROC_REGISTRY[name] = cls
+        cls.preproc_type = name
+        return cls
+    return deco
+
+
+class InputPreProcessor:
+    def pre_process(self, x):
+        raise NotImplementedError
+
+    def get_output_type(self, input_type):
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = {"type": self.preproc_type}
+        d.update({k: v for k, v in self.__dict__.items()})
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        typ = d.pop("type")
+        return PREPROC_REGISTRY[typ](**d)
+
+
+@register_preproc("cnn_to_ff")
+@dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[B,H,W,C] -> [B, H*W*C]. reference: CnnToFeedForwardPreProcessor.java"""
+    input_height: int = 0
+    input_width: int = 0
+    num_channels: int = 0
+
+    def pre_process(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(
+            self.input_height * self.input_width * self.num_channels)
+
+
+@register_preproc("ff_to_cnn")
+@dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    """[B, H*W*C] -> [B,H,W,C]. reference: FeedForwardToCnnPreProcessor.java"""
+    input_height: int = 0
+    input_width: int = 0
+    num_channels: int = 0
+
+    def pre_process(self, x):
+        if x.ndim == 4:
+            return x
+        return x.reshape(x.shape[0], self.input_height, self.input_width,
+                         self.num_channels)
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(self.input_height, self.input_width,
+                                       self.num_channels)
+
+
+@register_preproc("ff_to_rnn")
+@dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """Identity on tensors here: dense layers broadcast over time in this
+    framework. Kept for config parity. reference: FeedForwardToRnnPreProcessor.java"""
+
+    def pre_process(self, x):
+        return x
+
+    def get_output_type(self, input_type):
+        from .input_type import FeedForwardInputType
+        if isinstance(input_type, FeedForwardInputType):
+            return InputType.recurrent(input_type.size)
+        return input_type
+
+
+@register_preproc("rnn_to_ff")
+@dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """Identity (time axis broadcasting); config parity only.
+    reference: RnnToFeedForwardPreProcessor.java"""
+
+    def pre_process(self, x):
+        return x
+
+    def get_output_type(self, input_type):
+        from .input_type import RecurrentInputType
+        if isinstance(input_type, RecurrentInputType):
+            return InputType.feed_forward(input_type.size)
+        return input_type
+
+
+@register_preproc("cnn_to_rnn")
+@dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[B*T,H,W,C]-style handling in the reference; here [B,T,H,W,C] -> [B,T,F].
+    reference: CnnToRnnPreProcessor.java"""
+    input_height: int = 0
+    input_width: int = 0
+    num_channels: int = 0
+
+    def pre_process(self, x):
+        return x.reshape(x.shape[0], x.shape[1], -1)
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(
+            self.input_height * self.input_width * self.num_channels)
+
+
+@register_preproc("rnn_to_cnn")
+@dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[B,T,F] -> [B*T,H,W,C]? In this framework: [B,T,H*W*C] -> [B,T,H,W,C]
+    consumed by time-distributed conv. reference: RnnToCnnPreProcessor.java"""
+    input_height: int = 0
+    input_width: int = 0
+    num_channels: int = 0
+
+    def pre_process(self, x):
+        return x.reshape(x.shape[0], x.shape[1], self.input_height,
+                         self.input_width, self.num_channels)
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(self.input_height, self.input_width,
+                                       self.num_channels)
+
+
+@register_preproc("reshape")
+@dataclass
+class ReshapePreProcessor(InputPreProcessor):
+    """reference: ReshapePreProcessor.java"""
+    target_shape: tuple = field(default_factory=tuple)
+
+    def pre_process(self, x):
+        shape = tuple(self.target_shape)
+        return x.reshape((x.shape[0],) + shape)
+
+    def get_output_type(self, input_type):
+        shape = tuple(self.target_shape)
+        if len(shape) == 1:
+            return InputType.feed_forward(shape[0])
+        if len(shape) == 2:
+            return InputType.recurrent(shape[1])
+        if len(shape) == 3:
+            return InputType.convolutional(shape[0], shape[1], shape[2])
+        return input_type
+
+    def to_dict(self):
+        return {"type": "reshape", "target_shape": list(self.target_shape)}
+
+
+@register_preproc("composable")
+class ComposableInputPreProcessor(InputPreProcessor):
+    """reference: ComposableInputPreProcessor.java"""
+
+    def __init__(self, processors=()):
+        self.processors = [p if isinstance(p, InputPreProcessor)
+                           else InputPreProcessor.from_dict(p) for p in processors]
+
+    def pre_process(self, x):
+        for p in self.processors:
+            x = p.pre_process(x)
+        return x
+
+    def get_output_type(self, input_type):
+        for p in self.processors:
+            input_type = p.get_output_type(input_type)
+        return input_type
+
+    def to_dict(self):
+        return {"type": "composable",
+                "processors": [p.to_dict() for p in self.processors]}

@@ -1,0 +1,162 @@
+"""Loss functions.
+
+TPU-native equivalent of ND4J's ``ILossFunction`` family used by the reference's
+output layers (reference: nn/conf/layers/OutputLayer.java + ND4J LossFunctions enum;
+score computation path MultiLayerNetwork.java:1840 -> IOutputLayer.computeScore).
+
+Each loss is a pure function ``loss(labels, preout, activation_fn, mask) -> per_example``
+returning a per-example scalar; containers reduce (mean over examples) and add
+L1/L2 terms, matching the reference's score semantics. Gradients come from jax
+autodiff (the reference hand-codes computeGradient per loss).
+
+Masking: ``mask`` has shape broadcastable to the per-element loss (e.g. [N,1] or
+[N, T] flattened for RNNs) and zeroes out masked elements, matching the
+reference's per-output masking (LossUtil.applyMask).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def _apply_activation(preout, activation_fn):
+    from . import activations
+    return activations.get(activation_fn)(preout)
+
+
+def _reduce_per_example(per_elem, mask):
+    """Sum per-element loss over feature axes -> per-example vector. Apply mask first."""
+    if mask is not None:
+        per_elem = per_elem * mask
+    axes = tuple(range(1, per_elem.ndim))
+    return jnp.sum(per_elem, axis=axes) if axes else per_elem
+
+
+def mcxent(labels, preout, activation_fn="softmax", mask=None):
+    """Multi-class cross entropy / negative log likelihood.
+
+    When activation is softmax, uses the numerically-stable log_softmax form
+    (the reference special-cases softmax the same way in LossMCXENT).
+    """
+    act = str(activation_fn).lower() if not callable(activation_fn) else ""
+    if act == "softmax":
+        logp = jax.nn.log_softmax(preout, axis=-1)
+        per_elem = -labels * logp
+    else:
+        out = _apply_activation(preout, activation_fn)
+        per_elem = -labels * jnp.log(jnp.clip(out, _EPS, 1.0 - _EPS))
+    return _reduce_per_example(per_elem, mask)
+
+
+negativeloglikelihood = mcxent
+
+
+def xent(labels, preout, activation_fn="sigmoid", mask=None):
+    """Binary cross entropy (elementwise)."""
+    act = str(activation_fn).lower() if not callable(activation_fn) else ""
+    if act == "sigmoid":
+        # stable: max(z,0) - z*y + log(1+exp(-|z|))
+        z = preout
+        per_elem = jnp.maximum(z, 0) - z * labels + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    else:
+        out = jnp.clip(_apply_activation(preout, activation_fn), _EPS, 1.0 - _EPS)
+        per_elem = -(labels * jnp.log(out) + (1.0 - labels) * jnp.log(1.0 - out))
+    return _reduce_per_example(per_elem, mask)
+
+
+def mse(labels, preout, activation_fn="identity", mask=None):
+    out = _apply_activation(preout, activation_fn)
+    d = out - labels
+    per_elem = d * d
+    # Reference LossMSE divides by nOut (column-mean) — keep sum over features
+    # divided by feature count for parity with DL4J score values.
+    n_out = labels.shape[-1]
+    return _reduce_per_example(per_elem, mask) / n_out
+
+
+def l2(labels, preout, activation_fn="identity", mask=None):
+    out = _apply_activation(preout, activation_fn)
+    d = out - labels
+    return _reduce_per_example(d * d, mask)
+
+
+def mae(labels, preout, activation_fn="identity", mask=None):
+    out = _apply_activation(preout, activation_fn)
+    per_elem = jnp.abs(out - labels)
+    n_out = labels.shape[-1]
+    return _reduce_per_example(per_elem, mask) / n_out
+
+
+def l1(labels, preout, activation_fn="identity", mask=None):
+    out = _apply_activation(preout, activation_fn)
+    return _reduce_per_example(jnp.abs(out - labels), mask)
+
+
+def hinge(labels, preout, activation_fn="identity", mask=None):
+    """Hinge loss; labels in {-1, +1} (or {0,1} converted by caller)."""
+    out = _apply_activation(preout, activation_fn)
+    per_elem = jnp.maximum(0.0, 1.0 - labels * out)
+    return _reduce_per_example(per_elem, mask)
+
+
+def squared_hinge(labels, preout, activation_fn="identity", mask=None):
+    out = _apply_activation(preout, activation_fn)
+    per_elem = jnp.maximum(0.0, 1.0 - labels * out) ** 2
+    return _reduce_per_example(per_elem, mask)
+
+
+def kl_divergence(labels, preout, activation_fn="softmax", mask=None):
+    out = jnp.clip(_apply_activation(preout, activation_fn), _EPS, 1.0)
+    lab = jnp.clip(labels, _EPS, 1.0)
+    per_elem = labels * (jnp.log(lab) - jnp.log(out))
+    return _reduce_per_example(per_elem, mask)
+
+
+def poisson(labels, preout, activation_fn="identity", mask=None):
+    out = _apply_activation(preout, activation_fn)
+    per_elem = out - labels * jnp.log(jnp.clip(out, _EPS, None))
+    return _reduce_per_example(per_elem, mask)
+
+
+def cosine_proximity(labels, preout, activation_fn="identity", mask=None):
+    out = _apply_activation(preout, activation_fn)
+    if mask is not None:
+        out = out * mask
+        labels = labels * mask
+    num = jnp.sum(labels * out, axis=-1)
+    den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1) + _EPS
+    sim = num / den
+    r = -sim
+    axes = tuple(range(1, r.ndim))
+    return jnp.sum(r, axis=axes) if axes else r
+
+
+LOSSES = {
+    "mcxent": mcxent,
+    "negativeloglikelihood": mcxent,
+    "xent": xent,
+    "mse": mse,
+    "l2": l2,
+    "mae": mae,
+    "l1": l1,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "squaredhinge": squared_hinge,
+    "kl_divergence": kl_divergence,
+    "kld": kl_divergence,
+    "reconstruction_crossentropy": xent,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "cosineproximity": cosine_proximity,
+}
+
+
+def get(name):
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in LOSSES:
+        raise ValueError(f"Unknown loss '{name}'. Known: {sorted(LOSSES)}")
+    return LOSSES[key]

@@ -1,0 +1,568 @@
+"""MultiLayerNetwork — the sequential network container.
+
+TPU-native equivalent of reference nn/multilayer/MultiLayerNetwork.java (2,486
+LoC): init (:398-465 flattened params), fit(DataSetIterator) (:978), backprop
+(:1064), output/feedForward (:1521/:657), computeGradientAndScore (:1807),
+evaluate (:1574), TBPTT (:1140).
+
+TPU-first redesign (SURVEY.md §7.1.3): instead of the reference's op-by-op
+execution (per-layer activate/backpropGradient + separate updater ops +
+in-place stepFunction on a flattened params vector), the ENTIRE training step
+
+    (params, updater_state, model_state, batch) ->
+        (params', updater_state', model_state', score)
+
+is ONE donated, jit-compiled XLA program: forward + loss + autodiff backward +
+updater math + parameter update fuse together; XLA schedules matmuls on the
+MXU and fuses elementwise chains. The reference's flattened-params contract is
+preserved at the API level (params()/set_params() expose a single flat vector
+in layer order) but device-side storage is the natural per-layer pytree, which
+is what lets XLA donate and alias buffers.
+
+Solver semantics: OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT with
+numIterations repeats per minibatch, matching
+optimize/solvers/StochasticGradientDescent.java:51-72. (LBFGS/CG/line-search
+variants live in optimize/solvers.py.)
+"""
+from __future__ import annotations
+
+import functools
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..datasets.dataset import DataSet
+from ..datasets.iterators import AsyncDataSetIterator, DataSetIterator, ListDataSetIterator
+from .conf.neural_net_configuration import MultiLayerConfiguration
+from .updater import updaters as U
+
+log = logging.getLogger(__name__)
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = conf.layers
+        g = conf.global_conf
+        dt = str(g.get("data_type", "float32"))
+        self.compute_dtype = {"bfloat16": jnp.bfloat16,
+                              "float64": jnp.float64}.get(dt, jnp.float32)
+        # param storage dtype: float32 unless float64 requested (gradient
+        # checks force double, like the reference's GradientCheckUtil)
+        self.param_dtype = jnp.float64 if dt == "float64" else jnp.float32
+        self._params = None          # list[dict[str, Array]] per layer
+        self._updater_state = None   # list[dict[var, state-dict]]
+        self._model_state = None     # list[dict] (e.g. BN running stats)
+        self._rng = jax.random.PRNGKey(int(g.get("seed", 123)))
+        self.listeners = []
+        self._score = None
+        self._last_batch_size = 0
+        self._jit_step = None
+        self._jit_forward = {}
+        self._rnn_state = None       # per-layer carried state for rnnTimeStep
+
+    # ------------------------------------------------------------------
+    # Init — reference MultiLayerNetwork.init():398-465
+    # ------------------------------------------------------------------
+    def init(self, parameters=None, clone_parameters=False):
+        if self._params is None:
+            keys = jax.random.split(self._rng, len(self.layers) + 1)
+            self._rng = keys[0]
+            self._params = [layer.init_params(keys[i + 1], self.param_dtype)
+                            for i, layer in enumerate(self.layers)]
+            self._model_state = [layer.init_state() for layer in self.layers]
+            self._init_updater_state()
+        if parameters is not None:
+            self.set_params(parameters)
+        return self
+
+    def _init_updater_state(self):
+        self._updater_state = []
+        for layer, p in zip(self.layers, self._params):
+            init_fn, _ = U.get(layer.updater or "sgd")
+            self._updater_state.append({k: init_fn(v) for k, v in p.items()})
+
+    def _ensure_init(self):
+        if self._params is None:
+            self.init()
+
+    # ------------------------------------------------------------------
+    # Forward — reference feedForwardToLayer(:694) / output(:1521)
+    # ------------------------------------------------------------------
+    def _apply_layers(self, params, state, x, *, train, rng, fmask=None,
+                      upto=None, carries=None):
+        """Pure forward through layers [0, upto).
+        Returns (activations, state', carries')."""
+        from .conf.layers.recurrent import BaseRecurrentLayer
+        n = len(self.layers) if upto is None else upto
+        acts = []
+        new_state = list(state)
+        new_carries = list(carries) if carries is not None else None
+        cdt = self.compute_dtype
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(cdt)
+        for i in range(n):
+            layer = self.layers[i]
+            if i in self.conf.preprocessors:
+                x = self.conf.preprocessors[i].pre_process(x)
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            p = jax.tree.map(lambda a: a.astype(cdt)
+                             if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                             params[i])
+            if isinstance(layer, BaseRecurrentLayer) and carries is not None:
+                x, c = layer.forward_with_carry(p, x, carries[i], train=train,
+                                                rng=lrng, mask=fmask)
+                new_carries[i] = c
+            elif layer.has_state():
+                x, st = layer.forward_with_state(p, x, state[i], train=train,
+                                                 rng=lrng, mask=fmask)
+                new_state[i] = st
+            else:
+                x = layer.forward(p, x, train=train, rng=lrng, mask=fmask)
+            acts.append(x)
+        return acts, new_state, new_carries
+
+    def _output_layer_input(self, params, state, x, *, train, rng, fmask=None,
+                            carries=None):
+        acts, new_state, new_carries = self._apply_layers(
+            params, state, x, train=train, rng=rng, fmask=fmask,
+            upto=len(self.layers) - 1, carries=carries)
+        h = acts[-1] if acts else x
+        i = len(self.layers) - 1
+        if i in self.conf.preprocessors:
+            h = self.conf.preprocessors[i].pre_process(h)
+        return h, new_state, new_carries
+
+    def _loss_fn(self, params, state, features, labels, fmask, lmask, rng,
+                 train, carries=None):
+        h, new_state, new_carries = self._output_layer_input(
+            params, state, features, train=train, rng=rng, fmask=fmask,
+            carries=carries)
+        out_layer = self.layers[-1]
+        i = len(self.layers) - 1
+        lrng = jax.random.fold_in(rng, i) if rng is not None else None
+        p_out = jax.tree.map(lambda a: a.astype(self.compute_dtype)
+                             if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                             params[i])
+        per_ex = out_layer.compute_score_per_example(
+            p_out, h, labels, train=train, rng=lrng, mask=lmask)
+        if per_ex.dtype == jnp.bfloat16:
+            per_ex = per_ex.astype(jnp.float32)
+        score = jnp.mean(per_ex)
+        reg = 0.0
+        for layer, p in zip(self.layers, params):
+            reg = reg + layer.reg_score(p)
+        score = score + reg
+        return score, (new_state, new_carries)
+
+    # ------------------------------------------------------------------
+    # The fused train step (jitted, donated)
+    # ------------------------------------------------------------------
+    def make_raw_step(self):
+        """The un-jitted training step over a batch dict — the compilation
+        unit shared by the single-chip path, ParallelWrapper's sharded paths,
+        and TrainingMaster. batch keys: features, labels, fmask, lmask,
+        iteration, rng, carries (optional)."""
+        layers = self.layers
+
+        def step(params, ustate, state, batch):
+            carries = batch.get("carries")
+            (score, (new_state, new_carries)), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(
+                    params, state, batch["features"], batch["labels"],
+                    batch.get("fmask"), batch.get("lmask"), batch["rng"],
+                    True, carries)
+            iteration = batch["iteration"]
+            new_params = []
+            new_ustate = []
+            minimize = self.conf.global_conf.get("minimize", True)
+            for i, layer in enumerate(layers):
+                g_i = grads[i]
+                g_i = U.normalize_gradients(
+                    g_i, layer.gradient_normalization,
+                    layer.gradient_normalization_threshold or 1.0)
+                _, apply_fn = U.get(layer.updater or "sgd")
+                hp = layer.updater_hp()
+                p_new, s_new = {}, {}
+                for k, p in params[i].items():
+                    base_lr = layer.learning_rate or 0.1
+                    if k in ("b", "beta") and layer.bias_learning_rate is not None:
+                        base_lr = layer.bias_learning_rate
+                    lr = U.schedule_lr(
+                        base_lr, layer.lr_policy or "none", iteration,
+                        decay_rate=layer.lr_policy_decay_rate or 0.0,
+                        steps=layer.lr_policy_steps or 1.0,
+                        power=layer.lr_policy_power or 1.0,
+                        schedule_map=layer.lr_schedule,
+                    )
+                    upd, s_k = apply_fn(ustate[i][k], g_i[k], lr, hp)
+                    p_new[k] = p - upd if minimize else p + upd
+                    s_new[k] = s_k
+                new_params.append(p_new)
+                new_ustate.append(s_new)
+            return new_params, new_ustate, new_state, score, new_carries
+
+        return step
+
+    def _make_step(self):
+        raw = self.make_raw_step()
+
+        def step(params, ustate, state, iteration, features, labels, fmask,
+                 lmask, rng, carries=None):
+            batch = {"features": features, "labels": labels, "fmask": fmask,
+                     "lmask": lmask, "iteration": iteration, "rng": rng,
+                     "carries": carries}
+            return raw(params, ustate, state, batch)
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    # fit — reference MultiLayerNetwork.fit(:978)
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None, features_mask=None, labels_mask=None,
+            num_epochs=1):
+        self._ensure_init()
+        if labels is not None:
+            data = DataSet(data, labels, features_mask, labels_mask)
+        if isinstance(data, DataSet):
+            it = ListDataSetIterator([data])
+            return self._fit_iterator(it, num_epochs)
+        if isinstance(data, DataSetIterator):
+            return self._fit_iterator(data, num_epochs)
+        raise TypeError(f"Cannot fit on {type(data)}")
+
+    def _fit_iterator(self, it, num_epochs=1):
+        async_it = (it if isinstance(it, AsyncDataSetIterator)
+                    else AsyncDataSetIterator(it, queue_size=2))
+        if self._jit_step is None:
+            self._jit_step = self._make_step()
+        for epoch in range(num_epochs):
+            if epoch > 0 or not async_it.has_next():
+                async_it.reset()
+            for l in self.listeners:
+                if hasattr(l, "on_epoch_start"):
+                    l.on_epoch_start(self)
+            while async_it.has_next():
+                ds = async_it.next_batch()
+                self._fit_batch(ds)
+            for l in self.listeners:
+                if hasattr(l, "on_epoch_end"):
+                    l.on_epoch_end(self)
+            self.conf.epoch_count += 1
+        return self
+
+    def _fit_batch(self, ds: DataSet):
+        if self.conf.backprop_type == "tbptt":
+            return self._fit_tbptt(ds)
+        num_iterations = int(self.conf.global_conf.get("num_iterations", 1))
+        features = jnp.asarray(ds.features)
+        labels = jnp.asarray(ds.labels)
+        fmask = jnp.asarray(ds.features_mask) if ds.features_mask is not None else None
+        lmask = jnp.asarray(ds.labels_mask) if ds.labels_mask is not None else None
+        self._last_batch_size = int(features.shape[0])
+        for _ in range(num_iterations):
+            self._rng, step_rng = jax.random.split(self._rng)
+            it_count = jnp.asarray(self.conf.iteration_count, jnp.float32)
+            (self._params, self._updater_state, self._model_state,
+             score, _) = self._jit_step(self._params, self._updater_state,
+                                        self._model_state, it_count, features,
+                                        labels, fmask, lmask, step_rng)
+            self._score = score
+            self.conf.iteration_count += 1
+            for l in self.listeners:
+                l.iteration_done(self, self.conf.iteration_count - 1)
+        return self
+
+    def _init_carries(self, batch_size):
+        from .conf.layers.recurrent import BaseRecurrentLayer
+        return [layer.init_carry(batch_size, self.param_dtype)
+                if isinstance(layer, BaseRecurrentLayer) else {}
+                for layer in self.layers]
+
+    def _fit_tbptt(self, ds: DataSet):
+        """Truncated BPTT: slice the time axis into tbptt_fwd_length segments,
+        carrying RNN cell state (but not gradients) across segments.
+        reference: MultiLayerNetwork.doTruncatedBPTT:1140 +
+        updateRnnStateWithTBPTTState:1196."""
+        T = ds.features.shape[1]
+        L = self.conf.tbptt_fwd_length
+        if self._jit_step is None:
+            self._jit_step = self._make_step()
+        B = int(ds.features.shape[0])
+        carries = self._init_carries(B)
+        features = jnp.asarray(ds.features)
+        labels = jnp.asarray(ds.labels)
+        fmask = jnp.asarray(ds.features_mask) if ds.features_mask is not None else None
+        lmask = jnp.asarray(ds.labels_mask) if ds.labels_mask is not None else None
+        self._last_batch_size = B
+        seq_labels = labels.ndim >= 3
+        for t0 in range(0, T, L):
+            f_seg = features[:, t0:t0 + L]
+            l_seg = labels[:, t0:t0 + L] if seq_labels else labels
+            fm_seg = fmask[:, t0:t0 + L] if fmask is not None else None
+            lm_seg = lmask[:, t0:t0 + L] if lmask is not None else None
+            self._rng, step_rng = jax.random.split(self._rng)
+            it_count = jnp.asarray(self.conf.iteration_count, jnp.float32)
+            (self._params, self._updater_state, self._model_state, score,
+             carries) = self._jit_step(self._params, self._updater_state,
+                                       self._model_state, it_count, f_seg,
+                                       l_seg, fm_seg, lm_seg, step_rng,
+                                       carries)
+            # stop gradient flow across segments (truncation) — carries are
+            # fresh inputs to the next jitted call, so this is automatic.
+            self._score = score
+            self.conf.iteration_count += 1
+            for l in self.listeners:
+                l.iteration_done(self, self.conf.iteration_count - 1)
+        return self
+
+    # ------------------------------------------------------------------
+    # Inference — reference output(:1521)/feedForward(:657)
+    # ------------------------------------------------------------------
+    def output(self, x, train=False):
+        self._ensure_init()
+        x = jnp.asarray(x)
+        key = ("output", bool(train))
+        if key not in self._jit_forward:
+            def fwd(params, state, x, rng):
+                h, _, _ = self._output_layer_input(params, state, x,
+                                                   train=train, rng=rng)
+                out_layer = self.layers[-1]
+                i = len(self.layers) - 1
+                p = jax.tree.map(lambda a: a.astype(self.compute_dtype)
+                                 if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                                 params[i])
+                return out_layer.forward(p, h, train=train,
+                                         rng=jax.random.fold_in(rng, i))
+            self._jit_forward[key] = jax.jit(fwd)
+        self._rng, rng = jax.random.split(self._rng)
+        return self._jit_forward[key](self._params, self._model_state, x, rng)
+
+    def feed_forward(self, x, train=False):
+        """Returns list of activations per layer, input first (reference :657)."""
+        self._ensure_init()
+        x = jnp.asarray(x)
+        self._rng, rng = jax.random.split(self._rng)
+        acts, _, _ = self._apply_layers(self._params, self._model_state, x,
+                                        train=train, rng=rng)
+        return [x] + acts
+
+    feedForward = feed_forward
+
+    # ------------------------------------------------------------------
+    # Streaming RNN inference — reference rnnTimeStep(:2196): O(1) per step,
+    # hidden state stashed per layer across calls.
+    # ------------------------------------------------------------------
+    def rnn_time_step(self, x):
+        """x: [B, F] single step or [B, T, F] multi-step. Returns output with
+        the same time rank; recurrent layer state carries across calls."""
+        self._ensure_init()
+        x = jnp.asarray(x)
+        single = x.ndim == 2
+        if single:
+            x = x[:, None, :]
+        B = int(x.shape[0])
+        if self._rnn_state is None:
+            self._rnn_state = self._init_carries(B)
+        if "rnn_step" not in self._jit_forward:
+            def fwd(params, state, x, rng, carries):
+                h, _, new_carries = self._output_layer_input(
+                    params, state, x, train=False, rng=rng, carries=carries)
+                out_layer = self.layers[-1]
+                i = len(self.layers) - 1
+                p = jax.tree.map(lambda a: a.astype(self.compute_dtype)
+                                 if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                                 params[i])
+                out = out_layer.forward(p, h, train=False,
+                                        rng=jax.random.fold_in(rng, i))
+                return out, new_carries
+            self._jit_forward["rnn_step"] = jax.jit(fwd)
+        self._rng, rng = jax.random.split(self._rng)
+        out, self._rnn_state = self._jit_forward["rnn_step"](
+            self._params, self._model_state, x, rng, self._rnn_state)
+        return out[:, 0] if single else out
+
+    rnnTimeStep = rnn_time_step
+
+    def rnn_clear_previous_state(self):
+        """reference: MultiLayerNetwork.rnnClearPreviousState"""
+        self._rnn_state = None
+
+    rnnClearPreviousState = rnn_clear_previous_state
+
+    def predict(self, x):
+        out = self.output(x)
+        return np.asarray(jnp.argmax(out, axis=-1))
+
+    # ------------------------------------------------------------------
+    # Score / gradients — reference computeGradientAndScore(:1807)
+    # ------------------------------------------------------------------
+    def score(self, data=None, training=False):
+        if data is None:
+            return float(self._score) if self._score is not None else float("nan")
+        self._ensure_init()
+        if isinstance(data, tuple):
+            data = DataSet(*data)
+        self._rng, rng = jax.random.split(self._rng)
+        s, _ = self._loss_fn(self._params, self._model_state,
+                             jnp.asarray(data.features), jnp.asarray(data.labels),
+                             jnp.asarray(data.features_mask) if data.features_mask is not None else None,
+                             jnp.asarray(data.labels_mask) if data.labels_mask is not None else None,
+                             rng, training)
+        return float(s)
+
+    def compute_gradient_and_score(self, features, labels, fmask=None, lmask=None,
+                                   train=True):
+        """Returns (grads pytree, score). Deterministic rng for gradient checks."""
+        self._ensure_init()
+        rng = jax.random.PRNGKey(0)
+        (score, _), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
+            self._params, self._model_state, jnp.asarray(features),
+            jnp.asarray(labels),
+            jnp.asarray(fmask) if fmask is not None else None,
+            jnp.asarray(lmask) if lmask is not None else None, rng, train)
+        return grads, float(score)
+
+    # ------------------------------------------------------------------
+    # Flattened-params API parity — reference init:398-465 contract
+    # ------------------------------------------------------------------
+    def _param_leaves(self):
+        leaves = []
+        for i, p in enumerate(self._params):
+            for k in sorted(p.keys(), key=_param_sort_key):
+                leaves.append(((i, k), p[k]))
+        return leaves
+
+    def params(self):
+        self._ensure_init()
+        vecs = [np.asarray(v).ravel() for _, v in self._param_leaves()]
+        if not vecs:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(vecs)
+
+    def set_params(self, flat):
+        self._ensure_init()
+        flat = np.asarray(flat).ravel()
+        offset = 0
+        new_params = [dict(p) for p in self._params]
+        for (i, k), v in self._param_leaves():
+            n = int(np.prod(v.shape)) if v.shape else 1
+            chunk = flat[offset:offset + n].reshape(v.shape)
+            new_params[i][k] = jnp.asarray(chunk, v.dtype)
+            offset += n
+        if offset != flat.size:
+            raise ValueError(f"Expected {offset} params, got {flat.size}")
+        self._params = new_params
+
+    setParams = set_params
+
+    def num_params(self):
+        return int(sum(int(np.prod(v.shape)) for _, v in self._param_leaves()))
+
+    numParams = num_params
+
+    def unflatten_params(self, flat):
+        """flat vector -> per-layer param pytree (jit-traceable)."""
+        offset = 0
+        out = []
+        for i, p in enumerate(self._params):
+            d = {}
+            for k in sorted(p.keys(), key=_param_sort_key):
+                v = p[k]
+                n = int(np.prod(v.shape)) if v.shape else 1
+                d[k] = flat[offset:offset + n].reshape(v.shape).astype(v.dtype)
+                offset += n
+            out.append(d)
+        return out
+
+    def make_flat_score_fn(self, features, labels, fmask=None, lmask=None,
+                           train=True):
+        """Jitted score(flat_params) -> scalar, for gradient checking."""
+        features = jnp.asarray(features)
+        labels = jnp.asarray(labels)
+        fmask = jnp.asarray(fmask) if fmask is not None else None
+        lmask = jnp.asarray(lmask) if lmask is not None else None
+        rng = jax.random.PRNGKey(0)
+
+        def score_fn(flat):
+            params = self.unflatten_params(flat)
+            s, _ = self._loss_fn(params, self._model_state, features, labels,
+                                 fmask, lmask, rng, train)
+            return s
+
+        return jax.jit(score_fn)
+
+    def flatten_gradients(self, grads):
+        vecs = []
+        for i, p in enumerate(grads):
+            for k in sorted(p.keys(), key=_param_sort_key):
+                vecs.append(np.asarray(p[k], np.float64).ravel())
+        return np.concatenate(vecs) if vecs else np.zeros((0,))
+
+    # ------------------------------------------------------------------
+    # Evaluation — reference evaluate(:1574)
+    # ------------------------------------------------------------------
+    def evaluate(self, data):
+        from ..eval.evaluation import Evaluation
+        ev = Evaluation()
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator([data])
+        for ds in data:
+            out = self.output(ds.features)
+            ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
+        return ev
+
+    def evaluate_regression(self, data):
+        from ..eval.regression import RegressionEvaluation
+        ev = None
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator([data])
+        for ds in data:
+            out = self.output(ds.features)
+            if ev is None:
+                ev = RegressionEvaluation(int(ds.labels.shape[-1]))
+            ev.eval(ds.labels, np.asarray(out))
+        return ev
+
+    # ------------------------------------------------------------------
+    # Listeners — reference setListeners
+    # ------------------------------------------------------------------
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    setListeners = set_listeners
+
+    def add_listener(self, listener):
+        self.listeners.append(listener)
+        return self
+
+    # ------------------------------------------------------------------
+    # Cloning / serde helpers
+    # ------------------------------------------------------------------
+    def clone(self):
+        net = MultiLayerNetwork(self.conf.clone())
+        if self._params is not None:
+            net.init()
+            net._params = jax.tree.map(lambda a: a, self._params)
+            net._updater_state = jax.tree.map(lambda a: a, self._updater_state)
+            net._model_state = jax.tree.map(lambda a: a, self._model_state)
+        return net
+
+    def get_layer(self, i):
+        return self.layers[i]
+
+    @property
+    def n_layers(self):
+        return len(self.layers)
+
+
+def _param_sort_key(k):
+    # canonical variable order: W-like first, then recurrent, then biases —
+    # mirrors the reference's per-layer param layout (DefaultParamInitializer:
+    # weights then bias).
+    order = {"W": 0, "RW": 1, "b": 2, "gamma": 0, "beta": 1, "mean": 2, "var": 3,
+             "vb": 3}
+    return (order.get(k, 9), k)

@@ -1,0 +1,108 @@
+"""Weight initialization.
+
+TPU-native equivalent of the reference's WeightInit enum + WeightInitUtil
+(reference: nn/weights/WeightInit.java:28-38, nn/weights/WeightInitUtil.java).
+
+Initializers are pure functions of a jax PRNG key — functional RNG replaces the
+reference's global Nd4j RNG so init is reproducible and parallelizable.
+fan_in/fan_out follow the reference's conventions (for conv: fan_in =
+channels_in * kernel_h * kernel_w).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+VALID = (
+    "zero", "ones", "uniform", "xavier", "xavier_uniform", "xavier_fan_in",
+    "xavier_legacy", "relu", "relu_uniform", "sigmoid_uniform", "lecun_normal",
+    "lecun_uniform", "normal", "distribution", "var_scaling_normal_fan_in",
+    "identity",
+)
+
+
+def init(key, shape, fan_in, fan_out, scheme="xavier", distribution=None, dtype=jnp.float32):
+    """Create a weight array per the named scheme.
+
+    reference: WeightInitUtil.initWeights — same formulas.
+    """
+    scheme = str(scheme).lower()
+    shape = tuple(int(s) for s in shape)
+    fan_in = max(float(fan_in), 1.0)
+    fan_out = max(float(fan_out), 1.0)
+
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ones":
+        return jnp.ones(shape, dtype)
+    if scheme == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("identity init requires square 2-D shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == "uniform":
+        # reference: U(-a, a), a = 1/sqrt(fanIn)
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "xavier":
+        # reference: N(0, 2/(fanIn+fanOut))
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "xavier_uniform":
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "xavier_fan_in":
+        std = math.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "xavier_legacy":
+        std = math.sqrt(1.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme in ("relu", "he_normal"):
+        std = math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme in ("relu_uniform", "he_uniform"):
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "sigmoid_uniform":
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "lecun_normal":
+        std = math.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "lecun_uniform":
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "var_scaling_normal_fan_in":
+        std = math.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "normal":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if scheme == "distribution":
+        if distribution is None:
+            raise ValueError("WeightInit 'distribution' requires a distribution spec")
+        return _from_distribution(key, shape, distribution, dtype)
+    raise ValueError(f"Unknown weight init '{scheme}'. Known: {VALID}")
+
+
+def _from_distribution(key, shape, dist, dtype):
+    """dist: dict like {"type": "normal", "mean": 0, "std": 0.01} or
+    {"type": "uniform", "lower": -a, "upper": a} — mirrors the reference's
+    nn/conf/distribution/ classes (NormalDistribution, UniformDistribution,
+    BinomialDistribution)."""
+    kind = str(dist.get("type", "normal")).lower()
+    if kind in ("normal", "gaussian"):
+        mean = float(dist.get("mean", 0.0))
+        std = float(dist.get("std", 1.0))
+        return mean + std * jax.random.normal(key, shape, dtype)
+    if kind == "uniform":
+        lo = float(dist.get("lower", -1.0))
+        hi = float(dist.get("upper", 1.0))
+        return jax.random.uniform(key, shape, dtype, lo, hi)
+    if kind == "binomial":
+        n = int(dist.get("n", 1))
+        p = float(dist.get("p", 0.5))
+        return jnp.sum(
+            jax.random.bernoulli(key, p, (n,) + shape).astype(dtype), axis=0
+        )
+    raise ValueError(f"Unknown distribution type '{kind}'")

@@ -1,0 +1,4 @@
+from .parallel_wrapper import ParallelWrapper
+from .sharding import make_mesh, shard_params
+
+__all__ = ["ParallelWrapper", "make_mesh", "shard_params"]

@@ -1,0 +1,265 @@
+"""ParallelWrapper — multi-chip data-parallel training.
+
+TPU-native equivalent of reference
+deeplearning4j-scaleout-parallelwrapper/.../ParallelWrapper.java:44-797.
+
+The reference spawns T threads each holding a model replica and calls
+`Nd4j.averageAndPropagate` every `averagingFrequency` iterations (:179,:381),
+optionally averaging updater state (:200-212). Here there are NO replicas and
+NO averaging kernel: the SAME jitted training step is partitioned over a
+`jax.sharding.Mesh`:
+
+- averaging_frequency == 1 (recommended): the batch is sharded over the
+  "data" axis, params replicated; XLA GSPMD inserts the gradient all-reduce
+  (psum over ICI) inside the one compiled step. With common starting params
+  this is mathematically the same as per-iteration parameter averaging, minus
+  the replicas and the averaging kernel.
+
+- averaging_frequency k > 1: reference semantics preserved — each device runs
+  k *local* steps on its own data shard (lax.scan inside shard_map), then
+  parameters (and optionally updater state, mirroring :200-212) are averaged
+  via `pmean` over the data axis — ICI doing what averageAndPropagate's
+  CUDA-P2P/host route did.
+
+Builder API mirrors the reference so user code translates 1:1. Tensor
+parallelism (absent in the reference, SURVEY.md §2.5) is available via
+`.tensor_parallel(True)`: big dense/conv weights column-shard over the
+"model" axis (see sharding.py).
+"""
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..datasets.dataset import DataSet
+from ..datasets.iterators import ListDataSetIterator
+from .sharding import make_mesh, shard_params
+
+log = logging.getLogger(__name__)
+
+
+class ParallelWrapper:
+    class Builder:
+        def __init__(self, model):
+            self.model = model
+            self._workers = None
+            self._avg_freq = 1
+            self._prefetch = 2
+            self._avg_updaters = True
+            self._tensor_parallel = False
+            self._mesh = None
+
+        def workers(self, n):
+            self._workers = int(n); return self
+
+        def averaging_frequency(self, k):
+            self._avg_freq = max(1, int(k)); return self
+
+        averagingFrequency = averaging_frequency
+
+        def prefetch_buffer(self, n):
+            self._prefetch = int(n); return self
+
+        prefetchBuffer = prefetch_buffer
+
+        def average_updaters(self, v):
+            self._avg_updaters = bool(v); return self
+
+        averageUpdaters = average_updaters
+
+        def report_score_after_averaging(self, v):
+            return self  # scores always reported
+
+        reportScoreAfterAveraging = report_score_after_averaging
+
+        def tensor_parallel(self, v):
+            self._tensor_parallel = bool(v); return self
+
+        def mesh(self, mesh):
+            self._mesh = mesh; return self
+
+        def build(self):
+            return ParallelWrapper(self.model, self._workers, self._avg_freq,
+                                   self._avg_updaters, self._tensor_parallel,
+                                   self._mesh)
+
+    def __init__(self, model, workers=None, averaging_frequency=1,
+                 average_updaters=True, tensor_parallel=False, mesh=None):
+        self.model = model
+        model._ensure_init()
+        if mesh is None:
+            n = workers or len(jax.devices())
+            n_model = 2 if (tensor_parallel and n % 2 == 0) else 1
+            mesh = make_mesh(n_data=n // n_model, n_model=n_model,
+                             devices=jax.devices()[:n])
+        self.mesh = mesh
+        self.workers = int(mesh.shape["data"])
+        self.averaging_frequency = int(averaging_frequency)
+        self.average_updaters = average_updaters
+        self.tensor_parallel = tensor_parallel
+        self._sharded = False
+        self._jit_step = None
+        self._jit_kstep = None
+
+    # ------------------------------------------------------------------
+    def _ensure_sharded(self):
+        if self._sharded:
+            return
+        net = self.model
+        net._params, self._param_shardings = shard_params(
+            net, self.mesh, self.tensor_parallel)
+        repl = NamedSharding(self.mesh, P())
+        net._updater_state = jax.device_put(net._updater_state, repl)
+        net._model_state = jax.device_put(net._model_state, repl)
+        self._sharded = True
+
+    def _put_batch(self, arr):
+        if arr is None:
+            return None
+        arr = jnp.asarray(arr)
+        spec = [None] * arr.ndim
+        spec[0] = "data"
+        return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
+
+    # ------------------------------------------------------------------
+    def fit(self, data, num_epochs=1):
+        net = self.model
+        self._ensure_sharded()
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator([data])
+        for _ in range(num_epochs):
+            data.reset()
+            if self.averaging_frequency == 1:
+                self._fit_allreduce(data)
+            else:
+                self._fit_local_steps(data)
+        return self
+
+    # -- mode 1: per-step gradient allreduce (GSPMD via shardings) -----
+    def _fit_allreduce(self, it):
+        net = self.model
+        if self._jit_step is None:
+            raw = net.make_raw_step()
+            self._jit_step = jax.jit(raw, donate_argnums=(0, 1, 2))
+        while it.has_next():
+            ds = it.next_batch()
+            net._rng, step_rng = jax.random.split(net._rng)
+            batch = {
+                "features": self._put_batch(ds.features),
+                "labels": self._put_batch(ds.labels),
+                "fmask": self._put_batch(ds.features_mask),
+                "lmask": self._put_batch(ds.labels_mask),
+                "iteration": jnp.asarray(net.conf.iteration_count, jnp.float32),
+                "rng": step_rng,
+            }
+            (net._params, net._updater_state, net._model_state, score,
+             _) = self._jit_step(net._params, net._updater_state,
+                                 net._model_state, batch)
+            net._score = score
+            net._last_batch_size = int(ds.features.shape[0])
+            net.conf.iteration_count += 1
+            for l in net.listeners:
+                l.iteration_done(net, net.conf.iteration_count - 1)
+
+    # -- mode 2: k local steps then parameter averaging ----------------
+    def _fit_local_steps(self, it):
+        k = self.averaging_frequency
+        pending = []
+        while it.has_next():
+            pending.append(it.next_batch())
+            if len(pending) == k:
+                self._run_kstep(pending)
+                pending = []
+        if pending:
+            while len(pending) < k:
+                pending.append(pending[-1])
+            self._run_kstep(pending)
+
+    @staticmethod
+    def _pad_to(arr, b):
+        """Pad a ragged tail batch up to size b by wrapping rows (keeps shapes
+        static for the compiled k-step)."""
+        if arr is None or arr.shape[0] == b:
+            return arr
+        idx = np.resize(np.arange(arr.shape[0]), b)
+        return arr[idx]
+
+    def _build_kstep(self):
+        net = self.model
+        mesh = self.mesh
+        avg_upd = self.average_updaters
+        raw = net.make_raw_step()
+        from jax import shard_map
+
+        def local_steps(params, ustate, state, batches):
+            def body(carry, batch_t):
+                p, u, s = carry
+                p, u, s, score, _ = raw(p, u, s, batch_t)
+                return (p, u, s), score
+            (p, u, s), scores = jax.lax.scan(body, (params, ustate, state),
+                                             batches)
+            # the TPU-native averageAndPropagate: pmean over ICI
+            p = jax.lax.pmean(p, "data")
+            if avg_upd:
+                u = jax.lax.pmean(u, "data")
+            s = jax.lax.pmean(s, "data")
+            score = jax.lax.pmean(jnp.mean(scores), "data")
+            return p, u, s, score
+
+        repl = P()
+
+        def spec_for_batch_leaf(path_key, a):
+            return P(None, "data") if a.ndim >= 2 else P()
+
+        _SHARDED_KEYS = ("features", "labels", "fmask", "lmask")
+
+        def build(batches_tree):
+            pspec = jax.tree.map(lambda _: repl, net._params)
+            uspec = jax.tree.map(lambda _: repl, net._updater_state)
+            sspec = jax.tree.map(lambda _: repl, net._model_state)
+            bspec = {k: (P(None, "data") if k in _SHARDED_KEYS else P())
+                     for k, v in batches_tree.items() if v is not None}
+            fn = shard_map(local_steps, mesh=mesh,
+                           in_specs=(pspec, uspec, sspec, bspec),
+                           out_specs=(pspec, uspec, sspec, repl))
+            return jax.jit(fn, donate_argnums=(0, 1, 2))
+        return build
+
+    def _run_kstep(self, batches):
+        net = self.model
+        k = len(batches)
+        B = max(int(b.features.shape[0]) for b in batches)
+        feats = jnp.asarray(np.stack(
+            [self._pad_to(np.asarray(b.features), B) for b in batches]))
+        labs = jnp.asarray(np.stack(
+            [self._pad_to(np.asarray(b.labels), B) for b in batches]))
+        net._rng, sub = jax.random.split(net._rng)
+        rngs = jax.random.split(sub, k)
+        batches_tree = {
+            "features": feats,   # [k, B, ...]
+            "labels": labs,
+            "iteration": jnp.arange(net.conf.iteration_count,
+                                    net.conf.iteration_count + k,
+                                    dtype=jnp.float32),
+            "rng": rngs,
+        }
+        if batches[0].features_mask is not None:
+            batches_tree["fmask"] = jnp.asarray(np.stack(
+                [self._pad_to(np.asarray(b.features_mask), B) for b in batches]))
+        if batches[0].labels_mask is not None:
+            batches_tree["lmask"] = jnp.asarray(np.stack(
+                [self._pad_to(np.asarray(b.labels_mask), B) for b in batches]))
+        if self._jit_kstep is None:
+            self._jit_kstep = self._build_kstep()(batches_tree)
+        (net._params, net._updater_state, net._model_state,
+         score) = self._jit_kstep(net._params, net._updater_state,
+                                  net._model_state, batches_tree)
+        net._score = score
+        net._last_batch_size = int(feats.shape[1])
+        net.conf.iteration_count += k
+        for l in net.listeners:
+            l.iteration_done(net, net.conf.iteration_count - 1)

@@ -1,0 +1,20 @@
+"""Test configuration.
+
+Tests run on the CPU backend with a virtual 8-device platform so multi-chip
+sharding paths compile+execute without TPU hardware (SURVEY.md §4 implication
+(c): single-process simulation of a pod), mirroring how the reference
+simulates clusters in one JVM (local-mode Spark, embedded Aeron).
+
+x64 is enabled for gradient-check precision (the reference forces double
+precision in GradientCheckUtil).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

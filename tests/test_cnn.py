@@ -1,0 +1,146 @@
+"""CNN layer tests: shapes, LeNet wiring, gradient checks.
+
+Mirrors reference test suites CNNGradientCheckTest / BNGradientCheckTest /
+LRNGradientCheckTests / ConvolutionLayerTest (SURVEY.md §4.1-4.2).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.gradientcheck.gradient_check_util import check_gradients
+from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               GlobalPoolingLayer,
+                                               LocalResponseNormalization,
+                                               OutputLayer, SubsamplingLayer)
+
+
+def small_cnn_conf(extra=None, h=8, w=8, c=2, n_classes=3, data_type="float64"):
+    layers = [
+        ConvolutionLayer(n_out=3, kernel_size=(3, 3), stride=(1, 1),
+                         activation="tanh"),
+    ]
+    if extra:
+        layers.extend(extra)
+    layers.append(OutputLayer(n_out=n_classes, activation="softmax",
+                              loss_function="mcxent"))
+    b = (NeuralNetConfiguration.Builder().seed(12345).data_type(data_type)
+         .learning_rate(0.1).weight_init("xavier").list())
+    for i, l in enumerate(layers):
+        b.layer(i, l)
+    return b.set_input_type(InputType.convolutional(h, w, c)).build()
+
+
+def rand_data(n=6, h=8, w=8, c=2, n_classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (n, h, w, c)).astype(np.float64)
+    y = np.eye(n_classes, dtype=np.float64)[rng.integers(0, n_classes, n)]
+    return x, y
+
+
+class TestShapes:
+    def test_conv_output_shape_valid(self):
+        conf = small_cnn_conf()
+        # conv 3x3 valid: 8->6
+        it = conf.layers[0].get_output_type(InputType.convolutional(8, 8, 2))
+        assert (it.height, it.width, it.channels) == (6, 6, 3)
+
+    def test_conv_same_mode(self):
+        layer = ConvolutionLayer(n_in=2, n_out=4, kernel_size=(3, 3),
+                                 stride=(1, 1), convolution_mode="same")
+        it = layer.get_output_type(InputType.convolutional(8, 8, 2))
+        assert (it.height, it.width, it.channels) == (8, 8, 4)
+
+    def test_subsampling_shape(self):
+        layer = SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2))
+        it = layer.get_output_type(InputType.convolutional(8, 8, 5))
+        assert (it.height, it.width, it.channels) == (4, 4, 5)
+
+    def test_lenet_wiring(self):
+        from deeplearning4j_tpu.models.zoo.lenet import lenet_conf
+        conf = lenet_conf()
+        # conv(5x5): 28->24; pool: 12; conv(5x5): 8; pool: 4 -> 4*4*50=800
+        assert conf.layers[4].n_in == 800
+        assert conf.layers[5].n_in == 500
+
+    def test_lenet_forward(self):
+        from deeplearning4j_tpu.models.zoo.lenet import lenet
+        net = lenet()
+        x = np.random.default_rng(0).random((4, 784)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (4, 10)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-4)
+
+    def test_lenet_param_count(self):
+        from deeplearning4j_tpu.models.zoo.lenet import lenet
+        net = lenet()
+        # conv1 5*5*1*20+20=520; conv2 5*5*20*50+50=25050;
+        # dense 800*500+500=400500; out 500*10+10=5010
+        assert net.num_params() == 520 + 25050 + 400500 + 5010
+
+
+class TestCnnTraining:
+    def test_cnn_fit_reduces_score(self):
+        x, y = rand_data(n=32)
+        conf = small_cnn_conf(
+            extra=[SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2))],
+            data_type="float32")
+        net = MultiLayerNetwork(conf).init()
+        ds = DataSet(x.astype(np.float32), y.astype(np.float32))
+        s0 = net.score(ds)
+        for _ in range(30):
+            net.fit(ds)
+        assert net.score(ds) < s0 * 0.7
+
+
+class TestCnnGradients:
+    def test_gradcheck_conv(self):
+        x, y = rand_data()
+        net = MultiLayerNetwork(small_cnn_conf()).init()
+        assert check_gradients(net, x, y, max_rel_error=1e-4, subset=60)
+
+    def test_gradcheck_conv_pool(self):
+        x, y = rand_data()
+        for pool in ("max", "avg", "sum"):
+            conf = small_cnn_conf(
+                extra=[SubsamplingLayer(pooling_type=pool, kernel_size=(2, 2),
+                                        stride=(2, 2))])
+            net = MultiLayerNetwork(conf).init()
+            assert check_gradients(net, x, y, max_rel_error=1e-4, subset=50), pool
+
+    def test_gradcheck_conv_bn(self):
+        x, y = rand_data()
+        conf = small_cnn_conf(extra=[BatchNormalization()])
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, x, y, max_rel_error=1e-4, subset=50)
+
+    def test_gradcheck_conv_lrn(self):
+        x, y = rand_data()
+        conf = small_cnn_conf(extra=[LocalResponseNormalization()])
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, x, y, max_rel_error=1e-4, subset=50)
+
+    def test_gradcheck_global_pooling(self):
+        x, y = rand_data()
+        conf = small_cnn_conf(extra=[GlobalPoolingLayer(pooling_type="avg")])
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, x, y, max_rel_error=1e-4, subset=40)
+
+
+class TestBatchNormSemantics:
+    def test_running_stats_update_and_inference(self):
+        conf = small_cnn_conf(extra=[BatchNormalization(decay=0.5)],
+                              data_type="float32")
+        net = MultiLayerNetwork(conf).init()
+        x, y = rand_data(n=16)
+        ds = DataSet(x.astype(np.float32), y.astype(np.float32))
+        st0 = np.asarray(net._model_state[1]["mean"]).copy()
+        net.fit(ds)
+        st1 = np.asarray(net._model_state[1]["mean"])
+        assert not np.allclose(st0, st1), "BN running mean should update in training"
+        # inference twice -> deterministic (uses running stats, not batch stats)
+        o1 = np.asarray(net.output(x.astype(np.float32)))
+        o2 = np.asarray(net.output(x.astype(np.float32)))
+        np.testing.assert_allclose(o1, o2, rtol=1e-6)

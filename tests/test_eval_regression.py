@@ -1,0 +1,32 @@
+"""RegressionEvaluation tests (reference: eval/RegressionEvaluation tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+
+
+class TestRegressionEvaluation:
+    def test_perfect_prediction(self):
+        ev = RegressionEvaluation(2)
+        y = np.array([[1.0, 2.0], [3.0, 4.0]])
+        ev.eval(y, y)
+        assert ev.mean_squared_error(0) == 0.0
+        assert ev.correlation_r2(1) == pytest.approx(1.0)
+
+    def test_mse_mae(self):
+        ev = RegressionEvaluation(1)
+        ev.eval(np.array([[0.0], [2.0]]), np.array([[1.0], [1.0]]))
+        assert ev.mean_squared_error(0) == pytest.approx(1.0)
+        assert ev.mean_absolute_error(0) == pytest.approx(1.0)
+        assert ev.root_mean_squared_error(0) == pytest.approx(1.0)
+
+    def test_merge_equals_joint(self):
+        rng = np.random.default_rng(0)
+        y = rng.normal(size=(20, 3)); p = y + rng.normal(0, 0.1, (20, 3))
+        joint = RegressionEvaluation(3).eval(y, p)
+        a = RegressionEvaluation(3).eval(y[:10], p[:10])
+        b = RegressionEvaluation(3).eval(y[10:], p[10:])
+        a.merge(b)
+        for c in range(3):
+            assert a.mean_squared_error(c) == pytest.approx(joint.mean_squared_error(c))
+            assert a.correlation_r2(c) == pytest.approx(joint.correlation_r2(c))

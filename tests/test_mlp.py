@@ -1,0 +1,181 @@
+"""End-to-end tests for the minimum slice: config DSL -> MultiLayerNetwork ->
+fit/output/evaluate on synthetic data, plus gradient checks.
+
+Mirrors the reference's backbone test strategy (SURVEY.md §4): gradient checks
++ convergence tests (deeplearning4j-core/src/test/.../gradientcheck/,
+nn/multilayer/).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerConfiguration,
+                                MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.gradientcheck.gradient_check_util import check_gradients
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+
+def make_blobs(n=200, n_features=4, n_classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 3, (n_classes, n_features))
+    X, Y = [], []
+    for i in range(n):
+        c = i % n_classes
+        X.append(centers[c] + rng.normal(0, 0.5, n_features))
+        y = np.zeros(n_classes)
+        y[c] = 1.0
+        Y.append(y)
+    return np.array(X, np.float32), np.array(Y, np.float32)
+
+
+def mlp_conf(lr=0.1, updater="sgd", seed=42, n_in=4, n_hidden=16, n_classes=3,
+             **g):
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed)
+         .updater(updater)
+         .learning_rate(lr))
+    for k, v in g.items():
+        getattr(b, k)(v)
+    return (b.list()
+            .layer(0, DenseLayer(n_out=n_hidden, activation="relu",
+                                 weight_init="xavier"))
+            .layer(1, OutputLayer(n_out=n_classes, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+
+
+class TestMLP:
+    def test_nin_inference(self):
+        conf = mlp_conf()
+        assert conf.layers[0].n_in == 4
+        assert conf.layers[1].n_in == 16
+
+    def test_param_counts(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        # 4*16+16 + 16*3+3 = 80 + 51 = 131
+        assert net.num_params() == 131
+        assert net.params().shape == (131,)
+
+    def test_set_get_params_roundtrip(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        p = net.params()
+        p2 = np.arange(p.size, dtype=np.float32) / p.size
+        net.set_params(p2)
+        np.testing.assert_allclose(net.params(), p2, rtol=1e-6)
+
+    def test_output_shape(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        X, _ = make_blobs(10)
+        out = np.asarray(net.output(X))
+        assert out.shape == (10, 3)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_fit_reduces_score(self):
+        X, Y = make_blobs(120)
+        net = MultiLayerNetwork(mlp_conf(lr=0.5)).init()
+        ds = DataSet(X, Y)
+        s0 = net.score(ds)
+        net.fit(ListDataSetIterator(ds, 32), num_epochs=20)
+        s1 = net.score(ds)
+        assert s1 < s0 * 0.5, f"score did not drop: {s0} -> {s1}"
+
+    def test_fit_accuracy(self):
+        X, Y = make_blobs(300)
+        net = MultiLayerNetwork(mlp_conf(lr=0.3, updater="adam",
+                                         learning_rate=0.01)).init()
+        net.fit(ListDataSetIterator(DataSet(X, Y), 50), num_epochs=30)
+        ev = net.evaluate(ListDataSetIterator(DataSet(X, Y), 100))
+        assert ev.accuracy() > 0.95, ev.stats()
+
+    def test_feed_forward_activations(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        X, _ = make_blobs(5)
+        acts = net.feed_forward(X)
+        assert len(acts) == 3  # input + 2 layers
+        assert acts[1].shape == (5, 16)
+        assert acts[2].shape == (5, 3)
+
+    def test_iteration_count_increments(self):
+        X, Y = make_blobs(64)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        net.fit(ListDataSetIterator(DataSet(X, Y), 16), num_epochs=2)
+        assert net.conf.iteration_count == 8
+
+
+class TestSerde:
+    def test_json_roundtrip(self):
+        conf = mlp_conf(updater="adam", l2=1e-4)
+        s = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(s)
+        assert conf2.to_json() == s
+        assert conf2.layers[0].n_out == 16
+        assert conf2.layers[1].loss_function == "mcxent"
+
+    def test_network_from_deserialized_conf(self):
+        conf = MultiLayerConfiguration.from_json(mlp_conf().to_json())
+        net = MultiLayerNetwork(conf).init()
+        assert net.num_params() == 131
+
+
+class TestGradients:
+    def _check(self, **kwargs):
+        X, Y = make_blobs(8)
+        conf = mlp_conf(data_type="float64", **kwargs)
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, X, Y, epsilon=1e-6, max_rel_error=1e-4)
+
+    def test_gradcheck_mlp_softmax(self):
+        self._check()
+
+    def test_gradcheck_l1_l2(self):
+        self._check(l1=0.01, l2=0.02)
+
+    def test_gradcheck_tanh_mse(self):
+        X, Y = make_blobs(8)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(7).data_type("float64").learning_rate(0.1)
+                .list()
+                .layer(0, DenseLayer(n_out=8, activation="tanh"))
+                .layer(1, OutputLayer(n_out=3, activation="identity",
+                                      loss_function="mse"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, X, Y, max_rel_error=1e-4)
+
+    def test_gradcheck_sigmoid_xent(self):
+        X, Y = make_blobs(8)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(7).data_type("float64").learning_rate(0.1)
+                .list()
+                .layer(0, DenseLayer(n_out=8, activation="elu"))
+                .layer(1, OutputLayer(n_out=3, activation="sigmoid",
+                                      loss_function="xent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, X, Y, max_rel_error=1e-4)
+
+
+class TestEvaluation:
+    def test_eval_counts(self):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        ev = Evaluation()
+        labels = np.eye(3)[[0, 1, 2, 0, 1]]
+        preds = np.eye(3)[[0, 1, 1, 0, 1]]
+        ev.eval(labels, preds)
+        assert ev.accuracy() == pytest.approx(0.8)
+        assert ev.precision(1) == pytest.approx(2 / 3)
+        assert ev.recall(2) == pytest.approx(0.0)
+
+    def test_eval_merge(self):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        labels = np.eye(3)[[0, 1, 2, 0]]
+        preds = np.eye(3)[[0, 1, 2, 1]]
+        e1 = Evaluation().eval(labels[:2], preds[:2])
+        e2 = Evaluation().eval(labels[2:], preds[2:])
+        e1.merge(e2)
+        full = Evaluation().eval(labels, preds)
+        assert e1.accuracy() == full.accuracy()
