@@ -190,9 +190,11 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def _start(self):
         self._q = queue.Queue(maxsize=self.queue_size)
+        self._error = None
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
         self._next = self._q.get()
+        self._raise_if_failed()
 
     def _worker(self):
         try:
@@ -201,8 +203,15 @@ class AsyncDataSetIterator(DataSetIterator):
                 if self._device_put:
                     ds = self._stage(ds)
                 self._q.put(ds)
+        except BaseException as e:  # re-raised on the consumer thread
+            self._error = e
         finally:
             self._q.put(self._sentinel)
+
+    def _raise_if_failed(self):
+        if self._next is self._sentinel and self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("prefetch worker failed") from err
 
     @staticmethod
     def _stage(ds):
@@ -218,10 +227,14 @@ class AsyncDataSetIterator(DataSetIterator):
         return staged
 
     def has_next(self):
+        self._raise_if_failed()
         return self._next is not self._sentinel
 
     def next_batch(self):
         b = self._next
+        if b is self._sentinel:
+            self._raise_if_failed()
+            raise StopIteration("iterator exhausted")
         self._next = self._q.get()
         return b
 

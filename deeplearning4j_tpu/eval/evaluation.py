@@ -52,6 +52,10 @@ class Evaluation:
                 m = np.ones(labels.shape[0] * labels.shape[1], bool)
             labels = labels.reshape(-1, labels.shape[-1])[m]
             predictions = predictions.reshape(-1, predictions.shape[-1])[m]
+        elif mask is not None:  # [N,C] with per-example mask
+            m = np.asarray(mask).astype(bool).reshape(-1)
+            labels = labels[m]
+            predictions = predictions[m]
         if self.num_classes is None:
             self.num_classes = labels.shape[-1]
             self.confusion = ConfusionMatrix(self.num_classes)

@@ -35,7 +35,7 @@ GLOBAL_OVERRIDABLE = (
     "rho", "rms_decay", "epsilon", "adam_mean_decay", "adam_var_decay",
     "gradient_normalization", "gradient_normalization_threshold",
     "lr_policy", "lr_policy_decay_rate", "lr_policy_steps", "lr_policy_power",
-    "lr_schedule",
+    "lr_policy_max_iterations", "lr_schedule",
 )
 
 
@@ -75,6 +75,7 @@ class LayerConf:
     lr_policy_decay_rate: float = None
     lr_policy_steps: float = None
     lr_policy_power: float = None
+    lr_policy_max_iterations: float = None  # horizon for 'poly' decay
     lr_schedule: dict = None
 
     # ------------------------------------------------------------------

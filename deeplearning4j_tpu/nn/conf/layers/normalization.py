@@ -18,7 +18,6 @@ from dataclasses import dataclass
 
 import jax.numpy as jnp
 
-from ... import activations
 from ..input_type import ConvolutionalInputType, FeedForwardInputType, InputType
 from .base import LayerConf, register_layer
 
@@ -81,7 +80,10 @@ class BatchNormalization(LayerConf):
         xn = (x - mean) / jnp.sqrt(var + self.eps)
         if not self.lock_gamma_beta and params:
             xn = xn * params["gamma"] + params["beta"]
-        return activations.get(self.activation or "identity")(xn), new_state
+        # No activation: the reference BatchNormalization.activate
+        # (nn/layers/normalization/BatchNormalization.java:227) returns
+        # preOutput untransformed, regardless of the global default.
+        return xn, new_state
 
     def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
         out, _ = self.forward_with_state(params, x, state or self.init_state(),

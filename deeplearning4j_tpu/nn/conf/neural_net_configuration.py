@@ -46,7 +46,7 @@ _GLOBAL_DEFAULTS = dict(
     adam_mean_decay=None, adam_var_decay=None,
     gradient_normalization=None, gradient_normalization_threshold=1.0,
     lr_policy=None, lr_policy_decay_rate=None, lr_policy_steps=None,
-    lr_policy_power=None, lr_schedule=None,
+    lr_policy_power=None, lr_policy_max_iterations=None, lr_schedule=None,
     optimization_algo="stochastic_gradient_descent",
     num_iterations=1,
     mini_batch=True,
@@ -138,6 +138,10 @@ class NeuralNetConfiguration:
         def lr_policy_power(self, v):
             self.g["lr_policy_power"] = float(v); return self
 
+        def lr_policy_max_iterations(self, v):
+            """Decay horizon for the 'poly' policy: lr*(1-it/max)^power."""
+            self.g["lr_policy_max_iterations"] = float(v); return self
+
         def learning_rate_schedule(self, v):
             self.g["lr_schedule"] = dict(v); return self
 
@@ -188,6 +192,7 @@ class NeuralNetConfiguration:
     Builder.lrPolicyDecayRate = Builder.lr_policy_decay_rate
     Builder.lrPolicySteps = Builder.lr_policy_steps
     Builder.lrPolicyPower = Builder.lr_policy_power
+    Builder.lrPolicyMaxIterations = Builder.lr_policy_max_iterations
     Builder.learningRateSchedule = Builder.learning_rate_schedule
     Builder.optimizationAlgo = Builder.optimization_algo
     Builder.miniBatch = Builder.mini_batch

@@ -196,6 +196,7 @@ class MultiLayerNetwork:
                         steps=layer.lr_policy_steps or 1.0,
                         power=layer.lr_policy_power or 1.0,
                         schedule_map=layer.lr_schedule,
+                        max_iterations=layer.lr_policy_max_iterations,
                     )
                     upd, s_k = apply_fn(ustate[i][k], g_i[k], lr, hp)
                     p_new[k] = p - upd if minimize else p + upd
@@ -321,14 +322,19 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # Inference — reference output(:1521)/feedForward(:657)
     # ------------------------------------------------------------------
-    def output(self, x, train=False):
+    def output(self, x, train=False, features_mask=None):
+        """Forward pass to the output layer. `features_mask` carries
+        variable-length sequence masks through recurrent layers, matching the
+        reference's output(input, train, featuresMask, labelsMask)."""
         self._ensure_init()
         x = jnp.asarray(x)
-        key = ("output", bool(train))
+        fmask = jnp.asarray(features_mask) if features_mask is not None else None
+        key = ("output", bool(train), fmask is not None)
         if key not in self._jit_forward:
-            def fwd(params, state, x, rng):
+            def fwd(params, state, x, fmask, rng):
                 h, _, _ = self._output_layer_input(params, state, x,
-                                                   train=train, rng=rng)
+                                                   train=train, rng=rng,
+                                                   fmask=fmask)
                 out_layer = self.layers[-1]
                 i = len(self.layers) - 1
                 p = jax.tree.map(lambda a: a.astype(self.compute_dtype)
@@ -338,7 +344,8 @@ class MultiLayerNetwork:
                                          rng=jax.random.fold_in(rng, i))
             self._jit_forward[key] = jax.jit(fwd)
         self._rng, rng = jax.random.split(self._rng)
-        return self._jit_forward[key](self._params, self._model_state, x, rng)
+        return self._jit_forward[key](self._params, self._model_state, x,
+                                      fmask, rng)
 
     def feed_forward(self, x, train=False):
         """Returns list of activations per layer, input first (reference :657)."""
@@ -510,7 +517,7 @@ class MultiLayerNetwork:
         if isinstance(data, DataSet):
             data = ListDataSetIterator([data])
         for ds in data:
-            out = self.output(ds.features)
+            out = self.output(ds.features, features_mask=ds.features_mask)
             ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
         return ev
 
@@ -520,7 +527,7 @@ class MultiLayerNetwork:
         if isinstance(data, DataSet):
             data = ListDataSetIterator([data])
         for ds in data:
-            out = self.output(ds.features)
+            out = self.output(ds.features, features_mask=ds.features_mask)
             if ev is None:
                 ev = RegressionEvaluation(int(ds.labels.shape[-1]))
             ev.eval(ds.labels, np.asarray(out))

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 
 def schedule_lr(base_lr, policy, iteration, *, decay_rate=0.0, steps=1.0, power=1.0,
-                schedule_map=None, max_iterations=1):
+                schedule_map=None, max_iterations=None):
     """Compute the effective learning rate at `iteration` (traced scalar ok).
 
     Policies: none, exponential, inverse, step, poly, sigmoid, torchstep, schedule.
@@ -52,7 +52,12 @@ def schedule_lr(base_lr, policy, iteration, *, decay_rate=0.0, steps=1.0, power=
     if policy == "torchstep":
         return base_lr * jnp.power(decay_rate, jnp.floor(it / steps))
     if policy == "poly":
-        frac = jnp.clip(it / max(float(max_iterations), 1.0), 0.0, 1.0)
+        if max_iterations is None or float(max_iterations) <= 0.0:
+            raise ValueError(
+                "lr policy 'poly' needs a decay horizon: set "
+                ".lr_policy_max_iterations(N) on the builder (lr reaches 0 "
+                "at iteration N)")
+        frac = jnp.clip(it / float(max_iterations), 0.0, 1.0)
         return base_lr * jnp.power(1.0 - frac, power)
     if policy == "sigmoid":
         return base_lr / (1.0 + jnp.exp(-decay_rate * (it - steps)))

@@ -175,8 +175,8 @@ class ParallelWrapper:
                 self._run_kstep(pending)
                 pending = []
         if pending:
-            while len(pending) < k:
-                pending.append(pending[-1])
+            # ragged tail: run the true remaining batches (the jitted k-step
+            # retraces for the smaller leading axis) — no duplicated steps.
             self._run_kstep(pending)
 
     @staticmethod
@@ -211,10 +211,6 @@ class ParallelWrapper:
             return p, u, s, score
 
         repl = P()
-
-        def spec_for_batch_leaf(path_key, a):
-            return P(None, "data") if a.ndim >= 2 else P()
-
         _SHARDED_KEYS = ("features", "labels", "fmask", "lmask")
 
         def build(batches_tree):

@@ -1,0 +1,118 @@
+"""Regression tests for review findings: BN activation, poly LR, async
+iterator error propagation, masked output/eval, ParallelWrapper ragged tail."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (AsyncDataSetIterator,
+                                                   ListDataSetIterator)
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+
+def test_batchnorm_applies_no_activation():
+    """BN output must be gamma*xhat+beta, not sigmoid(...) from the global
+    default (reference BatchNormalization.java:227 applies none)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.conf.layers.normalization import BatchNormalization
+    bn = BatchNormalization(n_out=4)
+    bn = bn.apply_global_defaults({"activation": "sigmoid"})
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(16, 4)) * 3 + 1)
+    out, _ = bn.forward_with_state(bn.init_params(None), x, bn.init_state(),
+                                   train=True)
+    out = np.asarray(out)
+    # sigmoid output would be in (0,1); normalized output must have
+    # negative values and ~unit variance
+    assert out.min() < -0.5
+    assert abs(out.std() - 1.0) < 0.2
+
+
+def test_poly_lr_requires_horizon():
+    from deeplearning4j_tpu.nn.updater import updaters as U
+    with pytest.raises(ValueError, match="poly"):
+        U.schedule_lr(0.1, "poly", 3, power=2.0)
+    lr = U.schedule_lr(0.1, "poly", 50, power=1.0, max_iterations=100)
+    assert abs(float(lr) - 0.05) < 1e-9
+
+
+def test_async_iterator_propagates_worker_error():
+    class FailingIterator(ListDataSetIterator):
+        def __init__(self):
+            ds = DataSet(np.zeros((4, 3), np.float32), np.zeros((4, 2), np.float32))
+            super().__init__([ds, ds, ds])
+            self._n = 0
+
+        def next_batch(self):
+            self._n += 1
+            if self._n >= 2:
+                raise RuntimeError("corrupt record")
+            return super().next_batch()
+
+    it = AsyncDataSetIterator(FailingIterator(), queue_size=1, device_put=False)
+    with pytest.raises(RuntimeError, match="prefetch worker failed"):
+        while it.has_next():
+            it.next_batch()
+
+
+def test_evaluation_2d_mask():
+    ev = Evaluation()
+    labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+    preds = np.eye(3, dtype=np.float32)[[0, 1, 0, 1]]  # last two wrong
+    mask = np.array([1, 1, 0, 0], np.float32)  # mask out the wrong ones
+    ev.eval(labels, preds, mask=mask)
+    assert ev.num_examples == 2
+    assert ev.accuracy() == 1.0
+
+
+def test_output_accepts_features_mask():
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(3).list()
+            .layer(0, GravesLSTM(n_out=6, activation="tanh"))
+            .layer(1, RnnOutputLayer(n_out=3, activation="softmax",
+                                     loss_function="mcxent"))
+            .set_input_type(InputType.recurrent(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.random((2, 5, 4)).astype(np.float32)
+    fmask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+    out_masked = np.asarray(net.output(x, features_mask=fmask))
+    out_unmasked = np.asarray(net.output(x))
+    assert out_masked.shape == (2, 5, 3)
+    # masking must change the padded-region computation for example 0
+    assert not np.allclose(out_masked[0], out_unmasked[0])
+
+
+def test_parallel_wrapper_ragged_tail_no_duplicate_steps():
+    import jax
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(1).updater("sgd").learning_rate(0.1).list()
+            .layer(0, DenseLayer(n_out=8, activation="relu"))
+            .layer(1, OutputLayer(n_out=2, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = make_mesh(n_data=2, n_model=1, devices=jax.devices()[:2])
+    pw = (ParallelWrapper.Builder(net).mesh(mesh)
+          .averaging_frequency(4).build())
+    rng = np.random.default_rng(0)
+    batches = [DataSet(rng.random((4, 5)).astype(np.float32),
+                       np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)])
+               for _ in range(6)]
+
+    class SixIterator(ListDataSetIterator):
+        pass
+
+    start = net.conf.iteration_count
+    pw.fit(ListDataSetIterator(batches))
+    # 6 batches -> exactly 6 optimizer iterations (4 + ragged tail of 2)
+    assert net.conf.iteration_count - start == 6
