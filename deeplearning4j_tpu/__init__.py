@@ -15,12 +15,17 @@ reference's parameter-averaging threads / Spark / Aeron parameter server.
 
 __version__ = "0.1.0"
 
+from .nn.conf.computation_graph_configuration import \
+    ComputationGraphConfiguration
 from .nn.conf.input_type import InputType
 from .nn.conf.neural_net_configuration import (MultiLayerConfiguration,
                                                NeuralNetConfiguration)
+from .nn.graph import ComputationGraph
 from .nn.multilayer import MultiLayerNetwork
 
 __all__ = [
+    "ComputationGraph",
+    "ComputationGraphConfiguration",
     "InputType",
     "MultiLayerConfiguration",
     "NeuralNetConfiguration",

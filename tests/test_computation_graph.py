@@ -1,0 +1,299 @@
+"""ComputationGraph tests — mirrors reference test strategy (SURVEY.md §4):
+gradient checks through every vertex type (GradientCheckTestsComputationGraph),
+config serde round-trips, convergence, multi-input/multi-output."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (ComputationGraph,
+                                ComputationGraphConfiguration, InputType,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.gradientcheck.gradient_check_util import check_gradients
+from deeplearning4j_tpu.nn.conf.graph_vertices import (
+    DuplicateToTimeSeriesVertex, ElementWiseVertex, L2NormalizeVertex,
+    L2Vertex, LastTimeStepVertex, MergeVertex, ScaleVertex, StackVertex,
+    SubsetVertex, UnstackVertex)
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, GravesLSTM,
+                                               OutputLayer, RnnOutputLayer)
+
+
+def _rng():
+    return np.random.default_rng(12345)
+
+
+def _xy(n=8, nin=4, nout=3):
+    r = _rng()
+    x = r.random((n, nin)).astype(np.float64)
+    y = np.eye(nout, dtype=np.float64)[r.integers(0, nout, n)]
+    return x, y
+
+
+def _gb(seed=42):
+    return (NeuralNetConfiguration.Builder().seed(seed)
+            .data_type("float64").updater("sgd").learning_rate(0.1)
+            .graph_builder())
+
+
+class TestGraphBuilding:
+    def test_topological_sort_and_cycle_detection(self):
+        conf = (_gb()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=5, activation="tanh"), "in")
+                .add_layer("d2", DenseLayer(n_out=5, activation="tanh"), "in")
+                .add_vertex("merge", MergeVertex(), "d1", "d2")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss_function="mcxent"), "merge")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+        order = conf.topological_order
+        assert order.index("merge") > order.index("d1")
+        assert order.index("merge") > order.index("d2")
+        assert order.index("out") > order.index("merge")
+        # merge output feeds out: nIn inferred as 10
+        assert conf.vertices["out"].conf.n_in == 10
+
+    def test_cycle_raises(self):
+        gb = (_gb().add_inputs("in")
+              .add_layer("a", DenseLayer(n_in=4, n_out=4), "b")
+              .add_layer("b", DenseLayer(n_in=4, n_out=4), "a")
+              .set_outputs("a"))
+        with pytest.raises(ValueError, match="[Cc]ycle"):
+            gb.build()
+
+    def test_unknown_input_raises(self):
+        gb = (_gb().add_inputs("in")
+              .add_layer("a", DenseLayer(n_in=4, n_out=4), "nope")
+              .set_outputs("a"))
+        with pytest.raises(ValueError, match="unknown input"):
+            gb.build()
+
+    def test_json_round_trip(self):
+        conf = (_gb()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=5, activation="relu"), "in")
+                .add_vertex("scale", ScaleVertex(scale_factor=0.5), "d1")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss_function="mcxent"), "scale")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+        js = conf.to_json()
+        conf2 = ComputationGraphConfiguration.from_json(js)
+        assert conf2.topological_order == conf.topological_order
+        assert conf2.vertices["scale"].conf.scale_factor == 0.5
+        assert conf2.vertices["out"].conf.n_in == 5
+        # weights transfer across round trip
+        net = ComputationGraph(conf).init()
+        net2 = ComputationGraph(conf2).init()
+        net2.set_params(net.params())
+        x, y = _xy()
+        o1 = np.asarray(net.output(x)[0])
+        o2 = np.asarray(net2.output(x)[0])
+        assert np.allclose(o1, o2)
+
+
+class TestGraphGradients:
+    def test_gradcheck_merge_elementwise(self):
+        x, y = _xy()
+        for vertex in (MergeVertex(), ElementWiseVertex(op="add"),
+                       ElementWiseVertex(op="subtract"),
+                       ElementWiseVertex(op="product"),
+                       ElementWiseVertex(op="average"),
+                       ElementWiseVertex(op="max")):
+            conf = (_gb()
+                    .add_inputs("in")
+                    .add_layer("d1", DenseLayer(n_out=5, activation="tanh"), "in")
+                    .add_layer("d2", DenseLayer(n_out=5, activation="tanh"), "in")
+                    .add_vertex("v", vertex, "d1", "d2")
+                    .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                                  loss_function="mcxent"), "v")
+                    .set_outputs("out")
+                    .set_input_types(InputType.feed_forward(4))
+                    .build())
+            net = ComputationGraph(conf).init()
+            assert check_gradients(net, x, y, max_rel_error=1e-4), vertex
+
+    def test_gradcheck_subset_scale_l2norm(self):
+        x, y = _xy()
+        for vname, vertex in (("subset", SubsetVertex(from_idx=1, to_idx=3)),
+                              ("scale", ScaleVertex(scale_factor=2.0)),
+                              ("l2n", L2NormalizeVertex())):
+            conf = (_gb()
+                    .add_inputs("in")
+                    .add_layer("d1", DenseLayer(n_out=5, activation="tanh"), "in")
+                    .add_vertex("v", vertex, "d1")
+                    .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                                  loss_function="mcxent"), "v")
+                    .set_outputs("out")
+                    .set_input_types(InputType.feed_forward(4))
+                    .build())
+            net = ComputationGraph(conf).init()
+            assert check_gradients(net, x, y, max_rel_error=1e-4), vname
+
+    def test_gradcheck_stack_unstack(self):
+        x, y = _xy()
+        conf = (_gb()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=5, activation="tanh"), "in")
+                .add_layer("d2", DenseLayer(n_out=5, activation="tanh"), "in")
+                .add_vertex("stack", StackVertex(), "d1", "d2")
+                .add_layer("shared", DenseLayer(n_out=5, activation="tanh"),
+                           "stack")
+                .add_vertex("u0", UnstackVertex(from_idx=0, stack_size=2),
+                            "shared")
+                .add_vertex("u1", UnstackVertex(from_idx=1, stack_size=2),
+                            "shared")
+                .add_vertex("sum", ElementWiseVertex(op="add"), "u0", "u1")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss_function="mcxent"), "sum")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        assert check_gradients(net, x, y, max_rel_error=1e-4)
+
+    def test_gradcheck_l2_vertex(self):
+        x, y = _xy(nout=1)
+        y = _rng().random((8, 1)).astype(np.float64)
+        conf = (_gb()
+                .add_inputs("in")
+                .add_layer("a", DenseLayer(n_out=5, activation="tanh"), "in")
+                .add_layer("b", DenseLayer(n_out=5, activation="tanh"), "in")
+                .add_vertex("dist", L2Vertex(), "a", "b")
+                .add_layer("out", OutputLayer(n_out=1, activation="identity",
+                                              loss_function="mse"), "dist")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        assert check_gradients(net, x, y, max_rel_error=1e-4)
+
+    def test_gradcheck_rnn_vertices(self):
+        r = _rng()
+        B, T, F = 4, 5, 3
+        x = r.random((B, T, F)).astype(np.float64)
+        y = np.eye(2, dtype=np.float64)[r.integers(0, 2, B)]
+        conf = (_gb()
+                .add_inputs("in")
+                .add_layer("lstm", GravesLSTM(n_out=6, activation="tanh"), "in")
+                .add_vertex("last", LastTimeStepVertex(), "lstm")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss_function="mcxent"), "last")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(F))
+                .build())
+        net = ComputationGraph(conf).init()
+        assert check_gradients(net, x, y, max_rel_error=1e-4, subset=60)
+
+    def test_gradcheck_duplicate_to_timeseries(self):
+        r = _rng()
+        B, T, F = 4, 5, 3
+        x_static = r.random((B, 4)).astype(np.float64)
+        x_seq = r.random((B, T, F)).astype(np.float64)
+        y = np.zeros((B, T, 2), np.float64)
+        y[np.arange(B)[:, None], np.arange(T)[None, :],
+          r.integers(0, 2, (B, T))] = 1.0
+        conf = (_gb()
+                .add_inputs("stat", "seq")
+                .add_layer("emb", DenseLayer(n_out=3, activation="tanh"), "stat")
+                .add_vertex("dup", DuplicateToTimeSeriesVertex(), "emb", "seq")
+                .add_vertex("cat", MergeVertex(), "seq", "dup")
+                .add_layer("lstm", GravesLSTM(n_out=5, activation="tanh"), "cat")
+                .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                                 loss_function="mcxent"), "lstm")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4),
+                                 InputType.recurrent(F))
+                .build())
+        net = ComputationGraph(conf).init()
+        assert check_gradients(net, [x_static, x_seq], y,
+                               max_rel_error=1e-4, subset=60)
+
+    def test_gradcheck_multi_output(self):
+        r = _rng()
+        x = r.random((8, 4)).astype(np.float64)
+        y1 = np.eye(3, dtype=np.float64)[r.integers(0, 3, 8)]
+        y2 = r.random((8, 2)).astype(np.float64)
+        conf = (_gb()
+                .add_inputs("in")
+                .add_layer("trunk", DenseLayer(n_out=6, activation="tanh"), "in")
+                .add_layer("cls", OutputLayer(n_out=3, activation="softmax",
+                                              loss_function="mcxent"), "trunk")
+                .add_layer("reg", OutputLayer(n_out=2, activation="identity",
+                                              loss_function="mse"), "trunk")
+                .set_outputs("cls", "reg")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        assert check_gradients(net, x, [y1, y2], max_rel_error=1e-4)
+
+
+class TestGraphTraining:
+    def test_fit_converges_xor(self):
+        x = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+        y = np.array([[1, 0], [0, 1], [0, 1], [1, 0]], np.float32)
+        conf = (NeuralNetConfiguration.Builder().seed(7)
+                .updater("adam").learning_rate(0.05)
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("h", DenseLayer(n_out=8, activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss_function="mcxent"), "h")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(2))
+                .build())
+        net = ComputationGraph(conf).init()
+        mds = MultiDataSet([x], [y])
+        for _ in range(300):
+            net.fit(mds)
+        ev = net.evaluate(mds)
+        assert ev.accuracy() == 1.0
+        assert net.score() < 0.2
+
+    def test_fit_dataset_and_score(self):
+        x, y = _xy(16)
+        conf = (_gb()
+                .add_inputs("in")
+                .add_layer("h", DenseLayer(n_out=8, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss_function="mcxent"), "h")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        s0 = net.score(DataSet(x, y))
+        for _ in range(50):
+            net.fit(DataSet(x, y))
+        assert net.score(DataSet(x, y)) < s0
+
+    def test_multi_input_output_training(self):
+        r = _rng()
+        xa = r.random((16, 3)).astype(np.float32)
+        xb = r.random((16, 5)).astype(np.float32)
+        y1 = np.eye(2, dtype=np.float32)[r.integers(0, 2, 16)]
+        y2 = r.random((16, 1)).astype(np.float32)
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .updater("adam").learning_rate(0.01)
+                .graph_builder()
+                .add_inputs("a", "b")
+                .add_layer("da", DenseLayer(n_out=4, activation="relu"), "a")
+                .add_layer("db", DenseLayer(n_out=4, activation="relu"), "b")
+                .add_vertex("m", MergeVertex(), "da", "db")
+                .add_layer("cls", OutputLayer(n_out=2, activation="softmax",
+                                              loss_function="mcxent"), "m")
+                .add_layer("reg", OutputLayer(n_out=1, activation="identity",
+                                              loss_function="mse"), "m")
+                .set_outputs("cls", "reg")
+                .set_input_types(InputType.feed_forward(3),
+                                 InputType.feed_forward(5))
+                .build())
+        net = ComputationGraph(conf).init()
+        mds = MultiDataSet([xa, xb], [y1, y2])
+        s0 = net.score(mds)
+        for _ in range(50):
+            net.fit(mds)
+        assert net.score(mds) < s0
+        outs = net.output([xa, xb])
+        assert np.asarray(outs[0]).shape == (16, 2)
+        assert np.asarray(outs[1]).shape == (16, 1)
