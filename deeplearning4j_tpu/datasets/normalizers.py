@@ -1,0 +1,164 @@
+"""Data normalizers — fit on training data, transform DataSets.
+
+TPU-native equivalent of ND4J's DataNormalization family used by the
+reference (NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessing
+scaler), persisted as `normalizer.bin` inside ModelSerializer zips
+(reference util/ModelSerializer.java — normalizer entry).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+NORMALIZER_REGISTRY = {}
+
+
+def _register(name):
+    def deco(cls):
+        NORMALIZER_REGISTRY[name] = cls
+        cls.kind = name
+        return cls
+    return deco
+
+
+class Normalizer:
+    def fit(self, data):
+        """data: DataSet or DataSetIterator."""
+        raise NotImplementedError
+
+    def transform(self, ds):
+        raise NotImplementedError
+
+    def pre_process(self, ds):
+        return self.transform(ds)
+
+    preProcess = pre_process
+
+    def to_dict(self):
+        raise NotImplementedError
+
+    @staticmethod
+    def from_dict(d):
+        kind = d["kind"]
+        if kind not in NORMALIZER_REGISTRY:
+            raise ValueError(f"Unknown normalizer '{kind}'")
+        return NORMALIZER_REGISTRY[kind]._from_dict(d)
+
+
+def _iter_features(data):
+    from .iterators import DataSetIterator
+    if isinstance(data, DataSetIterator):
+        data.reset()
+        while data.has_next():
+            yield data.next_batch().features
+        data.reset()
+    else:
+        yield data.features
+
+
+@_register("standardize")
+class NormalizerStandardize(Normalizer):
+    """Zero-mean unit-variance per feature (ND4J NormalizerStandardize)."""
+
+    def __init__(self, mean=None, std=None):
+        self.mean = mean
+        self.std = std
+
+    def fit(self, data):
+        n, s, s2 = 0, None, None
+        for f in _iter_features(data):
+            f = f.reshape(-1, f.shape[-1]).astype(np.float64)
+            if s is None:
+                s = f.sum(axis=0)
+                s2 = (f * f).sum(axis=0)
+            else:
+                s += f.sum(axis=0)
+                s2 += (f * f).sum(axis=0)
+            n += f.shape[0]
+        self.mean = (s / n).astype(np.float32)
+        var = s2 / n - (s / n) ** 2
+        self.std = np.sqrt(np.maximum(var, 1e-12)).astype(np.float32)
+        return self
+
+    def transform(self, ds):
+        ds.features = ((ds.features - self.mean) / self.std).astype(
+            ds.features.dtype)
+        return ds
+
+    def to_dict(self):
+        return {"kind": "standardize", "mean": self.mean.tolist(),
+                "std": self.std.tolist()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(np.asarray(d["mean"], np.float32),
+                   np.asarray(d["std"], np.float32))
+
+
+@_register("minmax")
+class NormalizerMinMaxScaler(Normalizer):
+    """Scale features into [min_range, max_range] (ND4J NormalizerMinMaxScaler)."""
+
+    def __init__(self, min_range=0.0, max_range=1.0, data_min=None,
+                 data_max=None):
+        self.min_range = float(min_range)
+        self.max_range = float(max_range)
+        self.data_min = data_min
+        self.data_max = data_max
+
+    def fit(self, data):
+        lo, hi = None, None
+        for f in _iter_features(data):
+            f = f.reshape(-1, f.shape[-1])
+            fl, fh = f.min(axis=0), f.max(axis=0)
+            lo = fl if lo is None else np.minimum(lo, fl)
+            hi = fh if hi is None else np.maximum(hi, fh)
+        self.data_min = lo.astype(np.float32)
+        self.data_max = hi.astype(np.float32)
+        return self
+
+    def transform(self, ds):
+        span = np.maximum(self.data_max - self.data_min, 1e-12)
+        scaled = (ds.features - self.data_min) / span
+        ds.features = (scaled * (self.max_range - self.min_range)
+                       + self.min_range).astype(ds.features.dtype)
+        return ds
+
+    def to_dict(self):
+        return {"kind": "minmax", "minRange": self.min_range,
+                "maxRange": self.max_range,
+                "dataMin": self.data_min.tolist(),
+                "dataMax": self.data_max.tolist()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d.get("minRange", 0.0), d.get("maxRange", 1.0),
+                   np.asarray(d["dataMin"], np.float32),
+                   np.asarray(d["dataMax"], np.float32))
+
+
+@_register("imagescaler")
+class ImagePreProcessingScaler(Normalizer):
+    """Scale pixel values [0, max_pixel] -> [0,1] (ND4J ImagePreProcessingScaler)."""
+
+    def __init__(self, min_range=0.0, max_range=1.0, max_pixel=255.0):
+        self.min_range = float(min_range)
+        self.max_range = float(max_range)
+        self.max_pixel = float(max_pixel)
+
+    def fit(self, data):
+        return self
+
+    def transform(self, ds):
+        scaled = ds.features / self.max_pixel
+        ds.features = (scaled * (self.max_range - self.min_range)
+                       + self.min_range).astype(np.float32)
+        return ds
+
+    def to_dict(self):
+        return {"kind": "imagescaler", "minRange": self.min_range,
+                "maxRange": self.max_range, "maxPixel": self.max_pixel}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d.get("minRange", 0.0), d.get("maxRange", 1.0),
+                   d.get("maxPixel", 255.0))

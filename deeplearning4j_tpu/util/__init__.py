@@ -1,0 +1,6 @@
+from . import model_serializer as ModelSerializer  # noqa: N812
+from .model_serializer import (restore_computation_graph, restore_model,
+                               restore_multi_layer_network, write_model)
+
+__all__ = ["ModelSerializer", "restore_computation_graph", "restore_model",
+           "restore_multi_layer_network", "write_model"]

@@ -1,0 +1,210 @@
+"""ModelSerializer zip round-trips + early stopping behavior + normalizers.
+Mirrors reference test strategy §4: serialization round-trips and
+early-stopping suites (deeplearning4j-core earlystopping tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (ComputationGraph, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.datasets.normalizers import (ImagePreProcessingScaler,
+                                                     Normalizer,
+                                                     NormalizerMinMaxScaler,
+                                                     NormalizerStandardize)
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InvalidScoreIterationTerminationCondition, LocalFileModelSaver,
+    MaxEpochsTerminationCondition, MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.util import model_serializer as MS
+
+
+def _mln(seed=7):
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, DenseLayer(n_out=8, activation="relu"))
+            .layer(1, OutputLayer(n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=32, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.random((n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+class TestModelSerializer:
+    def test_mln_round_trip_exact(self, tmp_path):
+        net = _mln()
+        ds = _data()
+        net.fit(ds)
+        path = str(tmp_path / "model.zip")
+        MS.write_model(net, path)
+        net2 = MS.restore_multi_layer_network(path)
+        assert np.allclose(net.params(), net2.params())
+        assert net2.conf.iteration_count == net.conf.iteration_count
+        out1 = np.asarray(net.output(ds.features))
+        out2 = np.asarray(net2.output(ds.features))
+        assert np.allclose(out1, out2)
+
+    def test_updater_state_resume(self, tmp_path):
+        """Exact resume: continuing training after restore must equal
+        continuous training (params + Adam moments round-trip)."""
+        ds = _data()
+        net_a = _mln()
+        net_b = _mln()
+        net_b.set_params(net_a.params())
+        net_a.fit(ds)
+        path = str(tmp_path / "ckpt.zip")
+        MS.write_model(net_a, path)
+        restored = MS.restore_multi_layer_network(path)
+        net_a.fit(ds)
+        restored.fit(ds)
+        assert np.allclose(net_a.params(), restored.params(), atol=1e-6)
+
+    def test_cg_round_trip(self, tmp_path):
+        conf = (NeuralNetConfiguration.Builder().seed(5)
+                .updater("sgd").learning_rate(0.1)
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("a", DenseLayer(n_out=5, activation="tanh"), "in")
+                .add_layer("b", DenseLayer(n_out=5, activation="tanh"), "in")
+                .add_vertex("m", MergeVertex(), "a", "b")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss_function="mcxent"), "m")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        ds = _data()
+        net.fit(ds)
+        path = str(tmp_path / "cg.zip")
+        MS.write_model(net, path)
+        net2 = MS.restore_model(path)  # ModelGuesser path
+        assert isinstance(net2, ComputationGraph)
+        o1 = np.asarray(net.output(ds.features)[0])
+        o2 = np.asarray(net2.output(ds.features)[0])
+        assert np.allclose(o1, o2)
+
+    def test_normalizer_round_trip(self, tmp_path):
+        net = _mln()
+        ds = _data()
+        norm = NormalizerStandardize().fit(ds)
+        path = str(tmp_path / "m.zip")
+        MS.write_model(net, path, normalizer=norm)
+        norm2 = MS.restore_normalizer(path)
+        assert isinstance(norm2, NormalizerStandardize)
+        assert np.allclose(norm.mean, norm2.mean)
+        assert np.allclose(norm.std, norm2.std)
+
+
+class TestNormalizers:
+    def test_standardize(self):
+        ds = _data(100)
+        norm = NormalizerStandardize().fit(ds)
+        norm.transform(ds)
+        assert np.allclose(ds.features.mean(axis=0), 0, atol=1e-5)
+        assert np.allclose(ds.features.std(axis=0), 1, atol=1e-2)
+
+    def test_minmax(self):
+        ds = _data(50)
+        ds.features = ds.features * 10 - 3
+        norm = NormalizerMinMaxScaler().fit(ds)
+        norm.transform(ds)
+        assert ds.features.min() >= -1e-6
+        assert ds.features.max() <= 1 + 1e-6
+
+    def test_image_scaler_serde(self):
+        s = ImagePreProcessingScaler()
+        ds = DataSet(np.full((2, 4), 255.0, np.float32),
+                     np.zeros((2, 3), np.float32))
+        s.transform(ds)
+        assert np.allclose(ds.features, 1.0)
+        s2 = Normalizer.from_dict(s.to_dict())
+        assert isinstance(s2, ImagePreProcessingScaler)
+
+
+class TestEarlyStopping:
+    def _iters(self):
+        train = ListDataSetIterator(list(_data(64, 1).batch_by(16)))
+        val = ListDataSetIterator(list(_data(32, 2).batch_by(16)))
+        return train, val
+
+    def test_max_epochs_termination(self):
+        train, val = self._iters()
+        es = (EarlyStoppingConfiguration.Builder()
+              .score_calculator(DataSetLossCalculator(val))
+              .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+              .build())
+        result = EarlyStoppingTrainer(es, _mln(), train).fit()
+        assert result.termination_reason == \
+            "EpochTerminationCondition"
+        assert "MaxEpochs" in result.termination_details
+        assert result.total_epochs == 3
+        assert result.get_best_model() is not None
+        assert len(result.score_vs_epoch) == 3
+
+    def test_score_improvement_termination(self):
+        train, val = self._iters()
+        es = (EarlyStoppingConfiguration.Builder()
+              .score_calculator(DataSetLossCalculator(val))
+              .epoch_termination_conditions(
+                  ScoreImprovementEpochTerminationCondition(2),
+                  MaxEpochsTerminationCondition(100))
+              .build())
+        net = _mln()
+        # zero LR -> no improvement -> stops after 2 stagnant epochs
+        for l in net.layers:
+            l.learning_rate = 0.0
+        result = EarlyStoppingTrainer(es, net, train).fit()
+        assert result.termination_reason == "EpochTerminationCondition"
+        assert "ScoreImprovement" in result.termination_details
+
+    def test_invalid_score_termination(self):
+        train, val = self._iters()
+        es = (EarlyStoppingConfiguration.Builder()
+              .score_calculator(DataSetLossCalculator(val))
+              .iteration_termination_conditions(
+                  InvalidScoreIterationTerminationCondition())
+              .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+              .build())
+        net = _mln()
+        for l in net.layers:
+            l.learning_rate = 1e9  # diverge -> NaN
+        result = EarlyStoppingTrainer(es, net, train).fit()
+        # either NaN hit (iteration condition) or epochs exhausted
+        assert result.termination_reason in (
+            "IterationTerminationCondition", "EpochTerminationCondition")
+
+    def test_local_file_saver(self, tmp_path):
+        train, val = self._iters()
+        es = (EarlyStoppingConfiguration.Builder()
+              .score_calculator(DataSetLossCalculator(val))
+              .epoch_termination_conditions(MaxEpochsTerminationCondition(2))
+              .model_saver(LocalFileModelSaver(str(tmp_path)))
+              .build())
+        result = EarlyStoppingTrainer(es, _mln(), train).fit()
+        best = result.get_best_model()
+        assert best is not None
+        assert (tmp_path / "bestModel.bin").exists()
+        ds = _data()
+        assert np.asarray(best.output(ds.features)).shape == (32, 3)
+
+    def test_max_time_termination(self):
+        train, val = self._iters()
+        es = (EarlyStoppingConfiguration.Builder()
+              .score_calculator(DataSetLossCalculator(val))
+              .iteration_termination_conditions(
+                  MaxTimeIterationTerminationCondition(0.0))
+              .epoch_termination_conditions(MaxEpochsTerminationCondition(50))
+              .build())
+        result = EarlyStoppingTrainer(es, _mln(), train).fit()
+        assert result.termination_reason == "IterationTerminationCondition"
+        assert "MaxTime" in result.termination_details
