@@ -12,14 +12,21 @@ from __future__ import annotations
 import numpy as np
 
 
+def _as_array(a):
+    """Keep ndarray-like inputs (numpy OR device-resident jax arrays) as-is;
+    only coerce plain Python data. Round-tripping a jax array through
+    np.asarray would force a device->host copy."""
+    if a is None or (hasattr(a, "dtype") and hasattr(a, "shape")):
+        return a
+    return np.asarray(a)
+
+
 class DataSet:
     def __init__(self, features, labels, features_mask=None, labels_mask=None):
-        self.features = np.asarray(features)
-        self.labels = np.asarray(labels) if labels is not None else None
-        self.features_mask = (np.asarray(features_mask)
-                              if features_mask is not None else None)
-        self.labels_mask = (np.asarray(labels_mask)
-                            if labels_mask is not None else None)
+        self.features = _as_array(features)
+        self.labels = _as_array(labels)
+        self.features_mask = _as_array(features_mask)
+        self.labels_mask = _as_array(labels_mask)
 
     def num_examples(self):
         return int(self.features.shape[0])
@@ -69,13 +76,11 @@ class MultiDataSet:
     consumed by ComputationGraph.fit)."""
 
     def __init__(self, features, labels, features_masks=None, labels_masks=None):
-        self.features = [np.asarray(f) for f in _as_list(features)]
-        self.labels = [np.asarray(l) for l in _as_list(labels)]
-        self.features_masks = ([np.asarray(m) if m is not None else None
-                                for m in features_masks]
+        self.features = [_as_array(f) for f in _as_list(features)]
+        self.labels = [_as_array(l) for l in _as_list(labels)]
+        self.features_masks = ([_as_array(m) for m in features_masks]
                                if features_masks else None)
-        self.labels_masks = ([np.asarray(m) if m is not None else None
-                              for m in labels_masks]
+        self.labels_masks = ([_as_array(m) for m in labels_masks]
                              if labels_masks else None)
 
     def num_examples(self):

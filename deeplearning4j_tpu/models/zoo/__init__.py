@@ -1,0 +1,6 @@
+from .char_rnn import char_rnn, char_rnn_conf
+from .lenet import lenet, lenet_conf
+from .resnet import resnet50, resnet50_conf
+
+__all__ = ["char_rnn", "char_rnn_conf", "lenet", "lenet_conf", "resnet50",
+           "resnet50_conf"]

@@ -1,0 +1,38 @@
+"""Char-RNN (GravesLSTM stack) — benchmark config #3 (BASELINE.md).
+
+Mirrors the classic DL4J GravesLSTMCharModellingExample exercised by the
+reference's LSTM timestep loop (nn/layers/recurrent/LSTMHelpers.java:157-171);
+here the sequence compiles to one lax.scan with the input projection hoisted
+onto the MXU (see nn/conf/layers/recurrent.py).
+"""
+from __future__ import annotations
+
+from ...nn.conf.input_type import InputType
+from ...nn.conf.layers import GravesLSTM, RnnOutputLayer
+from ...nn.conf.neural_net_configuration import NeuralNetConfiguration
+
+
+def char_rnn_conf(vocab_size=77, hidden=200, layers=2, tbptt_length=50,
+                  seed=12345, learning_rate=0.1, updater="rmsprop",
+                  data_type="float32"):
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed)
+         .updater(updater)
+         .learning_rate(learning_rate)
+         .weight_init("xavier")
+         .data_type(data_type)
+         .list())
+    for i in range(layers):
+        b.layer(i, GravesLSTM(n_out=hidden, activation="tanh"))
+    b.layer(layers, RnnOutputLayer(n_out=vocab_size, activation="softmax",
+                                   loss_function="mcxent"))
+    return (b.set_input_type(InputType.recurrent(vocab_size))
+            .backprop_type("tbptt")
+            .t_bptt_forward_length(tbptt_length)
+            .t_bptt_backward_length(tbptt_length)
+            .build())
+
+
+def char_rnn(**kwargs):
+    from ...nn.multilayer import MultiLayerNetwork
+    return MultiLayerNetwork(char_rnn_conf(**kwargs)).init()

@@ -306,8 +306,9 @@ def _infer_preprocessor(input_type, layer):
                 input_type.height, input_type.width, input_type.depth)
         return None
     if isinstance(input_type, ConvolutionalInputType) and not cnn_layer:
-        if lt in ("dense", "output", "autoencoder", "embedding", "loss",
-                  "activation", "dropoutlayer", "vae", "rbm"):
+        # shape-agnostic layers (activation/dropout/loss) pass CNN activations
+        # through untouched — the reference returns a null preprocessor there
+        if lt in ("dense", "output", "autoencoder", "embedding", "vae", "rbm"):
             return CnnToFeedForwardPreProcessor(
                 input_type.height, input_type.width, input_type.channels)
     return None

@@ -46,6 +46,7 @@ class ComputationGraph:
         self._last_batch_size = 0
         self._jit_step = None
         self._jit_forward = {}
+        self._loop = None            # device-resident {iteration, rng}
 
     # ------------------------------------------------------------------
     def _layer_names(self):
@@ -239,13 +240,29 @@ class ComputationGraph:
     def _make_step(self):
         raw = self.make_raw_step()
 
-        def step(params, ustate, state, iteration, features, labels, fmask,
-                 lmask, rng):
+        def step(params, ustate, state, loop, features, labels, fmask, lmask):
+            # device-resident loop state (iteration counter + PRNG key):
+            # advances inside the compiled step — no per-iteration host
+            # scalar transfer or key-split dispatch (see multilayer.py)
+            rng, next_rng = jax.random.split(loop["rng"])
             batch = {"features": features, "labels": labels, "fmask": fmask,
-                     "lmask": lmask, "iteration": iteration, "rng": rng}
-            return raw(params, ustate, state, batch)
+                     "lmask": lmask, "iteration": loop["iteration"],
+                     "rng": rng}
+            p, u, s, score, _ = raw(params, ustate, state, batch)
+            new_loop = {"iteration": loop["iteration"] + 1.0, "rng": next_rng}
+            return p, u, s, score, new_loop
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def _loop_state(self):
+        if self._loop is None:
+            self._rng, k = jax.random.split(self._rng)
+            self._loop = {
+                "iteration": jnp.asarray(self.conf.iteration_count,
+                                         jnp.float32),
+                "rng": k,
+            }
+        return self._loop
 
     # ------------------------------------------------------------------
     # fit — reference ComputationGraph.fit:809
@@ -293,12 +310,10 @@ class ComputationGraph:
         self._last_batch_size = int(mds.features[0].shape[0])
         num_iterations = int(self.conf.global_conf.get("num_iterations", 1))
         for _ in range(num_iterations):
-            self._rng, step_rng = jax.random.split(self._rng)
-            it_count = jnp.asarray(self.conf.iteration_count, jnp.float32)
             (self._params, self._updater_state, self._model_state,
-             score, _) = self._jit_step(self._params, self._updater_state,
-                                        self._model_state, it_count, features,
-                                        labels, fmasks, lmasks, step_rng)
+             score, self._loop) = self._jit_step(
+                 self._params, self._updater_state, self._model_state,
+                 self._loop_state(), features, labels, fmasks, lmasks)
             self._score = score
             self.conf.iteration_count += 1
             for l in self.listeners:

@@ -62,6 +62,7 @@ class MultiLayerNetwork:
         self._jit_step = None
         self._jit_forward = {}
         self._rnn_state = None       # per-layer carried state for rnnTimeStep
+        self._loop = None            # device-resident {iteration, rng}
 
     # ------------------------------------------------------------------
     # Init — reference MultiLayerNetwork.init():398-465
@@ -210,14 +211,32 @@ class MultiLayerNetwork:
     def _make_step(self):
         raw = self.make_raw_step()
 
-        def step(params, ustate, state, iteration, features, labels, fmask,
-                 lmask, rng, carries=None):
+        def step(params, ustate, state, loop, features, labels, fmask,
+                 lmask, carries=None):
+            # `loop` = {"iteration": f32[], "rng": key} is device-resident
+            # train-loop state: the iteration counter (LR schedules) and the
+            # PRNG key advance INSIDE the compiled step, so the host never
+            # ships a scalar or splits a key per iteration (each of those is
+            # a dispatch round-trip on remote-attached TPUs).
+            rng, next_rng = jax.random.split(loop["rng"])
             batch = {"features": features, "labels": labels, "fmask": fmask,
-                     "lmask": lmask, "iteration": iteration, "rng": rng,
-                     "carries": carries}
-            return raw(params, ustate, state, batch)
+                     "lmask": lmask, "iteration": loop["iteration"],
+                     "rng": rng, "carries": carries}
+            p, u, s, score, car = raw(params, ustate, state, batch)
+            new_loop = {"iteration": loop["iteration"] + 1.0, "rng": next_rng}
+            return p, u, s, score, car, new_loop
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def _loop_state(self):
+        if getattr(self, "_loop", None) is None:
+            self._rng, k = jax.random.split(self._rng)
+            self._loop = {
+                "iteration": jnp.asarray(self.conf.iteration_count,
+                                         jnp.float32),
+                "rng": k,
+            }
+        return self._loop
 
     # ------------------------------------------------------------------
     # fit — reference MultiLayerNetwork.fit(:978)
@@ -228,8 +247,13 @@ class MultiLayerNetwork:
         if labels is not None:
             data = DataSet(data, labels, features_mask, labels_mask)
         if isinstance(data, DataSet):
-            it = ListDataSetIterator([data])
-            return self._fit_iterator(it, num_epochs)
+            # single in-memory batch: no prefetch pipeline needed (the
+            # reference's fit(DataSet) path is likewise direct)
+            if self._jit_step is None:
+                self._jit_step = self._make_step()
+            for _ in range(num_epochs):
+                self._fit_batch(data)
+            return self
         if isinstance(data, DataSetIterator):
             return self._fit_iterator(data, num_epochs)
         raise TypeError(f"Cannot fit on {type(data)}")
@@ -264,12 +288,10 @@ class MultiLayerNetwork:
         lmask = jnp.asarray(ds.labels_mask) if ds.labels_mask is not None else None
         self._last_batch_size = int(features.shape[0])
         for _ in range(num_iterations):
-            self._rng, step_rng = jax.random.split(self._rng)
-            it_count = jnp.asarray(self.conf.iteration_count, jnp.float32)
             (self._params, self._updater_state, self._model_state,
-             score, _) = self._jit_step(self._params, self._updater_state,
-                                        self._model_state, it_count, features,
-                                        labels, fmask, lmask, step_rng)
+             score, _, self._loop) = self._jit_step(
+                 self._params, self._updater_state, self._model_state,
+                 self._loop_state(), features, labels, fmask, lmask)
             self._score = score
             self.conf.iteration_count += 1
             for l in self.listeners:
@@ -304,13 +326,10 @@ class MultiLayerNetwork:
             l_seg = labels[:, t0:t0 + L] if seq_labels else labels
             fm_seg = fmask[:, t0:t0 + L] if fmask is not None else None
             lm_seg = lmask[:, t0:t0 + L] if lmask is not None else None
-            self._rng, step_rng = jax.random.split(self._rng)
-            it_count = jnp.asarray(self.conf.iteration_count, jnp.float32)
             (self._params, self._updater_state, self._model_state, score,
-             carries) = self._jit_step(self._params, self._updater_state,
-                                       self._model_state, it_count, f_seg,
-                                       l_seg, fm_seg, lm_seg, step_rng,
-                                       carries)
+             carries, self._loop) = self._jit_step(
+                 self._params, self._updater_state, self._model_state,
+                 self._loop_state(), f_seg, l_seg, fm_seg, lm_seg, carries)
             # stop gradient flow across segments (truncation) — carries are
             # fresh inputs to the next jitted call, so this is automatic.
             self._score = score

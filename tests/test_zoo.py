@@ -1,0 +1,59 @@
+"""Model zoo: ResNet-50 topology/training smoke, char-RNN TBPTT training."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models.zoo import char_rnn_conf, lenet_conf, resnet50_conf
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def test_resnet50_full_param_count():
+    conf = resnet50_conf(num_classes=1000, data_type="float32")
+    net = ComputationGraph(conf).init()
+    # canonical ResNet-50 parameter count ~25.6M (fc 1000 head);
+    # BN gamma/beta included, running stats are model state not params
+    n = net.num_params()
+    assert 25.4e6 < n < 25.8e6, n
+
+
+def test_resnet_tiny_trains():
+    conf = resnet50_conf(height=32, width=32, channels=3, num_classes=10,
+                         data_type="float32", learning_rate=1e-3,
+                         updater="sgd")
+    net = ComputationGraph(conf).init()
+    r = np.random.default_rng(0)
+    x = r.random((4, 32, 32, 3)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[r.integers(0, 10, 4)]
+    ds = DataSet(x, y)
+    # train-mode score: BN batch statistics (running stats are cold at init)
+    s0 = net.score(ds, training=True)
+    for _ in range(5):
+        net.fit(ds)
+    assert net.score(ds, training=True) < s0
+    out = np.asarray(net.output(x)[0])
+    assert out.shape == (4, 10)
+    assert np.allclose(out.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_char_rnn_tbptt_trains():
+    vocab, T, B = 12, 20, 4
+    conf = char_rnn_conf(vocab_size=vocab, hidden=16, layers=2,
+                         tbptt_length=5, learning_rate=0.05)
+    net = MultiLayerNetwork(conf).init()
+    r = np.random.default_rng(0)
+    ids = r.integers(0, vocab, (B, T + 1))
+    x = np.eye(vocab, dtype=np.float32)[ids[:, :-1]]
+    y = np.eye(vocab, dtype=np.float32)[ids[:, 1:]]
+    ds = DataSet(x, y)
+    net.fit(ds)
+    # 20 timesteps / tbptt 5 -> 4 optimizer iterations per fit
+    assert net.conf.iteration_count == 4
+    out = np.asarray(net.output(x))
+    assert out.shape == (B, T, vocab)
+
+
+def test_lenet_conf_shapes():
+    net = MultiLayerNetwork(lenet_conf()).init()
+    x = np.random.default_rng(0).random((2, 784)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 10)
