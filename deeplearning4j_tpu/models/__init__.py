@@ -1,0 +1,6 @@
+from .glove import Glove
+from .paragraphvectors import ParagraphVectors
+from .sequencevectors import SequenceVectors
+from .word2vec import Word2Vec
+
+__all__ = ["Glove", "ParagraphVectors", "SequenceVectors", "Word2Vec"]

@@ -1,0 +1,298 @@
+"""Element learning algorithms: SkipGram, CBOW — batched XLA kernels.
+
+TPU-native equivalent of reference
+models/embeddings/learning/impl/elements/{SkipGram,CBOW}.java, whose hot loop
+is the native libnd4j AggregateSkipGram kernel (SkipGram.java:258,
+exec at :164-178 — hierarchical-softmax / negative-sampling inner loop in
+C++/CUDA, hogwild-racy by design).
+
+TPU-first redesign (SURVEY.md §7.3.6): instead of hogwild per-pair updates,
+training pairs are batched on the host into index arrays and ONE jitted,
+donated XLA program per batch does gather -> closed-form word2vec gradient ->
+scatter-add. Deterministic, batched, MXU-friendly — and mathematically the
+classic word2vec SGD step:
+
+  negative sampling: for pair (c, o) with negatives n_k,
+      g_t = (label_t - sigmoid(u_t . v_c)) * lr
+      v_c     += sum_t g_t * u_t
+      u_t     += g_t * v_c
+  hierarchical softmax: same with targets = Huffman path nodes and
+      label = 1 - code  (reference Huffman semantics).
+
+Scatter collisions (same word appearing twice in a batch) accumulate via
+at[].add — equivalent to applying the updates sequentially at the same
+parameter values; at word2vec learning rates this matches hogwild-quality
+convergence (embedding-quality test in tests/test_word2vec.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# jitted update steps (module-level, cached by shape)
+# ---------------------------------------------------------------------------
+
+_CHUNK = 64   # pairs applied simultaneously inside the sequential scan
+
+
+def _chunked(arr, B):
+    import jax.numpy as jnp
+    return jnp.reshape(arr, (B // _CHUNK, _CHUNK) + arr.shape[1:])
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _sg_step(syn0, syn1, centers, targets, labels, mask, lr):
+    """One batched skip-gram update.
+
+    The reference's hogwild loop applies pairs sequentially (the sigmoid
+    saturating between updates is what keeps word2vec SGD stable); a single
+    batched scatter-add of thousands of pairs hitting the same hot word
+    overshoots. Middle ground: lax.scan over _CHUNK-sized sub-batches —
+    sequential semantics at hogwild-like granularity, deterministic, and
+    still ONE dispatch + fused XLA loop per host batch.
+
+    syn0 [V,D]; syn1 [M,D] (syn1neg or HS syn1); centers [B];
+    targets [B,T] indices into syn1; labels [B,T] in {0,1};
+    mask [B,T] valid flags; lr scalar."""
+    import jax.numpy as jnp
+    from jax import lax
+    B = centers.shape[0]
+
+    def chunk_update(carry, inp):
+        s0, s1 = carry
+        c, t, l, m = inp
+        v = s0[c]                                       # [C,D]
+        u = s1[t]                                       # [C,T,D]
+        logits = jnp.einsum("ctd,cd->ct", u, v)
+        g = (l - _sigmoid(logits)) * m * lr             # [C,T]
+        dv = jnp.einsum("ct,ctd->cd", g, u)
+        du = g[..., None] * v[:, None, :]
+        s0 = s0.at[c].add(dv)
+        s1 = s1.at[t.reshape(-1)].add(du.reshape(-1, du.shape[-1]))
+        return (s0, s1), 0.0
+
+    xs = (_chunked(centers, B), _chunked(targets, B),
+          _chunked(labels, B), _chunked(mask, B))
+    (syn0, syn1), _ = lax.scan(chunk_update, (syn0, syn1), xs)
+    return syn0, syn1
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_step(syn0, syn1, context, cmask, targets, labels, tmask, lr):
+    """One batched CBOW update: h = mean(context vectors) predicts targets.
+    Sequential _CHUNK-sized sub-batches via lax.scan, as in _sg_step.
+    context [B,C] ids (-1 padded), cmask [B,C]; targets/labels/tmask [B,T]."""
+    import jax.numpy as jnp
+    from jax import lax
+    B = context.shape[0]
+
+    def chunk_update(carry, inp):
+        s0, s1 = carry
+        ctx_ids, cm, t, l, tm = inp
+        ctx = jnp.maximum(ctx_ids, 0)
+        vc = s0[ctx] * cm[..., None]                    # [C,W,D]
+        counts = jnp.maximum(jnp.sum(cm, axis=1, keepdims=True), 1.0)
+        h = jnp.sum(vc, axis=1) / counts                # [C,D]
+        u = s1[t]                                       # [C,T,D]
+        logits = jnp.einsum("ctd,cd->ct", u, h)
+        g = (l - _sigmoid(logits)) * tm * lr
+        dh = jnp.einsum("ct,ctd->cd", g, u)             # [C,D]
+        du = g[..., None] * h[:, None, :]
+        # distribute dh to every context word (classic word2vec neu1e path)
+        dctx = dh[:, None, :] * cm[..., None]
+        s0 = s0.at[ctx.reshape(-1)].add(dctx.reshape(-1, dctx.shape[-1]))
+        s1 = s1.at[t.reshape(-1)].add(du.reshape(-1, du.shape[-1]))
+        return (s0, s1), 0.0
+
+    xs = (_chunked(context, B), _chunked(cmask, B), _chunked(targets, B),
+          _chunked(labels, B), _chunked(tmask, B))
+    (syn0, syn1), _ = lax.scan(chunk_update, (syn0, syn1), xs)
+    return syn0, syn1
+
+
+def _sigmoid(x):
+    import jax.numpy as jnp
+    return 1.0 / (1.0 + jnp.exp(-jnp.clip(x, -6.0, 6.0)))  # MAX_EXP=6 as in word2vec
+
+
+# ---------------------------------------------------------------------------
+# Host-side batch builders + algorithm classes
+# ---------------------------------------------------------------------------
+
+class BaseElementsLearning:
+    """Shared batching machinery. Subclasses emit (center, context) training
+    pairs; this class turns them into padded index arrays and runs the jitted
+    step."""
+
+    def __init__(self, batch_pairs=4096):
+        self.batch_pairs = int(batch_pairs)
+        self.lookup = None
+        self.vocab = None
+        self.window = 5
+        self.negative = 0
+        self.use_hs = True
+        self._max_code_len = 1
+        self._rng = np.random.default_rng(0)
+        self._syn0 = None
+        self._syn1 = None   # whichever of syn1 / syn1neg is in use
+
+    def configure(self, vocab, lookup, *, window=5, negative=0, use_hs=True,
+                  seed=12345):
+        import jax
+        self.vocab = vocab
+        self.lookup = lookup
+        self.window = int(window)
+        self.negative = int(negative)
+        self.use_hs = bool(use_hs) and lookup.syn1 is not None
+        self._rng = np.random.default_rng(seed)
+        if self.use_hs:
+            self._max_code_len = max(
+                (len(w.codes) for w in vocab.vocab_words()), default=1)
+        self._syn0 = jax.device_put(lookup.syn0)
+        if self.use_hs:
+            self._syn1 = jax.device_put(lookup.syn1)
+        else:
+            self._syn1 = jax.device_put(lookup.syn1neg)
+        self._codes = None
+        self._points = None
+        if self.use_hs:
+            V = len(vocab)
+            L = self._max_code_len
+            self._codes = np.zeros((V, L), np.float32)
+            self._points = np.zeros((V, L), np.int32)
+            self._code_mask = np.zeros((V, L), np.float32)
+            for w in vocab.vocab_words():
+                l = len(w.codes)
+                self._codes[w.index, :l] = w.codes
+                self._points[w.index, :l] = w.points
+                self._code_mask[w.index, :l] = 1.0
+        self._pending = []
+        return self
+
+    def finish(self):
+        """Flush pending pairs and write weights back to the lookup table."""
+        self._flush(force=True)
+        self.lookup.syn0 = np.asarray(self._syn0)
+        if self.use_hs:
+            self.lookup.syn1 = np.asarray(self._syn1)
+        else:
+            self.lookup.syn1neg = np.asarray(self._syn1)
+
+    # -- pair -> target/label arrays ------------------------------------
+    def _targets_labels(self, out_ids):
+        """out_ids [B]: the predicted word per pair. Returns
+        (targets [B,T], labels [B,T], mask [B,T])."""
+        B = len(out_ids)
+        out_ids = np.asarray(out_ids, np.int32)
+        if self.use_hs:
+            targets = self._points[out_ids]
+            labels = 1.0 - self._codes[out_ids]
+            mask = self._code_mask[out_ids]
+            return targets, labels.astype(np.float32), mask
+        K = self.negative
+        neg = self.lookup.neg_table[
+            self._rng.integers(0, self.lookup.table_size, (B, K))]
+        targets = np.concatenate([out_ids[:, None], neg], axis=1)
+        labels = np.zeros((B, K + 1), np.float32)
+        labels[:, 0] = 1.0
+        mask = np.ones((B, K + 1), np.float32)
+        # negatives that collide with the positive are masked out
+        mask[:, 1:] = (neg != out_ids[:, None]).astype(np.float32)
+        return targets.astype(np.int32), labels, mask
+
+
+class SkipGram(BaseElementsLearning):
+    """reference: learning/impl/elements/SkipGram.java"""
+
+    name = "skipgram"
+
+    def learn_sequence(self, ids, lr):
+        """ids: list of vocab indices for one sequence."""
+        w = self.window
+        n = len(ids)
+        for pos in range(n):
+            b = int(self._rng.integers(1, w + 1))
+            for off in range(-b, b + 1):
+                if off == 0:
+                    continue
+                j = pos + off
+                if 0 <= j < n:
+                    self._pending.append((ids[pos], ids[j], lr))
+        if len(self._pending) >= self.batch_pairs:
+            self._flush()
+
+    def _flush(self, force=False):
+        # run fixed-size chunks only (stable shapes -> one compiled
+        # executable); pad the forced tail with masked dummy pairs
+        B = self.batch_pairs
+        while len(self._pending) >= B or (force and self._pending):
+            chunk = self._pending[:B]
+            self._pending = self._pending[B:]
+            valid = np.zeros((B,), np.float32)
+            valid[:len(chunk)] = 1.0
+            while len(chunk) < B:
+                chunk.append((0, 0, 0.0))
+            centers = np.array([p[0] for p in chunk], np.int32)
+            outs = np.array([p[1] for p in chunk], np.int32)
+            lrs = [p[2] for p in chunk if p[2] > 0]
+            lr = float(np.mean(lrs)) if lrs else 0.0
+            targets, labels, mask = self._targets_labels(outs)
+            mask = mask * valid[:, None]
+            self._syn0, self._syn1 = _sg_step(
+                self._syn0, self._syn1, centers, targets, labels, mask,
+                np.float32(lr))
+
+
+class CBOW(BaseElementsLearning):
+    """reference: learning/impl/elements/CBOW.java"""
+
+    name = "cbow"
+
+    def __init__(self, batch_pairs=2048, cbow_mean=True):
+        super().__init__(batch_pairs)
+        self.cbow_mean = cbow_mean
+
+    def learn_sequence(self, ids, lr):
+        w = self.window
+        n = len(ids)
+        for pos in range(n):
+            b = int(self._rng.integers(1, w + 1))
+            ctx = [ids[j] for j in range(max(0, pos - b),
+                                         min(n, pos + b + 1)) if j != pos]
+            if ctx:
+                self._pending.append((ctx, ids[pos], lr))
+        if len(self._pending) >= self.batch_pairs:
+            self._flush()
+
+    def _flush(self, force=False):
+        B = self.batch_pairs
+        C = 2 * self.window   # fixed width: no per-batch re-trace
+        while len(self._pending) >= B or (force and self._pending):
+            chunk = self._pending[:B]
+            self._pending = self._pending[B:]
+            valid = np.zeros((B,), np.float32)
+            valid[:len(chunk)] = 1.0
+            while len(chunk) < B:
+                chunk.append(([0], 0, 0.0))
+            context = np.full((B, C), -1, np.int32)
+            cmask = np.zeros((B, C), np.float32)
+            for i, (ctx, _, _) in enumerate(chunk):
+                ctx = ctx[:C]
+                context[i, :len(ctx)] = ctx
+                cmask[i, :len(ctx)] = 1.0
+            cmask = cmask * valid[:, None]
+            outs = np.array([p[1] for p in chunk], np.int32)
+            lrs = [p[2] for p in chunk if p[2] > 0]
+            lr = float(np.mean(lrs)) if lrs else 0.0
+            targets, labels, tmask = self._targets_labels(outs)
+            tmask = tmask * valid[:, None]
+            self._syn0, self._syn1 = _cbow_step(
+                self._syn0, self._syn1, context, cmask, targets, labels,
+                tmask, np.float32(lr))
+
+
+ELEMENTS_LEARNING = {"skipgram": SkipGram, "cbow": CBOW}

@@ -1,0 +1,70 @@
+"""InMemoryLookupTable — the embedding weight store (syn0/syn1/syn1neg).
+
+TPU-native equivalent of reference
+models/embeddings/inmemory/InMemoryLookupTable.java: syn0 (input vectors),
+syn1 (hierarchical-softmax inner-node vectors), syn1neg (negative-sampling
+output vectors), exp table replaced by exact jnp.sigmoid, negative-sampling
+unigram^0.75 table kept device-resident (reference keeps it DeviceLocal —
+SkipGram.java:90).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class InMemoryLookupTable:
+    def __init__(self, vocab, vector_length=100, seed=12345,
+                 negative=0, use_hs=True, table_size=1 << 20):
+        self.vocab = vocab
+        self.vector_length = int(vector_length)
+        self.seed = int(seed)
+        self.negative = int(negative)
+        self.use_hs = bool(use_hs)
+        self.table_size = int(table_size)
+        self.syn0 = None
+        self.syn1 = None        # HS inner nodes
+        self.syn1neg = None     # negative sampling
+        self.neg_table = None
+
+    def reset_weights(self):
+        """reference: InMemoryLookupTable.resetWeights — syn0 uniform
+        [-0.5/dim, 0.5/dim), syn1/syn1neg zeros."""
+        V, D = len(self.vocab), self.vector_length
+        rng = np.random.default_rng(self.seed)
+        self.syn0 = ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
+        if self.use_hs:
+            self.syn1 = np.zeros((max(V - 1, 1), D), np.float32)
+        if self.negative > 0:
+            self.syn1neg = np.zeros((V, D), np.float32)
+            self._build_neg_table()
+        return self
+
+    resetWeights = reset_weights
+
+    def _build_neg_table(self):
+        """Unigram^0.75 sampling table (word2vec classic)."""
+        counts = np.array([w.count for w in self.vocab.vocab_words()],
+                         np.float64)
+        probs = counts ** 0.75
+        probs /= probs.sum()
+        cum = np.cumsum(probs)
+        self.neg_table = np.searchsorted(
+            cum, (np.arange(self.table_size) + 0.5) / self.table_size
+        ).astype(np.int32)
+
+    # -- vector access ---------------------------------------------------
+    def vector(self, word):
+        i = self.vocab.index_of(word)
+        if i < 0:
+            return None
+        return np.asarray(self.syn0[i])
+
+    def set_vector(self, word, vec):
+        i = self.vocab.index_of(word)
+        if i >= 0:
+            self.syn0[i] = np.asarray(vec, np.float32)
+
+    def get_weights(self):
+        return np.asarray(self.syn0)
+
+    getWeights = get_weights

@@ -1,0 +1,3 @@
+from .glove import Glove
+
+__all__ = ["Glove"]

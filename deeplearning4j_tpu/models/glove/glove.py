@@ -1,0 +1,187 @@
+"""GloVe — global vectors from co-occurrence statistics.
+
+TPU-native equivalent of reference models/glove/Glove.java +
+models/glove/AbstractCoOccurrences.java (1,413 LoC pkg): symmetric windowed
+co-occurrence counting with 1/distance weighting, then weighted-least-squares
+factorization  f(X_ij)(w_i . w~_j + b_i + b~_j - log X_ij)^2  trained by
+batched AdaGrad — the reference's per-pair AdaGrad loop becomes one donated
+jitted scatter-update per shuffled batch of nonzero co-occurrence entries.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from ..sequencevectors.sequence_vectors import SequenceVectors
+from ..word2vec.vocab import VocabCache
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _glove_step(state, wi, wj, logx, fx, lr):
+    """One AdaGrad batch. state = dict(W, Wc, b, bc, hW, hWc, hb, hbc);
+    wi/wj [B] indices; logx/fx [B]."""
+    import jax.numpy as jnp
+    W, Wc = state["W"], state["Wc"]
+    vi = W[wi]                    # [B,D]
+    vj = Wc[wj]
+    diff = (jnp.einsum("bd,bd->b", vi, vj)
+            + state["b"][wi] + state["bc"][wj] - logx)       # [B]
+    g = fx * diff                                            # [B]
+    gvi = g[:, None] * vj
+    gvj = g[:, None] * vi
+    gb = g
+    # AdaGrad accumulators (scatter-add of squared grads)
+    new = dict(state)
+    new["hW"] = state["hW"].at[wi].add(gvi * gvi)
+    new["hWc"] = state["hWc"].at[wj].add(gvj * gvj)
+    new["hb"] = state["hb"].at[wi].add(gb * gb)
+    new["hbc"] = state["hbc"].at[wj].add(gb * gb)
+    eps = 1e-8
+    new["W"] = W.at[wi].add(-lr * gvi / jnp.sqrt(new["hW"][wi] + eps))
+    new["Wc"] = Wc.at[wj].add(-lr * gvj / jnp.sqrt(new["hWc"][wj] + eps))
+    new["b"] = state["b"].at[wi].add(-lr * gb / jnp.sqrt(new["hb"][wi] + eps))
+    new["bc"] = state["bc"].at[wj].add(
+        -lr * gb / jnp.sqrt(new["hbc"][wj] + eps))
+    return new
+
+
+class Glove(SequenceVectors):
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._xmax = 100.0
+            self._alpha = 0.75
+            self._sym = True
+
+        def min_word_frequency(self, v):
+            self._kw["min_word_frequency"] = int(v); return self
+
+        minWordFrequency = min_word_frequency
+
+        def layer_size(self, v):
+            self._kw["vector_length"] = int(v); return self
+
+        layerSize = layer_size
+
+        def window_size(self, v):
+            self._kw["window"] = int(v); return self
+
+        windowSize = window_size
+
+        def seed(self, v):
+            self._kw["seed"] = int(v); return self
+
+        def epochs(self, v):
+            self._kw["epochs"] = int(v); return self
+
+        def learning_rate(self, v):
+            self._kw["learning_rate"] = float(v); return self
+
+        learningRate = learning_rate
+
+        def x_max(self, v):
+            self._xmax = float(v); return self
+
+        xMax = x_max
+
+        def alpha(self, v):
+            self._alpha = float(v); return self
+
+        def symmetric(self, v):
+            self._sym = bool(v); return self
+
+        def build(self):
+            g = Glove(**self._kw)
+            g.x_max = self._xmax
+            g.alpha = self._alpha
+            g.symmetric = self._sym
+            return g
+
+    def __init__(self, **kw):
+        kw.setdefault("learning_rate", 0.05)
+        super().__init__(**kw)
+        self.x_max = 100.0
+        self.alpha = 0.75
+        self.symmetric = True
+        self.batch_size = 8192
+
+    # ------------------------------------------------------------------
+    def build_cooccurrences(self, sequences):
+        """reference: AbstractCoOccurrences — windowed counts with 1/distance
+        weighting (and symmetric counting)."""
+        cooc = {}
+        w = self.window
+        for seq in sequences:
+            ids = self._sequence_ids(seq)
+            n = len(ids)
+            for i in range(n):
+                for off in range(1, w + 1):
+                    j = i + off
+                    if j >= n:
+                        break
+                    weight = 1.0 / off
+                    a, b = ids[i], ids[j]
+                    cooc[(a, b)] = cooc.get((a, b), 0.0) + weight
+                    if self.symmetric:
+                        cooc[(b, a)] = cooc.get((b, a), 0.0) + weight
+        return cooc
+
+    # ------------------------------------------------------------------
+    def fit(self, sequence_source):
+        if callable(sequence_source):
+            get_sequences = sequence_source
+        else:
+            seqs = list(sequence_source)
+            get_sequences = lambda: seqs  # noqa: E731
+        if self.vocab is None:
+            self.build_vocab(get_sequences())
+        V, D = len(self.vocab), self.vector_length
+        if V == 0:
+            raise ValueError("Empty vocabulary")
+
+        cooc = self.build_cooccurrences(get_sequences())
+        entries = np.array([(i, j, x) for (i, j), x in cooc.items()],
+                          np.float64)
+        if entries.size == 0:
+            raise ValueError("No co-occurrences found")
+        rng = np.random.default_rng(self.seed)
+        init = lambda shape: ((rng.random(shape) - 0.5) / D).astype(np.float32)  # noqa: E731
+        state = {
+            "W": init((V, D)), "Wc": init((V, D)),
+            "b": init((V,)), "bc": init((V,)),
+            "hW": np.zeros((V, D), np.float32),
+            "hWc": np.zeros((V, D), np.float32),
+            "hb": np.zeros((V,), np.float32),
+            "hbc": np.zeros((V,), np.float32),
+        }
+        state = {k: jax.device_put(v) for k, v in state.items()}
+
+        B = self.batch_size
+        n = len(entries)
+        for _epoch in range(self.epochs):
+            order = rng.permutation(n)
+            for s in range(0, n, B):
+                idx = order[s:s + B]
+                if len(idx) < B:   # pad tail (fx=0 makes pads no-ops)
+                    idx = np.concatenate([idx, np.zeros(B - len(idx), int)])
+                    pad_valid = np.zeros(B, np.float32)
+                    pad_valid[:len(order[s:s + B])] = 1.0
+                else:
+                    pad_valid = np.ones(B, np.float32)
+                batch = entries[idx]
+                wi = batch[:, 0].astype(np.int32)
+                wj = batch[:, 1].astype(np.int32)
+                x = batch[:, 2]
+                fx = (np.minimum(x / self.x_max, 1.0) ** self.alpha
+                      ).astype(np.float32) * pad_valid
+                logx = np.log(np.maximum(x, 1e-12)).astype(np.float32)
+                state = _glove_step(state, wi, wj, logx, fx,
+                                    np.float32(self.learning_rate))
+
+        from ..embeddings.lookup_table import InMemoryLookupTable
+        self.lookup = InMemoryLookupTable(self.vocab, D, seed=self.seed)
+        # final vectors: W + Wc (GloVe paper / reference convention)
+        self.lookup.syn0 = np.asarray(state["W"]) + np.asarray(state["Wc"])
+        return self

@@ -1,0 +1,3 @@
+from .paragraph_vectors import ParagraphVectors
+
+__all__ = ["ParagraphVectors"]

@@ -1,0 +1,3 @@
+from .sequence_vectors import SequenceVectors
+
+__all__ = ["SequenceVectors"]

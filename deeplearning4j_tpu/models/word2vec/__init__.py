@@ -1,0 +1,12 @@
+from .vocab import VocabCache, VocabWord, build_huffman
+
+__all__ = ["VocabCache", "VocabWord", "Word2Vec", "build_huffman"]
+
+
+def __getattr__(name):
+    # lazy: word2vec.py imports SequenceVectors, which imports .vocab from
+    # this package — a direct import here would be circular
+    if name == "Word2Vec":
+        from .word2vec import Word2Vec
+        return Word2Vec
+    raise AttributeError(name)

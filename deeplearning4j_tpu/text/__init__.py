@@ -1,0 +1,18 @@
+from .sentence_iterator import (BasicLineIterator, CollectionSentenceIterator,
+                                FileSentenceIterator, LabelAwareIterator,
+                                LabelAwareListSentenceIterator, LabelsSource,
+                                SentenceIterator)
+from .tokenization import (CommonPreprocessor, DefaultTokenizerFactory,
+                           EndingPreProcessor, LowCasePreProcessor,
+                           NGramTokenizerFactory, TokenPreProcess, Tokenizer,
+                           TokenizerFactory)
+from .vectorizers import BagOfWordsVectorizer, TfidfVectorizer
+
+__all__ = [
+    "BagOfWordsVectorizer", "BasicLineIterator", "CollectionSentenceIterator",
+    "CommonPreprocessor", "DefaultTokenizerFactory", "EndingPreProcessor",
+    "FileSentenceIterator", "LabelAwareIterator",
+    "LabelAwareListSentenceIterator", "LabelsSource", "LowCasePreProcessor",
+    "NGramTokenizerFactory", "SentenceIterator", "TfidfVectorizer",
+    "TokenPreProcess", "Tokenizer", "TokenizerFactory",
+]

@@ -1,0 +1,221 @@
+"""NLP tests: vocab/Huffman, Word2Vec (HS + negative sampling, skipgram +
+CBOW) embedding quality, ParagraphVectors, GloVe, serializer round-trips,
+tokenizers, vectorizers. Mirrors the reference's convergence-and-similarity
+test pattern (models/paragraphvectors tests, SURVEY.md §4.7)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import Glove, ParagraphVectors, Word2Vec
+from deeplearning4j_tpu.models.embeddings import serializer as WVS
+from deeplearning4j_tpu.models.word2vec.vocab import (VocabCache,
+                                                      build_huffman)
+from deeplearning4j_tpu.text import (CollectionSentenceIterator,
+                                     CommonPreprocessor,
+                                     DefaultTokenizerFactory,
+                                     NGramTokenizerFactory, TfidfVectorizer)
+from deeplearning4j_tpu.text.vectorizers import BagOfWordsVectorizer
+
+
+ANIMALS = ["cat", "dog", "pet", "fur", "tail", "paw", "claw", "kitten",
+           "puppy", "whisker", "leash", "collar"]
+VEHICLES = ["car", "truck", "road", "wheel", "engine", "tire", "brake",
+            "gear", "fuel", "driver", "lane", "horn"]
+
+
+def _toy_corpus(n_repeat=150, seed=0):
+    """Two topic clusters. Words within a cluster co-occur; across clusters
+    they never do. (Vocab large enough that the Huffman tree has depth —
+    hierarchical softmax cannot separate a handful of words.)"""
+    rng = np.random.default_rng(seed)
+    seqs = []
+    for _ in range(n_repeat):
+        seqs.append(list(rng.choice(ANIMALS, 6, replace=False)))
+        seqs.append(list(rng.choice(VEHICLES, 6, replace=False)))
+    return seqs
+
+
+def _check_clusters(model):
+    # intra-cluster similarity must dominate inter-cluster
+    intra = model.similarity("cat", "dog")
+    inter = model.similarity("cat", "car")
+    assert intra > inter + 0.2, (intra, inter)
+    nearest = model.words_nearest("cat", top_n=4)
+    assert set(nearest) <= set(ANIMALS), nearest
+
+
+class TestVocab:
+    def test_vocab_ordering_and_counts(self):
+        v = VocabCache()
+        for w in ["b", "a", "a", "c", "a", "b"]:
+            v.add_token(w)
+        v.finish()
+        assert v.word_at_index(0) == "a"
+        assert v.word_frequency("a") == 3
+        assert v.index_of("zzz") == -1
+        assert len(v) == 3
+
+    def test_min_frequency_filter(self):
+        v = VocabCache()
+        for w in ["a"] * 5 + ["b"] * 2 + ["rare"]:
+            v.add_token(w)
+        v.finish(min_word_frequency=2)
+        assert "rare" not in v
+        assert len(v) == 2
+
+    def test_huffman_codes_prefix_free(self):
+        v = VocabCache()
+        rng = np.random.default_rng(0)
+        for i in range(50):
+            v.add_token(f"w{i}", int(rng.integers(1, 100)))
+        v.finish()
+        build_huffman(v)
+        codes = {tuple(w.codes) for w in v.vocab_words()}
+        assert len(codes) == 50
+        # prefix-free: no code is a prefix of another
+        as_strings = sorted("".join(map(str, c)) for c in codes)
+        for a, b in zip(as_strings, as_strings[1:]):
+            assert not b.startswith(a)
+        # frequent words get shorter codes
+        words = v.vocab_words()
+        assert len(words[0].codes) <= len(words[-1].codes)
+
+
+class TestWord2Vec:
+    def test_skipgram_hs(self):
+        w2v = (Word2Vec.Builder().layer_size(24).window_size(3).seed(7)
+               .min_word_frequency(1).learning_rate(0.05)
+               .epochs(8).use_hierarchic_softmax(True).build())
+        w2v.fit(_toy_corpus())
+        _check_clusters(w2v)
+
+    def test_skipgram_negative_sampling(self):
+        w2v = (Word2Vec.Builder().layer_size(24).window_size(3).seed(7)
+               .min_word_frequency(1).learning_rate(0.05)
+               .epochs(8).negative_sample(5).build())
+        w2v.fit(_toy_corpus())
+        _check_clusters(w2v)
+
+    def test_cbow(self):
+        w2v = (Word2Vec.Builder().layer_size(24).window_size(3).seed(7)
+               .elements_learning_algorithm("cbow")
+               .learning_rate(0.05).epochs(10)
+               .negative_sample(5).build())
+        w2v.fit(_toy_corpus())
+        _check_clusters(w2v)
+
+    def test_sentence_iterator_path(self):
+        sentences = [" ".join(s) for s in _toy_corpus(60)]
+        w2v = (Word2Vec.Builder().layer_size(16).window_size(3).seed(3)
+               .epochs(8).learning_rate(0.05)
+               .iterate(CollectionSentenceIterator(sentences))
+               .tokenizer_factory(DefaultTokenizerFactory())
+               .build())
+        w2v.fit()
+        assert w2v.has_word("cat")
+        assert w2v.get_word_vector("cat").shape == (16,)
+
+
+class TestParagraphVectors:
+    def test_dbow_document_clusters(self):
+        corpus = _toy_corpus(60)
+        docs = [(f"DOC_{i}", toks) for i, toks in enumerate(corpus)]
+        pv = (ParagraphVectors.Builder().layer_size(24).seed(7)
+              .learning_rate(0.05).epochs(25).negative_sample(5)
+              .sequence_learning_algorithm("dbow").build())
+        pv.fit(docs)
+        # even-index docs are animal docs, odd are vehicle docs
+        va0 = pv.get_label_vector("DOC_0")
+        va2 = pv.get_label_vector("DOC_2")
+        vv1 = pv.get_label_vector("DOC_1")
+        from deeplearning4j_tpu.models.embeddings.model_utils import cosine_sim
+        assert cosine_sim(va0, va2) > cosine_sim(va0, vv1) + 0.15
+
+    def test_dm_and_infer(self):
+        corpus = _toy_corpus(60)
+        docs = [(f"DOC_{i}", toks) for i, toks in enumerate(corpus)]
+        pv = (ParagraphVectors.Builder().layer_size(24).seed(7)
+              .learning_rate(0.05).epochs(6).negative_sample(5)
+              .sequence_learning_algorithm("dm").build())
+        pv.fit(docs)
+        inferred = pv.infer_vector(["cat", "dog", "pet"])
+        assert inferred.shape == (24,)
+        from deeplearning4j_tpu.models.embeddings.model_utils import cosine_sim
+        sim_animal = cosine_sim(inferred, pv.get_word_vector("fur"))
+        sim_vehicle = cosine_sim(inferred, pv.get_word_vector("wheel"))
+        assert sim_animal > sim_vehicle
+
+
+class TestGlove:
+    def test_glove_clusters(self):
+        g = (Glove.Builder().layer_size(24).window_size(3).seed(7)
+             .learning_rate(0.1).epochs(25).build())
+        g.fit(_toy_corpus())
+        _check_clusters(g)
+
+
+class TestSerializer:
+    def _model(self):
+        w2v = (Word2Vec.Builder().layer_size(12).window_size(3).seed(7)
+               .epochs(4).learning_rate(0.05).build())
+        return w2v.fit(_toy_corpus(40))
+
+    def test_text_round_trip(self, tmp_path):
+        m = self._model()
+        p = str(tmp_path / "vec.txt")
+        WVS.write_word2vec_text(m, p)
+        m2 = WVS.read_word2vec_text(p)
+        assert np.allclose(m2.get_word_vector("cat"),
+                           m.get_word_vector("cat"), atol=1e-5)
+        assert m2.words_nearest("cat", 2) == m.words_nearest("cat", 2)
+
+    def test_binary_round_trip(self, tmp_path):
+        m = self._model()
+        p = str(tmp_path / "vec.bin")
+        WVS.write_word2vec_binary(m, p)
+        m2 = WVS.read_word2vec_binary(p)
+        assert np.allclose(m2.get_word_vector("dog"),
+                           m.get_word_vector("dog"), atol=1e-6)
+
+    def test_full_model_round_trip(self, tmp_path):
+        m = self._model()
+        p = str(tmp_path / "model.zip")
+        WVS.write_full_model(m, p)
+        m2 = WVS.read_full_model(p)
+        assert np.allclose(m2.get_word_vector("cat"),
+                           m.get_word_vector("cat"))
+        assert m2.vocab.word_frequency("cat") == m.vocab.word_frequency("cat")
+        assert m2.lookup.syn1 is not None  # HS weights preserved
+
+
+class TestTextPipeline:
+    def test_default_tokenizer_and_preprocessor(self):
+        tf = DefaultTokenizerFactory()
+        tf.set_token_pre_processor(CommonPreprocessor())
+        toks = tf.create("Hello, World! 123 foo.").get_tokens()
+        assert toks == ["hello", "world", "foo"]
+
+    def test_ngram_tokenizer(self):
+        tf = NGramTokenizerFactory(min_n=1, max_n=2)
+        toks = tf.create("a b c").get_tokens()
+        assert toks == ["a", "b", "c", "a b", "b c"]
+
+    def test_bow_and_tfidf(self):
+        docs = ["cat dog cat", "dog truck", "truck road truck"]
+        bow = BagOfWordsVectorizer()
+        X = bow.fit_transform(docs)
+        assert X.shape == (3, len(bow.vocab))
+        ci = bow.vocab.index_of("cat")
+        assert X[0, ci] == 2.0
+        tfidf = TfidfVectorizer()
+        Xt = tfidf.fit_transform(docs)
+        # 'cat' appears in 1/3 docs -> positive idf; present only in doc 0
+        assert Xt[0, tfidf.vocab.index_of("cat")] > 0
+        assert Xt[1, tfidf.vocab.index_of("cat")] == 0
+
+    def test_dataset_vectorize(self):
+        docs = ["cat dog", "truck road"]
+        bow = BagOfWordsVectorizer()
+        bow.fit(docs)
+        ds = bow.vectorize(docs, labels=["animal", "vehicle"])
+        assert ds.features.shape[0] == 2
+        assert ds.labels.shape == (2, 2)
