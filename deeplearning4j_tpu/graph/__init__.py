@@ -1,0 +1,6 @@
+from .deepwalk import DeepWalk
+from .graph import Edge, Graph, GraphLoader, Vertex
+from .walks import RandomWalkIterator, WeightedRandomWalkIterator
+
+__all__ = ["DeepWalk", "Edge", "Graph", "GraphLoader", "RandomWalkIterator",
+           "Vertex", "WeightedRandomWalkIterator"]

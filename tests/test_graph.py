@@ -1,0 +1,98 @@
+"""Graph module: adjacency graph, loaders, random walks, DeepWalk
+embeddings. Mirrors reference deeplearning4j-graph tests (walk coverage,
+DeepWalk similarity structure)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (DeepWalk, Graph, GraphLoader,
+                                      RandomWalkIterator,
+                                      WeightedRandomWalkIterator)
+
+
+def _two_cliques(k=6):
+    """Two k-cliques joined by a single bridge edge."""
+    g = Graph(2 * k)
+    for base in (0, k):
+        for i in range(k):
+            for j in range(i + 1, k):
+                g.add_edge(base + i, base + j)
+    g.add_edge(0, k)  # bridge
+    return g
+
+
+class TestGraph:
+    def test_adjacency_and_degree(self):
+        g = Graph(4)
+        g.add_edge(0, 1)
+        g.add_edge(1, 2, directed=True)
+        assert set(g.get_connected_vertex_indices(0)) == {1}
+        assert set(g.get_connected_vertex_indices(1)) == {0, 2}
+        assert g.get_connected_vertex_indices(2) == []  # directed edge
+        assert g.degree(1) == 2
+
+    def test_edge_list_loader(self, tmp_path):
+        p = tmp_path / "edges.csv"
+        p.write_text("0,1\n1,2,2.5\n# comment\n2,3\n")
+        g = GraphLoader.load_undirected_graph_edge_list_file(str(p), 4)
+        assert g.degree(1) == 2
+        assert g.get_edges_out(1)[1].weight == 2.5
+
+    def test_adjacency_list_loader(self, tmp_path):
+        p = tmp_path / "adj.txt"
+        p.write_text("0,1,2\n1,0\n2\n")
+        g = GraphLoader.load_adjacency_list_file(str(p))
+        assert set(g.get_connected_vertex_indices(0)) == {1, 2}
+        assert g.get_connected_vertex_indices(2) == []
+
+
+class TestWalks:
+    def test_walk_shape_and_coverage(self):
+        g = _two_cliques()
+        it = RandomWalkIterator(g, walk_length=8, seed=1)
+        walks = list(it)
+        assert len(walks) == g.num_vertices()
+        assert all(len(w) == 8 for w in walks)
+        # every walk starts at its vertex and follows edges
+        for start, w in enumerate(walks):
+            assert w[0] == start
+            for a, b in zip(w, w[1:]):
+                assert b in g.get_connected_vertex_indices(a) or a == b
+
+    def test_disconnected_self_loop(self):
+        g = Graph(2)   # no edges at all
+        walks = list(RandomWalkIterator(g, walk_length=4))
+        assert walks[0] == [0, 0, 0, 0]
+
+    def test_weighted_walk_bias(self):
+        g = Graph(3, allow_multiple_edges=False)
+        g.add_edge(0, 1, weight=100.0, directed=True)
+        g.add_edge(0, 2, weight=0.01, directed=True)
+        it = WeightedRandomWalkIterator(g, walk_length=2, seed=3)
+        firsts = []
+        for _ in range(30):
+            it.reset()
+            firsts.append(it.next()[1])
+        assert firsts.count(1) > 25   # heavy edge dominates
+
+
+class TestDeepWalk:
+    def test_clique_structure(self):
+        g = _two_cliques()
+        dw = (DeepWalk.Builder().vector_size(16).window_size(3)
+              .learning_rate(0.05).seed(7).epochs(10).build())
+        dw.fit(g, walk_length=10)
+        # same-clique vertices more similar than cross-clique (non-bridge)
+        intra = dw.similarity(1, 2)
+        inter = dw.similarity(1, 8)
+        assert intra > inter, (intra, inter)
+        assert dw.get_vertex_vector(0).shape == (16,)
+
+    def test_save_load_round_trip(self, tmp_path):
+        g = _two_cliques()
+        dw = (DeepWalk.Builder().vector_size(8).seed(7).epochs(3).build())
+        dw.fit(g, walk_length=6)
+        p = str(tmp_path / "gv.json")
+        dw.save(p)
+        dw2 = DeepWalk.load(p)
+        assert np.allclose(dw2.get_vertex_vector(3), dw.get_vertex_vector(3))
+        assert dw2.num_vertices == dw.num_vertices
