@@ -1,0 +1,284 @@
+"""Keras 1.x model import (JSON topology + HDF5 weights).
+
+TPU-native equivalent of reference deeplearning4j-modelimport:
+KerasModelImport (KerasModelImport.java:85-250), KerasModel/
+KerasSequentialModel (KerasModel.java:57), per-layer mapping (KerasLayer.java,
+1,111 LoC). The reference reads HDF5 through JavaCPP; here h5py plays that
+role.
+
+Supported layers (the reference's set, KerasLayer.java): Dense,
+Convolution2D, MaxPooling2D, AveragePooling2D, LSTM, Embedding,
+BatchNormalization, Activation, Dropout, Flatten, Reshape, ZeroPadding2D,
+Merge (sequential path treats structural layers as preprocessor hints).
+
+Dim-ordering: Keras 1 'th' (NCHW) and 'tf' (NHWC) are both handled; since
+this framework is NHWC-native, 'th' conv kernels are transposed
+OIHW -> HWIO and the first post-Flatten Dense has its rows permuted from
+CHW to HWC order (the reference does the same NCHW bookkeeping in
+KerasModel.copyWeights).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..nn.conf.input_type import InputType
+from ..nn.conf.layers import (ActivationLayer, BatchNormalization,
+                              ConvolutionLayer, DenseLayer, DropoutLayer,
+                              EmbeddingLayer, GravesLSTM, LossLayer,
+                              OutputLayer, SubsamplingLayer, ZeroPaddingLayer)
+from ..nn.conf.neural_net_configuration import NeuralNetConfiguration
+
+_ACTIVATION_MAP = {
+    "linear": "identity", "relu": "relu", "tanh": "tanh",
+    "sigmoid": "sigmoid", "softmax": "softmax", "softplus": "softplus",
+    "softsign": "softsign", "hard_sigmoid": "hardsigmoid", "elu": "elu",
+}
+
+
+def _map_activation(name):
+    if name not in _ACTIVATION_MAP:
+        raise ValueError(f"Unsupported Keras activation '{name}'")
+    return _ACTIVATION_MAP[name]
+
+
+# ---------------------------------------------------------------------------
+# Public API — reference KerasModelImport.java
+# ---------------------------------------------------------------------------
+
+def import_keras_sequential_model_and_weights(h5_path):
+    """Read a Keras 1.x sequential model saved via model.save(): topology from
+    the `model_config` attribute, weights from `model_weights`.
+    reference: KerasModelImport.importKerasSequentialModelAndWeights."""
+    import h5py
+    with h5py.File(h5_path, "r") as f:
+        cfg = f.attrs["model_config"]
+        if isinstance(cfg, bytes):
+            cfg = cfg.decode("utf-8")
+        model_cfg = json.loads(cfg)
+        weights = _read_weight_groups(f["model_weights"]
+                                      if "model_weights" in f else f)
+    return _build_sequential(model_cfg, weights)
+
+
+importKerasSequentialModelAndWeights = import_keras_sequential_model_and_weights
+
+
+def import_keras_model_configuration(json_path_or_str):
+    """Topology-only import (no weights).
+    reference: KerasModelImport.importKerasModelConfiguration."""
+    s = json_path_or_str
+    if not s.lstrip().startswith("{"):
+        with open(s, "r", encoding="utf-8") as fh:
+            s = fh.read()
+    model_cfg = json.loads(s)
+    return _build_sequential(model_cfg, weights=None, conf_only=True)
+
+
+importKerasModelConfiguration = import_keras_model_configuration
+
+
+def _read_weight_groups(g):
+    """layer-name -> list of arrays, in `weight_names` attribute order."""
+    out = {}
+    for lname in g:
+        grp = g[lname]
+        if "weight_names" in grp.attrs:
+            names = [n.decode() if isinstance(n, bytes) else n
+                     for n in grp.attrs["weight_names"]]
+            out[lname] = [np.asarray(grp[n]) for n in names]
+        else:
+            out[lname] = [np.asarray(grp[d]) for d in sorted(grp)]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sequential build
+# ---------------------------------------------------------------------------
+
+def _build_sequential(model_cfg, weights, conf_only=False):
+    if model_cfg.get("class_name") != "Sequential":
+        raise ValueError(
+            f"Expected Sequential model, got {model_cfg.get('class_name')} "
+            "(functional Model import: use the ComputationGraph path)")
+    layer_cfgs = model_cfg["config"]
+    if isinstance(layer_cfgs, dict):   # keras 2 style {"layers": [...]}
+        layer_cfgs = layer_cfgs["layers"]
+
+    builder = (NeuralNetConfiguration.Builder().seed(12345).list())
+    input_type, dim_ordering = _input_type_of(layer_cfgs[0])
+
+    mapped = []        # (our LayerConf or None, keras cfg)
+    flatten_perm = []  # indices of our-layers needing th->HWC row permute
+    pending_flatten_shape = None
+    idx = 0
+    for lc in layer_cfgs:
+        cls = lc["class_name"]
+        cfg = lc["config"]
+        layer, is_structural = _map_layer(cls, cfg, dim_ordering)
+        if cls == "Flatten":
+            pending_flatten_shape = "flatten"
+            mapped.append((None, lc))
+            continue
+        if layer is None:
+            mapped.append((None, lc))
+            continue
+        if (pending_flatten_shape and isinstance(layer, DenseLayer)
+                and dim_ordering == "th"):
+            flatten_perm.append(idx)
+        pending_flatten_shape = None
+        builder.layer(idx, layer)
+        mapped.append((layer, lc))
+        idx += 1
+
+    builder.set_input_type(input_type)
+    conf = builder.build()
+    from ..nn.multilayer import MultiLayerNetwork
+    net = MultiLayerNetwork(conf)
+    if conf_only:
+        return conf
+    net.init()
+    if weights is not None:
+        _copy_weights(net, mapped, weights, flatten_perm, conf)
+    return net
+
+
+def _input_type_of(first_layer_cfg):
+    cfg = first_layer_cfg["config"]
+    shape = cfg.get("batch_input_shape")
+    dim_ordering = cfg.get("dim_ordering", "tf")
+    if shape is None:
+        raise ValueError("First layer has no batch_input_shape")
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0]), dim_ordering
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1]), dim_ordering
+    if len(dims) == 3:
+        if dim_ordering == "th":   # (C, H, W)
+            c, h, w = dims
+        else:                      # (H, W, C)
+            h, w, c = dims
+        return InputType.convolutional(h, w, c), dim_ordering
+    raise ValueError(f"Unsupported input shape {shape}")
+
+
+def _map_layer(cls, cfg, dim_ordering):
+    """Keras layer config -> our LayerConf (or None for structural layers).
+    reference: KerasLayer layer-by-layer mapping."""
+    act = cfg.get("activation", "linear")
+    if cls == "Dense":
+        return DenseLayer(n_out=int(cfg["output_dim"]),
+                          activation=_map_activation(act)), False
+    if cls in ("Convolution2D", "Conv2D"):
+        return ConvolutionLayer(
+            n_out=int(cfg["nb_filter"]),
+            kernel_size=(int(cfg["nb_row"]), int(cfg["nb_col"])),
+            stride=tuple(cfg.get("subsample", (1, 1))),
+            convolution_mode=("same" if cfg.get("border_mode") == "same"
+                              else "truncate"),
+            activation=_map_activation(act)), False
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        return SubsamplingLayer(
+            pooling_type="max" if cls.startswith("Max") else "avg",
+            kernel_size=tuple(cfg.get("pool_size", (2, 2))),
+            stride=tuple(cfg.get("strides") or cfg.get("pool_size", (2, 2))),
+            convolution_mode=("same" if cfg.get("border_mode") == "same"
+                              else "truncate")), False
+    if cls == "LSTM":
+        return GravesLSTM(n_out=int(cfg["output_dim"]),
+                          activation=_map_activation(act),
+                          gate_activation=_map_activation(
+                              cfg.get("inner_activation", "hard_sigmoid")),
+                          forget_gate_bias_init=0.0), False
+    if cls == "Embedding":
+        return EmbeddingLayer(n_in=int(cfg["input_dim"]),
+                              n_out=int(cfg["output_dim"]),
+                              activation="identity"), False
+    if cls == "BatchNormalization":
+        return BatchNormalization(eps=float(cfg.get("epsilon", 1e-5)),
+                                  decay=float(cfg.get("momentum", 0.99))), False
+    if cls == "Activation":
+        return ActivationLayer(activation=_map_activation(act)), False
+    if cls == "Dropout":
+        # Keras p = drop probability; ours = retain probability
+        return DropoutLayer(dropout=1.0 - float(cfg.get("p", 0.5))), False
+    if cls == "ZeroPadding2D":
+        return ZeroPaddingLayer(pad=tuple(cfg.get("padding", (1, 1)))), False
+    if cls in ("Flatten", "Reshape", "InputLayer"):
+        return None, True
+    raise ValueError(f"Unsupported Keras layer type '{cls}'")
+
+
+def _copy_weights(net, mapped, weights, flatten_perm, conf):
+    """Copy Keras weight arrays into the net's param pytree.
+    reference: KerasModel.copyWeights (name mapping KerasModel.java:76-99)."""
+    import jax.numpy as jnp
+
+    our_idx = 0
+    params = [dict(p) for p in net._params]
+    state = [dict(s) for s in net._model_state]
+    prev_cnn_shape = None   # (C,H,W) before the most recent Flatten (th)
+    cur_type = conf.input_type
+    for layer, lc in mapped:
+        cls = lc["class_name"]
+        name = lc["config"].get("name", "")
+        if layer is None:
+            if cls == "Flatten":
+                from ..nn.conf.input_type import ConvolutionalInputType
+                if isinstance(cur_type, ConvolutionalInputType):
+                    prev_cnn_shape = (cur_type.channels, cur_type.height,
+                                      cur_type.width)
+            continue
+        w = weights.get(name, [])
+        if cls == "Dense" and w:
+            W, b = w[0], w[1]
+            if our_idx in flatten_perm and prev_cnn_shape is not None:
+                c, h, hw = prev_cnn_shape
+                # rows are CHW-ordered (th flatten); ours flatten HWC
+                W = (W.reshape(c, h, hw, -1).transpose(1, 2, 0, 3)
+                     .reshape(c * h * hw, -1))
+            params[our_idx]["W"] = jnp.asarray(W)
+            params[our_idx]["b"] = jnp.asarray(b.ravel())
+        elif cls in ("Convolution2D", "Conv2D") and w:
+            W, b = w[0], w[1]
+            # th stores OIHW; we are HWIO-native (tf ordering matches).
+            # Trust the layer's dim_ordering; fall back to a shape check
+            # when it is absent (square kernels can be ambiguous).
+            do = lc["config"].get("dim_ordering")
+            th = (do == "th" if do is not None
+                  else (W.shape[0] == layer.n_out
+                        and W.shape[-1] != layer.n_out))
+            if th:
+                W = W.transpose(2, 3, 1, 0)
+            params[our_idx]["W"] = jnp.asarray(W)
+            params[our_idx]["b"] = jnp.asarray(b.ravel())
+        elif cls == "LSTM" and w:
+            # Keras 1 order: W_i U_i b_i, W_c U_c b_c, W_f U_f b_f, W_o U_o b_o
+            (Wi, Ui, bi, Wc, Uc, bc, Wf, Uf, bf, Wo, Uo, bo) = w
+            # our gate order: a(=c), i, f, o
+            W = np.concatenate([Wc, Wi, Wf, Wo], axis=1)
+            RW = np.concatenate([Uc, Ui, Uf, Uo], axis=1)
+            b = np.concatenate([bc, bi, bf, bo])
+            params[our_idx]["W"] = jnp.asarray(W)
+            params[our_idx]["RW"] = jnp.asarray(RW)
+            params[our_idx]["b"] = jnp.asarray(b)
+            # peepholes stay zero (Keras LSTM has none)
+        elif cls == "Embedding" and w:
+            params[our_idx]["W"] = jnp.asarray(w[0])
+            params[our_idx]["b"] = jnp.zeros((layer.n_out,), jnp.float32)
+        elif cls == "BatchNormalization" and w:
+            gamma, beta, mean, var = w[0], w[1], w[2], w[3]
+            params[our_idx]["gamma"] = jnp.asarray(gamma)
+            params[our_idx]["beta"] = jnp.asarray(beta)
+            state[our_idx] = {"mean": jnp.asarray(mean),
+                              "var": jnp.asarray(np.abs(var))}
+        cur_type = layer.get_output_type(cur_type) if layer else cur_type
+        our_idx += 1
+    net._params = params
+    net._model_state = state
+
+
+def dim_ordering_of(lc):
+    return lc["config"].get("dim_ordering", "tf")

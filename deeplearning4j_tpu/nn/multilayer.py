@@ -359,8 +359,12 @@ class MultiLayerNetwork:
                 p = jax.tree.map(lambda a: a.astype(self.compute_dtype)
                                  if jnp.issubdtype(a.dtype, jnp.floating) else a,
                                  params[i])
-                return out_layer.forward(p, h, train=train,
-                                         rng=jax.random.fold_in(rng, i))
+                lrng = jax.random.fold_in(rng, i)
+                if out_layer.has_state():
+                    out, _ = out_layer.forward_with_state(
+                        p, h, state[i], train=train, rng=lrng)
+                    return out
+                return out_layer.forward(p, h, train=train, rng=lrng)
             self._jit_forward[key] = jax.jit(fwd)
         self._rng, rng = jax.random.split(self._rng)
         return self._jit_forward[key](self._params, self._model_state, x,

@@ -1,0 +1,177 @@
+"""Keras 1.x import: synthetic HDF5 models verified against manual numpy
+forward passes (the reference's pattern: import then assert output equality,
+modelimport ModelConfigurationTest/KerasLayerTest)."""
+import json
+
+import h5py
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.keras import (import_keras_model_configuration,
+                                      import_keras_sequential_model_and_weights)
+
+
+def _write_model(path, layer_cfgs, weights):
+    """weights: dict layer_name -> list[(suffix, array)]."""
+    cfg = {"class_name": "Sequential",
+           "config": [{"class_name": c, "config": k}
+                      for c, k in layer_cfgs]}
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(cfg).encode("utf-8")
+        mw = f.create_group("model_weights")
+        for lname, arrs in weights.items():
+            g = mw.create_group(lname)
+            names = []
+            for suffix, arr in arrs:
+                n = f"{lname}_{suffix}"
+                g.create_dataset(n, data=np.asarray(arr, np.float32))
+                names.append(n.encode())
+            g.attrs["weight_names"] = names
+
+
+def test_dense_mlp_output_matches_numpy(tmp_path):
+    rng = np.random.default_rng(0)
+    W1 = rng.standard_normal((4, 8)).astype(np.float32)
+    b1 = rng.standard_normal(8).astype(np.float32)
+    W2 = rng.standard_normal((8, 3)).astype(np.float32)
+    b2 = rng.standard_normal(3).astype(np.float32)
+    p = str(tmp_path / "mlp.h5")
+    _write_model(
+        p,
+        [("Dense", {"name": "d1", "output_dim": 8, "activation": "relu",
+                    "batch_input_shape": [None, 4]}),
+         ("Dense", {"name": "d2", "output_dim": 3,
+                    "activation": "softmax"})],
+        {"d1": [("W", W1), ("b", b1)], "d2": [("W", W2), ("b", b2)]})
+    net = import_keras_sequential_model_and_weights(p)
+    x = rng.standard_normal((5, 4)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    h = np.maximum(x @ W1 + b1, 0)
+    z = h @ W2 + b2
+    want = np.exp(z - z.max(1, keepdims=True))
+    want /= want.sum(1, keepdims=True)
+    assert np.allclose(got, want, atol=1e-5)
+
+
+def test_conv_th_ordering_matches_numpy(tmp_path):
+    rng = np.random.default_rng(1)
+    C, H, W = 2, 8, 8
+    F, KH, KW = 3, 3, 3
+    Wc = rng.standard_normal((F, C, KH, KW)).astype(np.float32)  # OIHW (th)
+    bc = rng.standard_normal(F).astype(np.float32)
+    OH, OW = H - KH + 1, W - KW + 1
+    PH, PW = OH // 2, OW // 2
+    Wd = rng.standard_normal((F * PH * PW, 4)).astype(np.float32)  # CHW rows
+    bd = rng.standard_normal(4).astype(np.float32)
+    p = str(tmp_path / "conv.h5")
+    _write_model(
+        p,
+        [("Convolution2D", {"name": "c1", "nb_filter": F, "nb_row": KH,
+                            "nb_col": KW, "activation": "relu",
+                            "dim_ordering": "th", "border_mode": "valid",
+                            "batch_input_shape": [None, C, H, W]}),
+         ("MaxPooling2D", {"name": "p1", "pool_size": [2, 2],
+                           "strides": [2, 2], "dim_ordering": "th"}),
+         ("Flatten", {"name": "f1"}),
+         ("Dense", {"name": "d1", "output_dim": 4,
+                    "activation": "identity" if False else "linear"})],
+        {"c1": [("W", Wc), ("b", bc)], "d1": [("W", Wd), ("b", bd)]})
+    net = import_keras_sequential_model_and_weights(p)
+
+    x_nchw = rng.standard_normal((2, C, H, W)).astype(np.float32)
+    # manual NCHW forward
+    conv = np.zeros((2, F, OH, OW), np.float32)
+    for n in range(2):
+        for f in range(F):
+            for i in range(OH):
+                for j in range(OW):
+                    conv[n, f, i, j] = (
+                        x_nchw[n, :, i:i + KH, j:j + KW] * Wc[f]).sum() + bc[f]
+    conv = np.maximum(conv, 0)
+    pool = conv[:, :, :PH * 2, :PW * 2].reshape(2, F, PH, 2, PW, 2).max((3, 5))
+    flat = pool.reshape(2, -1)        # CHW order
+    want = flat @ Wd + bd
+
+    x_nhwc = x_nchw.transpose(0, 2, 3, 1)
+    got = np.asarray(net.output(x_nhwc))
+    assert np.allclose(got, want, atol=1e-3), np.abs(got - want).max()
+
+
+def test_lstm_matches_numpy(tmp_path):
+    rng = np.random.default_rng(2)
+    nin, H = 3, 5
+    mk = lambda *s: rng.standard_normal(s).astype(np.float32) * 0.5
+    Wi, Ui, bi = mk(nin, H), mk(H, H), mk(H)
+    Wc, Uc, bc = mk(nin, H), mk(H, H), mk(H)
+    Wf, Uf, bf = mk(nin, H), mk(H, H), mk(H)
+    Wo, Uo, bo = mk(nin, H), mk(H, H), mk(H)
+    p = str(tmp_path / "lstm.h5")
+    _write_model(
+        p,
+        [("LSTM", {"name": "l1", "output_dim": H, "activation": "tanh",
+                   "inner_activation": "hard_sigmoid",
+                   "batch_input_shape": [None, 6, nin]}),
+         ("Dense", {"name": "d1", "output_dim": 2, "activation": "linear"})],
+        {"l1": [("W_i", Wi), ("U_i", Ui), ("b_i", bi),
+                ("W_c", Wc), ("U_c", Uc), ("b_c", bc),
+                ("W_f", Wf), ("U_f", Uf), ("b_f", bf),
+                ("W_o", Wo), ("U_o", Uo), ("b_o", bo)],
+         "d1": [("W", mk(H, 2)), ("b", mk(2))]})
+    net = import_keras_sequential_model_and_weights(p)
+
+    x = rng.standard_normal((2, 6, nin)).astype(np.float32)
+    hs = lambda v: np.clip(0.2 * v + 0.5, 0, 1)
+    h = np.zeros((2, H), np.float32)
+    c = np.zeros((2, H), np.float32)
+    for t in range(6):
+        xt = x[:, t]
+        i = hs(xt @ Wi + h @ Ui + bi)
+        f = hs(xt @ Wf + h @ Uf + bf)
+        a = np.tanh(xt @ Wc + h @ Uc + bc)
+        c = f * c + i * a
+        o = hs(xt @ Wo + h @ Uo + bo)
+        h = o * np.tanh(c)
+    Wd = net._params[1]["W"]
+    bd = net._params[1]["b"]
+    want = h @ np.asarray(Wd) + np.asarray(bd)
+    got = np.asarray(net.output(x))[:, -1]
+    assert np.allclose(got, want, atol=1e-4), np.abs(got - want).max()
+
+
+def test_batchnorm_inference_stats(tmp_path):
+    rng = np.random.default_rng(3)
+    gamma = rng.standard_normal(4).astype(np.float32)
+    beta = rng.standard_normal(4).astype(np.float32)
+    mean = rng.standard_normal(4).astype(np.float32)
+    var = np.abs(rng.standard_normal(4)).astype(np.float32) + 0.5
+    p = str(tmp_path / "bn.h5")
+    _write_model(
+        p,
+        [("BatchNormalization", {"name": "bn", "epsilon": 1e-5,
+                                 "batch_input_shape": [None, 4]})],
+        {"bn": [("gamma", gamma), ("beta", beta),
+                ("running_mean", mean), ("running_std", var)]})
+    net = import_keras_sequential_model_and_weights(p)
+    x = rng.standard_normal((6, 4)).astype(np.float32)
+    want = gamma * (x - mean) / np.sqrt(var + 1e-5) + beta
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, want, atol=1e-5)
+
+
+def test_config_only_import():
+    cfg = {"class_name": "Sequential", "config": [
+        {"class_name": "Dense",
+         "config": {"name": "d", "output_dim": 7, "activation": "tanh",
+                    "batch_input_shape": [None, 3]}}]}
+    conf = import_keras_model_configuration(json.dumps(cfg))
+    assert conf.layers[0].n_out == 7
+    assert conf.layers[0].n_in == 3
+    assert conf.layers[0].activation == "tanh"
+
+
+def test_unsupported_layer_raises():
+    cfg = {"class_name": "Sequential", "config": [
+        {"class_name": "Lambda",
+         "config": {"name": "x", "batch_input_shape": [None, 3]}}]}
+    with pytest.raises(ValueError, match="Unsupported Keras layer"):
+        import_keras_model_configuration(json.dumps(cfg))
