@@ -1,0 +1,8 @@
+from .server import UIServer
+from .stats import StatsListener, StatsUpdateConfiguration
+from .storage import (FileStatsStorage, InMemoryStatsStorage,
+                      RemoteUIStatsStorageRouter, StatsStorageRouter)
+
+__all__ = ["FileStatsStorage", "InMemoryStatsStorage",
+           "RemoteUIStatsStorageRouter", "StatsListener",
+           "StatsStorageRouter", "StatsUpdateConfiguration", "UIServer"]

@@ -1,0 +1,155 @@
+"""UIServer — training visualization web server.
+
+TPU-native equivalent of reference deeplearning4j-play PlayUIServer
+(api/UIServer.java:38 — UIServer.getInstance().attach(statsStorage)): a
+stdlib http.server replaces the Play framework. Pages: train overview
+(score chart, perf, memory, model info) rendered client-side from the JSON
+API; a remote-receiver endpoint accepts POSTed reports from
+RemoteUIStatsStorageRouter (reference module/remote/RemoteReceiverModule).
+
+Endpoints:
+  GET  /                     overview page (HTML + inline JS chart)
+  GET  /api/sessions         session ids
+  GET  /api/static/<id>      static info
+  GET  /api/updates/<id>     all updates
+  POST /remoteReceive/static remote static info
+  POST /remoteReceive/update remote update
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>DL4J-TPU Training UI</title>
+<style>
+ body{font-family:sans-serif;margin:2em;background:#fafafa}
+ .card{background:#fff;border:1px solid #ddd;border-radius:6px;
+       padding:1em;margin-bottom:1em}
+ h1{font-size:1.3em} h2{font-size:1.05em;color:#333}
+ table{border-collapse:collapse} td,th{padding:2px 10px;text-align:left}
+ svg{width:100%;height:260px}
+</style></head><body>
+<h1>Training overview</h1>
+<div class="card"><h2>Score vs iteration</h2><svg id="chart"></svg></div>
+<div class="card"><h2>Performance</h2><div id="perf"></div></div>
+<div class="card"><h2>Model</h2><pre id="model"></pre></div>
+<script>
+async function refresh(){
+ const sessions = await (await fetch('/api/sessions')).json();
+ if(!sessions.length) return;
+ const sid = sessions[sessions.length-1];
+ const ups = await (await fetch('/api/updates/'+sid)).json();
+ const st = await (await fetch('/api/static/'+sid)).json();
+ if(st && st.model) document.getElementById('model').textContent =
+   st.model.class+': '+st.model.numParams+' params on '+st.machine.device;
+ if(!ups.length) return;
+ const last = ups[ups.length-1];
+ document.getElementById('perf').innerHTML =
+  '<table><tr><th>iteration</th><td>'+last.iteration+'</td></tr>'+
+  '<tr><th>score</th><td>'+(last.score||0).toFixed(5)+'</td></tr>'+
+  '<tr><th>examples/sec</th><td>'+(last.examplesPerSecond||0).toFixed(1)+
+  '</td></tr><tr><th>minibatches/sec</th><td>'+
+  (last.minibatchesPerSecond||0).toFixed(2)+'</td></tr></table>';
+ const pts = ups.filter(u=>u.score!==undefined)
+               .map(u=>[u.iteration,u.score]);
+ const svg = document.getElementById('chart');
+ const W = svg.clientWidth, H = svg.clientHeight, pad=30;
+ const xs = pts.map(p=>p[0]), ys = pts.map(p=>p[1]);
+ const xmin=Math.min(...xs), xmax=Math.max(...xs)||1;
+ const ymin=Math.min(...ys), ymax=Math.max(...ys)||1;
+ const X=x=>pad+(x-xmin)/(xmax-xmin||1)*(W-2*pad);
+ const Y=y=>H-pad-(y-ymin)/(ymax-ymin||1)*(H-2*pad);
+ svg.innerHTML = '<polyline fill="none" stroke="#06c" stroke-width="1.5" '+
+  'points="'+pts.map(p=>X(p[0])+','+Y(p[1])).join(' ')+'"/>'+
+  '<text x="'+pad+'" y="12" font-size="11">'+ymax.toFixed(4)+'</text>'+
+  '<text x="'+pad+'" y="'+(H-8)+'" font-size="11">'+ymin.toFixed(4)+'</text>';
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    storage = None
+
+    def log_message(self, *a):   # silence request logging
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        s = self.storage
+        if self.path in ("/", "/train", "/train/overview"):
+            body = _PAGE.encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/api/sessions":
+            self._json(s.list_session_ids() if s else [])
+        elif self.path.startswith("/api/static/"):
+            self._json(s.get_static_info(self.path.split("/")[-1]) or {})
+        elif self.path.startswith("/api/updates/"):
+            self._json(s.get_all_updates(self.path.split("/")[-1]))
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(n) or b"{}")
+        if self.path == "/remoteReceive/static":
+            self.storage.put_static_info(payload)
+            self._json({"ok": True})
+        elif self.path == "/remoteReceive/update":
+            self.storage.put_update(payload)
+            self._json({"ok": True})
+        else:
+            self._json({"error": "not found"}, 404)
+
+
+class UIServer:
+    """reference: api/UIServer.java — getInstance().attach(statsStorage)."""
+
+    _instance = None
+
+    def __init__(self, port=9000):
+        self.port = int(port)
+        self._httpd = None
+        self._thread = None
+        self.storage = None
+
+    @classmethod
+    def get_instance(cls, port=9000):
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    getInstance = get_instance
+
+    def attach(self, storage):
+        self.storage = storage
+        handler = type("BoundHandler", (_Handler,), {"storage": storage})
+        if self._httpd is None:
+            self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                              handler)
+            self.port = self._httpd.server_address[1]
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True)
+            self._thread.start()
+        else:
+            self._httpd.RequestHandlerClass = handler
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+        UIServer._instance = None

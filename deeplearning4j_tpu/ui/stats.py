@@ -1,0 +1,163 @@
+"""Stats collection: StatsListener + report model.
+
+TPU-native equivalent of reference ui-model
+stats/BaseStatsListener.java:43 (iterationDone:273-420): per-iteration score,
+timing, examples/sec, memory, learning rates, and per-parameter summary
+statistics (mean/stdev/mean-magnitude) + histograms of params/gradients/
+updates. The SBE wire encoding is replaced by plain dict reports (JSON-able);
+routing/storage in ui/storage.py.
+
+TPU note: param statistics require device->host transfers, which are
+expensive on remote-attached chips — the collection frequency and the
+histogram toggle exist for exactly that reason (the reference has the same
+knobs in StatsUpdateConfiguration).
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..optimize.listeners import IterationListener
+
+
+class StatsUpdateConfiguration:
+    """reference: ui-model api/StatsUpdateConfiguration.java"""
+
+    def __init__(self, collect_score=True, collect_timing=True,
+                 collect_memory=True, collect_learning_rates=True,
+                 collect_histograms=False, histogram_bins=20,
+                 collect_mean=True, collect_stdev=True,
+                 collect_mean_magnitudes=True, report_frequency=1):
+        self.collect_score = collect_score
+        self.collect_timing = collect_timing
+        self.collect_memory = collect_memory
+        self.collect_learning_rates = collect_learning_rates
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = int(histogram_bins)
+        self.collect_mean = collect_mean
+        self.collect_stdev = collect_stdev
+        self.collect_mean_magnitudes = collect_mean_magnitudes
+        self.report_frequency = max(1, int(report_frequency))
+
+
+def _summary(arr, bins=None):
+    a = np.asarray(arr, np.float64).ravel()
+    out = {"mean": float(a.mean()) if a.size else 0.0,
+           "stdev": float(a.std()) if a.size else 0.0,
+           "meanMagnitude": float(np.abs(a).mean()) if a.size else 0.0}
+    if bins:
+        counts, edges = np.histogram(a, bins=bins)
+        out["histogram"] = {"counts": counts.tolist(),
+                            "min": float(edges[0]), "max": float(edges[-1])}
+    return out
+
+
+class StatsListener(IterationListener):
+    """reference: ui-model stats/BaseStatsListener.java"""
+
+    def __init__(self, router_or_storage, config=None, session_id=None,
+                 worker_id="worker_0"):
+        self.router = router_or_storage
+        self.config = config or StatsUpdateConfiguration()
+        self.session_id = session_id or f"session_{int(time.time() * 1000)}"
+        self.worker_id = worker_id
+        self._last_report_time = None
+        self._total_examples = 0
+        self._total_minibatches = 0
+        self._init_sent = False
+        self._start_time = time.time()
+
+    # ------------------------------------------------------------------
+    def iteration_done(self, model, iteration):
+        c = self.config
+        now = time.time()
+        self._total_minibatches += 1
+        self._total_examples += getattr(model, "_last_batch_size", 0)
+        if iteration % c.report_frequency != 0:
+            return
+        if not self._init_sent:
+            self.router.put_static_info(self._static_info(model))
+            self._init_sent = True
+
+        report = {"sessionId": self.session_id, "workerId": self.worker_id,
+                  "timestamp": int(now * 1000), "iteration": int(iteration)}
+        if c.collect_score:
+            report["score"] = float(model.score())
+        if c.collect_timing:
+            if self._last_report_time is not None:
+                dt = now - self._last_report_time
+                report["iterationTimeMs"] = dt * 1000.0 * c.report_frequency
+            total_dt = max(now - self._start_time, 1e-9)
+            report["totalRuntimeMs"] = total_dt * 1000.0
+            report["examplesPerSecond"] = self._total_examples / total_dt
+            report["minibatchesPerSecond"] = self._total_minibatches / total_dt
+            report["totalExamples"] = self._total_examples
+            report["totalMinibatches"] = self._total_minibatches
+            self._last_report_time = now
+        if c.collect_memory:
+            report["memory"] = self._memory_info()
+        if c.collect_learning_rates:
+            report["learningRates"] = self._learning_rates(model)
+        if c.collect_mean or c.collect_stdev or c.collect_histograms:
+            bins = c.histogram_bins if c.collect_histograms else None
+            report["parameters"] = {
+                name: _summary(arr, bins)
+                for name, arr in self._param_arrays(model)}
+        self.router.put_update(report)
+
+    # ------------------------------------------------------------------
+    def _static_info(self, model):
+        import platform
+
+        import jax
+        dev = jax.devices()[0]
+        return {
+            "sessionId": self.session_id,
+            "workerId": self.worker_id,
+            "startTime": int(self._start_time * 1000),
+            "machine": {"hostname": platform.node(),
+                        "os": platform.system(),
+                        "backend": dev.platform,
+                        "device": str(dev)},
+            "model": {"class": type(model).__name__,
+                      "numParams": int(model.num_params()),
+                      "configJson": model.conf.to_json()},
+        }
+
+    def _memory_info(self):
+        import jax
+        out = {}
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            out["deviceBytesInUse"] = int(stats.get("bytes_in_use", 0))
+            out["deviceBytesLimit"] = int(stats.get("bytes_limit", 0))
+        except Exception:
+            pass
+        try:
+            import resource
+            out["hostMaxRssKb"] = int(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+        except Exception:
+            pass
+        return out
+
+    def _learning_rates(self, model):
+        out = {}
+        layers = (model.layers if hasattr(model, "layers")
+                  else [s.conf for s in model.conf.vertices.values()
+                        if s.is_layer])
+        for i, l in enumerate(layers):
+            out[getattr(l, "name", None) or str(i)] = float(
+                l.learning_rate or 0.0)
+        return out
+
+    def _param_arrays(self, model):
+        if isinstance(model._params, dict):     # ComputationGraph
+            for name, p in model._params.items():
+                for k, v in p.items():
+                    yield f"{name}_{k}", np.asarray(v)
+        else:                                   # MultiLayerNetwork
+            for i, p in enumerate(model._params):
+                for k, v in p.items():
+                    yield f"{i}_{k}", np.asarray(v)

@@ -1,0 +1,149 @@
+"""StatsStorage backends + routing.
+
+TPU-native equivalent of reference ui-model storage/: the StatsStorage API
+(sessions, static infos, updates, listeners for live UI push),
+InMemoryStatsStorage, FileStatsStorage (JSON-lines replacing MapDB/SQLite),
+and RemoteUIStatsStorageRouter (HTTP POST of reports to a remote UI server —
+deeplearning4j-core api/storage/impl/RemoteUIStatsStorageRouter.java).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.request
+
+
+class StatsStorageRouter:
+    """Write-side interface (reference: api/storage/StatsStorageRouter.java)."""
+
+    def put_static_info(self, info):
+        raise NotImplementedError
+
+    def put_update(self, update):
+        raise NotImplementedError
+
+    putStaticInfo = put_static_info
+    putUpdate = put_update
+
+
+class BaseStatsStorage(StatsStorageRouter):
+    """Read side (what the UI consumes) + listener push.
+    reference: api/storage/StatsStorage.java."""
+
+    def __init__(self):
+        self._static = {}        # session -> info
+        self._updates = {}       # session -> list[update]
+        self._listeners = []
+        self._lock = threading.Lock()
+
+    # -- write ----------------------------------------------------------
+    def put_static_info(self, info):
+        with self._lock:
+            self._static[info["sessionId"]] = info
+        self._notify("static", info)
+
+    def put_update(self, update):
+        with self._lock:
+            self._updates.setdefault(update["sessionId"], []).append(update)
+        self._notify("update", update)
+
+    # -- read -----------------------------------------------------------
+    def list_session_ids(self):
+        with self._lock:
+            return sorted(set(self._static) | set(self._updates))
+
+    listSessionIDs = list_session_ids
+
+    def get_static_info(self, session_id):
+        return self._static.get(session_id)
+
+    getStaticInfo = get_static_info
+
+    def get_all_updates(self, session_id):
+        with self._lock:
+            return list(self._updates.get(session_id, []))
+
+    getAllUpdates = get_all_updates
+
+    def get_latest_update(self, session_id):
+        ups = self._updates.get(session_id)
+        return ups[-1] if ups else None
+
+    getLatestUpdate = get_latest_update
+
+    # -- listeners ------------------------------------------------------
+    def register_stats_storage_listener(self, fn):
+        self._listeners.append(fn)
+
+    registerStatsStorageListener = register_stats_storage_listener
+
+    def _notify(self, kind, payload):
+        for fn in self._listeners:
+            try:
+                fn(kind, payload)
+            except Exception:
+                pass
+
+
+class InMemoryStatsStorage(BaseStatsStorage):
+    """reference: ui-model storage/InMemoryStatsStorage.java"""
+
+
+class FileStatsStorage(BaseStatsStorage):
+    """JSON-lines persistence (one record per line, replayed on open) —
+    stands in for the reference's FileStatsStorage/MapDB/SQLite backends."""
+
+    def __init__(self, path):
+        super().__init__()
+        self.path = str(path)
+        if os.path.exists(self.path):
+            self._replay()
+
+    def _replay(self):
+        with open(self.path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if rec["kind"] == "static":
+                    super().put_static_info(rec["data"])
+                else:
+                    super().put_update(rec["data"])
+
+    def _append(self, kind, data):
+        with open(self.path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps({"kind": kind, "data": data}) + "\n")
+
+    def put_static_info(self, info):
+        self._append("static", info)
+        super().put_static_info(info)
+
+    def put_update(self, update):
+        self._append("update", update)
+        super().put_update(update)
+
+
+class RemoteUIStatsStorageRouter(StatsStorageRouter):
+    """POST reports to a remote UI server (reference:
+    deeplearning4j-core RemoteUIStatsStorageRouter — used by cluster workers
+    to route stats to the driver-side UI)."""
+
+    def __init__(self, url, timeout=5.0):
+        self.url = url.rstrip("/")
+        self.timeout = float(timeout)
+
+    def _post(self, endpoint, payload):
+        data = json.dumps(payload).encode("utf-8")
+        req = urllib.request.Request(
+            f"{self.url}{endpoint}", data=data,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.status
+
+    def put_static_info(self, info):
+        self._post("/remoteReceive/static", info)
+
+    def put_update(self, update):
+        self._post("/remoteReceive/update", update)

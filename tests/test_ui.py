@@ -1,0 +1,107 @@
+"""UI/stats: StatsListener collection, storage backends (memory/file),
+remote router -> UIServer round trip, overview page served.
+Mirrors reference ui-model TestStatsClasses / storage tests."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   RemoteUIStatsStorageRouter, StatsListener,
+                                   StatsUpdateConfiguration, UIServer)
+
+
+def _net():
+    conf = (NeuralNetConfiguration.Builder().seed(1)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, DenseLayer(n_out=8, activation="relu"))
+            .layer(1, OutputLayer(n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _ds(n=16):
+    r = np.random.default_rng(0)
+    return DataSet(r.random((n, 4)).astype(np.float32),
+                   np.eye(3, dtype=np.float32)[r.integers(0, 3, n)])
+
+
+def test_stats_listener_collects_reports():
+    storage = InMemoryStatsStorage()
+    net = _net()
+    net.set_listeners(StatsListener(
+        storage, StatsUpdateConfiguration(collect_histograms=True,
+                                          histogram_bins=10)))
+    ds = _ds()
+    for _ in range(5):
+        net.fit(ds)
+    sessions = storage.list_session_ids()
+    assert len(sessions) == 1
+    sid = sessions[0]
+    static = storage.get_static_info(sid)
+    assert static["model"]["class"] == "MultiLayerNetwork"
+    assert static["model"]["numParams"] == net.num_params()
+    ups = storage.get_all_updates(sid)
+    assert len(ups) == 5
+    last = ups[-1]
+    assert "score" in last and np.isfinite(last["score"])
+    assert last["totalExamples"] == 5 * 16
+    assert "0_W" in last["parameters"]
+    p = last["parameters"]["0_W"]
+    assert {"mean", "stdev", "meanMagnitude", "histogram"} <= set(p)
+    assert sum(p["histogram"]["counts"]) == 4 * 8
+
+
+def test_file_storage_replay(tmp_path):
+    path = str(tmp_path / "stats.jsonl")
+    storage = FileStatsStorage(path)
+    net = _net()
+    net.set_listeners(StatsListener(storage, session_id="s1"))
+    net.fit(_ds())
+    # reopen -> replay from disk
+    storage2 = FileStatsStorage(path)
+    assert storage2.list_session_ids() == ["s1"]
+    assert len(storage2.get_all_updates("s1")) == 1
+    assert storage2.get_static_info("s1")["model"]["class"] == \
+        "MultiLayerNetwork"
+
+
+def test_ui_server_and_remote_router():
+    storage = InMemoryStatsStorage()
+    server = UIServer(port=0).attach(storage)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        # remote router posts into the server
+        router = RemoteUIStatsStorageRouter(base)
+        router.put_static_info({"sessionId": "remote1", "model": {
+            "class": "MultiLayerNetwork", "numParams": 1},
+            "machine": {"device": "test"}})
+        router.put_update({"sessionId": "remote1", "iteration": 0,
+                           "score": 1.5})
+        with urllib.request.urlopen(f"{base}/api/sessions") as r:
+            assert json.load(r) == ["remote1"]
+        with urllib.request.urlopen(f"{base}/api/updates/remote1") as r:
+            ups = json.load(r)
+        assert ups[0]["score"] == 1.5
+        with urllib.request.urlopen(base + "/") as r:
+            page = r.read().decode()
+        assert "Training overview" in page
+    finally:
+        server.stop()
+
+
+def test_listener_events_push():
+    storage = InMemoryStatsStorage()
+    events = []
+    storage.register_stats_storage_listener(
+        lambda kind, payload: events.append(kind))
+    net = _net()
+    net.set_listeners(StatsListener(storage))
+    net.fit(_ds())
+    assert events == ["static", "update"]
