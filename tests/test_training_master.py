@@ -1,0 +1,93 @@
+"""Distributed training APIs on the virtual 8-device mesh:
+ParameterAveragingTrainingMaster split/average semantics, facade, stats
+timeline, async parameter-server wrapper. Mirrors reference dl4j-spark tests
+run on a local-mode cluster (BaseSparkTest pattern)."""
+import json
+
+import numpy as np
+
+from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import (ParameterAveragingTrainingMaster,
+                                         ParameterServerParallelWrapper,
+                                         TpuDl4jMultiLayer)
+
+
+def _net(seed=7):
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, DenseLayer(n_out=16, activation="relu"))
+            .layer(1, OutputLayer(n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=256, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.random((n, 5)).astype(np.float32)
+    w = r.random((5, 3))
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return DataSet(x, y)
+
+
+def test_training_master_trains_and_records_stats():
+    net = _net()
+    tm = (ParameterAveragingTrainingMaster.Builder(batch_size_per_worker=8)
+          .workers(4).averaging_frequency(2).collect_training_stats(True)
+          .build())
+    ds = _data()
+    s0 = net.score(ds)
+    master = TpuDl4jMultiLayer(net, tm)
+    master.fit(ds, num_epochs=3)
+    assert net.score(ds) < s0
+    phases = {e["phase"] for e in tm.stats.events}
+    assert phases == {"split", "fit"}
+    assert tm.stats.phase_total("fit") > 0
+
+
+def test_training_master_iterator_and_eval():
+    net = _net()
+    tm = (ParameterAveragingTrainingMaster.Builder(batch_size_per_worker=8)
+          .workers(2).averaging_frequency(2).build())
+    batches = list(_data().batch_by(64))
+    master = TpuDl4jMultiLayer(net, tm)
+    master.fit(ListDataSetIterator(batches), num_epochs=3)
+    ev = master.evaluate(list(_data(128, seed=9).batch_by(64)))
+    assert ev.accuracy() > 0.5
+
+
+def test_training_master_json_round_trip():
+    tm = (ParameterAveragingTrainingMaster.Builder(batch_size_per_worker=32)
+          .workers(4).averaging_frequency(3).build())
+    d = json.loads(tm.to_json())
+    tm2 = ParameterAveragingTrainingMaster.from_json(tm.to_json())
+    assert tm2.batch_size == 32
+    assert tm2.averaging_frequency == 3
+    assert d["type"] == "ParameterAveragingTrainingMaster"
+
+
+def test_stats_html_export(tmp_path):
+    net = _net()
+    tm = (ParameterAveragingTrainingMaster.Builder(batch_size_per_worker=8)
+          .workers(2).averaging_frequency(1).collect_training_stats(True)
+          .build())
+    TpuDl4jMultiLayer(net, tm).fit(_data(64))
+    p = tmp_path / "stats.html"
+    tm.stats.export_html(str(p))
+    assert "Training phases" in p.read_text()
+
+
+def test_parameter_server_async_training():
+    net = _net()
+    ds = _data()
+    s0 = net.score(ds)
+    psw = (ParameterServerParallelWrapper.Builder(net)
+           .workers(3).queue_size(4).build())
+    psw.fit(ListDataSetIterator(list(ds.batch_by(32))), num_epochs=3)
+    assert net.score(ds) < s0
+    # every pushed batch was applied: 8 batches * 3 epochs
+    assert net.conf.iteration_count == 24
