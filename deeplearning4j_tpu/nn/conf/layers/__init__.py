@@ -1,3 +1,4 @@
+from .attention import SelfAttentionLayer
 from .base import LAYER_REGISTRY, LayerConf, register_layer
 from .convolution import (ConvolutionLayer, GlobalPoolingLayer,
                           SubsamplingLayer, ZeroPaddingLayer)
@@ -15,4 +16,5 @@ __all__ = [
     "ConvolutionLayer", "SubsamplingLayer", "ZeroPaddingLayer",
     "GlobalPoolingLayer", "BatchNormalization", "LocalResponseNormalization",
     "BaseRecurrentLayer", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
+    "SelfAttentionLayer",
 ]

@@ -1,0 +1,88 @@
+"""Attention layers.
+
+Beyond-reference capability (SURVEY.md §5.7: the reference predates
+attention): multi-head self-attention as a first-class layer, with optional
+causal masking, and a sequence-parallel mode that runs the ring-attention
+kernel over a mesh axis (parallel/ring_attention.py) for long contexts.
+
+Layout [batch, time, features] matches the recurrent layers; the projections
+are single fused matmuls on the MXU.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ... import weights
+from ..input_type import InputType, RecurrentInputType
+from .base import LayerConf, apply_input_dropout, register_layer
+
+
+@register_layer("selfattention")
+@dataclass
+class SelfAttentionLayer(LayerConf):
+    """Multi-head self-attention: out = proj(softmax(QK^T/sqrt(d))V)."""
+    n_in: int = None
+    n_out: int = None          # model dim of the output projection
+    n_heads: int = 4
+    causal: bool = False
+    # sequence-parallel execution (set via with_sequence_parallel)
+    _mesh: object = None
+    _seq_axis: str = None
+
+    def with_sequence_parallel(self, mesh, axis="seq"):
+        """Run attention with the ring kernel sharded over mesh[axis]."""
+        self._mesh = mesh
+        self._seq_axis = axis
+        return self
+
+    def set_n_in(self, input_type, override=True):
+        if isinstance(input_type, RecurrentInputType):
+            if self.n_in is None or override:
+                self.n_in = input_type.size
+        if self.n_out is None:
+            self.n_out = self.n_in
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_out,
+                                   getattr(input_type, "time_series_length",
+                                           -1))
+
+    def init_params(self, key, dtype=jnp.float32):
+        if self.n_in % self.n_heads != 0:
+            raise ValueError(
+                f"n_in={self.n_in} not divisible by n_heads={self.n_heads}")
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        D = self.n_in
+        mk = lambda k, o: weights.init(k, (D, o), D, o,  # noqa: E731
+                                       self.weight_init or "xavier",
+                                       self.dist, dtype)
+        return {"Wq": mk(k1, D), "Wk": mk(k2, D), "Wv": mk(k3, D),
+                "Wo": mk(k4, self.n_out),
+                "b": jnp.zeros((self.n_out,), dtype)}
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None,
+                state=None):
+        from ....parallel.ring_attention import (blockwise_attention,
+                                                 ring_self_attention)
+        x = apply_input_dropout(self, x, train, rng)
+        B, T, D = x.shape
+        H = self.n_heads
+        Dh = D // H
+        q = (x @ params["Wq"]).reshape(B, T, H, Dh)
+        k = (x @ params["Wk"]).reshape(B, T, H, Dh)
+        v = (x @ params["Wv"]).reshape(B, T, H, Dh)
+        kv_mask = mask.astype(x.dtype) if mask is not None else None
+        if self._mesh is not None:
+            out = ring_self_attention(q, k, v, self._mesh,
+                                      axis=self._seq_axis,
+                                      causal=self.causal, kv_mask=kv_mask)
+        else:
+            out = blockwise_attention(q, k, v, kv_mask=kv_mask,
+                                      causal=self.causal)
+        out = out.reshape(B, T, D) @ params["Wo"] + params["b"]
+        if mask is not None:
+            out = out * mask[:, :, None].astype(out.dtype)
+        return out

@@ -1,0 +1,134 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Not present in the reference (SURVEY.md §5.7: the 2016 codebase predates
+attention; its only long-sequence mechanism is truncated BPTT). This module
+is the framework's first-class long-context path, designed TPU-native from
+the start: the sequence axis is sharded over a mesh axis; each device holds
+one Q/K/V chunk; K/V blocks rotate around the ring via `lax.ppermute` over
+ICI while a flash-attention-style running softmax (max + log-sum-exp
+accumulators) folds each block in. Peak memory per device is
+O(T_local * T_local) instead of O(T^2), and compute/communication overlap on
+the ring (the pattern of Liu et al.'s Ring Attention with Blockwise
+Transformers).
+
+`ring_self_attention(x, mesh, axis)` is the user entry: shard_map's the
+per-device kernel over the mesh; plain `blockwise_attention` is the
+single-device reference (identical math, used for equivalence tests).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _attend_block(q, k, v, bias):
+    """Scores for one (Q-chunk, K-block) pair.
+    q [B,Tq,H,D]; k,v [B,Tk,H,D]; bias [Tq,Tk] additive (0 or NEG_INF).
+    Returns (scores [B,H,Tq,Tk], values v)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    return s + bias[None, None, :, :]
+
+
+def _flash_fold(o, m, l, s, v):
+    """Fold one block's scores into running (output, max, sumexp)."""
+    m_blk = jnp.max(s, axis=-1)                        # [B,H,Tq]
+    m_new = jnp.maximum(m, m_blk)
+    scale = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])                  # [B,H,Tq,Tk]
+    l_new = l * scale + jnp.sum(p, axis=-1)
+    o_new = o * scale[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    return o_new, m_new, l_new
+
+
+def ring_attention_kernel(q, k, v, kv_mask, axis_name, causal=False,
+                          scale=None):
+    """Per-device ring attention body (run under shard_map).
+
+    q,k,v: [B, T_local, H, D] — this device's sequence chunk.
+    kv_mask: [B, T_local] validity of this chunk's keys (rotates with K/V).
+    Rotates K/V around `axis_name` N times, folding each block with the
+    running-softmax accumulators. Causal masking uses global chunk offsets.
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    q = q * scale
+
+    o0 = jnp.zeros((B, H, Tq, D), q.dtype)
+    m0 = jnp.full((B, H, Tq), NEG_INF, q.dtype)
+    l0 = jnp.zeros((B, H, Tq), q.dtype)
+    if hasattr(lax, "pvary"):
+        # constants start replicated under shard_map; the loop carry becomes
+        # axis-varying, so mark the initial accumulators varying too
+        o0, m0, l0 = lax.pvary((o0, m0, l0), (axis_name,))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    qpos = my * Tq + jnp.arange(Tq)                    # global q positions
+
+    def body(i, carry):
+        o, m, l, k_blk, v_blk, km_blk = carry
+        src = (my - i) % n                             # origin chunk of k_blk
+        kpos = src * Tq + jnp.arange(Tq)
+        if causal:
+            bias = jnp.where(qpos[:, None] >= kpos[None, :], 0.0, NEG_INF)
+        else:
+            bias = jnp.zeros((Tq, Tq))
+        s = _attend_block(q, k_blk, v_blk, bias.astype(q.dtype))
+        # invalid keys: -inf for every query, per batch element
+        s = s + jnp.where(km_blk > 0, 0.0,
+                          NEG_INF)[:, None, None, :].astype(q.dtype)
+        o, m, l = _flash_fold(o, m, l, s, v_blk)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        km_blk = lax.ppermute(km_blk, axis_name, perm)
+        return o, m, l, k_blk, v_blk, km_blk
+
+    o, m, l, _, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v, kv_mask))
+    out = o / jnp.maximum(l, 1e-30)[..., None]         # [B,H,Tq,D]
+    return jnp.transpose(out, (0, 2, 1, 3))            # [B,Tq,H,D]
+
+
+def blockwise_attention(q, k, v, kv_mask=None, causal=False, scale=None):
+    """Single-device reference with the same math (full T).
+    q,k,v: [B,T,H,D]; kv_mask [B,T] key validity."""
+    B, T, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    q = q * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    if causal:
+        pos = jnp.arange(T)
+        s = jnp.where(pos[:, None] >= pos[None, :], s, NEG_INF)
+    if kv_mask is not None:
+        s = s + jnp.where(kv_mask > 0, 0.0,
+                          NEG_INF)[:, None, None, :].astype(q.dtype)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def ring_self_attention(q, k, v, mesh, axis="seq", causal=False,
+                        kv_mask=None):
+    """Sequence-parallel attention over `mesh[axis]`.
+
+    q,k,v: GLOBAL [B,T,H,D] arrays (or already sharded); T must divide by
+    the axis size. kv_mask: [B,T] key validity. Returns global [B,T,H,D]."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    if kv_mask is None:
+        kv_mask = jnp.ones(q.shape[:2], q.dtype)
+    spec = P(None, axis, None, None)
+    mspec = P(None, axis)
+    fn = shard_map(
+        functools.partial(ring_attention_kernel, axis_name=axis,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec)
+    return fn(q, k, v, kv_mask)
