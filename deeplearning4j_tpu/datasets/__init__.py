@@ -1,0 +1,25 @@
+from .dataset import DataSet, MultiDataSet
+from .fetchers import (CifarDataSetIterator, CurvesDataSetIterator,
+                       LFWDataSetIterator)
+from .iterators import (AsyncDataSetIterator, DataSetIterator,
+                        IteratorDataSetIterator, ListDataSetIterator,
+                        MultipleEpochsIterator, SamplingDataSetIterator)
+from .mnist import MnistDataSetIterator
+from .mnist import IrisDataSetIterator
+from .normalizers import (ImagePreProcessingScaler, NormalizerMinMaxScaler,
+                          NormalizerStandardize)
+from .records import (CollectionRecordReader, CSVRecordReader,
+                      CSVSequenceRecordReader, RecordReader,
+                      RecordReaderDataSetIterator,
+                      SequenceRecordReaderDataSetIterator)
+
+__all__ = [
+    "AsyncDataSetIterator", "CSVRecordReader", "CSVSequenceRecordReader",
+    "CifarDataSetIterator", "CollectionRecordReader", "CurvesDataSetIterator",
+    "DataSet", "DataSetIterator", "ImagePreProcessingScaler",
+    "IrisDataSetIterator", "IteratorDataSetIterator", "LFWDataSetIterator",
+    "ListDataSetIterator", "MnistDataSetIterator", "MultiDataSet",
+    "MultipleEpochsIterator", "NormalizerMinMaxScaler",
+    "NormalizerStandardize", "RecordReader", "RecordReaderDataSetIterator",
+    "SamplingDataSetIterator", "SequenceRecordReaderDataSetIterator",
+]
