@@ -6,8 +6,12 @@ from .feedforward import (ActivationLayer, AutoEncoder, DenseLayer,
                           DropoutLayer, EmbeddingLayer, LossLayer, OutputLayer,
                           RnnOutputLayer)
 from .normalization import BatchNormalization, LocalResponseNormalization
+from .rbm import RBM
 from .recurrent import (BaseRecurrentLayer, GravesBidirectionalLSTM,
                         GravesLSTM, SimpleRnn)
+from .variational import (BernoulliReconstructionDistribution,
+                          GaussianReconstructionDistribution,
+                          VariationalAutoencoder)
 
 __all__ = [
     "LAYER_REGISTRY", "LayerConf", "register_layer",
@@ -16,5 +20,7 @@ __all__ = [
     "ConvolutionLayer", "SubsamplingLayer", "ZeroPaddingLayer",
     "GlobalPoolingLayer", "BatchNormalization", "LocalResponseNormalization",
     "BaseRecurrentLayer", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
-    "SelfAttentionLayer",
+    "SelfAttentionLayer", "RBM", "VariationalAutoencoder",
+    "BernoulliReconstructionDistribution",
+    "GaussianReconstructionDistribution",
 ]

@@ -1,0 +1,95 @@
+"""Restricted Boltzmann Machine layer (pretrain via contrastive divergence).
+
+TPU-native equivalent of reference nn/conf/layers/RBM.java + impl
+nn/layers/feedforward/rbm/RBM.java: binary/gaussian visible+hidden units,
+CD-k pretraining, propup as the feed-forward activation.
+
+CD gradients are not the gradient of any scalar loss, so unlike the other
+pretrain layers (autodiff of pretrain_loss) RBM supplies `pretrain_grads`
+directly — the positive/negative phase statistics of classic CD — which the
+pretraining driver applies through the layer's updater.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ... import weights
+from ..input_type import InputType
+from .base import LayerConf, register_layer
+from .feedforward import _ff_size
+
+
+@register_layer("rbm")
+@dataclass
+class RBM(LayerConf):
+    n_in: int = None
+    n_out: int = None
+    hidden_unit: str = "binary"     # binary | gaussian
+    visible_unit: str = "binary"
+    k: int = 1                      # CD-k steps
+
+    def set_n_in(self, input_type, override=True):
+        if self.n_in is None or override:
+            self.n_in = _ff_size(input_type)
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, key, dtype=jnp.float32):
+        w = weights.init(key, (self.n_in, self.n_out), self.n_in, self.n_out,
+                         self.weight_init or "xavier", self.dist, dtype)
+        return {"W": w, "b": jnp.zeros((self.n_out,), dtype),   # hidden bias
+                "vb": jnp.zeros((self.n_in,), dtype)}           # visible bias
+
+    # ------------------------------------------------------------------
+    def _prop_up(self, params, v):
+        pre = v @ params["W"] + params["b"]
+        if self.hidden_unit == "gaussian":
+            return pre
+        return jax.nn.sigmoid(pre)
+
+    def _prop_down(self, params, h):
+        pre = h @ params["W"].T + params["vb"]
+        if self.visible_unit == "gaussian":
+            return pre
+        return jax.nn.sigmoid(pre)
+
+    def _sample(self, rng, p, unit):
+        if unit == "gaussian":
+            return p + jax.random.normal(rng, p.shape, p.dtype)
+        return jax.random.bernoulli(rng, p).astype(p.dtype)
+
+    def forward(self, params, x, *, train=False, rng=None, mask=None,
+                state=None):
+        """propup — reference RBM.activate."""
+        return self._prop_up(params, x)
+
+    # ------------------------------------------------------------------
+    def pretrain_grads(self, params, v0, *, rng=None):
+        """CD-k gradients (to MINIMIZE, i.e. negative of the likelihood
+        ascent direction). Returns dict matching params."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        ph0 = self._prop_up(params, v0)
+        h = self._sample(jax.random.fold_in(rng, 0), ph0, self.hidden_unit)
+        vk, phk = v0, ph0
+        for step in range(self.k):
+            pv = self._prop_down(params, h)
+            vk = self._sample(jax.random.fold_in(rng, 2 * step + 1), pv,
+                              self.visible_unit)
+            phk = self._prop_up(params, vk)
+            h = self._sample(jax.random.fold_in(rng, 2 * step + 2), phk,
+                             self.hidden_unit)
+        B = v0.shape[0]
+        dW = (vk.T @ phk - v0.T @ ph0) / B
+        db = jnp.mean(phk - ph0, axis=0)
+        dvb = jnp.mean(vk - v0, axis=0)
+        return {"W": dW, "b": db, "vb": dvb}
+
+    def pretrain_loss(self, params, x, *, rng=None):
+        """Monitoring quantity: reconstruction error after one CD pass
+        (CD gradients themselves come from pretrain_grads)."""
+        pv = self._prop_down(params, self._prop_up(params, x))
+        return jnp.mean(jnp.sum((x - pv) ** 2, axis=-1))

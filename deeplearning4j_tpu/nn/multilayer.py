@@ -339,6 +339,78 @@ class MultiLayerNetwork:
         return self
 
     # ------------------------------------------------------------------
+    # Layerwise pretraining — reference MultiLayerNetwork.pretrain /
+    # pretrainLayer(:183): greedy unsupervised training of each pretrainable
+    # layer (AutoEncoder / RBM / VAE) on the activations from below.
+    # ------------------------------------------------------------------
+    def pretrain(self, data, num_epochs=1):
+        self._ensure_init()
+        for i, layer in enumerate(self.layers):
+            if hasattr(layer, "pretrain_loss") or hasattr(layer,
+                                                          "pretrain_grads"):
+                self.pretrain_layer(i, data, num_epochs)
+        return self
+
+    def pretrain_layer(self, i, data, num_epochs=1):
+        """One fused jitted step per batch: feed-forward to layer i (frozen),
+        unsupervised grads for layer i (autodiff of pretrain_loss, or the
+        layer's own pretrain_grads e.g. RBM contrastive divergence), updater
+        apply — all one XLA program."""
+        self._ensure_init()
+        layer = self.layers[i]
+        use_cd = hasattr(layer, "pretrain_grads")
+        if not (use_cd or hasattr(layer, "pretrain_loss")):
+            raise ValueError(f"Layer {i} ({type(layer).__name__}) is not "
+                             "pretrainable")
+        init_fn, apply_fn = U.get(layer.updater or "sgd")
+        hp = layer.updater_hp()
+        lr = layer.learning_rate or 0.1
+        ustate = {k: init_fn(v) for k, v in self._params[i].items()}
+        cdt = self.compute_dtype
+
+        def step(params, ustate, state, x, rng):
+            h, _, _ = self._apply_layers(params, state, x, train=False,
+                                         rng=rng, upto=i)
+            h = h[-1] if i > 0 and h else (
+                x.astype(cdt) if jnp.issubdtype(x.dtype, jnp.floating) else x)
+            if i in self.conf.preprocessors:
+                h = self.conf.preprocessors[i].pre_process(h)
+            p_i = jax.tree.map(
+                lambda a: a.astype(cdt)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, params[i])
+            if use_cd:
+                grads = layer.pretrain_grads(p_i, h, rng=rng)
+                loss = layer.pretrain_loss(p_i, h, rng=rng)
+            else:
+                loss, grads = jax.value_and_grad(
+                    lambda p: layer.pretrain_loss(p, h, rng=rng))(p_i)
+            new_p, new_u = {}, {}
+            for k, p in params[i].items():
+                upd, s_k = apply_fn(ustate[k], grads[k].astype(p.dtype), lr,
+                                    hp)
+                new_p[k] = p - upd
+                new_u[k] = s_k
+            return new_p, new_u, loss
+
+        jit_step = jax.jit(step, donate_argnums=(1,))
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator([data])
+        for _ in range(num_epochs):
+            data.reset()
+            while data.has_next():
+                ds = data.next_batch()
+                self._rng, rng = jax.random.split(self._rng)
+                new_p, ustate, loss = jit_step(
+                    self._params, ustate, self._model_state,
+                    jnp.asarray(ds.features), rng)
+                self._params = (self._params[:i] + [new_p]
+                                + self._params[i + 1:])
+                self._score = loss
+        return self
+
+    pretrainLayer = pretrain_layer
+
+    # ------------------------------------------------------------------
     # Inference — reference output(:1521)/feedForward(:657)
     # ------------------------------------------------------------------
     def output(self, x, train=False, features_mask=None):

@@ -1,0 +1,170 @@
+"""VAE + RBM + layerwise pretraining. Mirrors reference VaeGradientCheckTests
+pattern (gradient-check the ELBO), RBM CD behavior, pretrain path."""
+import numpy as np
+import pytest
+
+jax = __import__("jax")
+jnp = jax.numpy
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.layers import (RBM, DenseLayer, OutputLayer,
+                                               VariationalAutoencoder)
+from deeplearning4j_tpu.nn.conf.layers.variational import \
+    BernoulliReconstructionDistribution
+
+
+def _x(n=16, d=8, seed=0, binary=False):
+    r = np.random.default_rng(seed)
+    x = r.random((n, d)).astype(np.float64)
+    return (x > 0.5).astype(np.float64) if binary else x
+
+
+class TestVAE:
+    def _vae(self, dist=None, **kw):
+        return VariationalAutoencoder(
+            n_in=8, n_out=3, encoder_layer_sizes=(12,),
+            decoder_layer_sizes=(12,), activation="tanh",
+            reconstruction_distribution=dist, **kw
+        ).apply_global_defaults({"weight_init": "xavier"})
+
+    def test_elbo_gradcheck_gaussian(self):
+        """Numerical-vs-analytic gradients of the negative ELBO (the
+        reference's VaeGradientCheckTests approach)."""
+        vae = self._vae()
+        params = vae.init_params(jax.random.PRNGKey(0), jnp.float64)
+        x = jnp.asarray(_x())
+        rng = jax.random.PRNGKey(3)
+
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        flat = np.concatenate([np.asarray(l).ravel() for l in leaves])
+
+        def unflatten(v):
+            out, off = [], 0
+            for l in leaves:
+                n = l.size
+                out.append(jnp.asarray(v[off:off + n]).reshape(l.shape))
+                off += n
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        loss = jax.jit(lambda v: vae.pretrain_loss(unflatten(v), x, rng=rng))
+        g = np.asarray(jax.jit(jax.grad(
+            lambda v: vae.pretrain_loss(unflatten(v), x, rng=rng)))(
+                jnp.asarray(flat)))
+        rs = np.random.default_rng(1)
+        idx = rs.choice(flat.size, 40, replace=False)
+        eps = 1e-6
+        for i in idx:
+            v = flat.copy()
+            v[i] += eps
+            sp = float(loss(jnp.asarray(v)))
+            v[i] -= 2 * eps
+            sm = float(loss(jnp.asarray(v)))
+            num = (sp - sm) / (2 * eps)
+            denom = abs(g[i]) + abs(num)
+            assert denom == 0 or abs(g[i] - num) / denom < 1e-4, \
+                (i, g[i], num)
+
+    def test_pretrain_reduces_elbo_and_recon_prob_orders(self):
+        conf = (NeuralNetConfiguration.Builder().seed(5)
+                .updater("adam").learning_rate(5e-3).list()
+                .layer(0, VariationalAutoencoder(
+                    n_out=4, encoder_layer_sizes=(16,),
+                    decoder_layer_sizes=(16,), activation="tanh"))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        ds = DataSet(_x(64, seed=2).astype(np.float32),
+                     np.zeros((64, 1), np.float32))
+        vae = net.layers[0]
+        p0 = {k: v for k, v in net._params[0].items()}
+        l0 = float(vae.pretrain_loss(p0, jnp.asarray(ds.features)))
+        net.pretrain_layer(0, ListDataSetIterator([ds]), num_epochs=60)
+        p1 = net._params[0]
+        l1 = float(vae.pretrain_loss(p1, jnp.asarray(ds.features)))
+        assert l1 < l0
+        # reconstruction probability: trained data scores higher than noise
+        logp_data = np.asarray(vae.reconstruction_probability(
+            p1, jnp.asarray(ds.features), num_samples=8))
+        noise = np.random.default_rng(9).random((64, 8)) * 10 - 5
+        logp_noise = np.asarray(vae.reconstruction_probability(
+            p1, jnp.asarray(noise.astype(np.float32)), num_samples=8))
+        assert logp_data.mean() > logp_noise.mean()
+
+    def test_forward_is_latent_mean_and_supervised_stack(self):
+        conf = (NeuralNetConfiguration.Builder().seed(1)
+                .updater("adam").learning_rate(1e-2).list()
+                .layer(0, VariationalAutoencoder(
+                    n_out=4, encoder_layer_sizes=(8,),
+                    decoder_layer_sizes=(8,), activation="tanh"))
+                .layer(1, OutputLayer(n_out=2, activation="softmax",
+                                      loss_function="mcxent"))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = _x(8).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (8, 2)
+        y = np.eye(2, dtype=np.float32)[np.random.default_rng(0).integers(0, 2, 8)]
+        net.fit(DataSet(x, y))   # supervised fine-tune path works
+        assert np.isfinite(net.score())
+
+    def test_bernoulli_distribution_and_generate(self):
+        vae = self._vae(dist={"type": "bernoulli"})
+        assert isinstance(vae._dist(), BernoulliReconstructionDistribution)
+        params = vae.init_params(jax.random.PRNGKey(0), jnp.float32)
+        x = jnp.asarray(_x(binary=True).astype(np.float32))
+        loss = float(vae.pretrain_loss(params, x, rng=jax.random.PRNGKey(1)))
+        assert np.isfinite(loss)
+        z = jnp.zeros((4, 3), jnp.float32)
+        recon = np.asarray(vae.generate_at_mean_given_z(params, z))
+        assert recon.shape == (4, 8)
+        assert (recon >= 0).all() and (recon <= 1).all()
+
+
+class TestRBM:
+    def test_cd_pretraining_reduces_reconstruction_error(self):
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .updater("sgd").learning_rate(0.1).list()
+                .layer(0, RBM(n_out=12))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = _x(32, binary=True, seed=4).astype(np.float32)
+        ds = DataSet(x, np.zeros((32, 1), np.float32))
+        rbm = net.layers[0]
+        e0 = float(rbm.pretrain_loss(net._params[0], jnp.asarray(x)))
+        net.pretrain_layer(0, ListDataSetIterator([ds]), num_epochs=80)
+        e1 = float(rbm.pretrain_loss(net._params[0], jnp.asarray(x)))
+        assert e1 < e0
+
+    def test_propup_forward_shape(self):
+        rbm = RBM(n_in=8, n_out=5).apply_global_defaults({})
+        params = rbm.init_params(jax.random.PRNGKey(0), jnp.float32)
+        out = np.asarray(rbm.forward(params, jnp.asarray(
+            _x(4).astype(np.float32))))
+        assert out.shape == (4, 5)
+        assert (out >= 0).all() and (out <= 1).all()  # binary units
+
+    def test_stacked_pretrain_then_finetune(self):
+        """DBN-style: RBM + RBM + softmax, greedy pretrain then backprop."""
+        conf = (NeuralNetConfiguration.Builder().seed(7)
+                .updater("sgd").learning_rate(0.05).list()
+                .layer(0, RBM(n_out=16))
+                .layer(1, RBM(n_out=8))
+                .layer(2, OutputLayer(n_out=3, activation="softmax",
+                                      loss_function="mcxent"))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        r = np.random.default_rng(0)
+        x = _x(48, binary=True, seed=5).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[r.integers(0, 3, 48)]
+        ds = DataSet(x, y)
+        net.pretrain(ListDataSetIterator([ds]), num_epochs=10)
+        s0 = net.score(ds)
+        for _ in range(20):
+            net.fit(ds)
+        assert net.score(ds) < s0
