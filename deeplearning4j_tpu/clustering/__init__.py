@@ -1,0 +1,4 @@
+from .kmeans import KMeansClustering
+from .trees import KDTree, VPTree
+
+__all__ = ["KDTree", "KMeansClustering", "VPTree"]

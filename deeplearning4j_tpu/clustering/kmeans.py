@@ -1,0 +1,83 @@
+"""KMeans clustering.
+
+TPU-native equivalent of reference deeplearning4j-core clustering/kmeans/
+(KMeansClustering + cluster/ strategy classes): kmeans++ initialization on
+the host, then jitted Lloyd iterations — the [N,K] distance matrix is one
+MXU matmul per iteration.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _lloyd_step(x, centers, K):
+    """One assignment+update step. x [N,D], centers [K,D]."""
+    d2 = (jnp.sum(x * x, axis=1)[:, None]
+          - 2.0 * x @ centers.T
+          + jnp.sum(centers * centers, axis=1)[None, :])
+    assign = jnp.argmin(d2, axis=1)                     # [N]
+    one_hot = jax.nn.one_hot(assign, K, dtype=x.dtype)  # [N,K]
+    counts = jnp.sum(one_hot, axis=0)                   # [K]
+    sums = one_hot.T @ x                                # [K,D]
+    new_centers = jnp.where(counts[:, None] > 0,
+                            sums / jnp.maximum(counts, 1.0)[:, None],
+                            centers)
+    cost = jnp.sum(jnp.min(d2, axis=1))
+    return new_centers, assign, cost
+
+
+class KMeansClustering:
+    """reference: clustering/kmeans/KMeansClustering.java"""
+
+    def __init__(self, k, max_iterations=100, tol=1e-6, seed=12345):
+        self.k = int(k)
+        self.max_iterations = int(max_iterations)
+        self.tol = float(tol)
+        self.seed = int(seed)
+        self.centers = None
+        self.cost = None
+
+    @staticmethod
+    def setup(k, max_iterations=100, seed=12345):
+        return KMeansClustering(k, max_iterations, seed=seed)
+
+    def _init_pp(self, x, rng):
+        """kmeans++ seeding."""
+        n = x.shape[0]
+        centers = [x[rng.integers(0, n)]]
+        for _ in range(1, self.k):
+            d2 = np.min(
+                [np.sum((x - c) ** 2, axis=1) for c in centers], axis=0)
+            p = d2 / max(d2.sum(), 1e-12)
+            centers.append(x[rng.choice(n, p=p)])
+        return np.stack(centers)
+
+    def fit(self, points):
+        x = np.asarray(points, np.float32)
+        rng = np.random.default_rng(self.seed)
+        centers = jnp.asarray(self._init_pp(x, rng))
+        xd = jnp.asarray(x)
+        prev_cost = None
+        assign = None
+        for _ in range(self.max_iterations):
+            centers, assign, cost = _lloyd_step(xd, centers, self.k)
+            cost = float(cost)
+            if prev_cost is not None and abs(prev_cost - cost) < self.tol:
+                break
+            prev_cost = cost
+        self.centers = np.asarray(centers)
+        self.cost = prev_cost
+        self.labels = np.asarray(assign)
+        return self
+
+    applyTo = fit
+
+    def predict(self, points):
+        x = np.asarray(points, np.float32)
+        d2 = ((x[:, None, :] - self.centers[None, :, :]) ** 2).sum(-1)
+        return np.argmin(d2, axis=1)

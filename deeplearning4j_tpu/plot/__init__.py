@@ -1,0 +1,3 @@
+from .tsne import BarnesHutTsne, Tsne
+
+__all__ = ["BarnesHutTsne", "Tsne"]

@@ -1,0 +1,82 @@
+"""Clustering (KMeans), spatial trees (VPTree/KDTree vs brute force),
+t-SNE cluster preservation."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeansClustering, VPTree
+from deeplearning4j_tpu.plot import Tsne
+
+
+def _blobs(n_per=40, centers=((0, 0, 0), (10, 10, 10), (-10, 5, -5)),
+           seed=0):
+    rng = np.random.default_rng(seed)
+    xs, ys = [], []
+    for ci, c in enumerate(centers):
+        xs.append(rng.normal(c, 1.0, (n_per, len(c))))
+        ys.extend([ci] * n_per)
+    return np.concatenate(xs).astype(np.float32), np.asarray(ys)
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        x, y = _blobs()
+        km = KMeansClustering.setup(3, max_iterations=50).fit(x)
+        labels = km.labels
+        # cluster purity: each true blob maps to one dominant cluster
+        for c in range(3):
+            counts = np.bincount(labels[y == c], minlength=3)
+            assert counts.max() / counts.sum() > 0.95
+        # predict matches fit assignment
+        assert np.array_equal(km.predict(x), labels)
+
+    def test_cost_decreases_with_k(self):
+        x, _ = _blobs()
+        c1 = KMeansClustering.setup(1).fit(x).cost
+        c3 = KMeansClustering.setup(3).fit(x).cost
+        assert c3 < c1
+
+
+class TestTrees:
+    def test_vptree_knn_matches_bruteforce(self):
+        rng = np.random.default_rng(1)
+        pts = rng.random((200, 5))
+        tree = VPTree(pts)
+        for qi in range(5):
+            q = rng.random(5)
+            got = [i for _, i in tree.knn(q, 7)]
+            want = np.argsort(np.linalg.norm(pts - q, axis=1))[:7]
+            assert set(got) == set(want.tolist())
+
+    def test_kdtree_nn_matches_bruteforce(self):
+        rng = np.random.default_rng(2)
+        pts = rng.random((150, 3))
+        tree = KDTree(pts)
+        for _ in range(10):
+            q = rng.random(3)
+            d, i = tree.nn(q)
+            want = int(np.argmin(np.linalg.norm(pts - q, axis=1)))
+            assert i == want
+            assert abs(d - np.linalg.norm(pts[want] - q)) < 1e-9
+
+
+class TestTsne:
+    def test_clusters_stay_separated(self):
+        x, y = _blobs(n_per=30)
+        emb = (Tsne.Builder().set_max_iter(300).perplexity(10)
+               .num_dimension(2).seed(3).build().fit(x))
+        assert emb.shape == (90, 2)
+        # mean intra-cluster distance << mean inter-cluster distance
+        intra, inter = [], []
+        for i in range(0, 90, 7):
+            for j in range(i + 1, 90, 11):
+                d = np.linalg.norm(emb[i] - emb[j])
+                (intra if y[i] == y[j] else inter).append(d)
+        assert np.mean(intra) * 2 < np.mean(inter)
+
+    def test_plot_tsv_export(self, tmp_path):
+        x, y = _blobs(n_per=10)
+        p = tmp_path / "coords.tsv"
+        Tsne(max_iter=50, perplexity=5).plot(x, labels=y, path=str(p))
+        lines = p.read_text().strip().split("\n")
+        assert len(lines) == 30
+        assert len(lines[0].split("\t")) == 3
