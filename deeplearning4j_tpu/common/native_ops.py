@@ -1,0 +1,165 @@
+"""ctypes binding to the native runtime library (native/dl4j_tpu_native.cpp).
+
+The reference's IO/data hot paths are native (SURVEY.md §2.9); this module
+loads the C++ equivalents — IDX parsing, CSV parsing, staging-buffer pool —
+and transparently builds the .so with `make` on first use if the toolchain is
+available. Every caller has a pure-Python fallback, so a missing compiler
+never breaks the framework (the reference's reflective-helper-with-fallback
+pattern, ConvolutionLayer.java:69-76).
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libdl4j_tpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_attempted = False
+
+
+def _try_build():
+    global _build_attempted
+    if _build_attempted:
+        return
+    _build_attempted = True
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+    except Exception as e:  # toolchain missing / build failure -> fallback
+        log.debug("native build failed (%s); using python fallbacks", e)
+
+
+def get_lib():
+    """Load (building if needed) the native library, or None."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            _try_build()
+        if not os.path.exists(_SO_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        lib.dl4j_read_idx_u8.restype = ctypes.POINTER(ctypes.c_float)
+        lib.dl4j_read_idx_u8.argtypes = [
+            ctypes.c_char_p, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
+        lib.dl4j_parse_csv.restype = ctypes.POINTER(ctypes.c_float)
+        lib.dl4j_parse_csv.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.dl4j_free.argtypes = [ctypes.c_void_p]
+        lib.dl4j_pool_create.restype = ctypes.c_void_p
+        lib.dl4j_pool_create.argtypes = [ctypes.c_size_t]
+        lib.dl4j_pool_acquire.restype = ctypes.c_void_p
+        lib.dl4j_pool_acquire.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+        lib.dl4j_pool_release.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                          ctypes.c_size_t]
+        lib.dl4j_pool_stats.restype = ctypes.c_int64
+        lib.dl4j_pool_stats.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dl4j_pool_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available():
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# High-level wrappers (None on unavailability -> caller falls back)
+# ---------------------------------------------------------------------------
+
+def read_idx_u8(path, scale=1.0):
+    """Parse a u8 IDX file -> float32 ndarray scaled by `scale`."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    ndim = ctypes.c_int32()
+    dims = (ctypes.c_int64 * 4)()
+    ptr = lib.dl4j_read_idx_u8(str(path).encode(), float(scale),
+                               ctypes.byref(ndim), dims)
+    if not ptr:
+        return None
+    shape = tuple(dims[i] for i in range(ndim.value))
+    n = int(np.prod(shape))
+    arr = np.ctypeslib.as_array(ptr, shape=(n,)).reshape(shape).copy()
+    lib.dl4j_free(ptr)
+    return arr
+
+
+def parse_csv(path, delimiter=",", skip_lines=0):
+    """Parse a numeric CSV -> float32 [rows, cols] ndarray."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    ptr = lib.dl4j_parse_csv(str(path).encode(),
+                             ctypes.c_char(delimiter.encode()),
+                             int(skip_lines), ctypes.byref(rows),
+                             ctypes.byref(cols))
+    if not ptr:
+        return None
+    n = rows.value * cols.value
+    arr = np.ctypeslib.as_array(ptr, shape=(n,)).reshape(
+        rows.value, cols.value).copy()
+    lib.dl4j_free(ptr)
+    return arr
+
+
+class StagingBufferPool:
+    """Aligned reusable host buffers for device staging (reference role:
+    ND4J AtomicAllocator host-side buffers / MagicQueue)."""
+
+    def __init__(self, alignment=4096):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._pool = lib.dl4j_pool_create(alignment)
+
+    def acquire(self, nbytes):
+        ptr = self._lib.dl4j_pool_acquire(self._pool, int(nbytes))
+        if not ptr:
+            raise MemoryError(f"pool acquire({nbytes}) failed")
+        return ptr
+
+    def release(self, ptr, nbytes):
+        self._lib.dl4j_pool_release(self._pool, ptr, int(nbytes))
+
+    def as_array(self, ptr, shape, dtype=np.float32):
+        n = int(np.prod(shape))
+        ctype = np.ctypeslib.as_ctypes_type(np.dtype(dtype))
+        buf = ctypes.cast(ptr, ctypes.POINTER(ctype * n)).contents
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+    def stats(self):
+        return {"allocated": self._lib.dl4j_pool_stats(self._pool, 0),
+                "reused": self._lib.dl4j_pool_stats(self._pool, 1),
+                "free": self._lib.dl4j_pool_stats(self._pool, 2)}
+
+    def close(self):
+        if self._pool:
+            self._lib.dl4j_pool_destroy(self._pool)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

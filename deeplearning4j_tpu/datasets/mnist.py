@@ -45,7 +45,13 @@ def _open_maybe_gz(path):
 
 def read_idx(path):
     """Parse an IDX file (reference: datasets/mnist/MnistImageFile /
-    MnistLabelFile binary readers)."""
+    MnistLabelFile binary readers). Uses the native C++ parser when the
+    library is built (common/native_ops.py); python fallback otherwise."""
+    if os.path.exists(path):
+        from ..common import native_ops
+        arr = native_ops.read_idx_u8(path, scale=1.0)
+        if arr is not None:
+            return arr   # raw byte values as float32
     with _open_maybe_gz(path) as f:
         magic = struct.unpack(">HBB", f.read(4))
         _, dtype_code, ndim = magic

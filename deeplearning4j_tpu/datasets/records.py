@@ -74,9 +74,17 @@ class CSVRecordReader(RecordReader):
 
     def initialize(self, path):
         self.path = str(path)
-        with open(self.path, "r", encoding="utf-8", newline="") as fh:
-            rows = list(csv.reader(fh, delimiter=self.delimiter))
-        self._records = [r for r in rows[self.skip_lines:] if r]
+        # numeric fast path: the native C++ parser (common/native_ops);
+        # non-numeric content makes it return None -> python csv fallback
+        from ..common import native_ops
+        mat = native_ops.parse_csv(self.path, self.delimiter,
+                                   self.skip_lines)
+        if mat is not None:
+            self._records = [row.tolist() for row in mat]
+        else:
+            with open(self.path, "r", encoding="utf-8", newline="") as fh:
+                rows = list(csv.reader(fh, delimiter=self.delimiter))
+            self._records = [r for r in rows[self.skip_lines:] if r]
         self._pos = 0
         return self
 

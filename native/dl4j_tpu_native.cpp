@@ -1,0 +1,196 @@
+// Native runtime components for deeplearning4j_tpu.
+//
+// The reference keeps its IO / data-pipeline hot paths native (DataVec record
+// parsing feeding libnd4j buffers; SURVEY.md §2.9). This library is the
+// TPU-side equivalent: the XLA compiler owns device compute, and this code
+// owns the host side of the input pipeline —
+//   * IDX (MNIST-format) binary parsing straight into a float32 batch buffer
+//   * CSV -> float32 matrix parsing (the RecordReader hot loop)
+//   * an aligned host staging-buffer pool (reused pinned-style buffers for
+//     host->HBM transfers, the AtomicAllocator/MagicQueue role)
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// IDX parsing (reference: datasets/mnist/MnistImageFile binary reader)
+// ---------------------------------------------------------------------------
+
+static uint32_t read_be32(const unsigned char* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+// Parses an IDX file of unsigned bytes. On success fills dims[0..ndim) and
+// returns a malloc'd float32 buffer (values scaled by `scale`, e.g. 1/255).
+// Caller frees with dl4j_free. Returns nullptr on failure.
+float* dl4j_read_idx_u8(const char* path, double scale, int32_t* ndim_out,
+                        int64_t* dims_out /* size >= 4 */) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  unsigned char header[4];
+  if (fread(header, 1, 4, f) != 4 || header[0] != 0 || header[1] != 0 ||
+      header[2] != 0x08) {  // dtype 0x08 = u8
+    fclose(f);
+    return nullptr;
+  }
+  int ndim = header[3];
+  if (ndim < 1 || ndim > 4) {
+    fclose(f);
+    return nullptr;
+  }
+  int64_t total = 1;
+  for (int i = 0; i < ndim; ++i) {
+    unsigned char d[4];
+    if (fread(d, 1, 4, f) != 4) {
+      fclose(f);
+      return nullptr;
+    }
+    dims_out[i] = read_be32(d);
+    total *= dims_out[i];
+  }
+  std::vector<unsigned char> raw(total);
+  if ((int64_t)fread(raw.data(), 1, total, f) != total) {
+    fclose(f);
+    return nullptr;
+  }
+  fclose(f);
+  float* out = (float*)malloc(total * sizeof(float));
+  if (!out) return nullptr;
+  const float s = (float)scale;
+  for (int64_t i = 0; i < total; ++i) out[i] = raw[i] * s;
+  *ndim_out = ndim;
+  return out;
+}
+
+void dl4j_free(void* p) { free(p); }
+
+// ---------------------------------------------------------------------------
+// CSV -> float32 matrix (reference: DataVec CSVRecordReader hot loop)
+// ---------------------------------------------------------------------------
+
+// Parses a delimited numeric file. Returns malloc'd row-major float32
+// [rows x cols]; rows/cols reported via out params. Lines are split on
+// `delim`; empty lines and the first `skip_lines` lines are skipped.
+// Returns nullptr if rows have inconsistent column counts or parse fails.
+float* dl4j_parse_csv(const char* path, char delim, int64_t skip_lines,
+                      int64_t* rows_out, int64_t* cols_out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string buf(size, '\0');
+  if ((long)fread(&buf[0], 1, size, f) != size) {
+    fclose(f);
+    return nullptr;
+  }
+  fclose(f);
+
+  std::vector<float> values;
+  values.reserve(1024);
+  int64_t rows = 0, cols = -1, line_no = 0;
+  const char* p = buf.c_str();
+  const char* end = p + buf.size();
+  while (p < end) {
+    const char* line_end = (const char*)memchr(p, '\n', end - p);
+    if (!line_end) line_end = end;
+    if (line_no++ < skip_lines || line_end == p) {
+      p = line_end + 1;
+      continue;
+    }
+    int64_t c = 0;
+    const char* q = p;
+    while (q < line_end) {
+      char* num_end = nullptr;
+      float v = strtof(q, &num_end);
+      if (num_end == q) return nullptr;  // parse failure
+      values.push_back(v);
+      ++c;
+      q = num_end;
+      while (q < line_end && (*q == delim || *q == ' ' || *q == '\r')) ++q;
+    }
+    if (cols < 0)
+      cols = c;
+    else if (c != cols)
+      return nullptr;  // ragged rows
+    ++rows;
+    p = line_end + 1;
+  }
+  if (rows == 0 || cols <= 0) return nullptr;
+  float* out = (float*)malloc(values.size() * sizeof(float));
+  if (!out) return nullptr;
+  memcpy(out, values.data(), values.size() * sizeof(float));
+  *rows_out = rows;
+  *cols_out = cols;
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Aligned staging-buffer pool (reference role: AtomicAllocator host buffers /
+// MagicQueue per-device staging)
+// ---------------------------------------------------------------------------
+
+struct Pool {
+  std::mutex mu;
+  std::vector<std::pair<void*, size_t>> free_list;
+  size_t alignment;
+  int64_t allocated = 0, reused = 0;
+};
+
+void* dl4j_pool_create(size_t alignment) {
+  Pool* pool = new Pool();
+  pool->alignment = alignment < 64 ? 64 : alignment;
+  return pool;
+}
+
+void* dl4j_pool_acquire(void* pool_ptr, size_t bytes) {
+  Pool* pool = (Pool*)pool_ptr;
+  {
+    std::lock_guard<std::mutex> lock(pool->mu);
+    for (size_t i = 0; i < pool->free_list.size(); ++i) {
+      if (pool->free_list[i].second >= bytes) {
+        void* buf = pool->free_list[i].first;
+        pool->free_list.erase(pool->free_list.begin() + i);
+        pool->reused++;
+        return buf;
+      }
+    }
+    pool->allocated++;
+  }
+  void* buf = nullptr;
+  if (posix_memalign(&buf, pool->alignment, bytes) != 0) return nullptr;
+  return buf;
+}
+
+void dl4j_pool_release(void* pool_ptr, void* buf, size_t bytes) {
+  Pool* pool = (Pool*)pool_ptr;
+  std::lock_guard<std::mutex> lock(pool->mu);
+  pool->free_list.push_back({buf, bytes});
+}
+
+int64_t dl4j_pool_stats(void* pool_ptr, int which) {
+  Pool* pool = (Pool*)pool_ptr;
+  std::lock_guard<std::mutex> lock(pool->mu);
+  if (which == 0) return pool->allocated;
+  if (which == 1) return pool->reused;
+  return (int64_t)pool->free_list.size();
+}
+
+void dl4j_pool_destroy(void* pool_ptr) {
+  Pool* pool = (Pool*)pool_ptr;
+  for (auto& kv : pool->free_list) free(kv.first);
+  delete pool;
+}
+
+}  // extern "C"
