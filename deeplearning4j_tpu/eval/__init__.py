@@ -1,0 +1,6 @@
+from .evaluation import ConfusionMatrix, Evaluation
+from .regression import RegressionEvaluation
+from .roc import ROC, ROCMultiClass
+
+__all__ = ["ConfusionMatrix", "Evaluation", "ROC", "ROCMultiClass",
+           "RegressionEvaluation"]

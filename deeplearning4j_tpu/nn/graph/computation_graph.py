@@ -87,11 +87,13 @@ class ComputationGraph:
     # Forward — reference: per-vertex doForward in topological order
     # ------------------------------------------------------------------
     def _apply_graph(self, params, state, inputs, *, train, rng, fmasks=None,
-                     stop_at=None):
+                     stop_at=None, carries=None):
         """Pure forward over the DAG.
 
         inputs: dict input-name -> array. fmasks: dict input-name -> mask.
-        Returns (activations dict incl. inputs, new_state dict, masks dict).
+        carries: dict layer-name -> RNN carry (TBPTT / rnnTimeStep state).
+        Returns (activations dict incl. inputs, new_state dict, masks dict,
+        new_carries dict).
         """
         cdt = self.compute_dtype
         acts = {}
@@ -103,6 +105,7 @@ class ComputationGraph:
             acts[name] = x
             masks[name] = fmasks.get(name) if fmasks else None
         new_state = dict(state)
+        new_carries = dict(carries) if carries is not None else None
         for vi, name in enumerate(self.conf.topological_order):
             spec = self.conf.vertices[name]
             in_acts = [acts[i] for i in spec.inputs]
@@ -118,7 +121,12 @@ class ComputationGraph:
                     if jnp.issubdtype(a.dtype, jnp.floating) else a,
                     params[name])
                 m = in_masks[0]
-                if layer.has_state():
+                if (isinstance(layer, BaseRecurrentLayer)
+                        and carries is not None):
+                    out, c = layer.forward_with_carry(
+                        p, x, carries[name], train=train, rng=lrng, mask=m)
+                    new_carries[name] = c
+                elif layer.has_state():
                     out, st = layer.forward_with_state(
                         p, x, state[name], train=train, rng=lrng, mask=m)
                     new_state[name] = st
@@ -132,7 +140,7 @@ class ComputationGraph:
                 masks[name] = spec.conf.output_mask(in_masks)
             if stop_at is not None and name == stop_at:
                 break
-        return acts, new_state, masks
+        return acts, new_state, masks, new_carries
 
     def _canon_inputs(self, features):
         if isinstance(features, dict):
@@ -159,10 +167,11 @@ class ComputationGraph:
     # Loss over output vertices
     # ------------------------------------------------------------------
     def _loss_fn(self, params, state, features, labels, fmasks, lmasks, rng,
-                 train):
+                 train, carries=None):
         """features: dict name->arr; labels: list aligned with network_outputs."""
-        acts, new_state, masks = self._apply_graph(
-            params, state, features, train=train, rng=rng, fmasks=fmasks)
+        acts, new_state, masks, new_carries = self._apply_graph(
+            params, state, features, train=train, rng=rng, fmasks=fmasks,
+            carries=carries)
         total = 0.0
         order = {n: i for i, n in enumerate(self.conf.topological_order)}
         for oi, out_name in enumerate(self.conf.network_outputs):
@@ -192,7 +201,7 @@ class ComputationGraph:
         reg = 0.0
         for n in self._layer_names():
             reg = reg + self.conf.vertices[n].conf.reg_score(params[n])
-        return total + reg, new_state
+        return total + reg, (new_state, new_carries)
 
     # ------------------------------------------------------------------
     # Fused train step (same contract as MultiLayerNetwork.make_raw_step)
@@ -201,10 +210,12 @@ class ComputationGraph:
         names = self._layer_names()
 
         def step(params, ustate, state, batch):
-            (score, new_state), grads = jax.value_and_grad(
+            carries = batch.get("carries")
+            (score, (new_state, new_carries)), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(
                     params, state, batch["features"], batch["labels"],
-                    batch.get("fmask"), batch.get("lmask"), batch["rng"], True)
+                    batch.get("fmask"), batch.get("lmask"), batch["rng"],
+                    True, carries)
             iteration = batch["iteration"]
             minimize = self.conf.global_conf.get("minimize", True)
             new_params = dict(params)
@@ -233,24 +244,25 @@ class ComputationGraph:
                     s_new[k] = s_k
                 new_params[n] = p_new
                 new_ustate[n] = s_new
-            return new_params, new_ustate, new_state, score, None
+            return new_params, new_ustate, new_state, score, new_carries
 
         return step
 
     def _make_step(self):
         raw = self.make_raw_step()
 
-        def step(params, ustate, state, loop, features, labels, fmask, lmask):
+        def step(params, ustate, state, loop, features, labels, fmask, lmask,
+                 carries=None):
             # device-resident loop state (iteration counter + PRNG key):
             # advances inside the compiled step — no per-iteration host
             # scalar transfer or key-split dispatch (see multilayer.py)
             rng, next_rng = jax.random.split(loop["rng"])
             batch = {"features": features, "labels": labels, "fmask": fmask,
                      "lmask": lmask, "iteration": loop["iteration"],
-                     "rng": rng}
-            p, u, s, score, _ = raw(params, ustate, state, batch)
+                     "rng": rng, "carries": carries}
+            p, u, s, score, car = raw(params, ustate, state, batch)
             new_loop = {"iteration": loop["iteration"] + 1.0, "rng": next_rng}
-            return p, u, s, score, new_loop
+            return p, u, s, score, car, new_loop
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
@@ -308,10 +320,12 @@ class ComputationGraph:
             lmasks = [jnp.asarray(m) if m is not None else None
                       for m in mds.labels_masks]
         self._last_batch_size = int(mds.features[0].shape[0])
+        if self.conf.backprop_type == "tbptt":
+            return self._fit_tbptt(features, labels, fmasks, lmasks)
         num_iterations = int(self.conf.global_conf.get("num_iterations", 1))
         for _ in range(num_iterations):
             (self._params, self._updater_state, self._model_state,
-             score, self._loop) = self._jit_step(
+             score, _, self._loop) = self._jit_step(
                  self._params, self._updater_state, self._model_state,
                  self._loop_state(), features, labels, fmasks, lmasks)
             self._score = score
@@ -319,6 +333,83 @@ class ComputationGraph:
             for l in self.listeners:
                 l.iteration_done(self, self.conf.iteration_count - 1)
         return self
+
+    # ------------------------------------------------------------------
+    # TBPTT + streaming RNN state — reference ComputationGraph TBPTT path
+    # + rnnTimeStep
+    # ------------------------------------------------------------------
+    def _recurrent_names(self):
+        return [n for n in self._layer_names()
+                if isinstance(self.conf.vertices[n].conf, BaseRecurrentLayer)]
+
+    def _init_carries(self, batch_size):
+        return {n: self.conf.vertices[n].conf.init_carry(batch_size,
+                                                         self.param_dtype)
+                for n in self._recurrent_names()}
+
+    def _fit_tbptt(self, features, labels, fmasks, lmasks):
+        """Slice the time axis into tbptt_fwd_length segments, carrying RNN
+        state (not gradients) across segments — reference ComputationGraph
+        TBPTT (same semantics as MultiLayerNetwork.doTruncatedBPTT:1140)."""
+        seq_names = [n for n, f in features.items() if f.ndim >= 3]
+        T = int(features[seq_names[0]].shape[1])
+        L = self.conf.tbptt_fwd_length
+        B = int(next(iter(features.values())).shape[0])
+        carries = self._init_carries(B)
+        for t0 in range(0, T, L):
+            f_seg = {n: (f[:, t0:t0 + L] if f.ndim >= 3 else f)
+                     for n, f in features.items()}
+            l_seg = [(l[:, t0:t0 + L] if l.ndim >= 3 else l) for l in labels]
+            fm_seg = ({n: (m[:, t0:t0 + L] if m is not None else None)
+                       for n, m in fmasks.items()} if fmasks else None)
+            lm_seg = ([m[:, t0:t0 + L] if m is not None else None
+                       for m in lmasks] if lmasks else None)
+            (self._params, self._updater_state, self._model_state, score,
+             carries, self._loop) = self._jit_step(
+                 self._params, self._updater_state, self._model_state,
+                 self._loop_state(), f_seg, l_seg, fm_seg, lm_seg, carries)
+            self._score = score
+            self.conf.iteration_count += 1
+            for l in self.listeners:
+                l.iteration_done(self, self.conf.iteration_count - 1)
+        return self
+
+    def rnn_time_step(self, *features):
+        """Single/multi-step streaming inference with carried RNN state
+        (reference: ComputationGraph.rnnTimeStep). Returns the list of
+        output activations."""
+        self._ensure_init()
+        if len(features) == 1 and isinstance(features[0], (list, tuple, dict)):
+            features = features[0]
+        inputs = {n: jnp.asarray(x)
+                  for n, x in self._canon_inputs(features).items()}
+        single = all(x.ndim == 2 for x in inputs.values())
+        if single:
+            inputs = {n: x[:, None, :] for n, x in inputs.items()}
+        B = int(next(iter(inputs.values())).shape[0])
+        if getattr(self, "_rnn_state", None) is None:
+            self._rnn_state = self._init_carries(B)
+        if "rnn_step" not in self._jit_forward:
+            def fwd(params, state, inputs, rng, carries):
+                acts, _, _, new_carries = self._apply_graph(
+                    params, state, inputs, train=False, rng=rng,
+                    carries=carries)
+                return ([acts[n] for n in self.conf.network_outputs],
+                        new_carries)
+            self._jit_forward["rnn_step"] = jax.jit(fwd)
+        self._rng, rng = jax.random.split(self._rng)
+        outs, self._rnn_state = self._jit_forward["rnn_step"](
+            self._params, self._model_state, inputs, rng, self._rnn_state)
+        if single:
+            outs = [o[:, 0] if o.ndim >= 3 else o for o in outs]
+        return outs
+
+    rnnTimeStep = rnn_time_step
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = None
+
+    rnnClearPreviousState = rnn_clear_previous_state
 
     # ------------------------------------------------------------------
     # Inference — reference ComputationGraph.output
@@ -336,9 +427,9 @@ class ComputationGraph:
         key = ("output", bool(train), fmasks is not None)
         if key not in self._jit_forward:
             def fwd(params, state, inputs, fmasks, rng):
-                acts, _, _ = self._apply_graph(params, state, inputs,
-                                               train=train, rng=rng,
-                                               fmasks=fmasks)
+                acts, _, _, _ = self._apply_graph(params, state, inputs,
+                                                  train=train, rng=rng,
+                                                  fmasks=fmasks)
                 return [acts[n] for n in self.conf.network_outputs]
             self._jit_forward[key] = jax.jit(fwd)
         self._rng, rng = jax.random.split(self._rng)
@@ -353,8 +444,8 @@ class ComputationGraph:
         inputs = {n: jnp.asarray(x)
                   for n, x in self._canon_inputs(features).items()}
         self._rng, rng = jax.random.split(self._rng)
-        acts, _, _ = self._apply_graph(self._params, self._model_state, inputs,
-                                       train=train, rng=rng)
+        acts, _, _, _ = self._apply_graph(self._params, self._model_state,
+                                          inputs, train=train, rng=rng)
         return acts
 
     feedForward = feed_forward

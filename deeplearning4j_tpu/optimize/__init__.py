@@ -1,0 +1,12 @@
+from .listeners import (CollectScoresIterationListener,
+                        ComposableIterationListener, IterationListener,
+                        PerformanceListener, ScoreIterationListener,
+                        TrainingListener)
+from .solvers import (LBFGS, BackTrackLineSearch, ConjugateGradient,
+                      LineGradientDescent, Solver)
+
+__all__ = ["BackTrackLineSearch", "CollectScoresIterationListener",
+           "ComposableIterationListener", "ConjugateGradient",
+           "IterationListener", "LBFGS", "LineGradientDescent",
+           "PerformanceListener", "ScoreIterationListener", "Solver",
+           "TrainingListener"]

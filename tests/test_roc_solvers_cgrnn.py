@@ -1,0 +1,131 @@
+"""ROC/AUC metrics, second-order solvers (LBFGS/CG/line search),
+ComputationGraph TBPTT + rnnTimeStep."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (ComputationGraph, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, GravesLSTM,
+                                               OutputLayer, RnnOutputLayer)
+from deeplearning4j_tpu.optimize.solvers import Solver
+
+
+class TestROC:
+    def test_perfect_classifier_auc_1(self):
+        roc = ROC(threshold_steps=50)
+        labels = np.array([0, 0, 1, 1, 1])
+        probs = np.array([0.1, 0.2, 0.8, 0.9, 0.95])
+        roc.eval(labels, probs)
+        assert roc.calculate_auc() > 0.99
+
+    def test_random_classifier_auc_half(self):
+        rng = np.random.default_rng(0)
+        roc = ROC(threshold_steps=100)
+        labels = rng.integers(0, 2, 4000)
+        probs = rng.random(4000)
+        roc.eval(labels, probs)
+        assert abs(roc.calculate_auc() - 0.5) < 0.05
+
+    def test_merge_equals_single_pass(self):
+        rng = np.random.default_rng(1)
+        labels = rng.integers(0, 2, 1000)
+        probs = np.clip(labels * 0.4 + rng.random(1000) * 0.6, 0, 1)
+        whole = ROC().eval(labels, probs)
+        a = ROC().eval(labels[:500], probs[:500])
+        b = ROC().eval(labels[500:], probs[500:])
+        a.merge(b)
+        assert abs(whole.calculate_auc() - a.calculate_auc()) < 1e-12
+
+    def test_one_hot_and_curve_monotone(self):
+        labels = np.eye(2)[[0, 1, 1, 0]]
+        probs = np.array([[0.9, 0.1], [0.2, 0.8], [0.3, 0.7], [0.6, 0.4]])
+        roc = ROC().eval(labels, probs)
+        curve = roc.get_roc_curve()
+        assert curve[0][1:] == (1.0, 1.0)    # threshold 0: everything positive
+        assert roc.calculate_auc() > 0.99
+
+    def test_multiclass(self):
+        rng = np.random.default_rng(2)
+        y = np.eye(3)[rng.integers(0, 3, 300)]
+        # good predictions with noise
+        probs = np.clip(y + rng.normal(0, 0.3, y.shape), 0, 1)
+        probs /= probs.sum(1, keepdims=True)
+        mroc = ROCMultiClass().eval(y, probs)
+        for c in range(3):
+            assert mroc.calculate_auc(c) > 0.8
+        assert mroc.calculate_average_auc() > 0.8
+
+
+class TestSolvers:
+    def _net(self, algo):
+        conf = (NeuralNetConfiguration.Builder().seed(11)
+                .optimization_algo(algo).data_type("float64").list()
+                .layer(0, DenseLayer(n_out=8, activation="tanh"))
+                .layer(1, OutputLayer(n_out=2, activation="softmax",
+                                      loss_function="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def _xor_ish(self):
+        r = np.random.default_rng(3)
+        x = r.random((32, 4)).astype(np.float64)
+        y = np.eye(2, dtype=np.float64)[
+            ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(int)]
+        return x, y
+
+    @pytest.mark.parametrize("algo", ["lbfgs", "conjugate_gradient",
+                                      "line_gradient_descent"])
+    def test_solver_reduces_score(self, algo):
+        net = self._net(algo)
+        x, y = self._xor_ish()
+        s0 = net.score(DataSet(x, y))
+        final = Solver(net, max_iterations=60).optimize(x, y)
+        assert final < s0 * 0.7, (algo, s0, final)
+
+    def test_lbfgs_beats_few_sgd_steps(self):
+        x, y = self._xor_ish()
+        net = self._net("lbfgs")
+        Solver(net, max_iterations=100).optimize(x, y)
+        assert net.score(DataSet(x, y)) < 0.3
+
+
+class TestCGRecurrent:
+    def _conf(self, tbptt=False):
+        gb = (NeuralNetConfiguration.Builder().seed(5)
+              .updater("adam").learning_rate(0.01)
+              .graph_builder()
+              .add_inputs("in")
+              .add_layer("lstm", GravesLSTM(n_out=8, activation="tanh"), "in")
+              .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                               loss_function="mcxent"),
+                         "lstm")
+              .set_outputs("out")
+              .set_input_types(InputType.recurrent(4)))
+        if tbptt:
+            gb.backprop_type("tbptt").t_bptt_forward_length(5)
+        return gb.build()
+
+    def test_cg_tbptt_iteration_count(self):
+        net = ComputationGraph(self._conf(tbptt=True)).init()
+        r = np.random.default_rng(0)
+        x = r.random((2, 20, 4)).astype(np.float32)
+        y = np.zeros((2, 20, 3), np.float32)
+        y[:, :, 0] = 1.0
+        net.fit(MultiDataSet([x], [y]))
+        assert net.conf.iteration_count == 4   # 20 / 5 segments
+        assert np.isfinite(float(net._score))
+
+    def test_cg_rnn_time_step_matches_full_output(self):
+        net = ComputationGraph(self._conf()).init()
+        r = np.random.default_rng(1)
+        x = r.random((2, 6, 4)).astype(np.float32)
+        full = np.asarray(net.output(x)[0])
+        net.rnn_clear_previous_state()
+        steps = [np.asarray(net.rnn_time_step(x[:, t])[0])
+                 for t in range(6)]
+        chained = np.stack(steps, axis=1)
+        assert np.allclose(full, chained, atol=1e-5), \
+            np.abs(full - chained).max()
