@@ -2,9 +2,8 @@
 
 TPU-native equivalent of reference eval/ROC.java (thresholded TPR/FPR curve,
 AUC via trapezoid, merge() for distributed aggregation) and
-eval/ROCMultiClass.java. `threshold_steps=0` keeps exact scores (the
-reference's exact mode added later); otherwise counts accumulate in
-threshold bins so merge() across workers is exact, as in the reference.
+eval/ROCMultiClass.java. Counts accumulate in threshold bins so merge()
+across workers is exact, as in the reference.
 """
 from __future__ import annotations
 
@@ -16,6 +15,8 @@ class ROC:
 
     def __init__(self, threshold_steps=100):
         self.threshold_steps = int(threshold_steps)
+        if self.threshold_steps < 1:
+            raise ValueError("threshold_steps must be >= 1")
         n = self.threshold_steps + 1
         # per-threshold counts: predicted-positive at threshold t
         self._tp = np.zeros(n, np.int64)
@@ -41,10 +42,15 @@ class ROC:
         pos = labels > 0.5
         self._pos += int(pos.sum())
         self._neg += int((~pos).sum())
-        for i, t in enumerate(self._thresholds()):
-            pred_pos = probs >= t
-            self._tp[i] += int((pred_pos & pos).sum())
-            self._fp[i] += int((pred_pos & ~pos).sum())
+        # single pass: bin each score, histogram per class, reversed cumsum
+        # gives predicted-positive counts at every threshold at once.
+        # bin i counts scores in [t_i, t_{i+1}); prob >= t_i <=> bin >= i.
+        S = self.threshold_steps
+        bins = np.clip(np.floor(probs * S).astype(np.int64), 0, S)
+        pos_hist = np.bincount(bins[pos], minlength=S + 1)
+        neg_hist = np.bincount(bins[~pos], minlength=S + 1)
+        self._tp += np.cumsum(pos_hist[::-1])[::-1]
+        self._fp += np.cumsum(neg_hist[::-1])[::-1]
         return self
 
     def get_roc_curve(self):
@@ -121,8 +127,8 @@ class ROCMultiClass:
 
     def merge(self, other):
         for c, roc in other._rocs.items():
-            if c in self._rocs:
-                self._rocs[c].merge(roc)
-            else:
-                self._rocs[c] = roc
+            # merge into a fresh/owned ROC — aliasing the source object
+            # would let later eval() calls corrupt both aggregators
+            mine = self._rocs.setdefault(c, ROC(self.threshold_steps))
+            mine.merge(roc)
         return self

@@ -357,13 +357,19 @@ class ComputationGraph:
         B = int(next(iter(features.values())).shape[0])
         carries = self._init_carries(B)
         for t0 in range(0, T, L):
-            f_seg = {n: (f[:, t0:t0 + L] if f.ndim >= 3 else f)
+            def _seg(a):
+                # only sequence-shaped arrays have a time axis to slice;
+                # static inputs/labels/masks pass through whole
+                if a is None or a.ndim < 2 or a.shape[1] < T:
+                    return a
+                return a[:, t0:t0 + L]
+
+            f_seg = {n: (_seg(f) if f.ndim >= 3 else f)
                      for n, f in features.items()}
-            l_seg = [(l[:, t0:t0 + L] if l.ndim >= 3 else l) for l in labels]
-            fm_seg = ({n: (m[:, t0:t0 + L] if m is not None else None)
-                       for n, m in fmasks.items()} if fmasks else None)
-            lm_seg = ([m[:, t0:t0 + L] if m is not None else None
-                       for m in lmasks] if lmasks else None)
+            l_seg = [(_seg(l) if l.ndim >= 3 else l) for l in labels]
+            fm_seg = ({n: _seg(m) for n, m in fmasks.items()}
+                      if fmasks else None)
+            lm_seg = ([_seg(m) for m in lmasks] if lmasks else None)
             (self._params, self._updater_state, self._model_state, score,
              carries, self._loop) = self._jit_step(
                  self._params, self._updater_state, self._model_state,
@@ -387,7 +393,15 @@ class ComputationGraph:
         if single:
             inputs = {n: x[:, None, :] for n, x in inputs.items()}
         B = int(next(iter(inputs.values())).shape[0])
-        if getattr(self, "_rnn_state", None) is None:
+        state = getattr(self, "_rnn_state", None)
+        if state is not None:
+            held = next(iter(next(iter(state.values())).values())).shape[0] \
+                if state else B
+            if held != B:
+                raise ValueError(
+                    f"rnn_time_step batch size changed ({held} -> {B}); "
+                    "call rnn_clear_previous_state() first")
+        if state is None:
             self._rnn_state = self._init_carries(B)
         if "rnn_step" not in self._jit_forward:
             def fwd(params, state, inputs, rng, carries):
