@@ -28,36 +28,55 @@ _lib_lock = threading.Lock()
 _build_attempted = False
 
 
-def _try_build():
+_ABI_VERSION = 2  # must match dl4j_abi_version() in dl4j_tpu_native.cpp
+
+
+def _try_build(force=False):
     global _build_attempted
     if _build_attempted:
         return
     _build_attempted = True
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True, timeout=120)
+        cmd = ["make", "-C", _NATIVE_DIR] + (["-B"] if force else [])
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except Exception as e:  # toolchain missing / build failure -> fallback
         log.debug("native build failed (%s); using python fallbacks", e)
 
 
+def _load_checked():
+    """CDLL + ABI version check; None if missing or mismatched."""
+    if not os.path.exists(_SO_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.dl4j_abi_version.restype = ctypes.c_int64
+        if lib.dl4j_abi_version() != _ABI_VERSION:
+            return None
+    except (OSError, AttributeError):
+        return None
+    return lib
+
+
 def get_lib():
-    """Load (building if needed) the native library, or None."""
+    """Load (rebuilding if absent or ABI-stale) the native library, or
+    None. A pre-existing .so built from older sources (the .so is not
+    committed) fails the version check and triggers one forced rebuild
+    rather than silently disabling the native paths."""
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO_PATH):
-            _try_build()
-        if not os.path.exists(_SO_PATH):
-            return None
-        try:
-            lib = ctypes.CDLL(_SO_PATH)
-        except OSError:
+        lib = _load_checked()
+        if lib is None:
+            _try_build(force=os.path.exists(_SO_PATH))
+            lib = _load_checked()
+        if lib is None:
             return None
         lib.dl4j_read_idx_u8.restype = ctypes.POINTER(ctypes.c_float)
         lib.dl4j_read_idx_u8.argtypes = [
             ctypes.c_char_p, ctypes.c_double,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64)]
         lib.dl4j_parse_csv.restype = ctypes.POINTER(ctypes.c_float)
         lib.dl4j_parse_csv.argtypes = [
             ctypes.c_char_p, ctypes.c_char, ctypes.c_int64,
@@ -91,12 +110,16 @@ def read_idx_u8(path, scale=1.0):
         return None
     ndim = ctypes.c_int32()
     dims = (ctypes.c_int64 * 4)()
+    count = ctypes.c_int64()
     ptr = lib.dl4j_read_idx_u8(str(path).encode(), float(scale),
-                               ctypes.byref(ndim), dims)
+                               ctypes.byref(ndim), dims, ctypes.byref(count))
     if not ptr:
         return None
     shape = tuple(dims[i] for i in range(ndim.value))
     n = int(np.prod(shape))
+    if n != count.value:  # C-side validated count must match; never read past it
+        lib.dl4j_free(ptr)
+        return None
     arr = np.ctypeslib.as_array(ptr, shape=(n,)).reshape(shape).copy()
     lib.dl4j_free(ptr)
     return arr

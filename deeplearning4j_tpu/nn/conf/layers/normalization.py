@@ -32,6 +32,11 @@ class BatchNormalization(LayerConf):
     gamma_init: float = 1.0
     beta_init: float = 0.0
     n_out: int = None  # feature count, inferred
+    # one-pass E[x^2]-E[x]^2 statistics (industry-standard TPU BN; saves a
+    # full HBM read of the input per step — see PERF.md). Trades off f32
+    # cancellation when |mean| >> std; set False for the two-pass
+    # shifted-variance form in such regimes.
+    use_fast_variance: bool = True
 
     def set_n_in(self, input_type, override=True):
         if self.n_out is None or override:
@@ -64,10 +69,19 @@ class BatchNormalization(LayerConf):
                            mask=None):
         axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
         if train:
-            # compute stats in >= f32 (stability under bf16 compute)
+            # One-pass statistics: E[x] and E[x^2] reduce over the SAME read
+            # of x (XLA fuses the two reductions into a single pass), vs
+            # jnp.var's mean-then-squared-deviations which re-reads x after
+            # the mean is known. The step is HBM-bound (see PERF.md) — one
+            # fewer full pass over every conv output is a direct win.
+            # Accumulate in >= f32 (stability under bf16 compute).
             xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
             mean = jnp.mean(xf, axis=axes)
-            var = jnp.var(xf, axis=axes)
+            if self.use_fast_variance:
+                var = jnp.maximum(
+                    jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
+            else:
+                var = jnp.var(xf, axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,

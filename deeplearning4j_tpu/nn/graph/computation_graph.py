@@ -206,17 +206,25 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     # Fused train step (same contract as MultiLayerNetwork.make_raw_step)
     # ------------------------------------------------------------------
-    def make_raw_step(self):
-        names = self._layer_names()
-
-        def step(params, ustate, state, batch):
-            carries = batch.get("carries")
+    def make_grad_fn(self):
+        """(params, state, batch) -> (grads, score, new_state, new_carries) —
+        gradient half of the step (async-PS worker compute; see
+        multilayer.make_grad_fn)."""
+        def grad_fn(params, state, batch):
             (score, (new_state, new_carries)), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(
                     params, state, batch["features"], batch["labels"],
                     batch.get("fmask"), batch.get("lmask"), batch["rng"],
-                    True, carries)
-            iteration = batch["iteration"]
+                    True, batch.get("carries"))
+            return grads, score, new_state, new_carries
+        return grad_fn
+
+    def make_apply_fn(self):
+        """(params, ustate, grads, iteration) -> (new_params, new_ustate) —
+        updater half of the step (reference ComputationGraphUpdater)."""
+        names = self._layer_names()
+
+        def apply_updates(params, ustate, grads, iteration):
             minimize = self.conf.global_conf.get("minimize", True)
             new_params = dict(params)
             new_ustate = dict(ustate)
@@ -244,6 +252,19 @@ class ComputationGraph:
                     s_new[k] = s_k
                 new_params[n] = p_new
                 new_ustate[n] = s_new
+            return new_params, new_ustate
+
+        return apply_updates
+
+    def make_raw_step(self):
+        grad_fn = self.make_grad_fn()
+        apply_updates = self.make_apply_fn()
+
+        def step(params, ustate, state, batch):
+            grads, score, new_state, new_carries = grad_fn(params, state,
+                                                           batch)
+            new_params, new_ustate = apply_updates(params, ustate, grads,
+                                                   batch["iteration"])
             return new_params, new_ustate, new_state, score, new_carries
 
         return step
@@ -476,9 +497,20 @@ class ComputationGraph:
         features = {n: jnp.asarray(f)
                     for n, f in zip(self.conf.network_inputs, data.features)}
         labels = [jnp.asarray(l) for l in data.labels]
+        # Honor DataSet/MultiDataSet masks (same as _fit_mds) — dropping them
+        # silently skews validation loss on variable-length sequence data.
+        fmasks = None
+        if data.features_masks:
+            fmasks = {n: jnp.asarray(m) if m is not None else None
+                      for n, m in zip(self.conf.network_inputs,
+                                      data.features_masks)}
+        lmasks = None
+        if data.labels_masks:
+            lmasks = [jnp.asarray(m) if m is not None else None
+                      for m in data.labels_masks]
         self._rng, rng = jax.random.split(self._rng)
         s, _ = self._loss_fn(self._params, self._model_state, features, labels,
-                             None, None, rng, training)
+                             fmasks, lmasks, rng, training)
         return float(s)
 
     def compute_gradient_and_score(self, features, labels, fmask=None,

@@ -161,21 +161,27 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # The fused train step (jitted, donated)
     # ------------------------------------------------------------------
-    def make_raw_step(self):
-        """The un-jitted training step over a batch dict — the compilation
-        unit shared by the single-chip path, ParallelWrapper's sharded paths,
-        and TrainingMaster. batch keys: features, labels, fmask, lmask,
-        iteration, rng, carries (optional)."""
-        layers = self.layers
-
-        def step(params, ustate, state, batch):
-            carries = batch.get("carries")
+    def make_grad_fn(self):
+        """(params, state, batch) -> (grads, score, new_state, new_carries).
+        The gradient half of the step — what an async parameter-server worker
+        computes on a (possibly stale) parameter snapshot (reference
+        ParameterServerParallelWrapper.java worker push path)."""
+        def grad_fn(params, state, batch):
             (score, (new_state, new_carries)), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(
                     params, state, batch["features"], batch["labels"],
                     batch.get("fmask"), batch.get("lmask"), batch["rng"],
-                    True, carries)
-            iteration = batch["iteration"]
+                    True, batch.get("carries"))
+            return grads, score, new_state, new_carries
+        return grad_fn
+
+    def make_apply_fn(self):
+        """(params, ustate, grads, iteration) -> (new_params, new_ustate).
+        The updater half of the step — gradient normalization, LR schedule,
+        per-variable updater state machine (reference LayerUpdater.java:72)."""
+        layers = self.layers
+
+        def apply_updates(params, ustate, grads, iteration):
             new_params = []
             new_ustate = []
             minimize = self.conf.global_conf.get("minimize", True)
@@ -204,6 +210,23 @@ class MultiLayerNetwork:
                     s_new[k] = s_k
                 new_params.append(p_new)
                 new_ustate.append(s_new)
+            return new_params, new_ustate
+
+        return apply_updates
+
+    def make_raw_step(self):
+        """The un-jitted training step over a batch dict — the compilation
+        unit shared by the single-chip path, ParallelWrapper's sharded paths,
+        and TrainingMaster. batch keys: features, labels, fmask, lmask,
+        iteration, rng, carries (optional)."""
+        grad_fn = self.make_grad_fn()
+        apply_updates = self.make_apply_fn()
+
+        def step(params, ustate, state, batch):
+            grads, score, new_state, new_carries = grad_fn(params, state,
+                                                           batch)
+            new_params, new_ustate = apply_updates(params, ustate, grads,
+                                                   batch["iteration"])
             return new_params, new_ustate, new_state, score, new_carries
 
         return step

@@ -1,0 +1,164 @@
+"""XLA profiler integration — the "deep profiler" (SURVEY.md §5.1).
+
+The reference's profiling story is wall-clock listeners (PerformanceListener,
+BaseStatsListener timing, Spark phase timelines). On TPU the equivalent deep
+tool is the XLA device trace: this module wraps `jax.profiler` so a trace can
+be captured from bench.py or mid-training via a listener, and adds a
+host-side summarizer that aggregates device-op time straight from the
+captured `.xplane.pb` (so no TensorBoard UI is needed to see where a step's
+time goes).
+
+Usage:
+    from deeplearning4j_tpu.optimize.profiler import trace, summarize_trace
+    with trace("/tmp/prof"):
+        net.fit(ds)
+    for row in summarize_trace("/tmp/prof")[:20]:
+        print(row)
+
+or attach `ProfilerListener("/tmp/prof", start_iteration=5, num_iterations=3)`
+to any model — it starts the trace when the start iteration is reached and
+stops it `num_iterations` later (the reference pattern of sampling a steady-
+state window, not the compile-heavy first steps).
+"""
+from __future__ import annotations
+
+import contextlib
+import glob
+import os
+from collections import defaultdict
+
+import jax
+
+from .listeners import IterationListener
+
+
+@contextlib.contextmanager
+def trace(logdir):
+    """Capture an XLA device trace into `logdir` (TensorBoard-compatible)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+class ProfilerListener(IterationListener):
+    """Trace a steady-state window of training iterations.
+
+    reference role: PerformanceListener tells you *that* iterations are slow;
+    this tells you *why* (per-op device time)."""
+
+    def __init__(self, logdir, start_iteration=5, num_iterations=3):
+        self.logdir = str(logdir)
+        self.start_iteration = int(start_iteration)
+        self.num_iterations = int(num_iterations)
+        self._seen = 0
+        self._active = False
+        self.done = False
+
+    def iteration_done(self, model, iteration):
+        self._seen += 1
+        if self.done:
+            return
+        if not self._active and self._seen >= self.start_iteration:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+            self._stop_at = self._seen + self.num_iterations
+        elif self._active and self._seen >= self._stop_at:
+            # barrier so the traced window contains completed device work
+            jax.block_until_ready(model._params)
+            jax.profiler.stop_trace()
+            self._active = False
+            self.done = True
+
+
+def _find(logdir, pattern):
+    return sorted(glob.glob(os.path.join(
+        str(logdir), "**", pattern), recursive=True))
+
+
+def _rows_from_totals(totals, counts):
+    grand = sum(totals.values()) or 1.0
+    rows = [{"name": k, "total_ms": round(v, 3), "count": counts[k],
+             "pct": round(100.0 * v / grand, 2)}
+            for k, v in totals.items()]
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def _merge_name(name, merge):
+    # strip trailing ".NN" disambiguators so repeated fusions aggregate
+    # ("fusion.123" -> "fusion")
+    return name.split(".")[0] if (merge and name) else name
+
+
+def summarize_trace(logdir, merge_fusion_names=True):
+    """Aggregate per-op device time from the newest trace under `logdir`.
+
+    Returns a list of dicts sorted by total device time descending:
+    {"name", "total_ms", "count", "pct"}. Prefers the Chrome-trace JSON the
+    profiler writes alongside the XPlane proto; falls back to parsing the
+    raw `.xplane.pb` with TensorFlow's bundled schema. No TensorBoard server
+    required either way.
+    """
+    jsons = _find(logdir, "*.trace.json.gz")
+    if jsons:
+        import gzip
+        import json as _json
+        with gzip.open(jsons[-1], "rt") as fh:
+            data = _json.load(fh)
+        events = data.get("traceEvents", [])
+        # map pid -> process name to keep only device (TPU/GPU) op lanes
+        pid_name = {}
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                pid_name[ev.get("pid")] = ev.get("args", {}).get("name", "")
+        device_pids = {pid for pid, n in pid_name.items()
+                       if ("TPU" in n or "GPU" in n) and "host" not in n.lower()}
+        totals = defaultdict(float)
+        counts = defaultdict(int)
+        for ev in events:
+            if ev.get("ph") != "X" or ev.get("pid") not in device_pids:
+                continue
+            name = _merge_name(ev.get("name", ""), merge_fusion_names)
+            totals[name] += ev.get("dur", 0) / 1000.0  # us -> ms
+            counts[name] += 1
+        if totals:
+            return _rows_from_totals(totals, counts)
+
+    xplane_pb2 = None
+    for mod in ("tensorflow.core.profiler.protobuf.xplane_pb2",
+                "tensorflow.tsl.profiler.protobuf.xplane_pb2"):
+        try:
+            import importlib
+            xplane_pb2 = importlib.import_module(mod)
+            break
+        except Exception:
+            continue
+    if xplane_pb2 is None:
+        raise RuntimeError("no parsable trace found (no trace.json.gz with "
+                           "device lanes, no xplane proto schema)")
+    paths = _find(logdir, "*.xplane.pb")
+    if not paths:
+        raise FileNotFoundError(f"no .xplane.pb under {logdir}")
+    xspace = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as fh:
+        xspace.ParseFromString(fh.read())
+    totals = defaultdict(float)
+    counts = defaultdict(int)
+    for plane in xspace.planes:
+        # device planes only; skip host python/thread planes
+        if not ("TPU" in plane.name or "GPU" in plane.name
+                or "device" in plane.name.lower()):
+            continue
+        if "host" in plane.name.lower():
+            continue
+        ev_meta = plane.event_metadata
+        for line in plane.lines:
+            for ev in line.events:
+                meta = ev_meta.get(ev.metadata_id)
+                name = _merge_name(meta.name if meta else str(ev.metadata_id),
+                                   merge_fusion_names)
+                totals[name] += ev.duration_ps / 1e9
+                counts[name] += 1
+    return _rows_from_totals(totals, counts)

@@ -139,6 +139,32 @@ class ParallelWrapper:
                 self._fit_local_steps(data)
         return self
 
+    def _canon_parts(self, ds):
+        """Normalize a DataSet's pieces to the container's raw-step layout:
+        bare arrays for MultiLayerNetwork; name-keyed feature dict + label
+        list for ComputationGraph."""
+        net = self.model
+        f, l = ds.features, ds.labels
+        fm = getattr(ds, "features_mask", None)
+        lm = getattr(ds, "labels_mask", None)
+        if not isinstance(net._params, dict):   # MultiLayerNetwork
+            return f, l, fm, lm
+        names = list(net.conf.network_inputs)
+        if isinstance(f, dict):
+            feats = f
+        else:
+            flist = list(f) if isinstance(f, (list, tuple)) else [f]
+            feats = dict(zip(names, flist))
+        labels = list(l) if isinstance(l, (list, tuple)) else [l]
+        fmasks = None
+        if fm is not None:
+            fmlist = list(fm) if isinstance(fm, (list, tuple)) else [fm]
+            fmasks = fm if isinstance(fm, dict) else dict(zip(names, fmlist))
+        lmasks = None
+        if lm is not None:
+            lmasks = list(lm) if isinstance(lm, (list, tuple)) else [lm]
+        return feats, labels, fmasks, lmasks
+
     # -- mode 1: per-step gradient allreduce (GSPMD via shardings) -----
     def _fit_allreduce(self, it):
         net = self.model
@@ -148,11 +174,13 @@ class ParallelWrapper:
         while it.has_next():
             ds = it.next_batch()
             net._rng, step_rng = jax.random.split(net._rng)
+            feats, labels, fm, lm = self._canon_parts(ds)
+            put = self._put_batch
             batch = {
-                "features": self._put_batch(ds.features),
-                "labels": self._put_batch(ds.labels),
-                "fmask": self._put_batch(ds.features_mask),
-                "lmask": self._put_batch(ds.labels_mask),
+                "features": jax.tree.map(put, feats),
+                "labels": jax.tree.map(put, labels),
+                "fmask": jax.tree.map(put, fm) if fm is not None else None,
+                "lmask": jax.tree.map(put, lm) if lm is not None else None,
                 "iteration": jnp.asarray(net.conf.iteration_count, jnp.float32),
                 "rng": step_rng,
             }
@@ -229,10 +257,14 @@ class ParallelWrapper:
         net = self.model
         k = len(batches)
         B = max(int(b.features.shape[0]) for b in batches)
-        feats = jnp.asarray(np.stack(
-            [self._pad_to(np.asarray(b.features), B) for b in batches]))
-        labs = jnp.asarray(np.stack(
-            [self._pad_to(np.asarray(b.labels), B) for b in batches]))
+
+        def stack(*leaves):
+            return jnp.asarray(np.stack(
+                [self._pad_to(np.asarray(x), B) for x in leaves]))
+
+        parts = [self._canon_parts(b) for b in batches]
+        feats = jax.tree.map(stack, *[p[0] for p in parts])  # [k, B, ...]
+        labs = jax.tree.map(stack, *[p[1] for p in parts])
         net._rng, sub = jax.random.split(net._rng)
         rngs = jax.random.split(sub, k)
         batches_tree = {
@@ -243,19 +275,19 @@ class ParallelWrapper:
                                     dtype=jnp.float32),
             "rng": rngs,
         }
-        if batches[0].features_mask is not None:
-            batches_tree["fmask"] = jnp.asarray(np.stack(
-                [self._pad_to(np.asarray(b.features_mask), B) for b in batches]))
-        if batches[0].labels_mask is not None:
-            batches_tree["lmask"] = jnp.asarray(np.stack(
-                [self._pad_to(np.asarray(b.labels_mask), B) for b in batches]))
+        if parts[0][2] is not None:
+            batches_tree["fmask"] = jax.tree.map(stack,
+                                                 *[p[2] for p in parts])
+        if parts[0][3] is not None:
+            batches_tree["lmask"] = jax.tree.map(stack,
+                                                 *[p[3] for p in parts])
         if self._jit_kstep is None:
             self._jit_kstep = self._build_kstep()(batches_tree)
         (net._params, net._updater_state, net._model_state,
          score) = self._jit_kstep(net._params, net._updater_state,
                                   net._model_state, batches_tree)
         net._score = score
-        net._last_batch_size = int(feats.shape[1])
+        net._last_batch_size = B
         net.conf.iteration_count += k
         for l in net.listeners:
             l.iteration_done(net, net.conf.iteration_count - 1)

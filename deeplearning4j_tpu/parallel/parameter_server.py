@@ -7,12 +7,23 @@ TrainingHook variant (dl4j-spark-parameterserver).
 
 Redesign: the Aeron UDP transport has no place inside a TPU pod — ICI
 collectives replace it for synchronous training (ParallelWrapper). What the
-PS uniquely provided was ASYNC staleness-tolerant updates; that semantics is
-preserved here in-process: worker threads compute gradients on (possibly
-stale) parameter snapshots and push them to an accumulator thread that
-applies them to the master copy — deterministic application order per queue
-arrival, bounded staleness via the queue size. Multi-host DCN transport can
-later replace the queue without changing this API.
+PS uniquely provided is ASYNC, staleness-tolerant updates, and that is what
+this module implements:
+
+  * worker threads pull a parameter snapshot (possibly stale), compute
+    GRADIENTS on it with a jitted gradient function, and push the gradients
+    to the accumulator — concurrently with other workers and with the
+    accumulator's own apply work;
+  * the accumulator thread pops gradients and applies them to the master
+    parameters with the jitted updater half of the step, then publishes a new
+    snapshot (version-tagged);
+  * staleness (master_version - snapshot_version at apply time) is tracked
+    and bounded: gradients staler than `max_staleness` are dropped (counted
+    in `stale_dropped`), mirroring soft-sync PS semantics. The queue size
+    bounds in-flight gradients the way the Aeron client's buffer did.
+
+Multi-host DCN transport can replace the in-process queue without changing
+this API.
 """
 from __future__ import annotations
 
@@ -21,7 +32,7 @@ import queue
 import threading
 
 import jax
-import numpy as np
+import jax.numpy as jnp
 
 from ..datasets.dataset import DataSet
 from ..datasets.iterators import ListDataSetIterator
@@ -29,58 +40,116 @@ from ..datasets.iterators import ListDataSetIterator
 log = logging.getLogger(__name__)
 
 
+def _jitted_ps_fns(net):
+    """(grad_fn, apply_fn) jitted once per network — cached on the model so
+    repeated fit() calls (and new accumulators) reuse the compiled XLA
+    programs instead of recompiling."""
+    cached = getattr(net, "_ps_jit", None)
+    if cached is None:
+        cached = (jax.jit(net.make_grad_fn()), jax.jit(net.make_apply_fn()))
+        net._ps_jit = cached
+    return cached
+
+
 class GradientsAccumulator:
     """The PS core: gradient inbox + apply loop on the master params.
     reference: ParameterServerClient.pushNDArray / ParameterServerNode."""
 
-    def __init__(self, net, queue_size=8):
+    def __init__(self, net, queue_size=8, max_staleness=None):
         self.net = net
+        net._ensure_init()
         self._q = queue.Queue(maxsize=queue_size)
         self._stop = threading.Event()
+        self._error = None
         self._applied = 0
+        self._stale_dropped = 0
+        self._staleness_seen = []
+        self.max_staleness = max_staleness
         self._lock = threading.Lock()
-        raw = net.make_raw_step()
-        self._raw = raw
+        # version-tagged published snapshot workers pull from
+        self._version = 0
+        self._snapshot = (net._params, net._model_state, 0)
+        self._apply_fn = _jitted_ps_fns(net)[1]
         self._thread = threading.Thread(target=self._apply_loop, daemon=True)
         self._thread.start()
 
-    def push(self, batch):
-        """Workers push training batches; the accumulator owns the actual
-        update (gradient computation + apply on the master params). This
-        matches the PS contract observably: workers never hold the canonical
-        parameters."""
-        self._q.put(batch)
-
+    # -- worker side ---------------------------------------------------
     def snapshot_params(self):
-        with self._lock:
-            return self.net._params
+        """Latest published (params, model_state, version). Lock-free read of
+        an atomically-swapped tuple — the PS 'pull' operation."""
+        return self._snapshot
 
-    def _apply_loop(self):
-        import jax.numpy as jnp
-        net = self.net
-        while not self._stop.is_set() or not self._q.empty():
+    def push_gradients(self, grads, score, version, model_state=None):
+        """The PS 'push' operation: enqueue gradients (plus the layer state
+        the worker's forward produced, e.g. BN running stats) computed
+        against snapshot `version`. Blocks when the inbox is full (bounded
+        in-flight). Raises if the accumulator died."""
+        while True:
+            if self._error is not None:
+                raise self._error
+            if self._stop.is_set():
+                return
             try:
-                batch = self._q.get(timeout=0.05)
-            except queue.Empty:
+                self._q.put((grads, score, version, model_state), timeout=0.1)
+                return
+            except queue.Full:
                 continue
-            with self._lock:
-                if net._jit_step is None:
-                    net._jit_step = net._make_step()
-                (net._params, net._updater_state, net._model_state,
-                 score, _, net._loop) = net._jit_step(
-                     net._params, net._updater_state, net._model_state,
-                     net._loop_state(), batch["features"], batch["labels"],
-                     batch.get("fmask"), batch.get("lmask"))
-                net._score = score
-                net.conf.iteration_count += 1
-                self._applied += 1
+
+    # -- accumulator side ----------------------------------------------
+    def _apply_loop(self):
+        net = self.net
+        try:
+            while not self._stop.is_set() or not self._q.empty():
+                try:
+                    grads, score, version, mstate = self._q.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                staleness = self._version - version
+                self._staleness_seen.append(staleness)
+                if (self.max_staleness is not None
+                        and staleness > self.max_staleness):
+                    self._stale_dropped += 1
+                    continue
+                with self._lock:
+                    net._params, net._updater_state = self._apply_fn(
+                        net._params, net._updater_state, grads,
+                        jnp.asarray(float(net.conf.iteration_count)))
+                    if mstate is not None:
+                        # last-writer-wins layer state (BN running stats) —
+                        # stale-tolerant, like the param updates themselves
+                        net._model_state = mstate
+                    net._score = score
+                    net.conf.iteration_count += 1
+                    self._applied += 1
+                    self._version += 1
+                    self._snapshot = (net._params, net._model_state,
+                                      self._version)
+        except Exception as e:  # record + unblock producers, re-raise at join
+            self._error = e
+            self._stop.set()
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
 
     def applied_count(self):
         return self._applied
 
+    def stats(self):
+        seen = self._staleness_seen
+        return {
+            "applied": self._applied,
+            "stale_dropped": self._stale_dropped,
+            "max_staleness_seen": max(seen) if seen else 0,
+            "mean_staleness": (sum(seen) / len(seen)) if seen else 0.0,
+        }
+
     def shutdown(self):
         self._stop.set()
-        self._thread.join(timeout=30)
+        self._thread.join(timeout=60)
+        if self._error is not None:
+            raise self._error
 
 
 class ParameterServerParallelWrapper:
@@ -92,6 +161,7 @@ class ParameterServerParallelWrapper:
             self.model = model
             self._workers = 2
             self._queue_size = 8
+            self._max_staleness = None
 
         def workers(self, n):
             self._workers = int(n); return self
@@ -101,49 +171,78 @@ class ParameterServerParallelWrapper:
 
         queueSize = queue_size
 
+        def max_staleness(self, n):
+            self._max_staleness = None if n is None else int(n); return self
+
+        maxStaleness = max_staleness
+
         def build(self):
             return ParameterServerParallelWrapper(
-                self.model, self._workers, self._queue_size)
+                self.model, self._workers, self._queue_size,
+                self._max_staleness)
 
-    def __init__(self, model, workers=2, queue_size=8):
+    def __init__(self, model, workers=2, queue_size=8, max_staleness=None):
         self.model = model
         model._ensure_init()
         self.workers = int(workers)
         self.queue_size = int(queue_size)
+        self.max_staleness = max_staleness
+        self.last_stats = None
 
     def fit(self, data, num_epochs=1):
         if isinstance(data, DataSet):
             data = ListDataSetIterator(list(data.batch_by(
                 max(1, data.num_examples() // self.workers))))
-        acc = GradientsAccumulator(self.model, self.queue_size)
+        net = self.model
+        acc = GradientsAccumulator(net, self.queue_size, self.max_staleness)
+        # one jitted grad fn shared by all workers (thread-safe dispatch),
+        # compiled once per network across fit() calls
+        grad_fn = _jitted_ps_fns(net)[0]
+        errors = []
         try:
             for _ in range(num_epochs):
+                net._rng, epoch_rng = jax.random.split(net._rng)
                 data.reset()
-                threads = []
                 shards = [[] for _ in range(self.workers)]
                 i = 0
                 while data.has_next():
                     shards[i % self.workers].append(data.next_batch())
                     i += 1
 
-                def worker(batches):
-                    import jax.numpy as jnp
-                    for ds in batches:
-                        acc.push({
-                            "features": jnp.asarray(ds.features),
-                            "labels": jnp.asarray(ds.labels),
-                            "fmask": (jnp.asarray(ds.features_mask)
-                                      if ds.features_mask is not None else None),
-                            "lmask": (jnp.asarray(ds.labels_mask)
-                                      if ds.labels_mask is not None else None),
-                        })
+                def worker(batches, wrng):
+                    try:
+                        for j, ds in enumerate(batches):
+                            params, state, version = acc.snapshot_params()
+                            batch = {
+                                "features": jnp.asarray(ds.features),
+                                "labels": jnp.asarray(ds.labels),
+                                "fmask": (jnp.asarray(ds.features_mask)
+                                          if ds.features_mask is not None
+                                          else None),
+                                "lmask": (jnp.asarray(ds.labels_mask)
+                                          if ds.labels_mask is not None
+                                          else None),
+                                "rng": jax.random.fold_in(wrng, j),
+                            }
+                            grads, score, new_state, _ = grad_fn(params,
+                                                                 state, batch)
+                            acc.push_gradients(grads, score, version,
+                                               new_state)
+                    except Exception as e:
+                        errors.append(e)
 
-                for s in shards:
-                    t = threading.Thread(target=worker, args=(s,))
+                threads = []
+                for w, s in enumerate(shards):
+                    t = threading.Thread(
+                        target=worker,
+                        args=(s, jax.random.fold_in(epoch_rng, w)))
                     t.start()
                     threads.append(t)
                 for t in threads:
                     t.join()
+                if errors:
+                    raise errors[0]
         finally:
             acc.shutdown()
-        return self.model
+            self.last_stats = acc.stats()
+        return net

@@ -73,24 +73,35 @@ def param_specs_for_layer(layer, tensor_parallel=False):
     return specs
 
 
+def _layer_sharding(layer, p, mesh, tensor_parallel):
+    specs = param_specs_for_layer(layer, tensor_parallel)
+    d = {}
+    for k, v in p.items():
+        spec = specs.get(k, P()) if specs else P()
+        # only shard axes that divide evenly; otherwise replicate
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            if v.shape[dim] % mesh.shape[axis] != 0:
+                spec = P()
+                break
+        d[k] = NamedSharding(mesh, spec)
+    return d
+
+
 def shard_params(net, mesh, tensor_parallel=False):
-    """Return (sharded_params, param_shardings) for a MultiLayerNetwork's
-    per-layer param pytree."""
-    shardings = []
-    for layer, p in zip(net.layers, net._params):
-        specs = param_specs_for_layer(layer, tensor_parallel)
-        d = {}
-        for k, v in p.items():
-            spec = specs.get(k, P()) if specs else P()
-            # only shard axes that divide evenly; otherwise replicate
-            for dim, axis in enumerate(spec):
-                if axis is None:
-                    continue
-                if v.shape[dim] % mesh.shape[axis] != 0:
-                    spec = P()
-                    break
-            d[k] = NamedSharding(mesh, spec)
-        shardings.append(d)
+    """Return (sharded_params, param_shardings) for a container's per-layer
+    param pytree — list-shaped for MultiLayerNetwork, name-keyed dict for
+    ComputationGraph."""
+    if isinstance(net._params, dict):   # ComputationGraph
+        shardings = {
+            n: _layer_sharding(net.conf.vertices[n].conf, p, mesh,
+                               tensor_parallel)
+            for n, p in net._params.items()}
+    else:                               # MultiLayerNetwork
+        shardings = [
+            _layer_sharding(layer, p, mesh, tensor_parallel)
+            for layer, p in zip(net.layers, net._params)]
     sharded = jax.device_put(net._params, shardings)
     return sharded, shardings
 

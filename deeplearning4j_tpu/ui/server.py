@@ -2,16 +2,28 @@
 
 TPU-native equivalent of reference deeplearning4j-play PlayUIServer
 (api/UIServer.java:38 — UIServer.getInstance().attach(statsStorage)): a
-stdlib http.server replaces the Play framework. Pages: train overview
-(score chart, perf, memory, model info) rendered client-side from the JSON
-API; a remote-receiver endpoint accepts POSTed reports from
+stdlib http.server replaces the Play framework. Pages (reference
+deeplearning4j-play module/ equivalents):
+
+  /                train overview   (TrainModule overview page)
+  /train/model     per-layer table + per-param mean-magnitude charts
+                   (TrainModule model page)
+  /train/histogram param/update histograms (HistogramModule)
+  /tsne            t-SNE scatter of uploaded coords (TsneModule)
+
+plus a remote-receiver endpoint accepting POSTed reports from
 RemoteUIStatsStorageRouter (reference module/remote/RemoteReceiverModule).
 
+All remote-supplied values are rendered via textContent/createElement (never
+innerHTML interpolation) so a process POSTing to /remoteReceive cannot
+inject script into the viewer's browser.
+
 Endpoints:
-  GET  /                     overview page (HTML + inline JS chart)
   GET  /api/sessions         session ids
   GET  /api/static/<id>      static info
   GET  /api/updates/<id>     all updates
+  GET  /api/tsne/<id>        uploaded t-SNE coords
+  POST /api/tsne/<id>        upload t-SNE coords {"coords": [[x,y],..], "labels": [..]}
   POST /remoteReceive/static remote static info
   POST /remoteReceive/update remote update
 """
@@ -21,57 +33,238 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-_PAGE = """<!DOCTYPE html>
-<html><head><title>DL4J-TPU Training UI</title>
-<style>
+_STYLE = """
  body{font-family:sans-serif;margin:2em;background:#fafafa}
  .card{background:#fff;border:1px solid #ddd;border-radius:6px;
        padding:1em;margin-bottom:1em}
  h1{font-size:1.3em} h2{font-size:1.05em;color:#333}
- table{border-collapse:collapse} td,th{padding:2px 10px;text-align:left}
+ table{border-collapse:collapse} td,th{padding:2px 10px;text-align:left;
+       border-bottom:1px solid #eee}
  svg{width:100%;height:260px}
-</style></head><body>
-<h1>Training overview</h1>
-<div class="card"><h2>Score vs iteration</h2><svg id="chart"></svg></div>
+ nav a{margin-right:1em}
+"""
+
+_NAV = """<nav><a href="/">Overview</a><a href="/train/model">Model</a>
+<a href="/train/histogram">Histograms</a><a href="/tsne">t-SNE</a></nav>"""
+
+# Shared JS helpers: safe DOM building + line/scatter/histogram rendering.
+_JS_LIB = """
+function el(tag, text){const e=document.createElement(tag);
+ if(text!==undefined) e.textContent=String(text); return e;}
+function kvTable(rows){const t=el('table');
+ for(const [k,v] of rows){const tr=el('tr');
+  tr.appendChild(el('th',k)); tr.appendChild(el('td',v));
+  t.appendChild(tr);} return t;}
+function drawLine(svg, pts, color){
+ svg.textContent='';
+ pts = pts.map(p=>[Number(p[0]),Number(p[1])]).filter(p=>isFinite(p[0])&&isFinite(p[1]));
+ if(!pts.length) return;
+ const W=svg.clientWidth||600, H=svg.clientHeight||260, pad=34;
+ const xs=pts.map(p=>p[0]), ys=pts.map(p=>p[1]);
+ const xmin=Math.min(...xs), xmax=Math.max(...xs);
+ const ymin=Math.min(...ys), ymax=Math.max(...ys);
+ const X=x=>pad+(x-xmin)/(xmax-xmin||1)*(W-2*pad);
+ const Y=y=>H-pad-(y-ymin)/(ymax-ymin||1)*(H-2*pad);
+ const ns='http://www.w3.org/2000/svg';
+ const pl=document.createElementNS(ns,'polyline');
+ pl.setAttribute('fill','none'); pl.setAttribute('stroke',color||'#06c');
+ pl.setAttribute('stroke-width','1.5');
+ pl.setAttribute('points', pts.map(p=>X(p[0])+','+Y(p[1])).join(' '));
+ svg.appendChild(pl);
+ const t1=document.createElementNS(ns,'text');
+ t1.setAttribute('x',pad); t1.setAttribute('y',12);
+ t1.setAttribute('font-size','11'); t1.textContent=ymax.toFixed(5);
+ const t2=document.createElementNS(ns,'text');
+ t2.setAttribute('x',pad); t2.setAttribute('y',H-8);
+ t2.setAttribute('font-size','11'); t2.textContent=ymin.toFixed(5);
+ svg.appendChild(t1); svg.appendChild(t2);}
+function drawHistogram(svg, counts, lo, hi, color){
+ svg.textContent='';
+ counts = counts.map(Number);
+ const W=svg.clientWidth||600, H=svg.clientHeight||260, pad=30;
+ const maxC=Math.max(...counts,1), n=counts.length;
+ const ns='http://www.w3.org/2000/svg';
+ for(let i=0;i<n;i++){
+  const r=document.createElementNS(ns,'rect');
+  const bw=(W-2*pad)/n;
+  r.setAttribute('x',pad+i*bw); r.setAttribute('width',Math.max(bw-1,1));
+  const h=(H-2*pad)*counts[i]/maxC;
+  r.setAttribute('y',H-pad-h); r.setAttribute('height',h);
+  r.setAttribute('fill',color||'#06c');
+  svg.appendChild(r);}
+ const t1=document.createElementNS(ns,'text');
+ t1.setAttribute('x',pad); t1.setAttribute('y',H-8);
+ t1.setAttribute('font-size','11'); t1.textContent=Number(lo).toFixed(4);
+ const t2=document.createElementNS(ns,'text');
+ t2.setAttribute('x',W-pad-60); t2.setAttribute('y',H-8);
+ t2.setAttribute('font-size','11'); t2.textContent=Number(hi).toFixed(4);
+ svg.appendChild(t1); svg.appendChild(t2);}
+function drawScatter(svg, pts, labels){
+ svg.textContent='';
+ pts = pts.map(p=>[Number(p[0]),Number(p[1])]).filter(p=>isFinite(p[0])&&isFinite(p[1]));
+ if(!pts.length) return;
+ const W=svg.clientWidth||600, H=svg.clientHeight||400, pad=20;
+ const xs=pts.map(p=>p[0]), ys=pts.map(p=>p[1]);
+ const xmin=Math.min(...xs), xmax=Math.max(...xs);
+ const ymin=Math.min(...ys), ymax=Math.max(...ys);
+ const X=x=>pad+(x-xmin)/(xmax-xmin||1)*(W-2*pad);
+ const Y=y=>H-pad-(y-ymin)/(ymax-ymin||1)*(H-2*pad);
+ const ns='http://www.w3.org/2000/svg';
+ for(let i=0;i<pts.length;i++){
+  const c=document.createElementNS(ns,'circle');
+  c.setAttribute('cx',X(pts[i][0])); c.setAttribute('cy',Y(pts[i][1]));
+  c.setAttribute('r','3'); c.setAttribute('fill','#06c');
+  svg.appendChild(c);
+  if(labels && labels[i]!==undefined){
+   const t=document.createElementNS(ns,'text');
+   t.setAttribute('x',X(pts[i][0])+4); t.setAttribute('y',Y(pts[i][1])-4);
+   t.setAttribute('font-size','9'); t.textContent=String(labels[i]);
+   svg.appendChild(t);}}}
+async function latestSession(){
+ const s=await (await fetch('/api/sessions')).json();
+ return s.length? s[s.length-1] : null;}
+"""
+
+
+def _page(title, body, script):
+    return (f"<!DOCTYPE html><html><head><title>{title}</title>"
+            f"<style>{_STYLE}</style></head><body>{_NAV}"
+            f"<h1>{title}</h1>{body}"
+            f"<script>{_JS_LIB}{script}</script></body></html>")
+
+
+_OVERVIEW = _page(
+    "Training overview",
+    """<div class="card"><h2>Score vs iteration</h2><svg id="chart"></svg></div>
 <div class="card"><h2>Performance</h2><div id="perf"></div></div>
-<div class="card"><h2>Model</h2><pre id="model"></pre></div>
-<script>
+<div class="card"><h2>Model</h2><pre id="model"></pre></div>""",
+    """
 async function refresh(){
- const sessions = await (await fetch('/api/sessions')).json();
- if(!sessions.length) return;
- const sid = sessions[sessions.length-1];
+ const sid = await latestSession(); if(!sid) return;
  const ups = await (await fetch('/api/updates/'+sid)).json();
  const st = await (await fetch('/api/static/'+sid)).json();
  if(st && st.model) document.getElementById('model').textContent =
-   st.model.class+': '+st.model.numParams+' params on '+st.machine.device;
+   st.model.class+': '+st.model.numParams+' params on '+
+   (st.machine? st.machine.device : '?');
  if(!ups.length) return;
  const last = ups[ups.length-1];
- document.getElementById('perf').innerHTML =
-  '<table><tr><th>iteration</th><td>'+last.iteration+'</td></tr>'+
-  '<tr><th>score</th><td>'+(last.score||0).toFixed(5)+'</td></tr>'+
-  '<tr><th>examples/sec</th><td>'+(last.examplesPerSecond||0).toFixed(1)+
-  '</td></tr><tr><th>minibatches/sec</th><td>'+
-  (last.minibatchesPerSecond||0).toFixed(2)+'</td></tr></table>';
- const pts = ups.filter(u=>u.score!==undefined)
-               .map(u=>[u.iteration,u.score]);
- const svg = document.getElementById('chart');
- const W = svg.clientWidth, H = svg.clientHeight, pad=30;
- const xs = pts.map(p=>p[0]), ys = pts.map(p=>p[1]);
- const xmin=Math.min(...xs), xmax=Math.max(...xs)||1;
- const ymin=Math.min(...ys), ymax=Math.max(...ys)||1;
- const X=x=>pad+(x-xmin)/(xmax-xmin||1)*(W-2*pad);
- const Y=y=>H-pad-(y-ymin)/(ymax-ymin||1)*(H-2*pad);
- svg.innerHTML = '<polyline fill="none" stroke="#06c" stroke-width="1.5" '+
-  'points="'+pts.map(p=>X(p[0])+','+Y(p[1])).join(' ')+'"/>'+
-  '<text x="'+pad+'" y="12" font-size="11">'+ymax.toFixed(4)+'</text>'+
-  '<text x="'+pad+'" y="'+(H-8)+'" font-size="11">'+ymin.toFixed(4)+'</text>';
+ const perf=document.getElementById('perf'); perf.textContent='';
+ perf.appendChild(kvTable([
+  ['iteration', last.iteration],
+  ['score', Number(last.score||0).toFixed(5)],
+  ['examples/sec', Number(last.examplesPerSecond||0).toFixed(1)],
+  ['minibatches/sec', Number(last.minibatchesPerSecond||0).toFixed(2)]]));
+ const pts = ups.filter(u=>u.score!==undefined).map(u=>[u.iteration,u.score]);
+ drawLine(document.getElementById('chart'), pts);
 }
-refresh(); setInterval(refresh, 2000);
-</script></body></html>"""
+refresh(); setInterval(refresh, 2000);""")
+
+
+_MODEL = _page(
+    "Model",
+    """<div class="card"><h2>Layers</h2><div id="layers"></div></div>
+<div class="card"><h2>Mean magnitude vs iteration
+ <select id="param"></select></h2><svg id="mm"></svg></div>""",
+    """
+let chosen=null;
+async function refresh(){
+ const sid = await latestSession(); if(!sid) return;
+ const st = await (await fetch('/api/static/'+sid)).json();
+ const ups = await (await fetch('/api/updates/'+sid)).json();
+ const div=document.getElementById('layers'); div.textContent='';
+ if(st && st.model && st.model.configJson){
+  try{
+   const conf=JSON.parse(st.model.configJson);
+   const t=el('table');
+   const hd=el('tr'); for(const h of ['#','type','out','activation'])
+     hd.appendChild(el('th',h));
+   t.appendChild(hd);
+   const layers = conf.confs || conf.layers ||
+     (conf.vertices? Object.entries(conf.vertices).map(([k,v])=>
+        Object.assign({name:k}, v.conf||v)) : []);
+   let i=0;
+   for(const lc of layers){
+    const l = lc.layer || lc;
+    const tr=el('tr');
+    tr.appendChild(el('td', l.name!==undefined? l.name : i));
+    tr.appendChild(el('td', l.type||l['@class']||'?'));
+    tr.appendChild(el('td', l.n_out!==undefined? l.n_out:(l.nOut||'')));
+    tr.appendChild(el('td', l.activation||''));
+    t.appendChild(tr); i++;}
+   div.appendChild(t);
+  }catch(e){div.appendChild(el('pre','config parse error: '+e));}
+ }
+ const withP = ups.filter(u=>u.parameters);
+ if(!withP.length) return;
+ const names = Object.keys(withP[withP.length-1].parameters);
+ const sel=document.getElementById('param');
+ if(sel.options.length!==names.length){
+  sel.textContent='';
+  for(const n of names){const o=el('option',n); o.value=n; sel.appendChild(o);}
+  sel.onchange=()=>{chosen=sel.value; refresh();};
+ }
+ const name = chosen || names[0];
+ const pts = withP.filter(u=>u.parameters[name])
+   .map(u=>[u.iteration, u.parameters[name].meanMagnitude]);
+ drawLine(document.getElementById('mm'), pts, '#083');
+}
+refresh(); setInterval(refresh, 3000);""")
+
+
+_HISTOGRAM = _page(
+    "Histograms",
+    """<div class="card"><h2>Parameter <select id="param"></select></h2>
+<svg id="hp"></svg></div>
+<div class="card"><h2>Update (param delta)</h2><svg id="hu"></svg></div>""",
+    """
+let chosen=null;
+async function refresh(){
+ const sid = await latestSession(); if(!sid) return;
+ const ups = await (await fetch('/api/updates/'+sid)).json();
+ const withH = ups.filter(u=>u.parameters &&
+   Object.values(u.parameters).some(p=>p.histogram));
+ if(!withH.length) return;
+ const last = withH[withH.length-1];
+ const names = Object.keys(last.parameters);
+ const sel=document.getElementById('param');
+ if(sel.options.length!==names.length){
+  sel.textContent='';
+  for(const n of names){const o=el('option',n); o.value=n; sel.appendChild(o);}
+  sel.onchange=()=>{chosen=sel.value; refresh();};
+ }
+ const name = chosen || names[0];
+ const ph = last.parameters[name] && last.parameters[name].histogram;
+ if(ph) drawHistogram(document.getElementById('hp'),
+                      ph.counts, ph.min, ph.max);
+ const uh = last.updates && last.updates[name] &&
+            last.updates[name].histogram;
+ if(uh) drawHistogram(document.getElementById('hu'),
+                      uh.counts, uh.min, uh.max, '#c60');
+}
+refresh(); setInterval(refresh, 3000);""")
+
+
+_TSNE = _page(
+    "t-SNE",
+    """<div class="card"><h2>Embedding scatter</h2>
+<svg id="scatter" style="height:420px"></svg></div>
+<div class="card">Upload coords:
+ POST /api/tsne/&lt;session&gt; {"coords": [[x,y],...], "labels": [...]}</div>""",
+    """
+async function refresh(){
+ const sid = await latestSession(); if(!sid) return;
+ const r = await fetch('/api/tsne/'+sid);
+ if(!r.ok) return;
+ const d = await r.json();
+ if(d && d.coords) drawScatter(document.getElementById('scatter'),
+                               d.coords, d.labels);
+}
+refresh(); setInterval(refresh, 5000);""")
 
 
 class _Handler(BaseHTTPRequestHandler):
     storage = None
+    tsne = None  # session_id -> {"coords": ..., "labels": ...}
 
     def log_message(self, *a):   # silence request logging
         pass
@@ -84,27 +277,60 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _html(self, page):
+        body = page.encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         s = self.storage
         if self.path in ("/", "/train", "/train/overview"):
-            body = _PAGE.encode("utf-8")
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._html(_OVERVIEW)
+        elif self.path == "/train/model":
+            self._html(_MODEL)
+        elif self.path == "/train/histogram":
+            self._html(_HISTOGRAM)
+        elif self.path == "/tsne":
+            self._html(_TSNE)
         elif self.path == "/api/sessions":
             self._json(s.list_session_ids() if s else [])
         elif self.path.startswith("/api/static/"):
-            self._json(s.get_static_info(self.path.split("/")[-1]) or {})
+            self._json((s.get_static_info(self.path.split("/")[-1]) or {})
+                       if s else {})
         elif self.path.startswith("/api/updates/"):
-            self._json(s.get_all_updates(self.path.split("/")[-1]))
+            self._json(s.get_all_updates(self.path.split("/")[-1])
+                       if s else [])
+        elif self.path.startswith("/api/tsne/"):
+            sid = self.path.split("/")[-1]
+            data = (self.tsne or {}).get(sid)
+            if data is None:
+                self._json({"error": "no tsne data"}, 404)
+            else:
+                self._json(data)
         else:
             self._json({"error": "not found"}, 404)
 
     def do_POST(self):
         n = int(self.headers.get("Content-Length", 0))
-        payload = json.loads(self.rfile.read(n) or b"{}")
+        try:
+            payload = json.loads(self.rfile.read(n) or b"{}")
+        except (ValueError, UnicodeDecodeError):
+            self._json({"error": "bad json"}, 400)
+            return
+        if self.path.startswith("/api/tsne/"):
+            sid = self.path.split("/")[-1]
+            if self.tsne is None:
+                type(self).tsne = {}
+            self.tsne[sid] = {"coords": payload.get("coords", []),
+                              "labels": payload.get("labels")}
+            self._json({"ok": True})
+            return
+        if self.storage is None:
+            self._json({"error": "no storage attached"}, 503)
+            return
         if self.path == "/remoteReceive/static":
             self.storage.put_static_info(payload)
             self._json({"ok": True})
@@ -136,7 +362,8 @@ class UIServer:
 
     def attach(self, storage):
         self.storage = storage
-        handler = type("BoundHandler", (_Handler,), {"storage": storage})
+        handler = type("BoundHandler", (_Handler,),
+                       {"storage": storage, "tsne": {}})
         if self._httpd is None:
             self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
                                               handler)
@@ -146,6 +373,20 @@ class UIServer:
             self._thread.start()
         else:
             self._httpd.RequestHandlerClass = handler
+        return self
+
+    def start(self):
+        """Serve without a storage attached (remote-receive-only use);
+        POSTs to /remoteReceive return 503 until attach() is called."""
+        if self._httpd is None:
+            handler = type("BoundHandler", (_Handler,),
+                           {"storage": None, "tsne": {}})
+            self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                              handler)
+            self.port = self._httpd.server_address[1]
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True)
+            self._thread.start()
         return self
 
     def stop(self):

@@ -67,6 +67,7 @@ class StatsListener(IterationListener):
         self._total_minibatches = 0
         self._init_sent = False
         self._start_time = time.time()
+        self._prev_params = None
 
     # ------------------------------------------------------------------
     def iteration_done(self, model, iteration):
@@ -101,9 +102,19 @@ class StatsListener(IterationListener):
             report["learningRates"] = self._learning_rates(model)
         if c.collect_mean or c.collect_stdev or c.collect_histograms:
             bins = c.histogram_bins if c.collect_histograms else None
-            report["parameters"] = {
-                name: _summary(arr, bins)
-                for name, arr in self._param_arrays(model)}
+            params = dict(self._param_arrays(model))
+            report["parameters"] = {name: _summary(arr, bins)
+                                    for name, arr in params.items()}
+            # "updates" = param deltas since the last report (reference
+            # BaseStatsListener collects update histograms the same way the
+            # updater writes them; the delta over report_frequency steps is
+            # the TPU-side equivalent without capturing gradients off-device)
+            if self._prev_params is not None:
+                report["updates"] = {
+                    name: _summary(arr - self._prev_params[name], bins)
+                    for name, arr in params.items()
+                    if name in self._prev_params}
+            self._prev_params = params
         self.router.put_update(report)
 
     # ------------------------------------------------------------------

@@ -22,6 +22,10 @@
 
 extern "C" {
 
+// Bumped whenever an exported signature changes; the Python loader refuses
+// (and rebuilds) a library whose version doesn't match.
+int64_t dl4j_abi_version() { return 2; }
+
 // ---------------------------------------------------------------------------
 // IDX parsing (reference: datasets/mnist/MnistImageFile binary reader)
 // ---------------------------------------------------------------------------
@@ -31,11 +35,13 @@ static uint32_t read_be32(const unsigned char* p) {
          (uint32_t(p[2]) << 8) | uint32_t(p[3]);
 }
 
-// Parses an IDX file of unsigned bytes. On success fills dims[0..ndim) and
-// returns a malloc'd float32 buffer (values scaled by `scale`, e.g. 1/255).
-// Caller frees with dl4j_free. Returns nullptr on failure.
+// Parses an IDX file of unsigned bytes. On success fills dims[0..ndim),
+// writes the validated element count to count_out, and returns a malloc'd
+// float32 buffer (values scaled by `scale`, e.g. 1/255). Caller frees with
+// dl4j_free. Returns nullptr on failure.
 float* dl4j_read_idx_u8(const char* path, double scale, int32_t* ndim_out,
-                        int64_t* dims_out /* size >= 4 */) {
+                        int64_t* dims_out /* size >= 4 */,
+                        int64_t* count_out) {
   FILE* f = fopen(path, "rb");
   if (!f) return nullptr;
   unsigned char header[4];
@@ -49,6 +55,11 @@ float* dl4j_read_idx_u8(const char* path, double scale, int32_t* ndim_out,
     fclose(f);
     return nullptr;
   }
+  // File-supplied dims are untrusted: bound each dim and check the running
+  // product for overflow so a corrupt header can't wrap `total` to a small
+  // value and cause an undersized allocation / OOB read downstream.
+  const int64_t kMaxDim = (int64_t)1 << 31;
+  const int64_t kMaxTotal = (int64_t)1 << 40;  // 1 TiB of u8 — far above any real IDX
   int64_t total = 1;
   for (int i = 0; i < ndim; ++i) {
     unsigned char d[4];
@@ -57,6 +68,10 @@ float* dl4j_read_idx_u8(const char* path, double scale, int32_t* ndim_out,
       return nullptr;
     }
     dims_out[i] = read_be32(d);
+    if (dims_out[i] <= 0 || dims_out[i] > kMaxDim || total > kMaxTotal / dims_out[i]) {
+      fclose(f);
+      return nullptr;
+    }
     total *= dims_out[i];
   }
   std::vector<unsigned char> raw(total);
@@ -70,6 +85,7 @@ float* dl4j_read_idx_u8(const char* path, double scale, int32_t* ndim_out,
   const float s = (float)scale;
   for (int64_t i = 0; i < total; ++i) out[i] = raw[i] * s;
   *ndim_out = ndim;
+  *count_out = total;
   return out;
 }
 
@@ -109,12 +125,23 @@ float* dl4j_parse_csv(const char* path, char delim, int64_t skip_lines,
       p = line_end + 1;
       continue;
     }
+    // Skip lines containing only whitespace/'\r' (e.g. a '\r'-only blank line
+    // in a CRLF file) — strtof skips leading whitespace including '\n', so
+    // letting it run would read past line_end into the next line.
+    {
+      const char* w = p;
+      while (w < line_end && (*w == ' ' || *w == '\t' || *w == '\r')) ++w;
+      if (w == line_end) {  // line_no already counted above
+        p = line_end + 1;
+        continue;
+      }
+    }
     int64_t c = 0;
     const char* q = p;
     while (q < line_end) {
       char* num_end = nullptr;
       float v = strtof(q, &num_end);
-      if (num_end == q) return nullptr;  // parse failure
+      if (num_end == q || num_end > line_end) return nullptr;  // parse failure / ran past line
       values.push_back(v);
       ++c;
       q = num_end;

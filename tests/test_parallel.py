@@ -91,6 +91,43 @@ class TestLocalStepsMode:
         assert net.conf.iteration_count == 12 * 8
 
 
+def make_cg_net(seed=42, lr=0.2):
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).learning_rate(lr).updater("sgd")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("h", DenseLayer(n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss_function="mcxent"), "h")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+class TestComputationGraphParallel:
+    def test_cg_allreduce_fit(self):
+        net = make_cg_net()
+        pw = (ParallelWrapper.Builder(net).workers(8)
+              .averaging_frequency(1).build())
+        x, y = blob_data()
+        ds = DataSet(x, y)
+        s0 = net.score(ds)
+        pw.fit(ListDataSetIterator(ds, 40), num_epochs=15)
+        assert net.score(ds) < s0 * 0.6
+
+    def test_cg_param_averaging_mode(self):
+        net = make_cg_net()
+        pw = (ParallelWrapper.Builder(net).workers(8)
+              .averaging_frequency(4).build())
+        x, y = blob_data(n=320)
+        ds = DataSet(x, y)
+        s0 = net.score(ds)
+        pw.fit(ListDataSetIterator(ds, 40), num_epochs=12)
+        assert net.score(ds) < s0 * 0.6
+
+
 class TestTensorParallel:
     def test_tp_fit(self):
         net = make_net()

@@ -96,6 +96,56 @@ def test_ui_server_and_remote_router():
         server.stop()
 
 
+def test_ui_pages_served_and_tsne_upload():
+    storage = InMemoryStatsStorage()
+    net = _net()
+    net.set_listeners(StatsListener(
+        storage, StatsUpdateConfiguration(collect_histograms=True),
+        session_id="s1"))
+    for _ in range(3):
+        net.fit(_ds())
+    server = UIServer(port=0).attach(storage)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        for path, marker in [("/train/model", "Model"),
+                             ("/train/histogram", "Histograms"),
+                             ("/tsne", "t-SNE")]:
+            with urllib.request.urlopen(base + path) as r:
+                assert marker in r.read().decode()
+        # updates carry param + update (delta) summaries for the pages
+        ups = storage.get_all_updates("s1")
+        assert "parameters" in ups[-1] and "updates" in ups[-1]
+        assert "0_W" in ups[-1]["updates"]
+        # t-SNE upload + fetch round trip
+        coords = {"coords": [[0.0, 1.0], [2.0, 3.0]], "labels": ["a", "b"]}
+        req = urllib.request.Request(
+            f"{base}/api/tsne/s1", data=json.dumps(coords).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            assert json.load(r)["ok"]
+        with urllib.request.urlopen(f"{base}/api/tsne/s1") as r:
+            got = json.load(r)
+        assert got["coords"] == coords["coords"]
+        assert got["labels"] == ["a", "b"]
+    finally:
+        server.stop()
+
+
+def test_post_without_storage_returns_503():
+    server = UIServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        req = urllib.request.Request(
+            f"{base}/remoteReceive/update",
+            data=b'{"sessionId": "x"}',
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 503
+    finally:
+        server.stop()
+
+
 def test_listener_events_push():
     storage = InMemoryStatsStorage()
     events = []
