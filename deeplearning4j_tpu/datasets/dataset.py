@@ -65,10 +65,36 @@ class DataSet:
 
     @staticmethod
     def merge(datasets):
-        feats = np.concatenate([d.features for d in datasets], axis=0)
-        labels = (np.concatenate([d.labels for d in datasets], axis=0)
-                  if datasets[0].labels is not None else None)
-        return DataSet(feats, labels)
+        def cat(attr):
+            vals = [getattr(d, attr) for d in datasets]
+            if vals[0] is None:
+                return None
+            return np.concatenate([np.asarray(v) for v in vals], axis=0)
+        return DataSet(cat("features"), cat("labels"),
+                       cat("features_mask"), cat("labels_mask"))
+
+    def save(self, path):
+        """Persist to an .npz file (reference: ND4J DataSet.save — the unit
+        the Export training approach writes to distributed storage)."""
+        arrs = {"features": np.asarray(self.features)}
+        if self.labels is not None:
+            arrs["labels"] = np.asarray(self.labels)
+        if self.features_mask is not None:
+            arrs["features_mask"] = np.asarray(self.features_mask)
+        if self.labels_mask is not None:
+            arrs["labels_mask"] = np.asarray(self.labels_mask)
+        np.savez(path, **arrs)
+
+    @staticmethod
+    def load(path):
+        """reference: ND4J DataSet.load."""
+        with np.load(path) as z:
+            return DataSet(z["features"],
+                           z["labels"] if "labels" in z.files else None,
+                           z["features_mask"] if "features_mask" in z.files
+                           else None,
+                           z["labels_mask"] if "labels_mask" in z.files
+                           else None)
 
 
 class MultiDataSet:

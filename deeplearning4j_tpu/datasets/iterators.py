@@ -47,6 +47,29 @@ class DataSetIterator:
             yield self.next_batch()
 
 
+class FileDataSetIterator(DataSetIterator):
+    """Streams DataSets saved with DataSet.save() from disk, one file per
+    batch — the read side of the Export training approach (reference:
+    spark/iterator/PathSparkDataSetIterator streaming exported files).
+    Only one batch is resident at a time."""
+
+    def __init__(self, paths):
+        self.paths = [str(p) for p in paths]
+        self._i = 0
+
+    def has_next(self):
+        return self._i < len(self.paths)
+
+    def next_batch(self):
+        from .dataset import DataSet
+        ds = DataSet.load(self.paths[self._i])
+        self._i += 1
+        return ds
+
+    def reset(self):
+        self._i = 0
+
+
 class ListDataSetIterator(DataSetIterator):
     """Iterate over a list of pre-batched DataSets (reference:
     datasets/iterator/impl/ListDataSetIterator.java)."""
@@ -247,3 +270,28 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def batch(self):
         return self.underlying.batch()
+
+
+class AsyncMultiDataSetIterator(AsyncDataSetIterator):
+    """Background prefetch of MultiDataSets for ComputationGraph training.
+    reference: datasets/iterator/AsyncMultiDataSetIterator.java — same
+    queue/thread contract as the DataSet variant, staging every input/output
+    array (and masks) to the device off the training thread."""
+
+    @staticmethod
+    def _stage(mds):
+        import jax
+
+        from .dataset import MultiDataSet
+        put = jax.device_put
+        staged = MultiDataSet.__new__(MultiDataSet)
+        staged.features = [put(f) for f in mds.features]
+        staged.labels = [put(l) for l in mds.labels]
+        staged.features_masks = ([put(m) if m is not None else None
+                                  for m in mds.features_masks]
+                                 if mds.features_masks else
+                                 mds.features_masks)
+        staged.labels_masks = ([put(m) if m is not None else None
+                                for m in mds.labels_masks]
+                               if mds.labels_masks else mds.labels_masks)
+        return staged

@@ -185,6 +185,112 @@ class RecordReaderDataSetIterator(DataSetIterator):
         return self.num_classes or -1
 
 
+class RecordReaderMultiDataSetIterator:
+    """Build MultiDataSets from one or more record readers — the multi-input/
+    multi-output feed for ComputationGraph.fit.
+
+    reference: datasets/datavec/RecordReaderMultiDataSetIterator.java — the
+    Builder registers named readers, then declares which column ranges of
+    which reader become which input/output arrays:
+
+        it = (RecordReaderMultiDataSetIterator.Builder(batch_size=16)
+              .add_reader("csv", reader)
+              .add_input("csv", 0, 3)              # cols 0..3 -> input 0
+              .add_input("csv", 4, 5)              # cols 4..5 -> input 1
+              .add_output_one_hot("csv", 6, 4)     # col 6 -> one-hot(4)
+              .add_output("csv", 7, 7)             # col 7 -> regression
+              .build())
+    """
+
+    class Builder:
+        def __init__(self, batch_size):
+            self._batch = int(batch_size)
+            self._readers = {}
+            self._inputs = []    # (reader, from, to)
+            self._outputs = []   # (reader, from, to, one_hot_classes|None)
+
+        def add_reader(self, name, reader):
+            self._readers[str(name)] = reader; return self
+
+        addReader = add_reader
+
+        def add_input(self, reader_name, col_from=0, col_to=None):
+            self._inputs.append((str(reader_name), int(col_from),
+                                 col_to if col_to is None else int(col_to)))
+            return self
+
+        addInput = add_input
+
+        def add_output(self, reader_name, col_from=0, col_to=None):
+            self._outputs.append((str(reader_name), int(col_from),
+                                  col_to if col_to is None else int(col_to),
+                                  None))
+            return self
+
+        addOutput = add_output
+
+        def add_output_one_hot(self, reader_name, column, num_classes):
+            self._outputs.append((str(reader_name), int(column), int(column),
+                                  int(num_classes)))
+            return self
+
+        addOutputOneHot = add_output_one_hot
+
+        def build(self):
+            if not self._inputs or not self._outputs:
+                raise ValueError("Need at least one input and one output")
+            for name, *_ in self._inputs + self._outputs:
+                if name not in self._readers:
+                    raise ValueError(f"No reader registered as '{name}'")
+            return RecordReaderMultiDataSetIterator(
+                self._batch, self._readers, self._inputs, self._outputs)
+
+    def __init__(self, batch_size, readers, inputs, outputs):
+        self.batch_size = int(batch_size)
+        self.readers = readers
+        self.inputs = inputs
+        self.outputs = outputs
+        self.reset()
+
+    def has_next(self):
+        return all(r.has_next() for r in self.readers.values())
+
+    def next_batch(self):
+        from .dataset import MultiDataSet
+        rows = {name: [] for name in self.readers}
+        n = 0
+        while self.has_next() and n < self.batch_size:
+            for name, r in self.readers.items():
+                rows[name].append([float(v) for v in r.next_record()])
+            n += 1
+        mats = {name: np.asarray(v, np.float32) for name, v in rows.items()}
+
+        def cols(m, c0, c1):
+            c1 = m.shape[1] - 1 if c1 is None else c1
+            return m[:, c0:c1 + 1]
+
+        feats = [cols(mats[name], c0, c1) for name, c0, c1 in self.inputs]
+        labels = []
+        for name, c0, c1, onehot in self.outputs:
+            block = cols(mats[name], c0, c1)
+            if onehot is not None:
+                block = np.eye(onehot, dtype=np.float32)[
+                    block[:, 0].astype(np.int64)]
+            labels.append(block)
+        return MultiDataSet(feats, labels)
+
+    next = next_batch
+
+    def reset(self):
+        for r in self.readers.values():
+            r.reset()
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_batch()
+
+
 class SequenceRecordReaderDataSetIterator(DataSetIterator):
     """reference: datasets/datavec/SequenceRecordReaderDataSetIterator.java.
 

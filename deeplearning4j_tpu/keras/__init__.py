@@ -1,8 +1,10 @@
-from .keras_import import (import_keras_model_configuration,
+from .keras_import import (import_keras_model_and_weights,
+                           import_keras_model_configuration,
                            import_keras_sequential_model_and_weights)
 
 KerasModelImport = __import__(
     "deeplearning4j_tpu.keras.keras_import", fromlist=["keras_import"])
 
-__all__ = ["KerasModelImport", "import_keras_model_configuration",
+__all__ = ["KerasModelImport", "import_keras_model_and_weights",
+           "import_keras_model_configuration",
            "import_keras_sequential_model_and_weights"]

@@ -47,10 +47,7 @@ def _map_activation(name):
 # Public API — reference KerasModelImport.java
 # ---------------------------------------------------------------------------
 
-def import_keras_sequential_model_and_weights(h5_path):
-    """Read a Keras 1.x sequential model saved via model.save(): topology from
-    the `model_config` attribute, weights from `model_weights`.
-    reference: KerasModelImport.importKerasSequentialModelAndWeights."""
+def _read_model_file(h5_path):
     import h5py
     with h5py.File(h5_path, "r") as f:
         cfg = f.attrs["model_config"]
@@ -59,10 +56,38 @@ def import_keras_sequential_model_and_weights(h5_path):
         model_cfg = json.loads(cfg)
         weights = _read_weight_groups(f["model_weights"]
                                       if "model_weights" in f else f)
+    return model_cfg, weights
+
+
+def import_keras_sequential_model_and_weights(h5_path):
+    """Read a Keras 1.x sequential model saved via model.save(): topology from
+    the `model_config` attribute, weights from `model_weights`.
+    reference: KerasModelImport.importKerasSequentialModelAndWeights."""
+    model_cfg, weights = _read_model_file(h5_path)
     return _build_sequential(model_cfg, weights)
 
 
 importKerasSequentialModelAndWeights = import_keras_sequential_model_and_weights
+
+
+def import_keras_model_and_weights(h5_path):
+    """Read a Keras model saved via model.save(). Sequential models build a
+    MultiLayerNetwork; functional `Model`s (Keras 1 "Model" / modern
+    "Functional") build a ComputationGraph — the reference's primary import
+    path (KerasModel.java:57 -> ComputationGraphConfiguration,
+    KerasModelImport.java:135).
+
+    Both the Keras 1.x config dialect (output_dim / nb_filter / Merge with
+    mode=...) and the modern dialect (units / filters / Add / Concatenate)
+    are understood, so fixtures written by today's Keras import identically
+    to period files."""
+    model_cfg, weights = _read_model_file(h5_path)
+    if model_cfg.get("class_name") == "Sequential":
+        return _build_sequential(model_cfg, weights)
+    return _build_functional(model_cfg, weights)
+
+
+importKerasModelAndWeights = import_keras_model_and_weights
 
 
 def import_keras_model_configuration(json_path_or_str):
@@ -164,33 +189,52 @@ def _input_type_of(first_layer_cfg):
     raise ValueError(f"Unsupported input shape {shape}")
 
 
+def _pair_of(v, default):
+    if v is None:
+        return default
+    if isinstance(v, (int, float)):
+        return (int(v), int(v))
+    return tuple(int(x) for x in v)
+
+
 def _map_layer(cls, cfg, dim_ordering):
     """Keras layer config -> our LayerConf (or None for structural layers).
+    Understands both the Keras 1 dialect (output_dim / nb_filter / nb_row /
+    subsample / border_mode / p) and the modern one (units / filters /
+    kernel_size / strides / padding / rate).
     reference: KerasLayer layer-by-layer mapping."""
     act = cfg.get("activation", "linear")
+    same = (cfg.get("border_mode") or cfg.get("padding", "valid")) == "same"
     if cls == "Dense":
-        return DenseLayer(n_out=int(cfg["output_dim"]),
+        n_out = cfg.get("output_dim", cfg.get("units"))
+        return DenseLayer(n_out=int(n_out),
                           activation=_map_activation(act)), False
     if cls in ("Convolution2D", "Conv2D"):
+        n_out = cfg.get("nb_filter", cfg.get("filters"))
+        if "nb_row" in cfg:
+            kernel = (int(cfg["nb_row"]), int(cfg["nb_col"]))
+        else:
+            kernel = _pair_of(cfg.get("kernel_size"), (3, 3))
+        stride = _pair_of(cfg.get("subsample") or cfg.get("strides"), (1, 1))
         return ConvolutionLayer(
-            n_out=int(cfg["nb_filter"]),
-            kernel_size=(int(cfg["nb_row"]), int(cfg["nb_col"])),
-            stride=tuple(cfg.get("subsample", (1, 1))),
-            convolution_mode=("same" if cfg.get("border_mode") == "same"
-                              else "truncate"),
+            n_out=int(n_out), kernel_size=kernel, stride=stride,
+            convolution_mode=("same" if same else "truncate"),
             activation=_map_activation(act)), False
     if cls in ("MaxPooling2D", "AveragePooling2D"):
+        pool = _pair_of(cfg.get("pool_size"), (2, 2))
         return SubsamplingLayer(
             pooling_type="max" if cls.startswith("Max") else "avg",
-            kernel_size=tuple(cfg.get("pool_size", (2, 2))),
-            stride=tuple(cfg.get("strides") or cfg.get("pool_size", (2, 2))),
-            convolution_mode=("same" if cfg.get("border_mode") == "same"
-                              else "truncate")), False
+            kernel_size=pool,
+            stride=_pair_of(cfg.get("strides"), pool),
+            convolution_mode=("same" if same else "truncate")), False
     if cls == "LSTM":
-        return GravesLSTM(n_out=int(cfg["output_dim"]),
+        n_out = cfg.get("output_dim", cfg.get("units"))
+        return GravesLSTM(n_out=int(n_out),
                           activation=_map_activation(act),
                           gate_activation=_map_activation(
-                              cfg.get("inner_activation", "hard_sigmoid")),
+                              cfg.get("inner_activation",
+                                      cfg.get("recurrent_activation",
+                                              "hard_sigmoid"))),
                           forget_gate_bias_init=0.0), False
     if cls == "Embedding":
         return EmbeddingLayer(n_in=int(cfg["input_dim"]),
@@ -202,13 +246,253 @@ def _map_layer(cls, cfg, dim_ordering):
     if cls == "Activation":
         return ActivationLayer(activation=_map_activation(act)), False
     if cls == "Dropout":
-        # Keras p = drop probability; ours = retain probability
-        return DropoutLayer(dropout=1.0 - float(cfg.get("p", 0.5))), False
+        # Keras p/rate = drop probability; ours = retain probability
+        p = cfg.get("p", cfg.get("rate", 0.5))
+        return DropoutLayer(dropout=1.0 - float(p)), False
     if cls == "ZeroPadding2D":
-        return ZeroPaddingLayer(pad=tuple(cfg.get("padding", (1, 1)))), False
+        pad = cfg.get("padding", (1, 1))
+        if isinstance(pad, (list, tuple)) and pad and \
+                isinstance(pad[0], (list, tuple)):
+            pad = (pad[0][0], pad[1][0])   # symmetric ((t,b),(l,r)) form
+        return ZeroPaddingLayer(pad=_pair_of(pad, (1, 1))), False
     if cls in ("Flatten", "Reshape", "InputLayer"):
         return None, True
     raise ValueError(f"Unsupported Keras layer type '{cls}'")
+
+
+# Merge-style layers -> graph vertices (functional path only).
+# Keras 1: one "Merge" class with a mode; modern: one class per op.
+_MERGE_MODES = {"sum": "add", "add": "add", "mul": "product",
+                "ave": "average", "average": "average", "max": "max",
+                "sub": "subtract", "subtract": "subtract"}
+_MERGE_CLASSES = {"Add": "add", "Multiply": "product", "Average": "average",
+                  "Maximum": "max", "Subtract": "subtract"}
+
+
+def _map_merge(cls, cfg):
+    """Returns a GraphVertexConf for merge-style layers, else None."""
+    from ..nn.conf.graph_vertices import ElementWiseVertex, MergeVertex
+    if cls == "Merge":   # Keras 1
+        mode = cfg.get("mode", "sum")
+        if mode in ("concat", "concatenate"):
+            return MergeVertex()
+        if mode in _MERGE_MODES:
+            return ElementWiseVertex(op=_MERGE_MODES[mode])
+        raise ValueError(f"Unsupported Keras Merge mode '{mode}'")
+    if cls in _MERGE_CLASSES:
+        return ElementWiseVertex(op=_MERGE_CLASSES[cls])
+    if cls == "Concatenate":
+        return MergeVertex()
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Functional Model -> ComputationGraph build
+# reference: KerasModel.java:57 (getComputationGraphConfiguration +
+# getComputationGraph)
+# ---------------------------------------------------------------------------
+
+def _inbound_names(lc):
+    """Names of the layers feeding `lc`, across config dialects.
+
+    Keras 1/2 classic: inbound_nodes = [[[name, node_idx, tensor_idx, ...],
+    ...]]; modern Keras: inbound_nodes = [{"args": [tensor-or-list], ...}]
+    with __keras_tensor__ dicts carrying keras_history = [name, ...]."""
+    nodes = lc.get("inbound_nodes", [])
+    if not nodes:
+        return []
+    node = nodes[0]
+    names = []
+    if isinstance(node, dict):                      # modern dialect
+        def collect(obj):
+            if isinstance(obj, dict):
+                if obj.get("class_name") == "__keras_tensor__":
+                    names.append(obj["config"]["keras_history"][0])
+                else:
+                    for v in obj.values():
+                        collect(v)
+            elif isinstance(obj, (list, tuple)):
+                for v in obj:
+                    collect(v)
+        collect(node.get("args", []))
+        return names
+    for entry in node:                              # classic dialect
+        names.append(entry[0])
+    return names
+
+
+def _keras_input_type(cfg, dim_ordering):
+    shape = cfg.get("batch_input_shape") or cfg.get("batch_shape")
+    if shape is None:
+        raise ValueError("InputLayer has no batch_input_shape/batch_shape")
+    dims = list(shape[1:])
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1])
+    if len(dims) == 3:
+        if dim_ordering in ("th", "channels_first"):
+            c, h, w = dims
+        else:
+            h, w, c = dims
+        return InputType.convolutional(h, w, c)
+    raise ValueError(f"Unsupported input shape {shape}")
+
+
+def _ref_names(refs):
+    """[[name, 0, 0], ...] or [name, 0, 0] -> [name, ...]"""
+    if refs and isinstance(refs[0], str):
+        refs = [refs]
+    return [r[0] for r in refs]
+
+
+def _build_functional(model_cfg, weights):
+    """Functional Model JSON -> ComputationGraph (+ weight copy).
+
+    Structural Flatten/Reshape layers dissolve into name aliases (the
+    GraphBuilder auto-inserts CnnToFeedForward preprocessors); Merge-family
+    layers become MergeVertex/ElementWiseVertex; a network-output Dense with
+    a softmax/sigmoid activation becomes an OutputLayer so the imported
+    graph is trainable via fit() (the reference's enforceTrainingConfig
+    behavior)."""
+    cls_name = model_cfg.get("class_name")
+    if cls_name not in ("Model", "Functional"):
+        raise ValueError(f"Expected functional Model, got {cls_name}")
+    cfg = model_cfg["config"]
+    layer_cfgs = cfg["layers"]
+    output_names = set(_ref_names(cfg.get("output_layers", [])))
+
+    dim_ordering = "tf"
+    for lc in layer_cfgs:
+        do = lc["config"].get("dim_ordering") or lc["config"].get("data_format")
+        if do:
+            dim_ordering = do
+            break
+
+    gb = (NeuralNetConfiguration.Builder().seed(12345).graph_builder())
+    alias = {}            # keras name -> vertex/input name it resolves to
+    input_types = []
+    input_names = []
+    mapped = []           # (vertex_name, our LayerConf, keras cfg)
+    dense_after_flatten = set()
+    flatten_sources = set()
+
+    for lc in layer_cfgs:
+        cls = lc["class_name"]
+        kcfg = lc["config"]
+        name = kcfg.get("name") or lc.get("name")
+        inputs = [alias.get(n, n) for n in _inbound_names(lc)]
+        if cls == "InputLayer":
+            input_names.append(name)
+            input_types.append(_keras_input_type(kcfg, dim_ordering))
+            gb.add_inputs(name)
+            alias[name] = name
+            continue
+        merge = _map_merge(cls, kcfg)
+        if merge is not None:
+            gb.add_vertex(name, merge, *inputs)
+            alias[name] = name
+            continue
+        layer, structural = _map_layer(cls, kcfg, dim_ordering)
+        if structural or layer is None:
+            # Flatten/Reshape dissolve: downstream preprocessor inference
+            # reproduces the shape change
+            alias[name] = inputs[0]
+            if cls == "Flatten":
+                flatten_sources.add(inputs[0])
+            continue
+        if (name in output_names and isinstance(layer, DenseLayer)
+                and layer.activation in ("softmax", "sigmoid")):
+            loss = "mcxent" if layer.activation == "softmax" else "xent"
+            layer = OutputLayer(n_out=layer.n_out,
+                                activation=layer.activation,
+                                loss_function=loss)
+        if (isinstance(layer, (DenseLayer, OutputLayer))
+                and inputs and inputs[0] in flatten_sources
+                and dim_ordering in ("th", "channels_first")):
+            dense_after_flatten.add(name)
+        gb.add_layer(name, layer, *inputs)
+        alias[name] = name
+        mapped.append((name, layer, lc))
+
+    gb.set_outputs(*[alias.get(n, n)
+                     for n in _ref_names(cfg.get("output_layers", []))])
+    in_order = _ref_names(cfg.get("input_layers", [])) or input_names
+    gb.set_input_types(*[input_types[input_names.index(n)]
+                         for n in in_order])
+    graph_conf = gb.build()
+    from ..nn.graph import ComputationGraph
+    net = ComputationGraph(graph_conf).init()
+    if weights is not None:
+        _copy_weights_graph(net, mapped, weights, dense_after_flatten,
+                            graph_conf)
+    return net
+
+
+def _copy_weights_graph(net, mapped, weights, dense_after_flatten, conf):
+    """Copy Keras weight arrays into the ComputationGraph's name-keyed param
+    pytree. reference: KerasModel.copyWeights."""
+    import jax.numpy as jnp
+
+    params = {n: dict(p) for n, p in net._params.items()}
+    state = {n: (dict(s) if isinstance(s, dict) else s)
+             for n, s in net._model_state.items()}
+    types = getattr(conf, "vertex_output_types", {})
+    for name, layer, lc in mapped:
+        cls = lc["class_name"]
+        w = weights.get(name, [])
+        if not w:
+            continue
+        if cls == "Dense":
+            W, b = w[0], w[1]
+            if name in dense_after_flatten:
+                # rows are CHW-ordered (channels-first flatten); ours HWC
+                src = conf.vertices[name].inputs[0]
+                t = types.get(src)
+                if t is not None and hasattr(t, "channels"):
+                    c, h, ww = t.channels, t.height, t.width
+                    W = (W.reshape(c, h, ww, -1).transpose(1, 2, 0, 3)
+                         .reshape(c * h * ww, -1))
+            params[name]["W"] = jnp.asarray(W)
+            params[name]["b"] = jnp.asarray(np.asarray(b).ravel())
+        elif cls in ("Convolution2D", "Conv2D"):
+            W, b = w[0], w[1]
+            do = lc["config"].get("dim_ordering") or \
+                lc["config"].get("data_format")
+            th = (do in ("th", "channels_first") if do is not None
+                  else (W.shape[0] == layer.n_out
+                        and W.shape[-1] != layer.n_out))
+            if th:
+                W = W.transpose(2, 3, 1, 0)   # OIHW -> HWIO
+            params[name]["W"] = jnp.asarray(W)
+            params[name]["b"] = jnp.asarray(np.asarray(b).ravel())
+        elif cls == "LSTM":
+            if len(w) == 12:   # Keras 1: per-gate i,c,f,o triplets
+                (Wi, Ui, bi, Wc, Uc, bc, Wf, Uf, bf, Wo, Uo, bo) = w
+                W = np.concatenate([Wc, Wi, Wf, Wo], axis=1)
+                RW = np.concatenate([Uc, Ui, Uf, Uo], axis=1)
+                b = np.concatenate([bc, bi, bf, bo])
+            else:              # modern: fused kernels, gate order i,f,c,o
+                K, R, b4 = w[0], w[1], w[2]
+                H = K.shape[1] // 4
+                def regate(a, axis):
+                    i, f, c, o = np.split(a, 4, axis=axis)
+                    return np.concatenate([c, i, f, o], axis=axis)
+                W, RW, b = regate(K, 1), regate(R, 1), regate(b4, 0)
+            params[name]["W"] = jnp.asarray(W)
+            params[name]["RW"] = jnp.asarray(RW)
+            params[name]["b"] = jnp.asarray(b)
+        elif cls == "Embedding":
+            params[name]["W"] = jnp.asarray(w[0])
+            params[name]["b"] = jnp.zeros((layer.n_out,), jnp.float32)
+        elif cls == "BatchNormalization":
+            gamma, beta, mean, var = w[0], w[1], w[2], w[3]
+            params[name]["gamma"] = jnp.asarray(gamma)
+            params[name]["beta"] = jnp.asarray(beta)
+            state[name] = {"mean": jnp.asarray(mean),
+                           "var": jnp.asarray(np.abs(var))}
+    net._params = params
+    net._model_state = state
 
 
 def _copy_weights(net, mapped, weights, flatten_perm, conf):

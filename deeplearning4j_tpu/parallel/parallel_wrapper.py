@@ -37,7 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..datasets.dataset import DataSet
 from ..datasets.iterators import ListDataSetIterator
-from .sharding import make_mesh, shard_params
+from .sharding import make_mesh, put_sharded, replicate, shard_params
 
 log = logging.getLogger(__name__)
 
@@ -112,18 +112,19 @@ class ParallelWrapper:
         net = self.model
         net._params, self._param_shardings = shard_params(
             net, self.mesh, self.tensor_parallel)
-        repl = NamedSharding(self.mesh, P())
-        net._updater_state = jax.device_put(net._updater_state, repl)
-        net._model_state = jax.device_put(net._model_state, repl)
+        net._updater_state = replicate(net._updater_state, self.mesh)
+        net._model_state = replicate(net._model_state, self.mesh)
         self._sharded = True
 
     def _put_batch(self, arr):
+        """Shard a batch over the "data" axis. On a multi-host mesh `arr` is
+        the process-LOCAL slice of the global batch (each host feeds its own
+        shard; see distributed.process_local_batch_slice)."""
         if arr is None:
             return None
-        arr = jnp.asarray(arr)
-        spec = [None] * arr.ndim
+        spec = [None] * np.ndim(arr)
         spec[0] = "data"
-        return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
+        return put_sharded(arr, NamedSharding(self.mesh, P(*spec)))
 
     # ------------------------------------------------------------------
     def fit(self, data, num_epochs=1):
@@ -184,6 +185,13 @@ class ParallelWrapper:
                 "iteration": jnp.asarray(net.conf.iteration_count, jnp.float32),
                 "rng": step_rng,
             }
+            from .sharding import is_multiprocess_mesh
+            if is_multiprocess_mesh(self.mesh):
+                # host-committed scalars (same value on every process) are
+                # what a multi-process jit accepts; local device arrays are
+                # not addressable across hosts
+                batch["iteration"] = np.float32(net.conf.iteration_count)
+                batch["rng"] = np.asarray(step_rng)
             (net._params, net._updater_state, net._model_state, score,
              _) = self._jit_step(net._params, net._updater_state,
                                  net._model_state, batch)
@@ -281,6 +289,16 @@ class ParallelWrapper:
         if parts[0][3] is not None:
             batches_tree["lmask"] = jax.tree.map(stack,
                                                  *[p[3] for p in parts])
+        from .sharding import is_multiprocess_mesh
+        if is_multiprocess_mesh(self.mesh):
+            # multi-host: leaves must be global arrays before the jit call
+            # (each process contributed its local [k, B_local, ...] stack)
+            shard_keys = ("features", "labels", "fmask", "lmask")
+            for key in list(batches_tree):
+                sp = (P(None, "data") if key in shard_keys else P())
+                batches_tree[key] = jax.tree.map(
+                    lambda a: put_sharded(a, NamedSharding(self.mesh, sp)),
+                    batches_tree[key])
         if self._jit_kstep is None:
             self._jit_kstep = self._build_kstep()(batches_tree)
         (net._params, net._updater_state, net._model_state,

@@ -102,9 +102,38 @@ def shard_params(net, mesh, tensor_parallel=False):
         shardings = [
             _layer_sharding(layer, p, mesh, tensor_parallel)
             for layer, p in zip(net.layers, net._params)]
-    sharded = jax.device_put(net._params, shardings)
+    if isinstance(shardings, dict):
+        sharded = {n: {k: put_sharded(v, shardings[n][k])
+                       for k, v in p.items()}
+                   for n, p in net._params.items()}
+    else:
+        sharded = [{k: put_sharded(v, d[k]) for k, v in p.items()}
+                   for d, p in zip(shardings, net._params)]
     return sharded, shardings
 
 
+def is_multiprocess_mesh(mesh):
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def put_sharded(arr, sharding):
+    """Place an array under `sharding`, working on single-host AND
+    multi-host meshes. Multi-host (jax.distributed) device_put cannot
+    address other hosts' devices; there each process contributes its local
+    data via make_array_from_process_local_data (replicated leaves pass the
+    full array; "data"-sharded batches pass the process-local slice).
+    This is the DCN-path seam: the same ParallelWrapper program runs on a
+    global mesh spanning hosts (SURVEY.md §5.8)."""
+    if arr is None:
+        return None
+    if is_multiprocess_mesh(sharding.mesh):
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(arr))
+    return jax.device_put(arr, sharding)
+
+
 def replicate(tree, mesh):
-    return jax.device_put(tree, NamedSharding(mesh, P()))
+    sh = NamedSharding(mesh, P())
+    if is_multiprocess_mesh(mesh):
+        return jax.tree.map(lambda a: put_sharded(a, sh), tree)
+    return jax.device_put(tree, sh)

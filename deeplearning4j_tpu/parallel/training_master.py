@@ -71,6 +71,24 @@ class ParameterAveragingTrainingMaster:
             self._collect_stats = False
             self._avg_updaters = True
             self._mesh = None
+            self._approach = "export"
+            self._export_dir = None
+
+        def rdd_training_approach(self, v):
+            """'export' (reference default: batch to disk, stream per split —
+            ParameterAveragingTrainingMaster.java:98-103) or 'direct'
+            (materialize in host RAM)."""
+            v = str(v).lower()
+            if v not in ("export", "direct"):
+                raise ValueError(f"Unknown training approach '{v}'")
+            self._approach = v; return self
+
+        rddTrainingApproach = rdd_training_approach
+
+        def export_directory(self, v):
+            self._export_dir = str(v); return self
+
+        exportDirectory = export_directory
 
         def batch_size_per_worker(self, v):
             self._batch = int(v); return self
@@ -99,11 +117,13 @@ class ParameterAveragingTrainingMaster:
         def build(self):
             return ParameterAveragingTrainingMaster(
                 self._batch, self._workers, self._avg_freq,
-                self._avg_updaters, self._collect_stats, self._mesh)
+                self._avg_updaters, self._collect_stats, self._mesh,
+                self._approach, self._export_dir)
 
     def __init__(self, batch_size_per_worker=16, workers=None,
                  averaging_frequency=5, average_updaters=True,
-                 collect_stats=False, mesh=None):
+                 collect_stats=False, mesh=None, approach="export",
+                 export_dir=None):
         import jax
         self.batch_size = int(batch_size_per_worker)
         self.num_workers = int(workers or len(jax.devices()))
@@ -111,8 +131,11 @@ class ParameterAveragingTrainingMaster:
         self.average_updaters = average_updaters
         self.collect_stats = collect_stats
         self.mesh = mesh
+        self.approach = approach
+        self.export_dir = export_dir
         self.stats = TrainingMasterStats() if collect_stats else None
         self._pw = None
+        self._export_cache = None   # (data id, [paths], owned_tmpdir)
 
     # -- config serde (reference: toJson:242) ---------------------------
     def to_json(self):
@@ -122,6 +145,8 @@ class ParameterAveragingTrainingMaster:
             "workers": self.num_workers,
             "averagingFrequency": self.averaging_frequency,
             "averageUpdaters": self.average_updaters,
+            "rddTrainingApproach": self.approach,
+            "exportDirectory": self.export_dir,
         })
 
     toJson = to_json
@@ -131,18 +156,16 @@ class ParameterAveragingTrainingMaster:
         d = json.loads(s)
         return ParameterAveragingTrainingMaster(
             d.get("batchSizePerWorker", 16), d.get("workers"),
-            d.get("averagingFrequency", 5), d.get("averageUpdaters", True))
+            d.get("averagingFrequency", 5), d.get("averageUpdaters", True),
+            approach=d.get("rddTrainingApproach", "export"),
+            export_dir=d.get("exportDirectory"))
 
     fromJson = from_json
 
     # ------------------------------------------------------------------
-    def execute_training(self, net, data):
-        """data: list[DataSet] | DataSetIterator | one big DataSet.
-        reference: executeTraining:344 — split, broadcast, map, aggregate."""
+    def _ensure_pw(self, net):
         from .sharding import make_mesh
         import jax
-
-        examples = self._collect_examples(data)
         if self._pw is None:
             mesh = self.mesh or make_mesh(
                 n_data=self.num_workers, n_model=1,
@@ -152,10 +175,36 @@ class ParameterAveragingTrainingMaster:
                         .averaging_frequency(self.averaging_frequency)
                         .average_updaters(self.average_updaters)
                         .build())
+        return self._pw
 
+    def execute_training(self, net, data):
+        """data: list[DataSet] | DataSetIterator | one big DataSet.
+        reference: executeTraining:344 — split, broadcast, map, aggregate.
+
+        approach='export' (default, matching the reference's
+        RDDTrainingApproach.Export): the source is streamed ONCE into
+        global-batch .npz files (one per ParallelWrapper step), then splits
+        stream batch-by-batch from disk — host memory holds at most one
+        global batch, so datasets larger than RAM train. approach='direct'
+        materializes everything in memory (the reference's Direct mode)."""
+        pw = self._ensure_pw(net)
+        global_batch = self.num_workers * self.batch_size
+        if self.approach == "export":
+            paths = self._export_if_required(data, global_batch)
+            k = self.averaging_frequency
+            for s0 in range(0, len(paths), k):
+                t1 = time.time()
+                split_paths = paths[s0:s0 + k]
+                from ..datasets.iterators import FileDataSetIterator
+                pw.fit(FileDataSetIterator(split_paths))
+                if self.stats:
+                    self.stats.record("fit", t1, time.time() - t1,
+                                      {"minibatches": len(split_paths)})
+            return net
+
+        examples = self._collect_examples(data)
         # one "split" = numWorkers * batchSize * averagingFrequency examples
-        split_size = (self.num_workers * self.batch_size
-                      * self.averaging_frequency)
+        split_size = global_batch * self.averaging_frequency
         n = examples.num_examples()
         for s0 in range(0, n, split_size):
             t0 = time.time()
@@ -170,7 +219,7 @@ class ParameterAveragingTrainingMaster:
                 self.stats.record("split", t0, time.time() - t0,
                                   {"examples": split.num_examples()})
             t1 = time.time()
-            batches = list(split.batch_by(self.num_workers * self.batch_size))
+            batches = list(split.batch_by(global_batch))
             # fit phase: k local steps per device + ICI parameter average,
             # one compiled program (the broadcast/aggregate of the reference
             # happens inside as device_put + pmean)
@@ -181,6 +230,68 @@ class ParameterAveragingTrainingMaster:
         return net
 
     executeTraining = execute_training
+
+    def _export_if_required(self, data, global_batch):
+        """Stream `data` into one .npz per global batch, once per source
+        (reference: exportIfRequired:351 — saves batched DataSets to temp
+        storage, caches by RDD id, streams paths thereafter)."""
+        import os
+        import tempfile
+        if self._export_cache is not None and \
+                self._export_cache[0] == id(data):
+            return self._export_cache[1]
+        t0 = time.time()
+        if self.export_dir:
+            d = self.export_dir
+            os.makedirs(d, exist_ok=True)
+        else:
+            d = tempfile.mkdtemp(prefix="dl4j_tpu_export_")
+        paths = []
+        pending = []        # list of row-chunks not yet one global batch
+        pending_rows = 0
+
+        def flush(chunks):
+            p = os.path.join(d, f"dataset_{len(paths)}.npz")
+            (chunks[0] if len(chunks) == 1
+             else DataSet.merge(chunks)).save(p)
+            paths.append(p)
+
+        for ds in self._iter_source(data):
+            start = 0
+            n = ds.num_examples()
+            while start < n:
+                take = min(global_batch - pending_rows, n - start)
+                pending.append(DataSet(
+                    ds.features[start:start + take],
+                    ds.labels[start:start + take]
+                    if ds.labels is not None else None,
+                    ds.features_mask[start:start + take]
+                    if ds.features_mask is not None else None,
+                    ds.labels_mask[start:start + take]
+                    if ds.labels_mask is not None else None))
+                pending_rows += take
+                start += take
+                if pending_rows == global_batch:
+                    flush(pending)
+                    pending, pending_rows = [], 0
+        if pending:
+            flush(pending)
+        if self.stats:
+            self.stats.record("export", t0, time.time() - t0,
+                              {"files": len(paths)})
+        self._export_cache = (id(data), paths, d)
+        return paths
+
+    @staticmethod
+    def _iter_source(data):
+        if isinstance(data, DataSet):
+            yield data
+        elif isinstance(data, (list, tuple)):
+            yield from data
+        else:
+            data.reset()
+            while data.has_next():
+                yield data.next_batch()
 
     @staticmethod
     def _collect_examples(data):

@@ -1,0 +1,125 @@
+"""Generates the golden checkpoint zips under tests/fixtures/golden/.
+
+Run once per format change (CPU, x64 off):
+
+    JAX_PLATFORMS=cpu python tests/fixtures/make_golden_models.py
+
+The zips are COMMITTED and then never regenerated casually — the regression
+test (tests/test_regression_golden.py) restores them and asserts config,
+params, updater state, and outputs stay bit-identical, so later rounds
+cannot silently drift the checkpoint format (reference pattern:
+regressiontest/RegressionTest050.java restoring 0.5.0-era zips).
+"""
+import json
+import os
+
+import numpy as np
+
+
+def _out(name):
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, name)
+
+
+def _train_a_bit(net, x, y, steps=3):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    ds = DataSet(x, y)
+    for _ in range(steps):
+        net.fit(ds)
+    return net
+
+
+def make_mlp(rng):
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.Builder().seed(11)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, DenseLayer(n_out=12, activation="relu"))
+            .layer(1, OutputLayer(n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.random((16, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    return _train_a_bit(net, x, y), x
+
+
+def make_lenet(rng):
+    from deeplearning4j_tpu.models.zoo.lenet import lenet
+    net = lenet()
+    x = rng.random((4, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)]
+    return _train_a_bit(net, x, y, steps=2), x
+
+
+def make_lstm(rng):
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.Builder().seed(13)
+            .updater("rmsprop").learning_rate(0.02).list()
+            .layer(0, GravesLSTM(n_out=10, activation="tanh"))
+            .layer(1, RnnOutputLayer(n_out=6, activation="softmax",
+                                     loss_function="mcxent"))
+            .set_input_type(InputType.recurrent(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.eye(6, dtype=np.float32)[rng.integers(0, 6, (4, 7))]
+    y = np.eye(6, dtype=np.float32)[rng.integers(0, 6, (4, 7))]
+    return _train_a_bit(net, x, y), x
+
+
+def make_cg(rng):
+    from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    conf = (NeuralNetConfiguration.Builder().seed(17)
+            .updater("nesterovs").momentum(0.9).learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("a", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("b", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_vertex("m", MergeVertex(), "a", "b")
+            .add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                          loss_function="mcxent"), "m")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(5)).build())
+    net = ComputationGraph(conf).init()
+    x = rng.random((8, 5)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    mds = MultiDataSet([x], [y])
+    for _ in range(3):
+        net.fit(mds)
+    return net, x
+
+
+def main():
+    from deeplearning4j_tpu.util import model_serializer as ms
+    rng = np.random.default_rng(1234)
+    manifest = {}
+    for name, maker in [("mlp", make_mlp), ("lenet", make_lenet),
+                        ("lstm", make_lstm), ("cg", make_cg)]:
+        net, x = maker(rng)
+        zpath = _out(f"{name}.zip")
+        ms.write_model(net, zpath)
+        if name == "cg":
+            out = np.asarray(net.output(x)[0])
+        else:
+            out = np.asarray(net.output(x))
+        np.savez(_out(f"{name}_io.npz"), x=x, y=out,
+                 params=np.asarray(net.params()))
+        manifest[name] = {
+            "type": type(net).__name__,
+            "iteration_count": net.conf.iteration_count,
+            "num_params": int(net.num_params()),
+        }
+        print(name, manifest[name])
+    with open(_out("manifest.json"), "w") as fh:
+        json.dump(manifest, fh, indent=2)
+
+
+if __name__ == "__main__":
+    main()

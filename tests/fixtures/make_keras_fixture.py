@@ -1,0 +1,56 @@
+"""Generates tests/fixtures/keras_toy_residual.h5 + expected outputs.
+
+Run with a real Keras installation (any version with legacy HDF5 save):
+
+    python tests/fixtures/make_keras_fixture.py
+
+The committed fixture is the ground truth the import tests assert against
+(reference pattern: dl4j-test-resources ships real-Keras h5 files; the tests
+in deeplearning4j-modelimport load them — KerasModelImport.java:135).
+"""
+import os
+
+import numpy as np
+
+
+def main():
+    import keras
+    from keras import layers
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rng = np.random.default_rng(42)
+
+    inp = keras.Input(shape=(8, 8, 3), name="input_1")
+    x = layers.Conv2D(4, (3, 3), padding="same", activation="relu",
+                      name="conv_a")(inp)
+    x = layers.BatchNormalization(name="bn_a")(x)
+    y = layers.Conv2D(4, (1, 1), padding="same", name="conv_sc")(inp)
+    z = layers.Add(name="add_1")([x, y])
+    z = layers.Activation("relu", name="act_1")(z)
+    z = layers.MaxPooling2D((2, 2), name="pool_1")(z)
+    w = layers.Conv2D(3, (3, 3), padding="same", activation="tanh",
+                      name="conv_b")(z)
+    m2 = layers.Concatenate(name="cat_1")([z, w])
+    f = layers.Flatten(name="flat_1")(m2)
+    out = layers.Dense(10, activation="softmax", name="dense_out")(f)
+    model = keras.Model(inp, out, name="toy_residual")
+
+    # non-trivial BN running stats so inference uses them
+    bn = model.get_layer("bn_a")
+    mean = rng.normal(0, 0.3, (4,)).astype(np.float32)
+    var = (0.5 + rng.random(4)).astype(np.float32)
+    gamma = (0.8 + 0.4 * rng.random(4)).astype(np.float32)
+    beta = rng.normal(0, 0.2, (4,)).astype(np.float32)
+    bn.set_weights([gamma, beta, mean, var])
+
+    xin = rng.standard_normal((5, 8, 8, 3)).astype(np.float32)
+    yout = model.predict(xin, verbose=0)
+
+    model.save(os.path.join(here, "keras_toy_residual.h5"))
+    np.savez(os.path.join(here, "keras_toy_residual_io.npz"),
+             x=xin, y=yout)
+    print("wrote fixture; output shape", yout.shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Worker process for the multi-host test (test_multihost.py).
+
+Each process: jax.distributed.initialize over CPU devices -> global mesh via
+parallel/distributed.py -> ParallelWrapper allreduce steps with per-process
+batch slices -> prints a params checksum. The test asserts both processes
+stay bit-identical and match the single-process result — proving the
+DCN-path code really executes (SURVEY.md §5.8; VERDICT round-1 item 4).
+
+Usage: python tests/multihost_worker.py <proc_id> <nproc> <coordinator>
+"""
+import os
+import sys
+
+proc_id, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,  # noqa: E402
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet  # noqa: E402
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,  # noqa: E402
+                                               OutputLayer)
+from deeplearning4j_tpu.parallel import distributed  # noqa: E402
+from deeplearning4j_tpu.parallel.parallel_wrapper import \
+    ParallelWrapper  # noqa: E402
+
+
+def main():
+    ok = distributed.initialize(coord, nproc, proc_id)
+    assert ok, "distributed.initialize returned False"
+    assert jax.process_count() == nproc
+    assert jax.device_count() == 2 * nproc       # 2 cpu devices per process
+    assert len(jax.local_devices()) == 2
+
+    mesh = distributed.global_mesh()             # all devices on "data"
+    assert int(mesh.shape["data"]) == 2 * nproc
+
+    conf = (NeuralNetConfiguration.Builder().seed(7).learning_rate(0.2)
+            .updater("sgd").list()
+            .layer(0, DenseLayer(n_out=16, activation="relu"))
+            .layer(1, OutputLayer(n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    # identical global data on every process; each feeds only its slice
+    rng = np.random.default_rng(0)
+    centers = rng.normal(0, 3, (3, 4))
+    c = rng.integers(0, 3, 64)
+    gx = (centers[c] + rng.normal(0, 0.5, (64, 4))).astype(np.float32)
+    gy = np.eye(3, dtype=np.float32)[c]
+    sl = distributed.process_local_batch_slice(64)
+    local = DataSet(gx[sl], gy[sl])
+
+    pw = ParallelWrapper.Builder(net).mesh(mesh).averaging_frequency(1).build()
+    for _ in range(3):
+        pw.fit(local)
+
+    params = np.asarray(net.params(), np.float64)
+    print(f"RESULT {proc_id} sum={params.sum():.10f} "
+          f"score={float(net._score):.10f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,14 +1,20 @@
-"""Keras 1.x import: synthetic HDF5 models verified against manual numpy
+"""Keras import: synthetic HDF5 models verified against manual numpy
 forward passes (the reference's pattern: import then assert output equality,
-modelimport ModelConfigurationTest/KerasLayerTest)."""
+modelimport ModelConfigurationTest/KerasLayerTest), plus a committed
+real-Keras functional-model fixture (dl4j-test-resources pattern)."""
 import json
+import os
 
 import h5py
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.keras import (import_keras_model_configuration,
+from deeplearning4j_tpu.keras import (import_keras_model_and_weights,
+                                      import_keras_model_configuration,
                                       import_keras_sequential_model_and_weights)
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
 
 
 def _write_model(path, layer_cfgs, weights):
@@ -175,3 +181,108 @@ def test_unsupported_layer_raises():
          "config": {"name": "x", "batch_input_shape": [None, 3]}}]}
     with pytest.raises(ValueError, match="Unsupported Keras layer"):
         import_keras_model_configuration(json.dumps(cfg))
+
+
+# ---------------------------------------------------------------------------
+# Functional Model -> ComputationGraph (reference KerasModel.java:57)
+# ---------------------------------------------------------------------------
+
+def test_functional_import_real_keras_fixture():
+    """Committed h5 written by an actual Keras installation (generator:
+    tests/fixtures/make_keras_fixture.py): Conv branches + Add + Concatenate
+    + BN + Flatten + softmax Dense. Outputs must match Keras's own
+    predictions."""
+    net = import_keras_model_and_weights(
+        os.path.join(FIXTURES, "keras_toy_residual.h5"))
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    assert isinstance(net, ComputationGraph)
+    io = np.load(os.path.join(FIXTURES, "keras_toy_residual_io.npz"))
+    got = np.asarray(net.output(io["x"])[0])
+    assert got.shape == io["y"].shape
+    assert np.abs(got - io["y"]).max() < 1e-5
+
+
+def test_functional_import_keras1_dialect_matches_numpy(tmp_path):
+    """Keras 1.x 'Model' JSON dialect: classic inbound_nodes triples, Merge
+    with mode=sum, th dim-ordering convs, Dense-after-Flatten row permute.
+    Verified against a manual numpy forward."""
+    rng = np.random.default_rng(9)
+    C, H, W = 2, 6, 6
+    F = 3
+    Wa = rng.standard_normal((F, C, 1, 1)).astype(np.float32)   # OIHW
+    ba = rng.standard_normal(F).astype(np.float32)
+    Wb = rng.standard_normal((F, C, 1, 1)).astype(np.float32)
+    bb = rng.standard_normal(F).astype(np.float32)
+    Wd = rng.standard_normal((F * H * W, 4)).astype(np.float32)  # CHW rows
+    bd = rng.standard_normal(4).astype(np.float32)
+
+    layers = [
+        {"class_name": "InputLayer", "name": "in1",
+         "config": {"name": "in1", "batch_input_shape": [None, C, H, W]},
+         "inbound_nodes": []},
+        {"class_name": "Convolution2D", "name": "ca",
+         "config": {"name": "ca", "nb_filter": F, "nb_row": 1, "nb_col": 1,
+                    "activation": "relu", "dim_ordering": "th",
+                    "border_mode": "valid"},
+         "inbound_nodes": [[["in1", 0, 0]]]},
+        {"class_name": "Convolution2D", "name": "cb",
+         "config": {"name": "cb", "nb_filter": F, "nb_row": 1, "nb_col": 1,
+                    "activation": "linear", "dim_ordering": "th",
+                    "border_mode": "valid"},
+         "inbound_nodes": [[["in1", 0, 0]]]},
+        {"class_name": "Merge", "name": "m1",
+         "config": {"name": "m1", "mode": "sum"},
+         "inbound_nodes": [[["ca", 0, 0], ["cb", 0, 0]]]},
+        {"class_name": "Flatten", "name": "f1",
+         "config": {"name": "f1"}, "inbound_nodes": [[["m1", 0, 0]]]},
+        {"class_name": "Dense", "name": "d1",
+         "config": {"name": "d1", "output_dim": 4, "activation": "linear"},
+         "inbound_nodes": [[["f1", 0, 0]]]},
+    ]
+    cfg = {"class_name": "Model", "config": {
+        "name": "toy", "layers": layers,
+        "input_layers": [["in1", 0, 0]],
+        "output_layers": [["d1", 0, 0]]}}
+    p = str(tmp_path / "func1.h5")
+    with h5py.File(p, "w") as f:
+        f.attrs["model_config"] = json.dumps(cfg).encode("utf-8")
+        mw = f.create_group("model_weights")
+        for lname, arrs in {"ca": [("W", Wa), ("b", ba)],
+                            "cb": [("W", Wb), ("b", bb)],
+                            "d1": [("W", Wd), ("b", bd)]}.items():
+            g = mw.create_group(lname)
+            names = []
+            for suffix, arr in arrs:
+                n = f"{lname}_{suffix}"
+                g.create_dataset(n, data=np.asarray(arr, np.float32))
+                names.append(n.encode())
+            g.attrs["weight_names"] = names
+    net = import_keras_model_and_weights(p)
+
+    x_nchw = rng.standard_normal((3, C, H, W)).astype(np.float32)
+    # numpy forward in NCHW (1x1 convs are einsums)
+    a = np.maximum(np.einsum("nchw,fcij->nfhw", x_nchw, Wa)
+                   + ba[None, :, None, None], 0)
+    b = (np.einsum("nchw,fcij->nfhw", x_nchw, Wb)
+         + bb[None, :, None, None])
+    m = a + b
+    want = m.reshape(3, -1) @ Wd + bd   # CHW flatten
+
+    got = np.asarray(net.output(x_nchw.transpose(0, 2, 3, 1))[0])
+    assert np.allclose(got, want, atol=1e-4), np.abs(got - want).max()
+
+
+def test_functional_output_dense_becomes_trainable_output_layer():
+    net = import_keras_model_and_weights(
+        os.path.join(FIXTURES, "keras_toy_residual.h5"))
+    from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+    assert isinstance(net.conf.vertices["dense_out"].conf, OutputLayer)
+    # and the imported graph trains
+    io = np.load(os.path.join(FIXTURES, "keras_toy_residual_io.npz"))
+    y = np.eye(10, dtype=np.float32)[np.arange(5)]
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    mds = MultiDataSet([io["x"]], [y])
+    s0 = net.score(mds)
+    for _ in range(5):
+        net.fit(mds)
+    assert net.score(mds) < s0

@@ -38,7 +38,7 @@ def test_training_master_trains_and_records_stats():
     net = _net()
     tm = (ParameterAveragingTrainingMaster.Builder(batch_size_per_worker=8)
           .workers(4).averaging_frequency(2).collect_training_stats(True)
-          .build())
+          .rdd_training_approach("direct").build())
     ds = _data()
     s0 = net.score(ds)
     master = TpuDl4jMultiLayer(net, tm)
@@ -47,6 +47,74 @@ def test_training_master_trains_and_records_stats():
     phases = {e["phase"] for e in tm.stats.events}
     assert phases == {"split", "fit"}
     assert tm.stats.phase_total("fit") > 0
+
+
+def test_training_master_export_approach_streams_from_disk(tmp_path):
+    """Reference default RDDTrainingApproach.Export: source streamed once to
+    batched files, splits read from disk — the whole dataset is never
+    merged into host memory (ParameterAveragingTrainingMaster.java:98-103,
+    351)."""
+    import os
+
+    from deeplearning4j_tpu.parallel import training_master as tm_mod
+    net = _net()
+    tm = (ParameterAveragingTrainingMaster.Builder(batch_size_per_worker=8)
+          .workers(4).averaging_frequency(2).collect_training_stats(True)
+          .export_directory(str(tmp_path / "export")).build())
+    assert tm.approach == "export"   # the default, as in the reference
+
+    # generator-backed iterator with batches misaligned to the global batch
+    # (32): would OOM if merged wholesale on a huge source. One consistent
+    # labeling function across batches (slices of one dataset).
+    full = _data(480, seed=3)
+    slices = list(full.batch_by(24))   # 20 x 24 = 480 examples
+    produced = {"n": 0}
+
+    class GenIterator:
+        def __init__(self):
+            self._i = 0
+        def reset(self):
+            self._i = 0
+        def has_next(self):
+            return self._i < len(slices)
+        def next_batch(self):
+            ds = slices[self._i]
+            self._i += 1
+            produced["n"] += 1
+            return ds
+
+    orig_collect = ParameterAveragingTrainingMaster._collect_examples
+    called = []
+    ParameterAveragingTrainingMaster._collect_examples = staticmethod(
+        lambda data: called.append(1) or orig_collect(data))
+    try:
+        s0 = net.score(full)
+        master = TpuDl4jMultiLayer(net, tm)
+        master.fit(GenIterator(), num_epochs=3)
+    finally:
+        ParameterAveragingTrainingMaster._collect_examples = staticmethod(
+            orig_collect)
+    assert not called   # never materialized in RAM
+    files = sorted(os.listdir(tmp_path / "export"))
+    assert len(files) == 15          # 480 examples / 32 global batch
+    # exported once, reused across the 3 epochs
+    assert produced["n"] == 20
+    assert net.score(full) < s0
+    assert {e["phase"] for e in tm.stats.events} == {"export", "fit"}
+
+
+def test_training_master_export_round_trips_masks(tmp_path):
+    ds = DataSet(np.ones((4, 3, 2), np.float32),
+                 np.ones((4, 3, 2), np.float32),
+                 np.ones((4, 3), np.float32),
+                 np.zeros((4, 3), np.float32))
+    p = tmp_path / "ds.npz"
+    ds.save(p)
+    back = DataSet.load(p)
+    assert back.features_mask.shape == (4, 3)
+    assert back.labels_mask.sum() == 0
+    merged = DataSet.merge([ds, back])
+    assert merged.features_mask.shape == (8, 3)
 
 
 def test_training_master_iterator_and_eval():
