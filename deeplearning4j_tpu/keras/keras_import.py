@@ -253,7 +253,14 @@ def _map_layer(cls, cfg, dim_ordering):
         pad = cfg.get("padding", (1, 1))
         if isinstance(pad, (list, tuple)) and pad and \
                 isinstance(pad[0], (list, tuple)):
-            pad = (pad[0][0], pad[1][0])   # symmetric ((t,b),(l,r)) form
+            # ((top,bottom),(left,right)) form: only symmetric pads map onto
+            # ZeroPaddingLayer's (ph, pw); silently dropping bottom/right
+            # would import a model that computes different activations
+            if pad[0][0] != pad[0][1] or pad[1][0] != pad[1][1]:
+                raise ValueError(
+                    f"Asymmetric ZeroPadding2D {tuple(map(tuple, pad))} is "
+                    f"not supported (top!=bottom or left!=right)")
+            pad = (pad[0][0], pad[1][0])
         return ZeroPaddingLayer(pad=_pair_of(pad, (1, 1))), False
     if cls in ("Flatten", "Reshape", "InputLayer"):
         return None, True

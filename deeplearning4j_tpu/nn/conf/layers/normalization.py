@@ -34,8 +34,12 @@ class BatchNormalization(LayerConf):
     n_out: int = None  # feature count, inferred
     # one-pass E[x^2]-E[x]^2 statistics (industry-standard TPU BN; saves a
     # full HBM read of the input per step — see PERF.md). Trades off f32
-    # cancellation when |mean| >> std; set False for the two-pass
-    # shifted-variance form in such regimes.
+    # cancellation when |mean| >> std: E[x^2] and mean^2 become nearly equal
+    # large numbers, the subtraction loses all significant bits, the clamp
+    # floors var at 0 and the normalizer becomes rsqrt(eps) — a large-gain
+    # blowup rather than a graceful degradation. Set False for the two-pass
+    # jnp.var form (the reference's two-pass variance) when activations can
+    # have |mean| orders of magnitude above their spread.
     use_fast_variance: bool = True
 
     def set_n_in(self, input_type, override=True):

@@ -130,7 +130,8 @@ class ParallelWrapper:
     def fit(self, data, num_epochs=1):
         net = self.model
         self._ensure_sharded()
-        if isinstance(data, DataSet):
+        from ..datasets.dataset import MultiDataSet
+        if isinstance(data, (DataSet, MultiDataSet)):
             data = ListDataSetIterator([data])
         for _ in range(num_epochs):
             data.reset()
@@ -146,8 +147,10 @@ class ParallelWrapper:
         list for ComputationGraph."""
         net = self.model
         f, l = ds.features, ds.labels
-        fm = getattr(ds, "features_mask", None)
-        lm = getattr(ds, "labels_mask", None)
+        fm = getattr(ds, "features_mask",
+                     getattr(ds, "features_masks", None))
+        lm = getattr(ds, "labels_mask",
+                     getattr(ds, "labels_masks", None))
         if not isinstance(net._params, dict):   # MultiLayerNetwork
             return f, l, fm, lm
         names = list(net.conf.network_inputs)
@@ -196,7 +199,8 @@ class ParallelWrapper:
              _) = self._jit_step(net._params, net._updater_state,
                                  net._model_state, batch)
             net._score = score
-            net._last_batch_size = int(ds.features.shape[0])
+            net._last_batch_size = int(
+                jax.tree.leaves(feats)[0].shape[0])
             net.conf.iteration_count += 1
             for l in net.listeners:
                 l.iteration_done(net, net.conf.iteration_count - 1)
@@ -264,13 +268,15 @@ class ParallelWrapper:
     def _run_kstep(self, batches):
         net = self.model
         k = len(batches)
-        B = max(int(b.features.shape[0]) for b in batches)
+        parts = [self._canon_parts(b) for b in batches]
+        # batch size from the first FEATURE leaf so multi-input feature
+        # dicts/lists (ComputationGraph / MultiDataSet) size correctly
+        B = max(int(jax.tree.leaves(p[0])[0].shape[0]) for p in parts)
 
         def stack(*leaves):
             return jnp.asarray(np.stack(
                 [self._pad_to(np.asarray(x), B) for x in leaves]))
 
-        parts = [self._canon_parts(b) for b in batches]
         feats = jax.tree.map(stack, *[p[0] for p in parts])  # [k, B, ...]
         labs = jax.tree.map(stack, *[p[1] for p in parts])
         net._rng, sub = jax.random.split(net._rng)

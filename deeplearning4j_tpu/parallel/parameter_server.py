@@ -63,7 +63,11 @@ class GradientsAccumulator:
         self._error = None
         self._applied = 0
         self._stale_dropped = 0
-        self._staleness_seen = []
+        # running aggregates, not a per-push history: a long-running job
+        # would grow an unbounded list otherwise
+        self._staleness_count = 0
+        self._staleness_sum = 0
+        self._staleness_max = 0
         self.max_staleness = max_staleness
         self._lock = threading.Lock()
         # version-tagged published snapshot workers pull from
@@ -105,7 +109,9 @@ class GradientsAccumulator:
                 except queue.Empty:
                     continue
                 staleness = self._version - version
-                self._staleness_seen.append(staleness)
+                self._staleness_count += 1
+                self._staleness_sum += staleness
+                self._staleness_max = max(self._staleness_max, staleness)
                 if (self.max_staleness is not None
                         and staleness > self.max_staleness):
                     self._stale_dropped += 1
@@ -137,12 +143,12 @@ class GradientsAccumulator:
         return self._applied
 
     def stats(self):
-        seen = self._staleness_seen
+        n = self._staleness_count
         return {
             "applied": self._applied,
             "stale_dropped": self._stale_dropped,
-            "max_staleness_seen": max(seen) if seen else 0,
-            "mean_staleness": (sum(seen) / len(seen)) if seen else 0.0,
+            "max_staleness_seen": self._staleness_max,
+            "mean_staleness": (self._staleness_sum / n) if n else 0.0,
         }
 
     def shutdown(self):

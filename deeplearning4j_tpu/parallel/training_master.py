@@ -135,7 +135,10 @@ class ParameterAveragingTrainingMaster:
         self.export_dir = export_dir
         self.stats = TrainingMasterStats() if collect_stats else None
         self._pw = None
-        self._export_cache = None   # (data id, [paths], owned_tmpdir)
+        # (data object, [paths], owned_tmpdir) — holds a strong reference to
+        # the source and compares with `is`: an id() key could collide when
+        # CPython reuses a freed object's address (reference keys by RDD id)
+        self._export_cache = None
 
     # -- config serde (reference: toJson:242) ---------------------------
     def to_json(self):
@@ -238,7 +241,7 @@ class ParameterAveragingTrainingMaster:
         import os
         import tempfile
         if self._export_cache is not None and \
-                self._export_cache[0] == id(data):
+                self._export_cache[0] is data:
             return self._export_cache[1]
         t0 = time.time()
         if self.export_dir:
@@ -279,7 +282,7 @@ class ParameterAveragingTrainingMaster:
         if self.stats:
             self.stats.record("export", t0, time.time() - t0,
                               {"files": len(paths)})
-        self._export_cache = (id(data), paths, d)
+        self._export_cache = (data, paths, d)
         return paths
 
     @staticmethod

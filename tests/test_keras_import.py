@@ -175,6 +175,27 @@ def test_config_only_import():
     assert conf.layers[0].activation == "tanh"
 
 
+def test_asymmetric_zero_padding_raises():
+    cfg = {"class_name": "Sequential", "config": [
+        {"class_name": "ZeroPadding2D",
+         "config": {"name": "zp", "padding": [[1, 2], [1, 1]],
+                    "batch_input_shape": [None, 8, 8, 3]}}]}
+    with pytest.raises(ValueError, match="Asymmetric ZeroPadding2D"):
+        import_keras_model_configuration(json.dumps(cfg))
+
+
+def test_symmetric_nested_zero_padding_imports():
+    cfg = {"class_name": "Sequential", "config": [
+        {"class_name": "ZeroPadding2D",
+         "config": {"name": "zp", "padding": [[2, 2], [3, 3]],
+                    "batch_input_shape": [None, 8, 8, 3]}},
+        {"class_name": "Flatten", "config": {"name": "f"}},
+        {"class_name": "Dense",
+         "config": {"name": "d", "output_dim": 4, "activation": "tanh"}}]}
+    conf = import_keras_model_configuration(json.dumps(cfg))
+    assert conf.layers[0].pad == (2, 3)
+
+
 def test_unsupported_layer_raises():
     cfg = {"class_name": "Sequential", "config": [
         {"class_name": "Lambda",
