@@ -1,9 +1,10 @@
 from .dataset import DataSet, MultiDataSet
 from .fetchers import (CifarDataSetIterator, CurvesDataSetIterator,
                        LFWDataSetIterator)
-from .iterators import (AsyncDataSetIterator, DataSetIterator,
-                        IteratorDataSetIterator, ListDataSetIterator,
-                        MultipleEpochsIterator, SamplingDataSetIterator)
+from .iterators import (AsyncDataSetIterator, AsyncMultiDataSetIterator,
+                        DataSetIterator, IteratorDataSetIterator,
+                        ListDataSetIterator, MultipleEpochsIterator,
+                        SamplingDataSetIterator)
 from .mnist import MnistDataSetIterator
 from .mnist import IrisDataSetIterator
 from .normalizers import (ImagePreProcessingScaler, NormalizerMinMaxScaler,
@@ -11,15 +12,18 @@ from .normalizers import (ImagePreProcessingScaler, NormalizerMinMaxScaler,
 from .records import (CollectionRecordReader, CSVRecordReader,
                       CSVSequenceRecordReader, RecordReader,
                       RecordReaderDataSetIterator,
+                      RecordReaderMultiDataSetIterator,
                       SequenceRecordReaderDataSetIterator)
 
 __all__ = [
-    "AsyncDataSetIterator", "CSVRecordReader", "CSVSequenceRecordReader",
+    "AsyncDataSetIterator", "AsyncMultiDataSetIterator", "CSVRecordReader",
+    "CSVSequenceRecordReader",
     "CifarDataSetIterator", "CollectionRecordReader", "CurvesDataSetIterator",
     "DataSet", "DataSetIterator", "ImagePreProcessingScaler",
     "IrisDataSetIterator", "IteratorDataSetIterator", "LFWDataSetIterator",
     "ListDataSetIterator", "MnistDataSetIterator", "MultiDataSet",
     "MultipleEpochsIterator", "NormalizerMinMaxScaler",
     "NormalizerStandardize", "RecordReader", "RecordReaderDataSetIterator",
-    "SamplingDataSetIterator", "SequenceRecordReaderDataSetIterator",
+    "RecordReaderMultiDataSetIterator", "SamplingDataSetIterator",
+    "SequenceRecordReaderDataSetIterator",
 ]

@@ -141,32 +141,46 @@ class RecordReaderDataSetIterator(DataSetIterator):
     float targets (label_index..label_index_to inclusive)."""
 
     def __init__(self, record_reader, batch_size, label_index=-1,
-                 num_classes=None, regression=False, label_index_to=None):
+                 num_classes=None, regression=False, label_index_to=None,
+                 collect_meta_data=False):
         self.reader = record_reader
         self.batch_size = int(batch_size)
         self.label_index = label_index
         self.label_index_to = label_index_to
         self.num_classes = num_classes
         self.regression = regression
+        # reference setCollectMetaData: batches carry (source, row) records
+        # so Evaluation's Prediction queries can point back at inputs
+        self.collect_meta_data = bool(collect_meta_data)
+        self._row = 0
         self.reader.reset()
+
+    setCollectMetaData = lambda self, v: setattr(
+        self, "collect_meta_data", bool(v)) or self
 
     def has_next(self):
         return self.reader.has_next()
 
     def next_batch(self):
-        feats, labels = [], []
+        feats, labels, metas = [], [], []
+        src = getattr(self.reader, "path", None)
         while self.reader.has_next() and len(feats) < self.batch_size:
             rec = [float(v) for v in self.reader.next_record()]
             f, l = self._split(rec)
             feats.append(f)
             labels.append(l)
+            metas.append((src, self._row))
+            self._row += 1
         x = np.asarray(feats, np.float32)
         if self.regression:
             y = np.asarray(labels, np.float32)
         else:
             y = np.eye(self.num_classes, dtype=np.float32)[
                 np.asarray(labels, np.int64).ravel()]
-        return DataSet(x, y)
+        ds = DataSet(x, y)
+        if self.collect_meta_data:
+            ds.example_metas = metas
+        return ds
 
     def _split(self, rec):
         li = self.label_index if self.label_index >= 0 else len(rec) - 1
@@ -176,6 +190,7 @@ class RecordReaderDataSetIterator(DataSetIterator):
         return feat, label
 
     def reset(self):
+        self._row = 0
         self.reader.reset()
 
     def batch(self):

@@ -28,6 +28,44 @@ class ConfusionMatrix:
         return self
 
 
+class Prediction:
+    """Per-example prediction record for error analysis — reference
+    eval/meta/Prediction.java (actualClass, predictedClass, recordMetaData).
+    Only recorded when eval() is called with `meta` (the reference's
+    eval(INDArray, INDArray, List<Serializable>) overload)."""
+
+    def __init__(self, actual_class, predicted_class, record_meta_data):
+        self.actual_class = int(actual_class)
+        self.predicted_class = int(predicted_class)
+        self.record_meta_data = record_meta_data
+
+    def get_actual_class(self):
+        return self.actual_class
+
+    getActualClass = get_actual_class
+
+    def get_predicted_class(self):
+        return self.predicted_class
+
+    getPredictedClass = get_predicted_class
+
+    def get_record_meta_data(self):
+        return self.record_meta_data
+
+    getRecordMetaData = get_record_meta_data
+
+    def __repr__(self):
+        return (f"Prediction(actualClass={self.actual_class},"
+                f"predictedClass={self.predicted_class},"
+                f"RecordMetaData={self.record_meta_data})")
+
+    def __eq__(self, other):
+        return (isinstance(other, Prediction)
+                and self.actual_class == other.actual_class
+                and self.predicted_class == other.predicted_class
+                and self.record_meta_data == other.record_meta_data)
+
+
 class Evaluation:
     def __init__(self, num_classes=None, labels=None, top_n=1):
         self.label_names = labels
@@ -37,25 +75,39 @@ class Evaluation:
         self.top_n = int(top_n)
         self.top_n_correct = 0
         self.num_examples = 0
+        # (actual, predicted) -> [meta, ...] — reference
+        # Evaluation.addToMetaConfusionMatrix:938
+        self._meta_confusion = {}
 
     # ------------------------------------------------------------------
-    def eval(self, labels, predictions, mask=None):
+    def eval(self, labels, predictions, mask=None, meta=None):
         """labels: one-hot [N,C] (or [N,T,C] sequences); predictions same shape
         of probabilities. reference: Evaluation.eval:191 (+ evalTimeSeries for
-        the RNN reshape)."""
+        the RNN reshape). `meta`: optional per-example metadata list (len N)
+        enabling the Prediction error-analysis queries (reference
+        eval(INDArray, INDArray, List<? extends Serializable>))."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
+        if meta is not None and len(meta) != labels.shape[0]:
+            raise ValueError(f"meta length {len(meta)} != batch "
+                             f"{labels.shape[0]}")
         if labels.ndim == 3:  # [N,T,C] sequence -> flatten valid timesteps
+            if meta is not None:  # expand per-sequence meta to timesteps
+                meta = [md for md in meta for _ in range(labels.shape[1])]
             if mask is not None:
                 m = np.asarray(mask).astype(bool).reshape(-1)
             else:
                 m = np.ones(labels.shape[0] * labels.shape[1], bool)
             labels = labels.reshape(-1, labels.shape[-1])[m]
             predictions = predictions.reshape(-1, predictions.shape[-1])[m]
+            if meta is not None:
+                meta = [x for x, keep in zip(meta, m) if keep]
         elif mask is not None:  # [N,C] with per-example mask
             m = np.asarray(mask).astype(bool).reshape(-1)
             labels = labels[m]
             predictions = predictions[m]
+            if meta is not None:
+                meta = [x for x, keep in zip(meta, m) if keep]
         if self.num_classes is None:
             self.num_classes = labels.shape[-1]
             self.confusion = ConfusionMatrix(self.num_classes)
@@ -66,7 +118,43 @@ class Evaluation:
         if self.top_n > 1:
             top = np.argsort(-predictions, axis=-1)[:, :self.top_n]
             self.top_n_correct += int(np.sum(top == actual[:, None]))
+        if meta is not None:
+            for a, p, md in zip(actual.tolist(), pred.tolist(), meta):
+                self._meta_confusion.setdefault((a, p), []).append(md)
         return self
+
+    # -- Prediction queries (meta-eval only) ---------------------------
+    def _predictions(self, pred_filter):
+        if not self._meta_confusion:
+            return None   # reference returns null without recorded metadata
+        out = []
+        for (a, p), metas in sorted(self._meta_confusion.items()):
+            if pred_filter(a, p):
+                out.extend(Prediction(a, p, md) for md in metas)
+        return out
+
+    def get_prediction_errors(self):
+        """reference Evaluation.getPredictionErrors:961"""
+        return self._predictions(lambda a, p: a != p)
+
+    getPredictionErrors = get_prediction_errors
+
+    def get_predictions(self, actual_class, predicted_class):
+        """reference Evaluation.getPredictions:1056"""
+        return self._predictions(
+            lambda a, p: a == actual_class and p == predicted_class)
+
+    getPredictions = get_predictions
+
+    def get_predictions_by_actual_class(self, actual_class):
+        return self._predictions(lambda a, p: a == actual_class)
+
+    getPredictionsByActualClass = get_predictions_by_actual_class
+
+    def get_predictions_by_predicted_class(self, predicted_class):
+        return self._predictions(lambda a, p: p == predicted_class)
+
+    getPredictionsByPredictedClass = get_predictions_by_predicted_class
 
     # ------------------------------------------------------------------
     def _tp(self, c):
@@ -126,6 +214,8 @@ class Evaluation:
         self.confusion.merge(other.confusion)
         self.num_examples += other.num_examples
         self.top_n_correct += other.top_n_correct
+        for key, metas in other._meta_confusion.items():
+            self._meta_confusion.setdefault(key, []).extend(metas)
         return self
 
     def stats(self):

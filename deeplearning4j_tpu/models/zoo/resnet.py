@@ -56,16 +56,18 @@ def _bottleneck(gb, name, inp, width, stride, project):
 
 def resnet50_conf(height=224, width=224, channels=3, num_classes=1000,
                   seed=123, learning_rate=0.1, updater="nesterovs",
-                  momentum=0.9, data_type="bfloat16"):
-    gb = (NeuralNetConfiguration.Builder()
-          .seed(seed)
-          .updater(updater)
-          .momentum(momentum)
-          .learning_rate(learning_rate)
-          .weight_init("relu")          # He init for relu nets
-          .data_type(data_type)
-          .graph_builder()
-          .add_inputs("input"))
+                  momentum=0.9, data_type="bfloat16",
+                  updater_state_dtype=None):
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed)
+         .updater(updater)
+         .momentum(momentum)
+         .learning_rate(learning_rate)
+         .weight_init("relu")          # He init for relu nets
+         .data_type(data_type))
+    if updater_state_dtype:
+        b = b.updater_state_dtype(updater_state_dtype)
+    gb = b.graph_builder().add_inputs("input")
     x = _conv_bn(gb, "stem", "input", 64, (7, 7), (2, 2), "relu")
     gb.add_layer("stem_pool",
                  SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),

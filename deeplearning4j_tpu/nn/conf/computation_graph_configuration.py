@@ -156,6 +156,22 @@ class ComputationGraphConfiguration:
     def from_json(s):
         return ComputationGraphConfiguration.from_dict(json.loads(s))
 
+    def to_yaml(self):
+        """YAML serde — reference ComputationGraphConfiguration toYaml/
+        fromYaml (Jackson YAML mapper on the same object model).
+        Normalized through JSON types so tuples serialize as lists."""
+        import yaml
+        return yaml.safe_dump(json.loads(self.to_json()), sort_keys=False)
+
+    toYaml = to_yaml
+
+    @staticmethod
+    def from_yaml(s):
+        import yaml
+        return ComputationGraphConfiguration.from_dict(yaml.safe_load(s))
+
+    fromYaml = from_yaml
+
     def clone(self):
         return ComputationGraphConfiguration.from_dict(self.to_dict())
 

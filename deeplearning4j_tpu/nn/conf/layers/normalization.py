@@ -18,8 +18,70 @@ from dataclasses import dataclass
 
 import jax.numpy as jnp
 
+import jax
+
 from ..input_type import ConvolutionalInputType, FeedForwardInputType, InputType
 from .base import LayerConf, register_layer
+
+
+def _bn_train_fused(eps, axes, fast_var):
+    """Batch-norm train-mode core with a hand-fused VJP.
+
+    Forward: one-pass E[x]/E[x^2] statistics (PERF.md r2 optimization).
+    Backward: the closed-form BN gradient
+        dx = gamma*rstd*(dy - mean(dy) - xhat*mean(dy*xhat))
+    computed as TWO twin reductions (sum dy, sum dy*(x-mean)) over the SAME
+    read of (x, dy) followed by one elementwise pass — instead of XLA's
+    autodiff chain through mean/var, which issues its reduction passes
+    separately (the same missed-fusion the forward one-pass stats fixed).
+    Reductions accumulate in f32 under bf16 compute.
+
+    Returns (y, mean, var); the mean/var outputs feed the EMA running-stats
+    update, which takes no gradient (cotangents ignored — matching the
+    autodiff behavior where new_state is an aux output).
+    reference seam: CudnnBatchNormalizationHelper.java:48 (the layer the
+    reference hands to fused native kernels).
+    """
+    @jax.custom_vjp
+    def f(x, gamma, beta):
+        y, mean, var, _ = _impl(x, gamma, beta)
+        return y, mean, var
+
+    def _impl(x, gamma, beta):
+        acc = jnp.promote_types(x.dtype, jnp.float32)
+        xf = x.astype(acc)
+        mean = jnp.mean(xf, axis=axes)
+        if fast_var:
+            var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean,
+                              0.0)
+        else:
+            var = jnp.var(xf, axis=axes)
+        rstd = jax.lax.rsqrt(var + eps)
+        xn = (xf - mean) * rstd * gamma.astype(acc) + beta.astype(acc)
+        return xn.astype(x.dtype), mean, var, rstd
+
+    def fwd(x, gamma, beta):
+        y, mean, var, rstd = _impl(x, gamma, beta)
+        return (y, mean, var), (x, gamma, mean, rstd)
+
+    def bwd(res, cts):
+        dy, _dmean, _dvar = cts      # EMA path carries no gradient
+        x, gamma, mean, rstd = res
+        acc = jnp.promote_types(x.dtype, jnp.float32)
+        dyf = dy.astype(acc)
+        xc = x.astype(acc) - mean
+        n = 1.0
+        for a in axes:
+            n *= x.shape[a]
+        s1 = jnp.sum(dyf, axis=axes)
+        s2 = jnp.sum(dyf * xc, axis=axes)
+        g = gamma.astype(acc)
+        dx = (g * rstd) * (dyf - s1 / n - xc * (rstd * rstd) * (s2 / n))
+        return (dx.astype(x.dtype), (s2 * rstd).astype(gamma.dtype),
+                s1.astype(gamma.dtype))
+
+    f.defvjp(fwd, bwd)
+    return f
 
 
 @register_layer("batchnorm")
@@ -41,6 +103,9 @@ class BatchNormalization(LayerConf):
     # jnp.var form (the reference's two-pass variance) when activations can
     # have |mean| orders of magnitude above their spread.
     use_fast_variance: bool = True
+    # hand-fused closed-form backward (_bn_train_fused) instead of XLA
+    # autodiff through the statistics chain; False restores pure autodiff
+    fused_backward: bool = True
 
     def set_n_in(self, input_type, override=True):
         if self.n_out is None or override:
@@ -72,6 +137,16 @@ class BatchNormalization(LayerConf):
     def forward_with_state(self, params, x, state, *, train=False, rng=None,
                            mask=None):
         axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
+        if train and self.fused_backward and params \
+                and not self.lock_gamma_beta:
+            y, mean, var = _bn_train_fused(
+                self.eps, axes, self.use_fast_variance)(
+                    x, params["gamma"], params["beta"])
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+            return y, new_state
         if train:
             # One-pass statistics: E[x] and E[x^2] reduce over the SAME read
             # of x (XLA fuses the two reductions into a single pass), vs

@@ -165,6 +165,12 @@ class NeuralNetConfiguration:
             """'float32' | 'bfloat16' (compute dtype; params stay float32)."""
             self.g["data_type"] = str(v); return self
 
+        def updater_state_dtype(self, v):
+            """Storage dtype for updater state (Adam m/v, momentum...).
+            'bfloat16' halves optimizer HBM traffic; see
+            updaters.cast_updater_state for the accuracy tradeoff."""
+            self.g["updater_state_dtype"] = str(v); return self
+
         def list(self):
             return ListBuilder(self.g)
 
@@ -380,6 +386,23 @@ class MultiLayerConfiguration:
     @staticmethod
     def from_json(s):
         return MultiLayerConfiguration.from_dict(json.loads(s))
+
+    def to_yaml(self):
+        """YAML serde — reference MultiLayerConfiguration.toYaml/fromYaml
+        (nn/conf/MultiLayerConfiguration.java, Jackson YAML mapper).
+        Normalized through JSON types so tuples serialize as lists (the
+        same representation to_json produces)."""
+        import yaml
+        return yaml.safe_dump(json.loads(self.to_json()), sort_keys=False)
+
+    toYaml = to_yaml
+
+    @staticmethod
+    def from_yaml(s):
+        import yaml
+        return MultiLayerConfiguration.from_dict(yaml.safe_load(s))
+
+    fromYaml = from_yaml
 
     def clone(self):
         return MultiLayerConfiguration.from_dict(self.to_dict())

@@ -72,12 +72,13 @@ class ComputationGraph:
         return self
 
     def _init_updater_state(self):
+        sd = self.conf.global_conf.get("updater_state_dtype")
         self._updater_state = {}
         for n in self._layer_names():
             layer = self.conf.vertices[n].conf
             init_fn, _ = U.get(layer.updater or "sgd")
-            self._updater_state[n] = {k: init_fn(v)
-                                      for k, v in self._params[n].items()}
+            st = {k: init_fn(v) for k, v in self._params[n].items()}
+            self._updater_state[n] = U.cast_updater_state(st, sd)
 
     def _ensure_init(self):
         if self._params is None:
@@ -249,7 +250,10 @@ class ComputationGraph:
                         max_iterations=layer.lr_policy_max_iterations)
                     upd, s_k = apply_fn(ustate[n][k], g_n[k], lr, hp)
                     p_new[k] = p - upd if minimize else p + upd
-                    s_new[k] = s_k
+                    # keep the stored state dtype (bf16 when
+                    # updater_state_dtype is set; math promotes to f32)
+                    s_new[k] = jax.tree.map(
+                        lambda a, old: a.astype(old.dtype), s_k, ustate[n][k])
                 new_params[n] = p_new
                 new_ustate[n] = s_new
             return new_params, new_ustate

@@ -80,10 +80,12 @@ class MultiLayerNetwork:
         return self
 
     def _init_updater_state(self):
+        sd = self.conf.global_conf.get("updater_state_dtype")
         self._updater_state = []
         for layer, p in zip(self.layers, self._params):
             init_fn, _ = U.get(layer.updater or "sgd")
-            self._updater_state.append({k: init_fn(v) for k, v in p.items()})
+            st = {k: init_fn(v) for k, v in p.items()}
+            self._updater_state.append(U.cast_updater_state(st, sd))
 
     def _ensure_init(self):
         if self._params is None:
@@ -207,7 +209,10 @@ class MultiLayerNetwork:
                     )
                     upd, s_k = apply_fn(ustate[i][k], g_i[k], lr, hp)
                     p_new[k] = p - upd if minimize else p + upd
-                    s_new[k] = s_k
+                    # keep the stored state dtype (bf16 when
+                    # updater_state_dtype is set; math promotes to f32)
+                    s_new[k] = jax.tree.map(
+                        lambda a, old: a.astype(old.dtype), s_k, ustate[i][k])
                 new_params.append(p_new)
                 new_ustate.append(s_new)
             return new_params, new_ustate
@@ -629,14 +634,24 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # Evaluation — reference evaluate(:1574)
     # ------------------------------------------------------------------
-    def evaluate(self, data):
+    def evaluate(self, data, meta=None):
+        """`meta`: optional per-example metadata (list over ALL examples in
+        iteration order, or per-DataSet `example_metas` attribute) enabling
+        Evaluation's Prediction error-analysis queries — reference
+        MultiLayerNetwork.evaluate + eval(..., List<Serializable> meta)."""
         from ..eval.evaluation import Evaluation
         ev = Evaluation()
         if isinstance(data, DataSet):
             data = ListDataSetIterator([data])
+        pos = 0
         for ds in data:
             out = self.output(ds.features, features_mask=ds.features_mask)
-            ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
+            batch_meta = getattr(ds, "example_metas", None)
+            if batch_meta is None and meta is not None:
+                batch_meta = meta[pos:pos + ds.num_examples()]
+            pos += ds.num_examples()
+            ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask,
+                    meta=batch_meta)
         return ev
 
     def evaluate_regression(self, data):

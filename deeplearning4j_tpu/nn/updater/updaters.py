@@ -224,6 +224,24 @@ def get(name):
     return UPDATERS[key]
 
 
+def cast_updater_state(state, dtype):
+    """Cast non-scalar float updater-state leaves (Adam m/v, momentum, ...)
+    to `dtype` ('bfloat16' to halve optimizer HBM traffic on bandwidth-bound
+    steps — see PERF.md). Scalar leaves (the Adam step counter `t`) keep
+    their exact dtype. ACCURACY NOTE: bf16 moment estimates lose ~8 bits of
+    mantissa; stochastic-rounding-free accumulation of many small gradients
+    can stall second-moment growth. Validated for SGD/momentum-class
+    training; prefer f32 state (the default) for Adam-family runs where
+    final-fraction-of-a-percent accuracy matters."""
+    if dtype is None:
+        return state
+    dt = jnp.dtype(jnp.bfloat16 if str(dtype) == "bfloat16" else dtype)
+    return jax.tree.map(
+        lambda a: a.astype(dt)
+        if (a.ndim > 0 and jnp.issubdtype(a.dtype, jnp.floating)) else a,
+        state)
+
+
 # ---------------------------------------------------------------------------
 # Gradient normalization — reference LayerUpdater.preApply (:174-240)
 # ---------------------------------------------------------------------------

@@ -1,4 +1,5 @@
 from . import distributed
+from .magic_queue import MagicQueue
 from .parallel_wrapper import ParallelWrapper
 from .parameter_server import (GradientsAccumulator,
                                ParameterServerParallelWrapper)
@@ -7,7 +8,7 @@ from .training_master import (ParameterAveragingTrainingMaster,
                               TpuComputationGraph, TpuDl4jMultiLayer,
                               TrainingMasterStats)
 
-__all__ = ["GradientsAccumulator", "ParallelWrapper",
+__all__ = ["GradientsAccumulator", "MagicQueue", "ParallelWrapper",
            "ParameterAveragingTrainingMaster",
            "ParameterServerParallelWrapper", "TpuComputationGraph",
            "TpuDl4jMultiLayer", "TrainingMasterStats", "distributed",

@@ -1,6 +1,9 @@
+from . import model_guesser as ModelGuesser  # noqa: N812
 from . import model_serializer as ModelSerializer  # noqa: N812
+from .model_guesser import load_config_guess, load_model_guess
 from .model_serializer import (restore_computation_graph, restore_model,
                                restore_multi_layer_network, write_model)
 
-__all__ = ["ModelSerializer", "restore_computation_graph", "restore_model",
+__all__ = ["ModelGuesser", "ModelSerializer", "load_config_guess",
+           "load_model_guess", "restore_computation_graph", "restore_model",
            "restore_multi_layer_network", "write_model"]

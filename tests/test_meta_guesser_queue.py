@@ -1,0 +1,202 @@
+"""Prediction metadata + ModelGuesser + MagicQueue (VERDICT r2 item 10 +
+missing item 7). Mirrors reference eval/meta/Prediction.java,
+util/ModelGuesser.java, parallelism/MagicQueue.java tests."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.eval.evaluation import Evaluation, Prediction
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+
+def _mln():
+    conf = (NeuralNetConfiguration.Builder().seed(7)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, DenseLayer(n_out=8, activation="relu"))
+            .layer(1, OutputLayer(n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestPredictionMetadata:
+    def test_eval_with_meta_records_predictions(self):
+        ev = Evaluation()
+        labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+        preds = np.eye(3, dtype=np.float32)[[0, 2, 2, 1]]  # errors at 1, 3
+        ev.eval(labels, preds, meta=["r0", "r1", "r2", "r3"])
+        errs = ev.get_prediction_errors()
+        assert errs == [Prediction(0, 1, "r3"), Prediction(1, 2, "r1")]
+        assert ev.get_predictions(1, 2) == [Prediction(1, 2, "r1")]
+        assert ev.get_predictions_by_actual_class(0) == [
+            Prediction(0, 0, "r0"), Prediction(0, 1, "r3")]
+        assert ev.get_predictions_by_predicted_class(2) == [
+            Prediction(1, 2, "r1"), Prediction(2, 2, "r2")]
+
+    def test_no_meta_returns_none(self):
+        ev = Evaluation()
+        ev.eval(np.eye(2, dtype=np.float32)[[0, 1]],
+                np.eye(2, dtype=np.float32)[[1, 0]])
+        assert ev.get_prediction_errors() is None   # reference returns null
+
+    def test_meta_survives_merge_and_masks(self):
+        a, b = Evaluation(), Evaluation()
+        labels = np.eye(2, dtype=np.float32)[[0, 1, 1]]
+        preds = np.eye(2, dtype=np.float32)[[1, 1, 0]]
+        a.eval(labels, preds, mask=np.asarray([1, 0, 1]),
+               meta=["x", "y", "z"])   # "y" masked out
+        b.eval(np.eye(2, dtype=np.float32)[[1]],
+               np.eye(2, dtype=np.float32)[[0]], meta=["w"])
+        a.merge(b)
+        assert a.get_prediction_errors() == [
+            Prediction(0, 1, "x"), Prediction(1, 0, "z"),
+            Prediction(1, 0, "w")]
+
+    def test_meta_length_mismatch_raises(self):
+        with pytest.raises(ValueError, match="meta length"):
+            Evaluation().eval(np.eye(2, dtype=np.float32)[[0]],
+                              np.eye(2, dtype=np.float32)[[0]],
+                              meta=["a", "b"])
+
+    def test_evaluate_with_meta_through_network(self):
+        net = _mln()
+        rng = np.random.default_rng(0)
+        x = rng.random((12, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 12)]
+        it = ListDataSetIterator(list(DataSet(x, y).batch_by(5)))
+        meta = [f"row{i}" for i in range(12)]
+        ev = net.evaluate(it, meta=meta)
+        errs = ev.get_prediction_errors()
+        assert errs is not None
+        total = sum(len(v) for v in ev._meta_confusion.values())
+        assert total == 12
+        # every recorded meta is one of ours
+        assert {p.record_meta_data for p in errs} <= set(meta)
+
+    def test_collect_meta_data_from_record_reader(self, tmp_path):
+        """reference RecordReaderDataSetIterator.setCollectMetaData path."""
+        from deeplearning4j_tpu.datasets import (CSVRecordReader,
+                                                 RecordReaderDataSetIterator)
+        p = tmp_path / "d.csv"
+        p.write_text("1,2,1,2,0\n3,4,3,4,1\n5,6,5,6,2\n7,8,7,8,0\n"
+                     "9,1,9,1,1\n")
+        it = RecordReaderDataSetIterator(CSVRecordReader(str(p)),
+                                         batch_size=2, label_index=4,
+                                         num_classes=3,
+                                         collect_meta_data=True)
+        ds = it.next_batch()
+        assert ds.example_metas == [(str(p), 0), (str(p), 1)]
+        ds2 = it.next_batch()
+        assert ds2.example_metas == [(str(p), 2), (str(p), 3)]
+        it.reset()
+        assert it.next_batch().example_metas[0] == (str(p), 0)
+        net = _mln()
+        it.reset()
+        ev = net.evaluate(it)
+        assert sum(len(v) for v in ev._meta_confusion.values()) == 5
+
+
+class TestModelGuesser:
+    def test_guess_zip_mln(self, tmp_path):
+        from deeplearning4j_tpu.util import load_model_guess, write_model
+        net = _mln()
+        p = str(tmp_path / "m.zip")
+        write_model(net, p)
+        restored = load_model_guess(p)
+        assert isinstance(restored, MultiLayerNetwork)
+        assert np.allclose(net.params(), restored.params())
+
+    def test_guess_json_and_yaml_configs(self, tmp_path):
+        from deeplearning4j_tpu import ComputationGraph
+        from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+        from deeplearning4j_tpu.util import (load_config_guess,
+                                             load_model_guess)
+        mconf = _mln().conf
+        pj = tmp_path / "c.json"
+        pj.write_text(mconf.to_json())
+        py = tmp_path / "c.yaml"
+        py.write_text(mconf.to_yaml())
+        for p in (pj, py):
+            m = load_model_guess(str(p))
+            assert isinstance(m, MultiLayerNetwork)
+            assert len(m.conf.layers) == 2
+        gconf = (NeuralNetConfiguration.Builder().graph_builder()
+                 .add_inputs("in")
+                 .add_layer("a", DenseLayer(n_out=4, activation="tanh"), "in")
+                 .add_layer("b", DenseLayer(n_out=4, activation="tanh"), "in")
+                 .add_vertex("m", MergeVertex(), "a", "b")
+                 .add_layer("o", OutputLayer(n_out=2, activation="softmax",
+                                             loss_function="mcxent"), "m")
+                 .set_outputs("o")
+                 .set_input_types(InputType.feed_forward(3))
+                 .build())
+        pg = tmp_path / "g.yaml"
+        pg.write_text(gconf.to_yaml())
+        g = load_model_guess(str(pg))
+        assert isinstance(g, ComputationGraph)
+        # raw strings parse too
+        conf2 = load_config_guess(gconf.to_yaml())
+        assert conf2.to_json() == gconf.to_json()
+
+    def test_guess_garbage_raises(self, tmp_path):
+        from deeplearning4j_tpu.util import load_model_guess
+        p = tmp_path / "x.txt"
+        p.write_text("definitely: not a [model")
+        with pytest.raises(ValueError, match="guess"):
+            load_model_guess(str(p))
+
+
+class TestMagicQueue:
+    def test_per_device_bucketing_and_residency(self):
+        import jax
+
+        from deeplearning4j_tpu.parallel import MagicQueue
+        devices = jax.devices()[:2] if len(jax.devices()) >= 2 \
+            else jax.devices()
+        n = len(devices)
+        rng = np.random.default_rng(0)
+        batches = [DataSet(rng.random((8, 3)).astype(np.float32),
+                           rng.random((8, 2)).astype(np.float32))
+                   for _ in range(3)]
+        mq = MagicQueue(devices=devices, capacity=2)
+        mq.feed(ListDataSetIterator(batches))
+        seen = [0] * n
+        for bi in range(3):
+            for di in range(n):
+                shard = mq.next_for(di)
+                assert shard is not None
+                assert shard.features.shape[0] == 8 // n
+                assert list(shard.features.devices())[0] == devices[di]
+                np.testing.assert_array_equal(
+                    np.asarray(shard.features),
+                    batches[bi].features[di * (8 // n):(di + 1) * (8 // n)])
+                seen[di] += 1
+        for di in range(n):
+            assert mq.next_for(di) is None     # end of stream
+        assert seen == [3] * n
+        mq.shutdown()
+
+    def test_masks_and_ragged_tail(self):
+        import jax
+
+        from deeplearning4j_tpu.parallel import MagicQueue
+        devices = jax.devices()[:2] if len(jax.devices()) >= 2 \
+            else jax.devices()
+        n = len(devices)
+        x = np.arange(5 * 3, dtype=np.float32).reshape(5, 3)
+        fm = np.ones((5, 3), np.float32)
+        ds = DataSet(x, x.copy(), fm, None)
+        mq = MagicQueue(devices=devices, capacity=2)
+        mq.feed(ListDataSetIterator([ds]))
+        rows = 0
+        for di in range(n):
+            shard = mq.next_for(di)
+            if shard is not None:
+                rows += shard.features.shape[0]
+                assert shard.features_mask is not None
+        assert rows == 5
+        mq.shutdown()

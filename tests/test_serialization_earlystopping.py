@@ -93,6 +93,54 @@ class TestModelSerializer:
         o2 = np.asarray(net2.output(ds.features)[0])
         assert np.allclose(o1, o2)
 
+    def test_mln_yaml_round_trip(self):
+        """YAML serde — reference MultiLayerConfiguration.toYaml/fromYaml."""
+        from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                       SubsamplingLayer)
+        from deeplearning4j_tpu.nn.conf.neural_net_configuration import \
+            MultiLayerConfiguration
+        conf = (NeuralNetConfiguration.Builder().seed(11)
+                .updater("nesterovs").momentum(0.9).learning_rate(0.05)
+                .list()
+                .layer(0, ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                           stride=(1, 1), activation="relu"))
+                .layer(1, SubsamplingLayer(pooling_type="max",
+                                           kernel_size=(2, 2)))
+                .layer(2, OutputLayer(n_out=3, activation="softmax",
+                                      loss_function="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+        y = conf.to_yaml()
+        conf2 = MultiLayerConfiguration.from_yaml(y)
+        assert conf2.to_json() == conf.to_json()
+        # round-tripped config trains/infers identically
+        net = MultiLayerNetwork(conf).init()
+        net2 = MultiLayerNetwork(conf2).init()
+        net2.set_params(net.params())
+        x = np.random.default_rng(0).random((2, 8, 8, 1)).astype(np.float32)
+        assert np.allclose(np.asarray(net.output(x)),
+                           np.asarray(net2.output(x)))
+
+    def test_cg_yaml_round_trip(self):
+        """reference ComputationGraphConfiguration toYaml/fromYaml."""
+        from deeplearning4j_tpu.nn.conf.computation_graph_configuration import \
+            ComputationGraphConfiguration
+        conf = (NeuralNetConfiguration.Builder().seed(5)
+                .updater("sgd").learning_rate(0.1)
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("a", DenseLayer(n_out=5, activation="tanh"), "in")
+                .add_layer("b", DenseLayer(n_out=5, activation="tanh"), "in")
+                .add_vertex("m", MergeVertex(), "a", "b")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss_function="mcxent"), "m")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+        y = conf.to_yaml()
+        conf2 = ComputationGraphConfiguration.from_yaml(y)
+        assert conf2.to_json() == conf.to_json()
+
     def test_normalizer_round_trip(self, tmp_path):
         net = _mln()
         ds = _data()
