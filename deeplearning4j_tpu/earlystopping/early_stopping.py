@@ -323,6 +323,21 @@ class EarlyStoppingTrainer:
         self.net = net
         self.train_iterator = train_iterator
 
+    def _fit_epoch(self, c):
+        """Template method: train one epoch, checking iteration
+        terminations; returns (reason, details) on termination else None.
+        Subclasses (the TrainingMaster trainer) override the epoch body."""
+        self.train_iterator.reset()
+        while self.train_iterator.has_next():
+            ds = self.train_iterator.next_batch()
+            self.net.fit(ds)
+            last = self.net.score()
+            for t in c.iteration_terminations:
+                if t.terminate(last):
+                    return (EarlyStoppingResult.TerminationReason
+                            .IterationTerminationCondition, str(t))
+        return None
+
     def fit(self):
         c = self.conf
         for t in c.iteration_terminations:
@@ -332,22 +347,10 @@ class EarlyStoppingTrainer:
         epoch = 0
         reason, details = None, None
         while True:
-            self.train_iterator.reset()
-            terminated = False
-            while self.train_iterator.has_next():
-                ds = self.train_iterator.next_batch()
-                self.net.fit(ds)
-                last = self.net.score()
-                for t in c.iteration_terminations:
-                    if t.terminate(last):
-                        reason = EarlyStoppingResult.TerminationReason.\
-                            IterationTerminationCondition
-                        details = str(t)
-                        terminated = True
-                        break
-                if terminated:
-                    break
+            stop = self._fit_epoch(c)
+            terminated = stop is not None
             if terminated:
+                reason, details = stop
                 break
             if epoch % c.eval_every_n == 0:
                 if c.score_calculator is not None:

@@ -686,9 +686,11 @@ class MultiLayerNetwork:
         net = MultiLayerNetwork(self.conf.clone())
         if self._params is not None:
             net.init()
-            net._params = jax.tree.map(lambda a: a, self._params)
-            net._updater_state = jax.tree.map(lambda a: a, self._updater_state)
-            net._model_state = jax.tree.map(lambda a: a, self._model_state)
+            # materialize COPIES: aliasing the live arrays would let the
+            # next donated train step delete the clone's buffers with it
+            net._params = jax.tree.map(jnp.copy, self._params)
+            net._updater_state = jax.tree.map(jnp.copy, self._updater_state)
+            net._model_state = jax.tree.map(jnp.copy, self._model_state)
         return net
 
     def get_layer(self, i):

@@ -1,15 +1,22 @@
 from . import distributed
+from .early_stopping import (MasterDataSetLossCalculator,
+                             SparkEarlyStoppingTrainer,
+                             TpuEarlyStoppingTrainer)
 from .magic_queue import MagicQueue
 from .parallel_wrapper import ParallelWrapper
 from .parameter_server import (GradientsAccumulator,
                                ParameterServerParallelWrapper)
+from .training_hook import ParameterServerTrainingHook, TrainingHook
 from .sharding import make_mesh, shard_params
 from .training_master import (ParameterAveragingTrainingMaster,
                               TpuComputationGraph, TpuDl4jMultiLayer,
                               TrainingMasterStats)
 
-__all__ = ["GradientsAccumulator", "MagicQueue", "ParallelWrapper",
+__all__ = ["GradientsAccumulator", "MagicQueue",
+           "MasterDataSetLossCalculator", "ParallelWrapper",
            "ParameterAveragingTrainingMaster",
-           "ParameterServerParallelWrapper", "TpuComputationGraph",
+           "ParameterServerParallelWrapper", "ParameterServerTrainingHook",
+           "SparkEarlyStoppingTrainer", "TpuComputationGraph",
+           "TpuEarlyStoppingTrainer", "TrainingHook",
            "TpuDl4jMultiLayer", "TrainingMasterStats", "distributed",
            "make_mesh", "shard_params"]

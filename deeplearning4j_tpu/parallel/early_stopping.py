@@ -1,0 +1,60 @@
+"""Cluster-side early stopping over the TrainingMaster.
+
+TPU-native equivalent of reference dl4j-spark
+spark/earlystopping/SparkEarlyStoppingTrainer.java (+
+SparkDataSetLossCalculator.java): each "epoch" is one
+TrainingMaster.execute_training pass over the data; scoring, best-model
+saving (same EarlyStoppingModelSaver SPI), and termination conditions are
+inherited unchanged from EarlyStoppingTrainer — only the epoch body is
+cluster-shaped (the template-method seam `_fit_epoch`).
+"""
+from __future__ import annotations
+
+import math
+
+from ..earlystopping.early_stopping import (DataSetLossCalculator,
+                                            EarlyStoppingResult,
+                                            EarlyStoppingTrainer)
+
+
+class MasterDataSetLossCalculator(DataSetLossCalculator):
+    """Held-out loss for cluster runs — reference
+    spark/earlystopping/SparkDataSetLossCalculator.java. The reference maps
+    partitions to (loss*n, n) pairs and reduces; that map/reduce is
+    arithmetically identical to the example-weighted running mean
+    DataSetLossCalculator already computes, so this is the same calculator
+    under the reference's cluster-side name."""
+
+    def __init__(self, iterator, average=True, num_shards=None):
+        super().__init__(iterator, average)
+        self.num_shards = num_shards   # accepted for API compat; unused
+
+
+class TpuEarlyStoppingTrainer(EarlyStoppingTrainer):
+    """reference: SparkEarlyStoppingTrainer.java — fit(JavaRDD) per epoch
+    through the TrainingMaster, then score/save/terminate (inherited)."""
+
+    def __init__(self, es_conf, training_master, net, data):
+        super().__init__(es_conf, net, train_iterator=None)
+        self.master = training_master
+        self.data = data
+
+    def _fit_epoch(self, c):
+        """One epoch = one execute_training pass. Iteration terminations are
+        checked at split-result granularity (the reference checks per
+        averaging round on the driver); a NaN score terminates regardless
+        of configured conditions (divergence guard, reference
+        InvalidScoreIterationTerminationCondition role)."""
+        self.master.execute_training(self.net, self.data)
+        last = float(self.net.score())
+        if math.isnan(last):
+            return (EarlyStoppingResult.TerminationReason
+                    .IterationTerminationCondition, "score is NaN")
+        for t in c.iteration_terminations:
+            if t.terminate(last):
+                return (EarlyStoppingResult.TerminationReason
+                        .IterationTerminationCondition, str(t))
+        return None
+
+
+SparkEarlyStoppingTrainer = TpuEarlyStoppingTrainer   # reference name

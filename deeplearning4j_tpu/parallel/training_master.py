@@ -73,6 +73,7 @@ class ParameterAveragingTrainingMaster:
             self._mesh = None
             self._approach = "export"
             self._export_dir = None
+            self._training_hook = None
 
         def rdd_training_approach(self, v):
             """'export' (reference default: batch to disk, stream per split —
@@ -114,16 +115,26 @@ class ParameterAveragingTrainingMaster:
         def mesh(self, m):
             self._mesh = m; return self
 
+        def training_hook(self, hook):
+            """Install a TrainingHook (reference spark/api/TrainingHook +
+            addHook). A hook with handles_training=True (the parameter-
+            server hook) takes over split training: workers push gradients
+            to the async GradientsAccumulator instead of parameter
+            averaging."""
+            self._training_hook = hook; return self
+
+        trainingHook = training_hook
+
         def build(self):
             return ParameterAveragingTrainingMaster(
                 self._batch, self._workers, self._avg_freq,
                 self._avg_updaters, self._collect_stats, self._mesh,
-                self._approach, self._export_dir)
+                self._approach, self._export_dir, self._training_hook)
 
     def __init__(self, batch_size_per_worker=16, workers=None,
                  averaging_frequency=5, average_updaters=True,
                  collect_stats=False, mesh=None, approach="export",
-                 export_dir=None):
+                 export_dir=None, training_hook=None):
         import jax
         self.batch_size = int(batch_size_per_worker)
         self.num_workers = int(workers or len(jax.devices()))
@@ -134,6 +145,7 @@ class ParameterAveragingTrainingMaster:
         self.approach = approach
         self.export_dir = export_dir
         self.stats = TrainingMasterStats() if collect_stats else None
+        self.training_hook = training_hook
         self._pw = None
         # (data object, [paths], owned_tmpdir) — holds a strong reference to
         # the source and compares with `is`: an id() key could collide when
@@ -190,47 +202,82 @@ class ParameterAveragingTrainingMaster:
         stream batch-by-batch from disk — host memory holds at most one
         global batch, so datasets larger than RAM train. approach='direct'
         materializes everything in memory (the reference's Direct mode)."""
-        pw = self._ensure_pw(net)
+        hook = self.training_hook
+        hook_trains = hook is not None and getattr(hook, "handles_training",
+                                                   False)
+        if not hook_trains:
+            pw = self._ensure_pw(net)
         global_batch = self.num_workers * self.batch_size
-        if self.approach == "export":
-            paths = self._export_if_required(data, global_batch)
-            k = self.averaging_frequency
-            for s0 in range(0, len(paths), k):
+        try:
+            if self.approach == "export":
+                paths = self._export_if_required(data, global_batch)
+                k = self.averaging_frequency
+                for s0 in range(0, len(paths), k):
+                    t1 = time.time()
+                    split_paths = paths[s0:s0 + k]
+                    from ..datasets.iterators import FileDataSetIterator
+                    self._train_split(net, FileDataSetIterator(split_paths),
+                                      hook, hook_trains)
+                    if self.stats:
+                        self.stats.record("fit", t1, time.time() - t1,
+                                          {"minibatches": len(split_paths)})
+                return net
+
+            examples = self._collect_examples(data)
+            # one "split" = numWorkers*batchSize*averagingFrequency examples
+            split_size = global_batch * self.averaging_frequency
+            n = examples.num_examples()
+            for s0 in range(0, n, split_size):
+                t0 = time.time()
+                split = DataSet(
+                    examples.features[s0:s0 + split_size],
+                    examples.labels[s0:s0 + split_size],
+                    (examples.features_mask[s0:s0 + split_size]
+                     if examples.features_mask is not None else None),
+                    (examples.labels_mask[s0:s0 + split_size]
+                     if examples.labels_mask is not None else None))
+                if self.stats:
+                    self.stats.record("split", t0, time.time() - t0,
+                                      {"examples": split.num_examples()})
                 t1 = time.time()
-                split_paths = paths[s0:s0 + k]
-                from ..datasets.iterators import FileDataSetIterator
-                pw.fit(FileDataSetIterator(split_paths))
+                batches = list(split.batch_by(global_batch))
+                self._train_split(net, batches, hook, hook_trains)
                 if self.stats:
                     self.stats.record("fit", t1, time.time() - t1,
-                                      {"minibatches": len(split_paths)})
+                                      {"minibatches": len(batches)})
             return net
+        finally:
+            if hook_trains:
+                hook.detach()   # flush accumulator, capture PS stats
 
-        examples = self._collect_examples(data)
-        # one "split" = numWorkers * batchSize * averagingFrequency examples
-        split_size = global_batch * self.averaging_frequency
-        n = examples.num_examples()
-        for s0 in range(0, n, split_size):
-            t0 = time.time()
-            split = DataSet(
-                examples.features[s0:s0 + split_size],
-                examples.labels[s0:s0 + split_size],
-                (examples.features_mask[s0:s0 + split_size]
-                 if examples.features_mask is not None else None),
-                (examples.labels_mask[s0:s0 + split_size]
-                 if examples.labels_mask is not None else None))
-            if self.stats:
-                self.stats.record("split", t0, time.time() - t0,
-                                  {"examples": split.num_examples()})
-            t1 = time.time()
-            batches = list(split.batch_by(global_batch))
-            # fit phase: k local steps per device + ICI parameter average,
-            # one compiled program (the broadcast/aggregate of the reference
-            # happens inside as device_put + pmean)
-            self._pw.fit(ListDataSetIterator(batches))
-            if self.stats:
-                self.stats.record("fit", t1, time.time() - t1,
-                                  {"minibatches": len(batches)})
-        return net
+    def _train_split(self, net, batches, hook, hook_trains):
+        """One split. Default: k local steps per device + ICI parameter
+        average in one compiled program (the broadcast/aggregate of the
+        reference happens inside as device_put + pmean). With a
+        handles_training hook installed (reference
+        ParameterServerTrainingHook), the split's workers push gradients to
+        the async accumulator instead. Observer hooks fire at split
+        granularity — per-minibatch host callbacks can't interrupt the
+        fused k-step program by design."""
+        if hook_trains:
+            if not isinstance(batches, list):
+                batches = self._drain(batches)
+            hook.process_split(net, batches)
+            return
+        if hook is not None:
+            hook.pre_update(None, net)
+        self._pw.fit(batches if not isinstance(batches, list)
+                     else ListDataSetIterator(batches))
+        if hook is not None:
+            hook.post_update(None, net)
+
+    @staticmethod
+    def _drain(it):
+        out = []
+        it.reset()
+        while it.has_next():
+            out.append(it.next_batch())
+        return out
 
     executeTraining = execute_training
 

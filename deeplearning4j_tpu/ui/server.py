@@ -9,6 +9,11 @@ deeplearning4j-play module/ equivalents):
   /train/model     per-layer table + per-param mean-magnitude charts
                    (TrainModule model page)
   /train/histogram param/update histograms (HistogramModule)
+  /train/flow      clickable network DAG (FlowListenerModule)
+  /train/activations conv activation grids from the probe batch
+                   (ConvolutionalListenerModule)
+  /train/system    hardware table + device/host memory charts
+                   (TrainModule system tab)
   /tsne            t-SNE scatter of uploaded coords (TsneModule)
 
 plus a remote-receiver endpoint accepting POSTed reports from
@@ -45,7 +50,9 @@ _STYLE = """
 """
 
 _NAV = """<nav><a href="/">Overview</a><a href="/train/model">Model</a>
-<a href="/train/histogram">Histograms</a><a href="/tsne">t-SNE</a></nav>"""
+<a href="/train/histogram">Histograms</a><a href="/train/flow">Flow</a>
+<a href="/train/activations">Activations</a>
+<a href="/train/system">System</a><a href="/tsne">t-SNE</a></nav>"""
 
 # Shared JS helpers: safe DOM building + line/scatter/histogram rendering.
 _JS_LIB = """
@@ -262,6 +269,171 @@ async function refresh(){
 refresh(); setInterval(refresh, 5000);""")
 
 
+_FLOW = _page(
+    "Flow graph",
+    """<div class="card"><h2>Network DAG (click a node)</h2>
+<svg id="dag" style="height:460px"></svg></div>
+<div class="card"><h2>Selected layer</h2><div id="detail"></div></div>""",
+    """
+let sel=null;
+function buildGraph(conf){
+ // returns {nodes:[{name,type,info}], edges:[[from,to]]}
+ if(conf.vertices){
+  const nodes=[], edges=[];
+  for(const inp of (conf.networkInputs||[]))
+    nodes.push({name:inp, type:'input', info:{}});
+  for(const [k,v] of Object.entries(conf.vertices)){
+   const l=v.conf||{};
+   nodes.push({name:k, type:(v.kind==='layer'? (l.type||'layer'):'vertex'),
+               info:l});
+   for(const i of (v.inputs||[])) edges.push([i,k]);
+  }
+  return {nodes, edges};
+ }
+ const layers = conf.layers||[];
+ const nodes=[{name:'input', type:'input', info:{}}], edges=[];
+ let prev='input';
+ layers.forEach((l,i)=>{
+  const name=String(i);
+  nodes.push({name, type:l.type||'layer', info:l});
+  edges.push([prev,name]); prev=name;});
+ return {nodes, edges};
+}
+function layerRanks(nodes, edges){
+ // longest-path layering
+ const rank={}; const indeg={}; const out={};
+ nodes.forEach(n=>{rank[n.name]=0; indeg[n.name]=0; out[n.name]=[];});
+ edges.forEach(([a,b])=>{indeg[b]++; out[a].push(b);});
+ const q=nodes.filter(n=>indeg[n.name]===0).map(n=>n.name);
+ while(q.length){
+  const u=q.shift();
+  for(const v of out[u]){
+   rank[v]=Math.max(rank[v], rank[u]+1);
+   if(--indeg[v]===0) q.push(v);}}
+ return rank;
+}
+function drawDag(svg, g, params){
+ svg.textContent='';
+ const ns='http://www.w3.org/2000/svg';
+ const rank=layerRanks(g.nodes, g.edges);
+ const byRank={};
+ g.nodes.forEach(n=>{(byRank[rank[n.name]]=byRank[rank[n.name]]||[]).push(n);});
+ const R=Object.keys(byRank).length;
+ const W=svg.clientWidth||900, H=svg.clientHeight||460;
+ const pos={};
+ Object.entries(byRank).forEach(([r,ns_])=>{
+  ns_.forEach((n,i)=>{pos[n.name]=[ (Number(r)+0.5)*W/R,
+                                    (i+0.5)*H/(ns_.length) ];});});
+ for(const [a,b] of g.edges){
+  const ln=document.createElementNS(ns,'line');
+  ln.setAttribute('x1',pos[a][0]); ln.setAttribute('y1',pos[a][1]);
+  ln.setAttribute('x2',pos[b][0]); ln.setAttribute('y2',pos[b][1]);
+  ln.setAttribute('stroke','#aaa'); svg.appendChild(ln);}
+ for(const n of g.nodes){
+  const gr=document.createElementNS(ns,'g');
+  const c=document.createElementNS(ns,'rect');
+  const [x,y]=pos[n.name];
+  c.setAttribute('x',x-44); c.setAttribute('y',y-14);
+  c.setAttribute('width',88); c.setAttribute('height',28);
+  c.setAttribute('rx',6);
+  c.setAttribute('fill', n.type==='input'? '#cde':'#fff');
+  c.setAttribute('stroke', sel===n.name? '#c30':'#06c');
+  c.setAttribute('stroke-width', sel===n.name? '3':'1.5');
+  const t=document.createElementNS(ns,'text');
+  t.setAttribute('x',x); t.setAttribute('y',y+4);
+  t.setAttribute('text-anchor','middle'); t.setAttribute('font-size','10');
+  t.textContent=n.name.length>12? n.name.slice(0,11)+'…' : n.name;
+  gr.appendChild(c); gr.appendChild(t);
+  gr.style.cursor='pointer';
+  gr.onclick=()=>{sel=n.name; showDetail(n, params); refresh();};
+  svg.appendChild(gr);}
+}
+function showDetail(n, params){
+ const d=document.getElementById('detail'); d.textContent='';
+ const rows=[['name',n.name],['type',n.type]];
+ for(const [k,v] of Object.entries(n.info||{}))
+  if(v!==null && typeof v!=='object') rows.push([k,v]);
+ for(const [pn,ps] of Object.entries(params||{}))
+  if(pn.startsWith(n.name+'_'))
+   rows.push([pn+' meanMag', Number(ps.meanMagnitude).toExponential(3)]);
+ d.appendChild(kvTable(rows));
+}
+async function refresh(){
+ const sid=await latestSession(); if(!sid) return;
+ const st=await (await fetch('/api/static/'+sid)).json();
+ if(!st || !st.model || !st.model.configJson) return;
+ const ups=await (await fetch('/api/updates/'+sid)).json();
+ const withP=ups.filter(u=>u.parameters);
+ const params=withP.length? withP[withP.length-1].parameters : {};
+ try{
+  const g=buildGraph(JSON.parse(st.model.configJson));
+  drawDag(document.getElementById('dag'), g, params);
+ }catch(e){}
+}
+refresh(); setInterval(refresh, 4000);""")
+
+
+_ACTIVATIONS = _page(
+    "Conv activations",
+    """<div class="card"><h2>Layer activations (probe batch, first example)
+</h2><div id="grids"></div></div>
+<div class="card">Enable with
+ StatsUpdateConfiguration(collect_activations=True) and an
+ activation_probe batch on the StatsListener.</div>""",
+    """
+function drawGrid(parent, grid){
+ const h=grid.length, w=grid[0].length, scale=Math.max(1, Math.floor(96/Math.max(h,w)));
+ const cv=document.createElement('canvas');
+ cv.width=w*scale; cv.height=h*scale;
+ cv.style.border='1px solid #ccc'; cv.style.margin='2px';
+ const ctx=cv.getContext('2d');
+ for(let y=0;y<h;y++) for(let x=0;x<w;x++){
+  const v=Number(grid[y][x])|0;
+  ctx.fillStyle='rgb('+v+','+v+','+v+')';
+  ctx.fillRect(x*scale,y*scale,scale,scale);}
+ parent.appendChild(cv);}
+async function refresh(){
+ const sid=await latestSession(); if(!sid) return;
+ const ups=await (await fetch('/api/updates/'+sid)).json();
+ const withA=ups.filter(u=>u.activations);
+ if(!withA.length) return;
+ const acts=withA[withA.length-1].activations;
+ const root=document.getElementById('grids'); root.textContent='';
+ for(const [name,a] of Object.entries(acts)){
+  const box=el('div'); box.appendChild(el('h2','layer '+name+' ('+
+    a.height+'x'+a.width+', '+a.channels.length+' ch)'));
+  for(const g of a.channels) drawGrid(box, g);
+  root.appendChild(box);}
+}
+refresh(); setInterval(refresh, 4000);""")
+
+
+_SYSTEM = _page(
+    "System",
+    """<div class="card"><h2>Hardware</h2><div id="hw"></div></div>
+<div class="card"><h2>Device memory in use (bytes)</h2><svg id="dm"></svg></div>
+<div class="card"><h2>Host max RSS (KB)</h2><svg id="hm"></svg></div>""",
+    """
+async function refresh(){
+ const sid=await latestSession(); if(!sid) return;
+ const st=await (await fetch('/api/static/'+sid)).json();
+ const hw=document.getElementById('hw'); hw.textContent='';
+ if(st && st.machine){
+  const rows=Object.entries(st.machine);
+  if(st.model) rows.push(['model params', st.model.numParams]);
+  hw.appendChild(kvTable(rows));}
+ const ups=await (await fetch('/api/updates/'+sid)).json();
+ const withM=ups.filter(u=>u.memory);
+ drawLine(document.getElementById('dm'),
+   withM.filter(u=>u.memory.deviceBytesInUse!==undefined)
+        .map(u=>[u.iteration,u.memory.deviceBytesInUse]), '#638');
+ drawLine(document.getElementById('hm'),
+   withM.filter(u=>u.memory.hostMaxRssKb!==undefined)
+        .map(u=>[u.iteration,u.memory.hostMaxRssKb]), '#a40');
+}
+refresh(); setInterval(refresh, 3000);""")
+
+
 class _Handler(BaseHTTPRequestHandler):
     storage = None
     tsne = None  # session_id -> {"coords": ..., "labels": ...}
@@ -293,6 +465,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._html(_MODEL)
         elif self.path == "/train/histogram":
             self._html(_HISTOGRAM)
+        elif self.path == "/train/flow":
+            self._html(_FLOW)
+        elif self.path == "/train/activations":
+            self._html(_ACTIVATIONS)
+        elif self.path == "/train/system":
+            self._html(_SYSTEM)
         elif self.path == "/tsne":
             self._html(_TSNE)
         elif self.path == "/api/sessions":

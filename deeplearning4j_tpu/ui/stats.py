@@ -28,7 +28,9 @@ class StatsUpdateConfiguration:
                  collect_memory=True, collect_learning_rates=True,
                  collect_histograms=False, histogram_bins=20,
                  collect_mean=True, collect_stdev=True,
-                 collect_mean_magnitudes=True, report_frequency=1):
+                 collect_mean_magnitudes=True, report_frequency=1,
+                 collect_activations=False, max_activation_channels=8,
+                 max_activation_size=48):
         self.collect_score = collect_score
         self.collect_timing = collect_timing
         self.collect_memory = collect_memory
@@ -39,6 +41,13 @@ class StatsUpdateConfiguration:
         self.collect_stdev = collect_stdev
         self.collect_mean_magnitudes = collect_mean_magnitudes
         self.report_frequency = max(1, int(report_frequency))
+        # conv-activation capture (reference ConvolutionalListenerModule /
+        # ConvolutionalIterationListener): requires an activation_probe
+        # batch on the StatsListener; each report carries normalized
+        # per-channel activation grids of every 4-D layer output
+        self.collect_activations = collect_activations
+        self.max_activation_channels = int(max_activation_channels)
+        self.max_activation_size = int(max_activation_size)
 
 
 def _summary(arr, bins=None):
@@ -57,11 +66,16 @@ class StatsListener(IterationListener):
     """reference: ui-model stats/BaseStatsListener.java"""
 
     def __init__(self, router_or_storage, config=None, session_id=None,
-                 worker_id="worker_0"):
+                 worker_id="worker_0", activation_probe=None):
         self.router = router_or_storage
         self.config = config or StatsUpdateConfiguration()
         self.session_id = session_id or f"session_{int(time.time() * 1000)}"
         self.worker_id = worker_id
+        # small sample batch run through feed_forward when
+        # collect_activations is on (the reference listener captures
+        # activations from the forward pass itself; the fused TPU step
+        # doesn't surface intermediates, so a probe forward collects them)
+        self.activation_probe = activation_probe
         self._last_report_time = None
         self._total_examples = 0
         self._total_minibatches = 0
@@ -115,6 +129,10 @@ class StatsListener(IterationListener):
                     for name, arr in params.items()
                     if name in self._prev_params}
             self._prev_params = params
+        if c.collect_activations and self.activation_probe is not None:
+            acts = self._activation_grids(model)
+            if acts:
+                report["activations"] = acts
         self.router.put_update(report)
 
     # ------------------------------------------------------------------
@@ -161,6 +179,38 @@ class StatsListener(IterationListener):
         for i, l in enumerate(layers):
             out[getattr(l, "name", None) or str(i)] = float(
                 l.learning_rate or 0.0)
+        return out
+
+    def _activation_grids(self, model):
+        """Per-layer activation images for conv layers: first probe example,
+        up to max_activation_channels channels, each normalized to 0-255
+        (reference ConvolutionalIterationListener image capture)."""
+        c = self.config
+        acts = model.feed_forward(self.activation_probe, train=False)
+        if isinstance(acts, dict):          # ComputationGraph: name -> act
+            items = acts.items()
+        else:                               # MLN: [input, layer0, ...]
+            items = ((str(i - 1), a) for i, a in enumerate(acts) if i > 0)
+        out = {}
+        for name, a in items:
+            a = np.asarray(a)
+            if a.ndim != 4:     # NHWC conv maps only
+                continue
+            a = a[0]            # first example
+            h, w, ch = a.shape
+            step = max(1, max(h, w) // c.max_activation_size)
+            a = a[::step, ::step, :]
+            grids = []
+            for ci in range(min(ch, c.max_activation_channels)):
+                g = a[:, :, ci].astype(np.float64)
+                lo, hi = float(g.min()), float(g.max())
+                g8 = np.zeros_like(g, np.uint8) if hi <= lo else \
+                    ((g - lo) / (hi - lo) * 255).astype(np.uint8)
+                grids.append(g8.tolist())
+            if grids:
+                out[name] = {"height": len(grids[0]),
+                             "width": len(grids[0][0]),
+                             "channels": grids}
         return out
 
     def _param_arrays(self, model):

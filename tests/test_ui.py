@@ -155,3 +155,54 @@ def test_listener_events_push():
     net.set_listeners(StatsListener(storage))
     net.fit(_ds())
     assert events == ["static", "update"]
+
+
+def test_activation_collection_and_new_pages():
+    """Flow / conv-activation / system pages + activation capture
+    (reference FlowListenerModule, ConvolutionalListenerModule,
+    TrainModule system tab — VERDICT r2 item 5)."""
+    from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                   SubsamplingLayer)
+    conf = (NeuralNetConfiguration.Builder().seed(1)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                       activation="relu"))
+            .layer(1, SubsamplingLayer(pooling_type="max",
+                                       kernel_size=(2, 2)))
+            .layer(2, OutputLayer(n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.convolutional(10, 10, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    r = np.random.default_rng(0)
+    x = r.random((8, 10, 10, 1)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.integers(0, 3, 8)]
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(
+        storage,
+        StatsUpdateConfiguration(collect_activations=True,
+                                 max_activation_channels=3),
+        session_id="act1", activation_probe=x[:2]))
+    for _ in range(2):
+        net.fit(DataSet(x, y))
+    ups = storage.get_all_updates("act1")
+    acts = ups[-1]["activations"]
+    # conv (layer 0) and pool (layer 1) produce 4-D maps; output doesn't
+    assert "0" in acts and "1" in acts and "2" not in acts
+    a0 = acts["0"]
+    assert a0["height"] == 8 and a0["width"] == 8     # 10 - 3 + 1 (truncate)
+    assert len(a0["channels"]) == 3
+    flat = [v for row in a0["channels"][0] for v in row]
+    assert all(0 <= v <= 255 for v in flat)
+    server = UIServer(port=0).attach(storage)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        for path, marker in [("/train/flow", "Network DAG"),
+                             ("/train/activations", "Layer activations"),
+                             ("/train/system", "Device memory")]:
+            with urllib.request.urlopen(base + path) as r2:
+                assert marker in r2.read().decode()
+        # the system page's data source: memory in updates
+        assert "memory" in ups[-1]
+    finally:
+        server.stop()
