@@ -137,11 +137,20 @@ class BaseElementsLearning:
         self.use_hs = True
         self._max_code_len = 1
         self._rng = np.random.default_rng(0)
+        self.mesh = None
         self._syn0 = None
         self._syn1 = None   # whichever of syn1 / syn1neg is in use
 
     def configure(self, vocab, lookup, *, window=5, negative=0, use_hs=True,
-                  seed=12345):
+                  seed=12345, mesh=None):
+        """`mesh`: optional jax Mesh — distributed mode (reference
+        dl4j-spark-nlp Word2Vec.java:61,130 trains embeddings cluster-wide).
+        TPU-first design: syn0/syn1 COLUMN-shard over the mesh's "model"
+        axis (each device holds every row's D/n slice), so pair gathers and
+        scatter-adds stay device-local and the only collective GSPMD inserts
+        is a psum of the [C,T] logits in the dot products — Megatron-style
+        sharding instead of the reference's per-iteration parameter
+        broadcast/collect."""
         import jax
         self.vocab = vocab
         self.lookup = lookup
@@ -149,14 +158,26 @@ class BaseElementsLearning:
         self.negative = int(negative)
         self.use_hs = bool(use_hs) and lookup.syn1 is not None
         self._rng = np.random.default_rng(seed)
+        self.mesh = mesh
         if self.use_hs:
             self._max_code_len = max(
                 (len(w.codes) for w in vocab.vocab_words()), default=1)
-        self._syn0 = jax.device_put(lookup.syn0)
-        if self.use_hs:
-            self._syn1 = jax.device_put(lookup.syn1)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ...parallel.sharding import put_sharded
+            col = NamedSharding(mesh, P(None, "model"))
+            # put_sharded handles multi-host meshes (device_put cannot
+            # address other hosts' devices); every process holds the full
+            # table at configure time
+            put = lambda a: put_sharded(a, col, full_array=True)
         else:
-            self._syn1 = jax.device_put(lookup.syn1neg)
+            put = jax.device_put
+        self._syn0 = put(lookup.syn0)
+        if self.use_hs:
+            self._syn1 = put(lookup.syn1)
+        else:
+            self._syn1 = put(lookup.syn1neg)
         self._codes = None
         self._points = None
         if self.use_hs:
@@ -176,11 +197,23 @@ class BaseElementsLearning:
     def finish(self):
         """Flush pending pairs and write weights back to the lookup table."""
         self._flush(force=True)
-        self.lookup.syn0 = np.asarray(self._syn0)
+        self.lookup.syn0 = self._fetch(self._syn0)
         if self.use_hs:
-            self.lookup.syn1 = np.asarray(self._syn1)
+            self.lookup.syn1 = self._fetch(self._syn1)
         else:
-            self.lookup.syn1neg = np.asarray(self._syn1)
+            self.lookup.syn1neg = self._fetch(self._syn1)
+
+    def _fetch(self, arr):
+        """Device array -> host numpy; on a multi-host mesh the shards on
+        other hosts aren't addressable, so replicate through a jitted
+        identity first (an all-gather over the mesh)."""
+        import jax
+        if self.mesh is not None and len(
+                {d.process_index for d in self.mesh.devices.flat}) > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            arr = jax.jit(lambda a: a, out_shardings=NamedSharding(
+                self.mesh, P()))(arr)
+        return np.asarray(arr)
 
     # -- pair -> target/label arrays ------------------------------------
     def _targets_labels(self, out_ids):

@@ -30,7 +30,7 @@ class SequenceVectors:
                  iterations=1, epochs=1, learning_rate=0.025,
                  min_learning_rate=1e-4, negative=0, use_hierarchic_softmax=True,
                  sampling=0.0, seed=12345, elements_algo="skipgram",
-                 batch_pairs=4096):
+                 batch_pairs=4096, mesh=None):
         self.vector_length = int(vector_length)
         self.window = int(window)
         self.min_word_frequency = int(min_word_frequency)
@@ -44,6 +44,9 @@ class SequenceVectors:
         self.seed = int(seed)
         self.elements_algo = str(elements_algo).lower()
         self.batch_pairs = int(batch_pairs)
+        # distributed mode: embedding tables column-shard over this mesh's
+        # "model" axis (reference dl4j-spark-nlp cluster-wide Word2Vec)
+        self.mesh = mesh
         self.vocab = None
         self.lookup = None
         self._rng = np.random.default_rng(self.seed)
@@ -92,7 +95,7 @@ class SequenceVectors:
         algo = algo_cls(batch_pairs=self.batch_pairs)
         algo.configure(self.vocab, self.lookup, window=self.window,
                        negative=self.negative, use_hs=self.use_hs,
-                       seed=self.seed)
+                       seed=self.seed, mesh=self.mesh)
 
         total_words = max(self.vocab.total_word_count * self.epochs
                           * self.iterations, 1)

@@ -77,6 +77,12 @@ class Word2Vec(SequenceVectors):
         def batch_pairs(self, v):
             self._kw["batch_pairs"] = int(v); return self
 
+        def mesh(self, m):
+            """Distributed training over a device mesh (embedding tables
+            column-sharded over the mesh "model" axis) — reference
+            dl4j-spark-nlp spark/models/embeddings/word2vec/Word2Vec.java."""
+            self._kw["mesh"] = m; return self
+
         def iterate(self, sentence_iterator):
             self._iterator = sentence_iterator; return self
 

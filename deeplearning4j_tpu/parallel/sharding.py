@@ -103,11 +103,12 @@ def shard_params(net, mesh, tensor_parallel=False):
             _layer_sharding(layer, p, mesh, tensor_parallel)
             for layer, p in zip(net.layers, net._params)]
     if isinstance(shardings, dict):
-        sharded = {n: {k: put_sharded(v, shardings[n][k])
+        sharded = {n: {k: put_sharded(v, shardings[n][k], full_array=True)
                        for k, v in p.items()}
                    for n, p in net._params.items()}
     else:
-        sharded = [{k: put_sharded(v, d[k]) for k, v in p.items()}
+        sharded = [{k: put_sharded(v, d[k], full_array=True)
+                    for k, v in p.items()}
                    for d, p in zip(shardings, net._params)]
     return sharded, shardings
 
@@ -116,24 +117,34 @@ def is_multiprocess_mesh(mesh):
     return len({d.process_index for d in mesh.devices.flat}) > 1
 
 
-def put_sharded(arr, sharding):
+def put_sharded(arr, sharding, full_array=False):
     """Place an array under `sharding`, working on single-host AND
     multi-host meshes. Multi-host (jax.distributed) device_put cannot
-    address other hosts' devices; there each process contributes its local
-    data via make_array_from_process_local_data (replicated leaves pass the
-    full array; "data"-sharded batches pass the process-local slice).
+    address other hosts' devices, so each process contributes data itself:
+
+    - full_array=False (batches): `arr` is this process's LOCAL slice —
+      make_array_from_process_local_data assembles the global array.
+    - full_array=True (parameters): every process holds the FULL array —
+      make_array_from_callback hands each addressable shard its global
+      slice. (Passing a full array through the local-data path would
+      mis-scale the global shape when a sharded axis spans processes.)
+
     This is the DCN-path seam: the same ParallelWrapper program runs on a
     global mesh spanning hosts (SURVEY.md §5.8)."""
     if arr is None:
         return None
     if is_multiprocess_mesh(sharding.mesh):
-        return jax.make_array_from_process_local_data(
-            sharding, np.asarray(arr))
+        a = np.asarray(arr)
+        if full_array:
+            return jax.make_array_from_callback(
+                a.shape, sharding, lambda idx: a[idx])
+        return jax.make_array_from_process_local_data(sharding, a)
     return jax.device_put(arr, sharding)
 
 
 def replicate(tree, mesh):
     sh = NamedSharding(mesh, P())
     if is_multiprocess_mesh(mesh):
-        return jax.tree.map(lambda a: put_sharded(a, sh), tree)
+        return jax.tree.map(lambda a: put_sharded(a, sh, full_array=True),
+                            tree)
     return jax.device_put(tree, sh)

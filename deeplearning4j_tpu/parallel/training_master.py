@@ -205,9 +205,25 @@ class ParameterAveragingTrainingMaster:
         hook = self.training_hook
         hook_trains = hook is not None and getattr(hook, "handles_training",
                                                    False)
+        global_batch = self.num_workers * self.batch_size
         if not hook_trains:
             pw = self._ensure_pw(net)
-        global_batch = self.num_workers * self.batch_size
+            from .sharding import is_multiprocess_mesh
+            if is_multiprocess_mesh(pw.mesh):
+                # multi-host: `data` is this PROCESS's slice; it feeds its
+                # local-device fraction of every global batch (the
+                # per-process input-slice role — MagicQueue/SURVEY §5.8)
+                import jax
+                n_local, n_global = len(jax.local_devices()), len(
+                    jax.devices())
+                if (global_batch * n_local) % n_global != 0 or \
+                        global_batch * n_local < n_global:
+                    raise ValueError(
+                        f"global batch {global_batch} (workers*batchSize) "
+                        f"must be a positive multiple of "
+                        f"{n_global}/{n_local} so every process feeds "
+                        f"whole rows")
+                global_batch = global_batch * n_local // n_global
         try:
             if self.approach == "export":
                 paths = self._export_if_required(data, global_batch)

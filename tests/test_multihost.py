@@ -51,3 +51,36 @@ def test_two_process_parallel_wrapper_allreduce():
     # both processes hold identical averaged params and scores
     assert results[0] == results[1]
     assert np.isfinite(results[0][0]) and np.isfinite(results[0][1])
+
+
+def test_four_process_model_axis_and_training_master():
+    """Scaled multi-host proof (VERDICT r2 item 9): 4 real processes, a
+    mesh whose model axis spans process boundaries (tensor parallelism over
+    DCN), a TrainingMaster run on the multi-host mesh with per-process
+    input slices, and MagicQueue staging per local device."""
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO
+    script = os.path.join(REPO, "tests", "multihost_worker4.py")
+    procs = [subprocess.Popen(
+        [sys.executable, script, str(i), "4", coord],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env) for i in range(4)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=280)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT"):
+                _, pid, tp, tm, sc = line.split()
+                results[int(pid)] = (tp, tm, sc)
+    assert set(results) == {0, 1, 2, 3}, f"missing results: {outs}"
+    # every process holds identical parameters after both paths
+    assert len({r for r in results.values()}) == 1
+    vals = [float(v.split("=")[1]) for v in results[0]]
+    assert all(np.isfinite(v) for v in vals)
