@@ -1,0 +1,10 @@
+from .object_store import (GCSObjectStore, LocalFSObjectStore, ObjectStore,
+                           ObjectStoreDataSetIterator, S3ObjectStore)
+from .provision import (ClusterProvisioner, ClusterSpec, CommandRunner,
+                        LocalCommandRunner, SSHCommandRunner,
+                        create_instances_command)
+
+__all__ = ["ClusterProvisioner", "ClusterSpec", "CommandRunner",
+           "GCSObjectStore", "LocalCommandRunner", "LocalFSObjectStore",
+           "ObjectStore", "ObjectStoreDataSetIterator", "S3ObjectStore",
+           "SSHCommandRunner", "create_instances_command"]

@@ -6,6 +6,8 @@ from .magic_queue import MagicQueue
 from .parallel_wrapper import ParallelWrapper
 from .parameter_server import (GradientsAccumulator,
                                ParameterServerParallelWrapper)
+from .time_source import (NTPTimeSource, SystemClockTimeSource,
+                          TimeSource)
 from .training_hook import ParameterServerTrainingHook, TrainingHook
 from .sharding import make_mesh, shard_params
 from .training_master import (ParameterAveragingTrainingMaster,
@@ -13,10 +15,11 @@ from .training_master import (ParameterAveragingTrainingMaster,
                               TrainingMasterStats)
 
 __all__ = ["GradientsAccumulator", "MagicQueue",
-           "MasterDataSetLossCalculator", "ParallelWrapper",
+           "MasterDataSetLossCalculator", "NTPTimeSource", "ParallelWrapper",
            "ParameterAveragingTrainingMaster",
            "ParameterServerParallelWrapper", "ParameterServerTrainingHook",
            "SparkEarlyStoppingTrainer", "TpuComputationGraph",
+           "SystemClockTimeSource", "TimeSource",
            "TpuEarlyStoppingTrainer", "TrainingHook",
            "TpuDl4jMultiLayer", "TrainingMasterStats", "distributed",
            "make_mesh", "shard_params"]

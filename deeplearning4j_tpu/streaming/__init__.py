@@ -1,0 +1,7 @@
+from . import serde
+from .broker import Broker, InMemoryBroker, KafkaBroker, Subscription
+from .pipelines import StreamingInferencePipeline, StreamingTrainingPipeline
+
+__all__ = ["Broker", "InMemoryBroker", "KafkaBroker",
+           "StreamingInferencePipeline", "StreamingTrainingPipeline",
+           "Subscription", "serde"]

@@ -1,7 +1,18 @@
+from .cjk_tokenization import (ChineseTokenizerFactory,
+                               JapaneseTokenizerFactory,
+                               KoreanTokenizerFactory)
+from .document_iterator import (AsyncLabelAwareIterator,
+                                BasicLabelAwareIterator, DocumentIterator,
+                                FileDocumentIterator, FileLabelAwareIterator,
+                                FilenamesLabelAwareIterator,
+                                LabelAwareDocumentIterator, LabelledDocument,
+                                SimpleLabelAwareIterator)
+from .inverted_index import InMemoryInvertedIndex
 from .sentence_iterator import (BasicLineIterator, CollectionSentenceIterator,
                                 FileSentenceIterator, LabelAwareIterator,
                                 LabelAwareListSentenceIterator, LabelsSource,
                                 SentenceIterator)
+from .stemming import StemmingPreprocessor, porter_stem
 from .tokenization import (CommonPreprocessor, DefaultTokenizerFactory,
                            EndingPreProcessor, LowCasePreProcessor,
                            NGramTokenizerFactory, TokenPreProcess, Tokenizer,
@@ -9,10 +20,17 @@ from .tokenization import (CommonPreprocessor, DefaultTokenizerFactory,
 from .vectorizers import BagOfWordsVectorizer, TfidfVectorizer
 
 __all__ = [
-    "BagOfWordsVectorizer", "BasicLineIterator", "CollectionSentenceIterator",
-    "CommonPreprocessor", "DefaultTokenizerFactory", "EndingPreProcessor",
-    "FileSentenceIterator", "LabelAwareIterator",
-    "LabelAwareListSentenceIterator", "LabelsSource", "LowCasePreProcessor",
-    "NGramTokenizerFactory", "SentenceIterator", "TfidfVectorizer",
-    "TokenPreProcess", "Tokenizer", "TokenizerFactory",
+    "AsyncLabelAwareIterator", "BagOfWordsVectorizer",
+    "BasicLabelAwareIterator", "BasicLineIterator", "ChineseTokenizerFactory",
+    "CollectionSentenceIterator", "CommonPreprocessor",
+    "DefaultTokenizerFactory", "DocumentIterator", "EndingPreProcessor",
+    "FileDocumentIterator", "FileLabelAwareIterator",
+    "FileSentenceIterator", "FilenamesLabelAwareIterator",
+    "InMemoryInvertedIndex", "JapaneseTokenizerFactory",
+    "KoreanTokenizerFactory", "LabelAwareDocumentIterator",
+    "LabelAwareIterator", "LabelAwareListSentenceIterator",
+    "LabelledDocument", "LabelsSource", "LowCasePreProcessor",
+    "NGramTokenizerFactory", "SentenceIterator", "SimpleLabelAwareIterator",
+    "StemmingPreprocessor", "TfidfVectorizer", "TokenPreProcess",
+    "Tokenizer", "TokenizerFactory", "porter_stem",
 ]

@@ -1,0 +1,283 @@
+"""Document iterators — whole-document text sources with optional labels.
+
+TPU-native equivalent of reference text/documentiterator/: DocumentIterator
+(nextDocument/hasNext/reset), FileDocumentIterator (one file = one
+document), LabelledDocument + LabelAwareIterator family
+(FileLabelAwareIterator with per-subdirectory labels,
+FilenamesLabelAwareIterator, BasicLabelAwareIterator wrapping a sentence
+iterator, SimpleLabelAwareIterator over in-memory documents) and
+AsyncLabelAwareIterator (background prefetch).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+
+from .sentence_iterator import LabelsSource
+
+
+class DocumentIterator:
+    """reference: documentiterator/DocumentIterator.java"""
+
+    def has_next(self):
+        raise NotImplementedError
+
+    hasNext = has_next
+
+    def next_document(self):
+        raise NotImplementedError
+
+    nextDocument = next_document
+
+    def reset(self):
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_document()
+
+
+class FileDocumentIterator(DocumentIterator):
+    """Each file under `path` (recursive, sorted) is one document.
+    reference: documentiterator/FileDocumentIterator.java"""
+
+    def __init__(self, path):
+        self.files = []
+        for root, _dirs, names in sorted(os.walk(str(path))):
+            for n in sorted(names):
+                self.files.append(os.path.join(root, n))
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self.files)
+
+    def next_document(self):
+        p = self.files[self._pos]
+        self._pos += 1
+        with open(p, "r", encoding="utf-8", errors="replace") as fh:
+            return fh.read()
+
+    def reset(self):
+        self._pos = 0
+
+
+class LabelledDocument:
+    """reference: documentiterator/LabelledDocument.java (content+labels)."""
+
+    def __init__(self, content, labels=None):
+        self.content = content
+        self.labels = list(labels) if labels else []
+
+    def get_content(self):
+        return self.content
+
+    getContent = get_content
+
+    def get_labels(self):
+        return list(self.labels)
+
+    getLabels = get_labels
+
+    @property
+    def label(self):
+        return self.labels[0] if self.labels else None
+
+
+class LabelAwareDocumentIterator(DocumentIterator):
+    """reference: documentiterator/LabelAwareIterator.java — documents with
+    labels + a LabelsSource of every label seen."""
+
+    def __init__(self):
+        self.labels_source = LabelsSource()
+
+    def next_labelled(self) -> LabelledDocument:
+        raise NotImplementedError
+
+    nextLabelled = next_labelled
+
+    def next_document(self):
+        return self.next_labelled().content
+
+    def get_labels_source(self):
+        return self.labels_source
+
+    getLabelsSource = get_labels_source
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_labelled()
+
+
+class SimpleLabelAwareIterator(LabelAwareDocumentIterator):
+    """In-memory (content, label) pairs.
+    reference: documentiterator/SimpleLabelAwareIterator.java"""
+
+    def __init__(self, docs):
+        """docs: iterable of (content, label) or LabelledDocument."""
+        super().__init__()
+        self._docs = [d if isinstance(d, LabelledDocument)
+                      else LabelledDocument(d[0], [d[1]]) for d in docs]
+        for d in self._docs:
+            for lb in d.labels:
+                self.labels_source.store_label(lb)
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._docs)
+
+    def next_labelled(self):
+        d = self._docs[self._pos]
+        self._pos += 1
+        return d
+
+    def reset(self):
+        self._pos = 0
+
+
+class _LazyFileLabelAwareIterator(LabelAwareDocumentIterator):
+    """Shared lazy base: (path, label) pairs resolved at construction,
+    contents read per next_labelled() — a multi-GB corpus never sits in
+    host memory (the streaming contract AsyncLabelAwareIterator prefetch
+    relies on)."""
+
+    def __init__(self, entries):
+        super().__init__()
+        self._entries = list(entries)     # [(path, label)]
+        for _p, lb in self._entries:
+            self.labels_source.store_label(lb)
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._entries)
+
+    def next_labelled(self):
+        p, label = self._entries[self._pos]
+        self._pos += 1
+        with open(p, "r", encoding="utf-8", errors="replace") as fh:
+            return LabelledDocument(fh.read(), [label])
+
+    def reset(self):
+        self._pos = 0
+
+
+class FileLabelAwareIterator(_LazyFileLabelAwareIterator):
+    """Per-subdirectory labels: <root>/<label>/<file>.
+    reference: documentiterator/FileLabelAwareIterator.java"""
+
+    def __init__(self, root):
+        entries = []
+        for label in sorted(os.listdir(str(root))):
+            d = os.path.join(str(root), label)
+            if not os.path.isdir(d):
+                continue
+            for n in sorted(os.listdir(d)):
+                entries.append((os.path.join(d, n), label))
+        super().__init__(entries)
+
+
+class FilenamesLabelAwareIterator(_LazyFileLabelAwareIterator):
+    """One file = one document labelled by its own filename.
+    reference: documentiterator/FilenamesLabelAwareIterator.java"""
+
+    def __init__(self, path):
+        fd = FileDocumentIterator(path)
+        super().__init__((p, os.path.basename(p)) for p in fd.files)
+
+
+class BasicLabelAwareIterator(LabelAwareDocumentIterator):
+    """Wrap a SentenceIterator, generating labels DOC_0, DOC_1, ... lazily
+    (one sentence pulled per next_labelled()).
+    reference: documentiterator/BasicLabelAwareIterator.java"""
+
+    def __init__(self, sentence_iterator, template="DOC_%d"):
+        super().__init__()
+        self.sentence_iterator = sentence_iterator
+        self.template = template
+        self.reset()
+
+    def reset(self):
+        self.sentence_iterator.reset()
+        self._i = 0
+        self._pending = self._pull()
+
+    def _pull(self):
+        while self.sentence_iterator.has_next():
+            s = self.sentence_iterator.next_sentence()
+            if s is not None:
+                return s
+        return None
+
+    def has_next(self):
+        return self._pending is not None
+
+    def next_labelled(self):
+        label = self.labels_source.store_label(self.template % self._i)
+        doc = LabelledDocument(self._pending, [label])
+        self._i += 1
+        self._pending = self._pull()
+        return doc
+
+
+class AsyncLabelAwareIterator(LabelAwareDocumentIterator):
+    """Background-prefetch wrapper over any LabelAwareDocumentIterator.
+    reference: documentiterator/AsyncLabelAwareIterator.java"""
+
+    _EOS = object()
+
+    def __init__(self, backing, buffer_size=64):
+        super().__init__()
+        self.backing = backing
+        self.labels_source = backing.labels_source
+        self.buffer_size = int(buffer_size)
+        self._q = None
+        self._next = None
+        self._thread = None
+        self._stop = None
+        self.reset()
+
+    def _fill(self, q, stop):
+        def put_blocking(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+        try:
+            while not stop.is_set() and self.backing.has_next():
+                put_blocking(self.backing.next_labelled())
+        finally:
+            # EOS must reach the consumer even if it is slow — dropping it
+            # would leave _advance()'s get() blocked forever
+            put_blocking(self._EOS)
+
+    def reset(self):
+        # stop + join the previous filler BEFORE touching the backing:
+        # two fillers racing on one backing iterator skip/duplicate items
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5.0)
+        self.backing.reset()
+        self._q = queue.Queue(maxsize=self.buffer_size)
+        self._next = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._fill, args=(self._q, self._stop), daemon=True)
+        self._thread.start()
+        self._advance()
+
+    def _advance(self):
+        item = self._q.get()
+        self._next = None if item is self._EOS else item
+
+    def has_next(self):
+        return self._next is not None
+
+    def next_labelled(self):
+        d = self._next
+        self._advance()
+        return d

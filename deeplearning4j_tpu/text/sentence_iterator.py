@@ -173,5 +173,13 @@ class LabelsSource:
 
     getLabels = get_labels
 
+    def store_label(self, label):
+        """Record an externally-supplied label (reference storeLabel)."""
+        if label not in self._labels:
+            self._labels.append(label)
+        return label
+
+    storeLabel = store_label
+
     def reset(self):
         self._counter = 0

