@@ -1,3 +1,4 @@
+from .server import DeepLearning4jEntryPoint, KerasBridgeServer
 from .keras_import import (import_keras_model_and_weights,
                            import_keras_model_configuration,
                            import_keras_sequential_model_and_weights)
@@ -5,6 +6,7 @@ from .keras_import import (import_keras_model_and_weights,
 KerasModelImport = __import__(
     "deeplearning4j_tpu.keras.keras_import", fromlist=["keras_import"])
 
-__all__ = ["KerasModelImport", "import_keras_model_and_weights",
+__all__ = ["DeepLearning4jEntryPoint", "KerasBridgeServer",
+           "KerasModelImport", "import_keras_model_and_weights",
            "import_keras_model_configuration",
            "import_keras_sequential_model_and_weights"]

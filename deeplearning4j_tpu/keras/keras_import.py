@@ -157,6 +157,29 @@ def _build_sequential(model_cfg, weights, conf_only=False):
         mapped.append((layer, lc))
         idx += 1
 
+    # a trailing classifier head becomes a trainable loss head, like the
+    # functional path (Keras models carry the loss in compile(), which
+    # model_config does not serialize — infer it from the activation).
+    # Two Keras idioms: Dense(softmax) directly, and the Keras-1 classic
+    # Dense(linear) followed by a separate Activation('softmax') layer.
+    our_layers = [m[0] for m in mapped if m[0] is not None]
+    last = our_layers[-1] if our_layers else None
+    if (isinstance(last, DenseLayer) and not isinstance(last, OutputLayer)
+            and last.activation in ("softmax", "sigmoid")):
+        loss = "mcxent" if last.activation == "softmax" else "xent"
+        out = OutputLayer(n_out=last.n_out, n_in=last.n_in,
+                          activation=last.activation, loss_function=loss)
+        builder.layer(idx - 1, out)
+        mapped[[i for i, m in enumerate(mapped)
+                if m[0] is last][0]] = (out, mapped[-1][1])
+    elif (isinstance(last, ActivationLayer)
+            and last.activation in ("softmax", "sigmoid")):
+        loss = "mcxent" if last.activation == "softmax" else "xent"
+        head = LossLayer(activation=last.activation, loss_function=loss)
+        builder.layer(idx - 1, head)
+        mapped[[i for i, m in enumerate(mapped)
+                if m[0] is last][0]] = (head, mapped[-1][1])
+
     builder.set_input_type(input_type)
     conf = builder.build()
     from ..nn.multilayer import MultiLayerNetwork

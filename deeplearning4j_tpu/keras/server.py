@@ -1,0 +1,179 @@
+"""Keras backend bridge — external processes drive training over RPC.
+
+TPU-native equivalent of reference deeplearning4j-keras: Server.java:18
+runs a Py4J GatewayServer exposing DeepLearning4jEntryPoint.fit() so the
+Python Keras wrapper can hand a Keras model + HDF5-exported batches to the
+JVM runtime. This runtime already IS Python, so the bridge becomes a
+language-agnostic HTTP gateway with the same entry points:
+
+  POST /fit      {"model_path", "features_path", "labels_path",
+                  "nb_epoch"?, "batch_size"?}     -> {"score": ...}
+  POST /predict  {"model_path", "features_path"}  -> {"predictions": [...]}
+  GET  /health                                    -> {"ok": true}
+
+Models are imported through keras_import (KerasModelImport role,
+NeuralNetworkReader.java) and cached per path; data files are .h5 (datasets
+"features"/"labels", the HDF5MiniBatchDataSetIterator layout) or .npz.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+
+def _load_array(path, key):
+    if str(path).endswith((".h5", ".hdf5")):
+        import h5py
+        with h5py.File(path, "r") as f:
+            if key in f:
+                return np.asarray(f[key])
+            # single-dataset files (the per-batch export layout)
+            names = list(f.keys())
+            if len(names) == 1:
+                return np.asarray(f[names[0]])
+            raise KeyError(f"no dataset '{key}' in {path} (has {names})")
+    with np.load(path) as z:
+        return np.asarray(z[key] if key in z else z[list(z.files)[0]])
+
+
+class HDF5MiniBatchDataSetIterator:
+    """Batches from features/labels array files — reference
+    keras/HDF5MiniBatchDataSetIterator.java (directory-of-batches there,
+    one array file sliced here; both feed fit() identically)."""
+
+    def __init__(self, features_path, labels_path, batch_size=32):
+        from ..datasets.dataset import DataSet
+        x = _load_array(features_path, "features")
+        y = _load_array(labels_path, "labels")
+        self._batches = list(DataSet(x, y).batch_by(int(batch_size)))
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._batches)
+
+    def next_batch(self):
+        b = self._batches[self._pos]
+        self._pos += 1
+        return b
+
+    def reset(self):
+        self._pos = 0
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_batch()
+
+
+class DeepLearning4jEntryPoint:
+    """reference: keras/DeepLearning4jEntryPoint.java — fit/predict on a
+    Keras-defined model, models cached per path."""
+
+    def __init__(self):
+        self._models = {}
+        self._lock = threading.Lock()
+
+    def _model(self, model_path):
+        with self._lock:
+            if model_path not in self._models:
+                from .keras_import import \
+                    import_keras_sequential_model_and_weights
+                try:
+                    net = import_keras_sequential_model_and_weights(
+                        model_path)
+                except Exception:
+                    from .keras_import import import_keras_model_and_weights
+                    net = import_keras_model_and_weights(model_path)
+                self._models[model_path] = net
+            return self._models[model_path]
+
+    def fit(self, model_path, features_path, labels_path, nb_epoch=1,
+            batch_size=32):
+        net = self._model(model_path)
+        it = HDF5MiniBatchDataSetIterator(features_path, labels_path,
+                                          batch_size)
+        for _ in range(int(nb_epoch)):
+            it.reset()
+            while it.has_next():
+                net.fit(it.next_batch())
+        return float(net.score())
+
+    def predict(self, model_path, features_path):
+        net = self._model(model_path)
+        x = _load_array(features_path, "features")
+        out = net.output(x)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return np.asarray(out)
+
+
+class KerasBridgeServer:
+    """reference: keras/Server.java (GatewayServer -> HTTP here)."""
+
+    def __init__(self, port=0):
+        self.port = int(port)
+        self.entry_point = DeepLearning4jEntryPoint()
+        self._httpd = None
+        self._thread = None
+
+    def start(self):
+        ep = self.entry_point
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._json({"ok": True})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._json({"error": "bad json"}, 400)
+                    return
+                try:
+                    if self.path == "/fit":
+                        score = ep.fit(req["model_path"],
+                                       req["features_path"],
+                                       req["labels_path"],
+                                       req.get("nb_epoch", 1),
+                                       req.get("batch_size", 32))
+                        self._json({"score": score})
+                    elif self.path == "/predict":
+                        preds = ep.predict(req["model_path"],
+                                           req["features_path"])
+                        self._json({"predictions": preds.tolist()})
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except KeyError as e:
+                    self._json({"error": f"missing field {e}"}, 400)
+                except Exception as e:   # surface the failure to the caller
+                    self._json({"error": str(e)[:500]}, 500)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None

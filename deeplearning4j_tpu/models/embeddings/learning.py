@@ -140,6 +140,9 @@ class BaseElementsLearning:
         self.mesh = None
         self._syn0 = None
         self._syn1 = None   # whichever of syn1 / syn1neg is in use
+        self._pending = []
+        self._pending_count = 0
+        self._flushed_pairs = 0   # valid (non-pad) pairs applied on device
 
     def configure(self, vocab, lookup, *, window=5, negative=0, use_hs=True,
                   seed=12345, mesh=None):
@@ -192,6 +195,8 @@ class BaseElementsLearning:
                 self._points[w.index, :l] = w.points
                 self._code_mask[w.index, :l] = 1.0
         self._pending = []
+        self._pending_count = 0
+        self._flushed_pairs = 0
         return self
 
     def finish(self):
@@ -239,45 +244,81 @@ class BaseElementsLearning:
 
 
 class SkipGram(BaseElementsLearning):
-    """reference: learning/impl/elements/SkipGram.java"""
+    """reference: learning/impl/elements/SkipGram.java
+
+    Pair generation is fully vectorized on the host (the reference's
+    per-position loop runs inside the native AggregateSkipGram kernel; a
+    Python loop here would bottleneck the TPU kernel — PERF.md r2 weak
+    item: the measured end-to-end pairs/s was host-bound)."""
 
     name = "skipgram"
 
     def learn_sequence(self, ids, lr):
         """ids: list of vocab indices for one sequence."""
-        w = self.window
         n = len(ids)
-        for pos in range(n):
-            b = int(self._rng.integers(1, w + 1))
-            for off in range(-b, b + 1):
-                if off == 0:
-                    continue
-                j = pos + off
-                if 0 <= j < n:
-                    self._pending.append((ids[pos], ids[j], lr))
-        if len(self._pending) >= self.batch_pairs:
+        if n < 2:
+            return
+        w = self.window
+        ids_arr = np.asarray(ids, np.int32)
+        # per-position reduced window b ~ U[1, w] (word2vec semantics)
+        b = self._rng.integers(1, w + 1, n)
+        offs = np.concatenate([np.arange(-w, 0), np.arange(1, w + 1)])
+        j = np.arange(n)[:, None] + offs[None, :]          # [n, 2w]
+        valid = ((np.abs(offs)[None, :] <= b[:, None])
+                 & (j >= 0) & (j < n))
+        pos_idx, off_idx = np.nonzero(valid)
+        self.enqueue_pairs(ids_arr[pos_idx], ids_arr[j[pos_idx, off_idx]],
+                           lr)
+
+    def enqueue_pairs(self, centers, outs, lr):
+        """Queue (center, predicted) index arrays for the batched kernel —
+        the buffer format is private to this class; external pair sources
+        (DBOW's label->word pairs) call this instead of touching
+        _pending."""
+        centers = np.asarray(centers, np.int32)
+        outs = np.asarray(outs, np.int32)
+        if centers.size == 0:
+            return
+        self._pending.append((centers, outs, np.float32(lr)))
+        self._pending_count += len(centers)
+        if self._pending_count >= self.batch_pairs:
             self._flush()
 
     def _flush(self, force=False):
         # run fixed-size chunks only (stable shapes -> one compiled
         # executable); pad the forced tail with masked dummy pairs
         B = self.batch_pairs
-        while len(self._pending) >= B or (force and self._pending):
-            chunk = self._pending[:B]
-            self._pending = self._pending[B:]
+        if not self._pending:
+            return
+        centers = np.concatenate([p[0] for p in self._pending])
+        outs = np.concatenate([p[1] for p in self._pending])
+        lrs = np.concatenate([
+            np.broadcast_to(np.asarray(p[2], np.float32),
+                            (len(p[0]),)) for p in self._pending])
+        self._pending = []
+        self._pending_count = 0
+        total = len(centers)
+        start = 0
+        while total - start >= B or (force and start < total):
+            take = min(B, total - start)
+            c = np.zeros((B,), np.int32)
+            o = np.zeros((B,), np.int32)
+            c[:take] = centers[start:start + take]
+            o[:take] = outs[start:start + take]
             valid = np.zeros((B,), np.float32)
-            valid[:len(chunk)] = 1.0
-            while len(chunk) < B:
-                chunk.append((0, 0, 0.0))
-            centers = np.array([p[0] for p in chunk], np.int32)
-            outs = np.array([p[1] for p in chunk], np.int32)
-            lrs = [p[2] for p in chunk if p[2] > 0]
-            lr = float(np.mean(lrs)) if lrs else 0.0
-            targets, labels, mask = self._targets_labels(outs)
+            valid[:take] = 1.0
+            lr = float(lrs[start:start + take].mean()) if take else 0.0
+            start += take
+            targets, labels, mask = self._targets_labels(o)
             mask = mask * valid[:, None]
             self._syn0, self._syn1 = _sg_step(
-                self._syn0, self._syn1, centers, targets, labels, mask,
+                self._syn0, self._syn1, c, targets, labels, mask,
                 np.float32(lr))
+            self._flushed_pairs += take
+        if start < total:   # stash the sub-batch remainder
+            self._pending.append((centers[start:], outs[start:],
+                                  lrs[start:]))
+            self._pending_count = total - start
 
 
 class CBOW(BaseElementsLearning):
@@ -302,11 +343,14 @@ class CBOW(BaseElementsLearning):
             self._flush()
 
     def _flush(self, force=False):
+        # CBOW's pending protocol: (context id list, out id, lr) TUPLES —
+        # variable-length contexts can't use SkipGram's array triples
         B = self.batch_pairs
         C = 2 * self.window   # fixed width: no per-batch re-trace
         while len(self._pending) >= B or (force and self._pending):
             chunk = self._pending[:B]
             self._pending = self._pending[B:]
+            self._flushed_pairs += len(chunk)
             valid = np.zeros((B,), np.float32)
             valid[:len(chunk)] = 1.0
             while len(chunk) < B:

@@ -176,10 +176,8 @@ class ParagraphVectors(SequenceVectors):
 
     def _learn_dbow(self, algo, lab_id, ids, lr):
         """Label predicts every word (skip-gram pairs label->word)."""
-        for wid in ids:
-            algo._pending.append((lab_id, wid, lr))
-        if len(algo._pending) >= algo.batch_pairs:
-            algo._flush()
+        import numpy as np
+        algo.enqueue_pairs(np.full((len(ids),), lab_id, np.int32), ids, lr)
 
     def _learn_dm(self, algo, lab_id, ids, lr):
         """Mean(label + context) predicts center (CBOW with label)."""

@@ -1,8 +1,16 @@
+from . import components
+from .components import (ChartHistogram, ChartLine, ChartScatter,
+                         ChartStackedArea, ChartTimeline, Component,
+                         ComponentDiv, ComponentTable, ComponentText,
+                         render_html)
 from .server import UIServer
 from .stats import StatsListener, StatsUpdateConfiguration
 from .storage import (FileStatsStorage, InMemoryStatsStorage,
                       RemoteUIStatsStorageRouter, StatsStorageRouter)
 
-__all__ = ["FileStatsStorage", "InMemoryStatsStorage",
+__all__ = ["ChartHistogram", "ChartLine", "ChartScatter", "ChartStackedArea",
+           "ChartTimeline", "Component", "ComponentDiv", "ComponentTable",
+           "ComponentText", "FileStatsStorage", "InMemoryStatsStorage",
            "RemoteUIStatsStorageRouter", "StatsListener",
-           "StatsStorageRouter", "StatsUpdateConfiguration", "UIServer"]
+           "StatsStorageRouter", "StatsUpdateConfiguration", "UIServer",
+           "components", "render_html"]

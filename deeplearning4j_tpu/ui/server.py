@@ -220,33 +220,48 @@ refresh(); setInterval(refresh, 3000);""")
 
 _HISTOGRAM = _page(
     "Histograms",
-    """<div class="card"><h2>Parameter <select id="param"></select></h2>
+    """<div class="card"><h2>Parameter <select id="param"></select>
+ — iteration <span id="iterLabel"></span>
+ <input type="range" id="iter" min="0" max="0" value="0"
+  style="width:300px;vertical-align:middle"></h2>
 <svg id="hp"></svg></div>
 <div class="card"><h2>Update (param delta)</h2><svg id="hu"></svg></div>""",
     """
-let chosen=null;
-async function refresh(){
- const sid = await latestSession(); if(!sid) return;
- const ups = await (await fetch('/api/updates/'+sid)).json();
- const withH = ups.filter(u=>u.parameters &&
-   Object.values(u.parameters).some(p=>p.histogram));
+let chosen=null, follow=true, withH=[];
+function draw(){
+ // pure redraw from the cached history — slider drags never refetch
  if(!withH.length) return;
- const last = withH[withH.length-1];
- const names = Object.keys(last.parameters);
+ const slider=document.getElementById('iter');
+ const rec = withH[Math.min(Number(slider.value), withH.length-1)];
+ document.getElementById('iterLabel').textContent = rec.iteration;
+ const names = Object.keys(rec.parameters);
  const sel=document.getElementById('param');
  if(sel.options.length!==names.length){
   sel.textContent='';
   for(const n of names){const o=el('option',n); o.value=n; sel.appendChild(o);}
-  sel.onchange=()=>{chosen=sel.value; refresh();};
+  sel.onchange=()=>{chosen=sel.value; draw();};
  }
  const name = chosen || names[0];
- const ph = last.parameters[name] && last.parameters[name].histogram;
+ const ph = rec.parameters[name] && rec.parameters[name].histogram;
  if(ph) drawHistogram(document.getElementById('hp'),
                       ph.counts, ph.min, ph.max);
- const uh = last.updates && last.updates[name] &&
-            last.updates[name].histogram;
+ const uh = rec.updates && rec.updates[name] &&
+            rec.updates[name].histogram;
  if(uh) drawHistogram(document.getElementById('hu'),
                       uh.counts, uh.min, uh.max, '#c60');
+}
+async function refresh(){
+ const sid = await latestSession(); if(!sid) return;
+ const ups = await (await fetch('/api/updates/'+sid)).json();
+ withH = ups.filter(u=>u.parameters &&
+   Object.values(u.parameters).some(p=>p.histogram));
+ if(!withH.length) return;
+ const slider=document.getElementById('iter');
+ slider.max = withH.length-1;
+ if(follow) slider.value = withH.length-1;
+ slider.oninput=()=>{follow=(Number(slider.value)===withH.length-1);
+                     draw();};
+ draw();
 }
 refresh(); setInterval(refresh, 3000);""")
 

@@ -1,0 +1,136 @@
+"""Keras backend bridge (HTTP gateway) + UI component library.
+reference: deeplearning4j-keras Server.java/DeepLearning4jEntryPoint.java
+and deeplearning4j-ui-components."""
+import json
+import urllib.request
+
+import h5py
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.keras import KerasBridgeServer
+from deeplearning4j_tpu.ui import (ChartHistogram, ChartLine, ChartTimeline,
+                                   Component, ComponentDiv, ComponentTable,
+                                   ComponentText, render_html)
+
+
+def _write_keras_model(path):
+    """Tiny Keras-1 sequential MLP in the HDF5 layout keras_import reads."""
+    rng = np.random.default_rng(0)
+    W1 = rng.standard_normal((4, 8)).astype(np.float32) * 0.3
+    b1 = np.zeros(8, np.float32)
+    W2 = rng.standard_normal((8, 2)).astype(np.float32) * 0.3
+    b2 = np.zeros(2, np.float32)
+    cfg = {"class_name": "Sequential", "config": [
+        {"class_name": "Dense",
+         "config": {"name": "d1", "output_dim": 8, "activation": "relu",
+                    "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense",
+         "config": {"name": "d2", "output_dim": 2,
+                    "activation": "softmax"}}]}
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(cfg).encode("utf-8")
+        mw = f.create_group("model_weights")
+        for lname, arrs in [("d1", [("W", W1), ("b", b1)]),
+                            ("d2", [("W", W2), ("b", b2)])]:
+            g = mw.create_group(lname)
+            names = []
+            for suffix, arr in arrs:
+                n = f"{lname}_{suffix}"
+                g.create_dataset(n, data=arr)
+                names.append(n.encode())
+            g.attrs["weight_names"] = names
+
+
+class TestKerasBridge:
+    def test_fit_and_predict_over_http(self, tmp_path):
+        model_path = str(tmp_path / "model.h5")
+        _write_keras_model(model_path)
+        rng = np.random.default_rng(1)
+        x = rng.random((64, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(1) > 2).astype(int)]
+        fpath, lpath = str(tmp_path / "x.h5"), str(tmp_path / "y.h5")
+        with h5py.File(fpath, "w") as f:
+            f.create_dataset("features", data=x)
+        with h5py.File(lpath, "w") as f:
+            f.create_dataset("labels", data=y)
+
+        server = KerasBridgeServer().start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(base + "/health") as r:
+                assert json.load(r)["ok"]
+
+            def post(path, payload):
+                req = urllib.request.Request(
+                    base + path, data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req) as r:
+                    return json.load(r)
+
+            scores = [post("/fit", {"model_path": model_path,
+                                    "features_path": fpath,
+                                    "labels_path": lpath,
+                                    "nb_epoch": 3, "batch_size": 16})
+                      ["score"] for _ in range(4)]
+            assert scores[-1] < scores[0]     # repeated fits keep learning
+            preds = np.asarray(post("/predict",
+                                    {"model_path": model_path,
+                                     "features_path": fpath})
+                               ["predictions"])
+            assert preds.shape == (64, 2)
+            assert np.allclose(preds.sum(1), 1.0, atol=1e-3)
+            # errors surface as HTTP codes, not hung connections
+            req = urllib.request.Request(
+                base + "/fit", data=b'{"model_path": "missing.h5"}',
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code in (400, 500)
+        finally:
+            server.stop()
+
+
+class TestUIComponents:
+    def test_component_json_round_trips(self):
+        line = (ChartLine(title="loss", x_label="iter", y_label="score")
+                .add_series("train", [0, 1, 2], [1.0, 0.5, 0.2])
+                .add_series("val", [0, 1, 2], [1.1, 0.7, 0.4]))
+        hist = ChartHistogram(title="weights").add_bin(-1, 0, 5).add_bin(
+            0, 1, 7)
+        tl = ChartTimeline(title="phases").add_lane(
+            "worker0", [(0, 10, "fit"), (10, 12, "avg")])
+        table = ComponentTable(["k", "v"], [["a", "1"], ["b", "2"]],
+                               title="stats")
+        text = ComponentText("hello world")
+        div = ComponentDiv(line, hist, tl, table, text)
+        for comp in (line, hist, tl, table, text, div):
+            back = Component.from_json(comp.to_json())
+            assert back.to_dict() == comp.to_dict()
+
+    def test_unknown_component_raises(self):
+        with pytest.raises(ValueError, match="Unknown component"):
+            Component.from_dict({"componentType": "Nope"})
+
+    def test_render_html_embeds_data(self):
+        line = ChartLine(title="curve").add_series("s", [0, 1], [2.0, 3.0])
+        html = render_html([line, ComponentText("note")], title="Report")
+        assert "<title>Report</title>" in html
+        assert "ChartLine" in html and "note" in html
+        # data is embedded as a JSON island the renderer parses
+        assert 'type=\'application/json\'' in html
+
+    def test_training_stats_to_components(self):
+        """TrainingMasterStats timeline -> ChartTimeline (the HTML export
+        path the reference builds from SparkTrainingStats)."""
+        from deeplearning4j_tpu.parallel import TrainingMasterStats
+        stats = TrainingMasterStats()
+        stats.record("fit", 1.0, 0.5)
+        stats.record("split", 1.5, 0.1)
+        tl = ChartTimeline(title="phases")
+        entries = [(e["startMs"], e["startMs"] + e["durationMs"],
+                    e["phase"]) for e in stats.events]
+        tl.add_lane("master", entries)
+        d = tl.to_dict()
+        assert len(d["lanes"][0]["entries"]) == 2
+        assert d["lanes"][0]["entries"][0]["label"] == "fit"

@@ -175,6 +175,31 @@ def test_config_only_import():
     assert conf.layers[0].activation == "tanh"
 
 
+def test_dense_linear_plus_activation_becomes_trainable_head():
+    """Keras-1 classic: Dense(linear) + separate Activation('softmax') —
+    must import with a loss head so fit()/score() work (keras bridge)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.layers.feedforward import LossLayer
+    cfg = {"class_name": "Sequential", "config": [
+        {"class_name": "Dense",
+         "config": {"name": "d", "output_dim": 3, "activation": "linear",
+                    "batch_input_shape": [None, 4]}},
+        {"class_name": "Activation",
+         "config": {"name": "a", "activation": "softmax"}}]}
+    conf = import_keras_model_configuration(json.dumps(cfg))
+    assert isinstance(conf.layers[-1], LossLayer)
+    assert conf.layers[-1].loss_function == "mcxent"
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.random((16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    net.fit(DataSet(x, y))
+    assert np.isfinite(float(net.score()))
+
+
 def test_asymmetric_zero_padding_raises():
     cfg = {"class_name": "Sequential", "config": [
         {"class_name": "ZeroPadding2D",
