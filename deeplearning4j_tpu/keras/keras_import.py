@@ -162,23 +162,24 @@ def _build_sequential(model_cfg, weights, conf_only=False):
     # model_config does not serialize — infer it from the activation).
     # Two Keras idioms: Dense(softmax) directly, and the Keras-1 classic
     # Dense(linear) followed by a separate Activation('softmax') layer.
-    our_layers = [m[0] for m in mapped if m[0] is not None]
-    last = our_layers[-1] if our_layers else None
+    last_i = next((i for i in range(len(mapped) - 1, -1, -1)
+                   if mapped[i][0] is not None), None)
+    last = mapped[last_i][0] if last_i is not None else None
     if (isinstance(last, DenseLayer) and not isinstance(last, OutputLayer)
             and last.activation in ("softmax", "sigmoid")):
         loss = "mcxent" if last.activation == "softmax" else "xent"
         out = OutputLayer(n_out=last.n_out, n_in=last.n_in,
                           activation=last.activation, loss_function=loss)
         builder.layer(idx - 1, out)
-        mapped[[i for i, m in enumerate(mapped)
-                if m[0] is last][0]] = (out, mapped[-1][1])
+        # keep the replaced layer's OWN keras config paired (weight copy
+        # matches entries by that config's class/name)
+        mapped[last_i] = (out, mapped[last_i][1])
     elif (isinstance(last, ActivationLayer)
             and last.activation in ("softmax", "sigmoid")):
         loss = "mcxent" if last.activation == "softmax" else "xent"
         head = LossLayer(activation=last.activation, loss_function=loss)
         builder.layer(idx - 1, head)
-        mapped[[i for i, m in enumerate(mapped)
-                if m[0] is last][0]] = (head, mapped[-1][1])
+        mapped[last_i] = (head, mapped[last_i][1])
 
     builder.set_input_type(input_type)
     conf = builder.build()
@@ -239,10 +240,11 @@ def _map_layer(cls, cfg, dim_ordering):
         else:
             kernel = _pair_of(cfg.get("kernel_size"), (3, 3))
         stride = _pair_of(cfg.get("subsample") or cfg.get("strides"), (1, 1))
+        has_bias = bool(cfg.get("use_bias", cfg.get("bias", True)))
         return ConvolutionLayer(
             n_out=int(n_out), kernel_size=kernel, stride=stride,
             convolution_mode=("same" if same else "truncate"),
-            activation=_map_activation(act)), False
+            activation=_map_activation(act), has_bias=has_bias), False
     if cls in ("MaxPooling2D", "AveragePooling2D"):
         pool = _pair_of(cfg.get("pool_size"), (2, 2))
         return SubsamplingLayer(
@@ -486,7 +488,7 @@ def _copy_weights_graph(net, mapped, weights, dense_after_flatten, conf):
             params[name]["W"] = jnp.asarray(W)
             params[name]["b"] = jnp.asarray(np.asarray(b).ravel())
         elif cls in ("Convolution2D", "Conv2D"):
-            W, b = w[0], w[1]
+            W = w[0]
             do = lc["config"].get("dim_ordering") or \
                 lc["config"].get("data_format")
             th = (do in ("th", "channels_first") if do is not None
@@ -495,7 +497,8 @@ def _copy_weights_graph(net, mapped, weights, dense_after_flatten, conf):
             if th:
                 W = W.transpose(2, 3, 1, 0)   # OIHW -> HWIO
             params[name]["W"] = jnp.asarray(W)
-            params[name]["b"] = jnp.asarray(np.asarray(b).ravel())
+            if len(w) > 1:                    # use_bias=False: kernel only
+                params[name]["b"] = jnp.asarray(np.asarray(w[1]).ravel())
         elif cls == "LSTM":
             if len(w) == 12:   # Keras 1: per-gate i,c,f,o triplets
                 (Wi, Ui, bi, Wc, Uc, bc, Wf, Uf, bf, Wo, Uo, bo) = w
@@ -556,7 +559,7 @@ def _copy_weights(net, mapped, weights, flatten_perm, conf):
             params[our_idx]["W"] = jnp.asarray(W)
             params[our_idx]["b"] = jnp.asarray(b.ravel())
         elif cls in ("Convolution2D", "Conv2D") and w:
-            W, b = w[0], w[1]
+            W = w[0]
             # th stores OIHW; we are HWIO-native (tf ordering matches).
             # Trust the layer's dim_ordering; fall back to a shape check
             # when it is absent (square kernels can be ambiguous).
@@ -567,7 +570,8 @@ def _copy_weights(net, mapped, weights, flatten_perm, conf):
             if th:
                 W = W.transpose(2, 3, 1, 0)
             params[our_idx]["W"] = jnp.asarray(W)
-            params[our_idx]["b"] = jnp.asarray(b.ravel())
+            if len(w) > 1:                    # use_bias=False: kernel only
+                params[our_idx]["b"] = jnp.asarray(w[1].ravel())
         elif cls == "LSTM" and w:
             # Keras 1 order: W_i U_i b_i, W_c U_c b_c, W_f U_f b_f, W_o U_o b_o
             (Wi, Ui, bi, Wc, Uc, bc, Wf, Uf, bf, Wo, Uo, bo) = w

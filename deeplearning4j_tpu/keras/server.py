@@ -73,7 +73,7 @@ class DeepLearning4jEntryPoint:
     Keras-defined model, models cached per path."""
 
     def __init__(self):
-        self._models = {}
+        self._models = {}      # path -> (net, per-model lock)
         self._lock = threading.Lock()
 
     def _model(self, model_path):
@@ -87,24 +87,28 @@ class DeepLearning4jEntryPoint:
                 except Exception:
                     from .keras_import import import_keras_model_and_weights
                     net = import_keras_model_and_weights(model_path)
-                self._models[model_path] = net
+                self._models[model_path] = (net, threading.Lock())
             return self._models[model_path]
 
     def fit(self, model_path, features_path, labels_path, nb_epoch=1,
             batch_size=32):
-        net = self._model(model_path)
+        net, mlock = self._model(model_path)
         it = HDF5MiniBatchDataSetIterator(features_path, labels_path,
                                           batch_size)
-        for _ in range(int(nb_epoch)):
-            it.reset()
-            while it.has_next():
-                net.fit(it.next_batch())
-        return float(net.score())
+        # serialize per model: the threaded HTTP server would otherwise
+        # race concurrent fit() calls on the same cached network
+        with mlock:
+            for _ in range(int(nb_epoch)):
+                it.reset()
+                while it.has_next():
+                    net.fit(it.next_batch())
+            return float(net.score())
 
     def predict(self, model_path, features_path):
-        net = self._model(model_path)
+        net, mlock = self._model(model_path)
         x = _load_array(features_path, "features")
-        out = net.output(x)
+        with mlock:
+            out = net.output(x)
         if isinstance(out, (list, tuple)):
             out = out[0]
         return np.asarray(out)

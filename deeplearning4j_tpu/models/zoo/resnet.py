@@ -24,10 +24,12 @@ STAGES = ((3, 64), (4, 128), (6, 256), (3, 512))
 
 
 def _conv_bn(gb, name, inp, n_out, kernel, stride, activation=None):
+    # has_bias=False: BN's beta subsumes the conv bias, and dropping it
+    # removes a full dy reduction per conv in backward (see PERF.md r3)
     gb.add_layer(f"{name}_conv",
                  ConvolutionLayer(n_out=n_out, kernel_size=kernel,
                                   stride=stride, convolution_mode="same",
-                                  activation="identity", bias_init=0.0),
+                                  activation="identity", has_bias=False),
                  inp)
     gb.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
     out = f"{name}_bn"

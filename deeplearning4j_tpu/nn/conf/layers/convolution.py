@@ -117,6 +117,11 @@ class ConvolutionLayer(LayerConf):
     padding: tuple = (0, 0)
     convolution_mode: str = "truncate"   # 'truncate' | 'same'
     cudnn_algo_mode: str = None          # accepted for config compat; ignored
+    # has_bias=False drops the per-channel bias entirely (standard for
+    # convs feeding BatchNorm: beta subsumes the bias, and the bias
+    # BACKWARD is a full reduction over dy — one whole HBM read of every
+    # conv output gradient, per conv, for a parameter BN cancels out)
+    has_bias: bool = True
 
     def __post_init__(self):
         self.kernel_size = _pair(self.kernel_size)
@@ -145,6 +150,8 @@ class ConvolutionLayer(LayerConf):
         fan_out = self.n_out * kh * kw
         w = weights.init(key, (kh, kw, self.n_in, self.n_out), fan_in, fan_out,
                          self.weight_init, self.dist, dtype)
+        if not self.has_bias:
+            return {"W": w}
         b = jnp.full((self.n_out,), float(self.bias_init or 0.0), dtype)
         return {"W": w, "b": b}
 
@@ -162,7 +169,9 @@ class ConvolutionLayer(LayerConf):
             padding=self._padding_spec(),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
-        return y + params["b"]
+        if "b" in params:
+            y = y + params["b"]
+        return y
 
     def forward(self, params, x, *, train=False, rng=None, mask=None, state=None):
         return activations.get(self.activation)(

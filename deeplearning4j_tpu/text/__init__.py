@@ -1,3 +1,8 @@
+from .annotation import (AnnotatedDocument, Annotation,
+                         AnnotationPipeline, Annotator,
+                         PosAnnotator, SentenceAnnotator,
+                         StemAnnotator, TokenAnnotator,
+                         standard_pipeline)
 from .cjk_tokenization import (ChineseTokenizerFactory,
                                JapaneseTokenizerFactory,
                                KoreanTokenizerFactory)
@@ -20,6 +25,7 @@ from .tokenization import (CommonPreprocessor, DefaultTokenizerFactory,
 from .vectorizers import BagOfWordsVectorizer, TfidfVectorizer
 
 __all__ = [
+    "AnnotatedDocument", "Annotation", "AnnotationPipeline", "Annotator",
     "AsyncLabelAwareIterator", "BagOfWordsVectorizer",
     "BasicLabelAwareIterator", "BasicLineIterator", "ChineseTokenizerFactory",
     "CollectionSentenceIterator", "CommonPreprocessor",
@@ -32,5 +38,7 @@ __all__ = [
     "LabelledDocument", "LabelsSource", "LowCasePreProcessor",
     "NGramTokenizerFactory", "SentenceIterator", "SimpleLabelAwareIterator",
     "StemmingPreprocessor", "TfidfVectorizer", "TokenPreProcess",
-    "Tokenizer", "TokenizerFactory", "porter_stem",
+    "PosAnnotator", "SentenceAnnotator", "StemAnnotator",
+    "TokenAnnotator", "Tokenizer", "TokenizerFactory", "porter_stem",
+    "standard_pipeline",
 ]

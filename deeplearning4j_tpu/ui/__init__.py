@@ -3,12 +3,17 @@ from .components import (ChartHistogram, ChartLine, ChartScatter,
                          ChartStackedArea, ChartTimeline, Component,
                          ComponentDiv, ComponentTable, ComponentText,
                          render_html)
+from .legacy import (ConvolutionalIterationListener,
+                     FlowIterationListener,
+                     HistogramIterationListener)
 from .server import UIServer
 from .stats import StatsListener, StatsUpdateConfiguration
 from .storage import (FileStatsStorage, InMemoryStatsStorage,
                       RemoteUIStatsStorageRouter, StatsStorageRouter)
 
-__all__ = ["ChartHistogram", "ChartLine", "ChartScatter", "ChartStackedArea",
+__all__ = ["ChartHistogram", "ChartLine", "ChartScatter",
+           "ChartStackedArea", "ConvolutionalIterationListener",
+           "FlowIterationListener", "HistogramIterationListener",
            "ChartTimeline", "Component", "ComponentDiv", "ComponentTable",
            "ComponentText", "FileStatsStorage", "InMemoryStatsStorage",
            "RemoteUIStatsStorageRouter", "StatsListener",

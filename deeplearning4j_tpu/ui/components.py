@@ -235,8 +235,14 @@ def render_html(components, title="Components"):
     """Standalone HTML rendering every component — the
     StatsUtils.exportStatsAsHtml role. Data is embedded as JSON and drawn
     client-side with the same safe DOM helpers as the training UI."""
+    import html as _html
+
     from .server import _JS_LIB, _STYLE
-    payload = json.dumps([c.to_dict() for c in components])
+    # '<' escaped so an embedded '</script>' in component text cannot
+    # terminate the JSON island and inject live HTML into the report
+    payload = json.dumps([c.to_dict() for c in components]).replace(
+        "<", "\\u003c")
+    title = _html.escape(str(title))
     script = _JS_LIB + """
 const comps = JSON.parse(document.getElementById('data').textContent);
 const root = document.getElementById('root');

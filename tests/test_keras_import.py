@@ -332,3 +332,38 @@ def test_functional_output_dense_becomes_trainable_output_layer():
     for _ in range(5):
         net.fit(mds)
     assert net.score(mds) < s0
+
+
+def test_conv_use_bias_false_imports(tmp_path):
+    """Conv2D(use_bias=False) — kernel-only weight group (standard for
+    conv+BN models) must import without a bias param."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    W = rng.standard_normal((3, 3, 1, 4)).astype(np.float32)
+    path = str(tmp_path / "nb.h5")
+    cfg = {"class_name": "Sequential", "config": [
+        {"class_name": "Conv2D",
+         "config": {"name": "c", "filters": 4, "kernel_size": [3, 3],
+                    "use_bias": False, "activation": "relu",
+                    "batch_input_shape": [None, 8, 8, 1]}},
+        {"class_name": "Flatten", "config": {"name": "f"}},
+        {"class_name": "Dense",
+         "config": {"name": "d", "units": 2, "activation": "softmax"}}]}
+    Wd = rng.standard_normal((144, 2)).astype(np.float32)
+    bd = np.zeros(2, np.float32)
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(cfg).encode("utf-8")
+        mw = f.create_group("model_weights")
+        g = mw.create_group("c")
+        g.create_dataset("c_W", data=W)
+        g.attrs["weight_names"] = [b"c_W"]
+        g2 = mw.create_group("d")
+        g2.create_dataset("d_W", data=Wd)
+        g2.create_dataset("d_b", data=bd)
+        g2.attrs["weight_names"] = [b"d_W", b"d_b"]
+    net = import_keras_sequential_model_and_weights(path)
+    assert "b" not in net._params[0]
+    assert np.allclose(np.asarray(net._params[0]["W"]), W)
+    out = np.asarray(net.output(rng.random((2, 8, 8, 1)).astype(np.float32)))
+    assert out.shape == (2, 2)

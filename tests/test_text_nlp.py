@@ -177,3 +177,42 @@ def test_inverted_index_empty_labelled_doc():
     idx = InMemoryInvertedIndex()
     idx.add_words_to_doc(0, [], label="spam")
     assert idx.document_with_label(0) == ([], "spam")
+
+
+class TestAnnotationPipeline:
+    """UIMA-module equivalent (reference deeplearning4j-nlp-uima aggregate
+    AnalysisEngine: sentence -> token -> stem -> pos)."""
+
+    def test_standard_pipeline(self):
+        from deeplearning4j_tpu.text import standard_pipeline
+        doc = standard_pipeline().process(
+            "The runners were running quickly. It was a beautiful day.")
+        sents = doc.select("sentence")
+        assert len(sents) == 2
+        toks = doc.select("token")
+        words = [t.features["text"] for t in toks]
+        assert "running" in words
+        assert "day." in words or "day" in words
+        run = next(t for t in toks if t.features["text"] == "running")
+        assert run.features["stem"] == "run"
+        assert run.features["pos"] == "VBG"
+        the = next(t for t in toks if t.features["text"] == "The")
+        assert the.features["pos"] == "DT"
+        # tokens of the first sentence only
+        in_first = doc.covered(sents[0], "token")
+        assert all(t.begin >= sents[0].begin and t.end <= sents[0].end
+                   for t in in_first)
+        assert len(in_first) == 5
+
+    def test_custom_tokenizer_and_spans(self):
+        from deeplearning4j_tpu.text import (JapaneseTokenizerFactory,
+                                             AnnotationPipeline,
+                                             SentenceAnnotator,
+                                             TokenAnnotator)
+        pipe = AnnotationPipeline(SentenceAnnotator(),
+                                  TokenAnnotator(JapaneseTokenizerFactory()))
+        doc = pipe.process("私は東京に住む")
+        toks = doc.select("token")
+        assert toks
+        for t in toks:
+            assert doc.text[t.begin:t.end]   # spans point into the text

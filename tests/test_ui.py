@@ -206,3 +206,46 @@ def test_activation_collection_and_new_pages():
         assert "memory" in ups[-1]
     finally:
         server.stop()
+
+
+def test_legacy_listeners_feed_modern_storage():
+    """reference deeplearning4j-ui legacy listeners as StatsListener
+    presets: histogram listener collects histograms, conv listener
+    collects activations, flow listener ships the topology."""
+    from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+    from deeplearning4j_tpu.ui import (ConvolutionalIterationListener,
+                                       FlowIterationListener,
+                                       HistogramIterationListener)
+    net = _net()
+    ds = _ds()
+    hl = HistogramIterationListener(session_id="legacy_h")
+    net.set_listeners(hl)
+    net.fit(ds)
+    ups = hl.router.get_all_updates("legacy_h")
+    assert any("histogram" in p for u in ups
+               for p in u.get("parameters", {}).values())
+
+    conf = (NeuralNetConfiguration.Builder().seed(1)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                       activation="relu"))
+            .layer(1, OutputLayer(n_out=2, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    cnet = MultiLayerNetwork(conf).init()
+    r = np.random.default_rng(0)
+    cx = r.random((4, 8, 8, 1)).astype(np.float32)
+    cy = np.eye(2, dtype=np.float32)[r.integers(0, 2, 4)]
+    cl = ConvolutionalIterationListener(cx[:1], session_id="legacy_c")
+    cnet.set_listeners(cl)
+    cnet.fit(DataSet(cx, cy))
+    ups = cl.router.get_all_updates("legacy_c")
+    assert "activations" in ups[-1] and "0" in ups[-1]["activations"]
+
+    fl = FlowIterationListener(session_id="legacy_f")
+    net2 = _net()
+    net2.set_listeners(fl)
+    net2.fit(ds)
+    static = fl.router.get_static_info("legacy_f")
+    assert "configJson" in static["model"]
