@@ -1,9 +1,15 @@
 from .dataset import DataSet, MultiDataSet
 from .fetchers import (CifarDataSetIterator, CurvesDataSetIterator,
                        LFWDataSetIterator)
-from .iterators import (AsyncDataSetIterator, AsyncMultiDataSetIterator,
-                        DataSetIterator, IteratorDataSetIterator,
-                        ListDataSetIterator, MultipleEpochsIterator,
+from .iterators import (ArraysDataSetIterator, AsyncDataSetIterator,
+                        AsyncMultiDataSetIterator,
+                        CombinedPreProcessor, DataSetIterator,
+                        ExistingDataSetIterator,
+                        IteratorDataSetIterator,
+                        ListDataSetIterator,
+                        MovingWindowDataSetIterator,
+                        MultipleEpochsIterator,
+                        ReconstructionDataSetIterator,
                         SamplingDataSetIterator)
 from .mnist import MnistDataSetIterator
 from .mnist import IrisDataSetIterator
@@ -16,14 +22,17 @@ from .records import (CollectionRecordReader, CSVRecordReader,
                       SequenceRecordReaderDataSetIterator)
 
 __all__ = [
-    "AsyncDataSetIterator", "AsyncMultiDataSetIterator", "CSVRecordReader",
+    "ArraysDataSetIterator", "AsyncDataSetIterator", "AsyncMultiDataSetIterator", "CSVRecordReader",
     "CSVSequenceRecordReader",
     "CifarDataSetIterator", "CollectionRecordReader", "CurvesDataSetIterator",
-    "DataSet", "DataSetIterator", "ImagePreProcessingScaler",
+    "CombinedPreProcessor", "DataSet", "DataSetIterator",
+    "ExistingDataSetIterator", "ImagePreProcessingScaler",
     "IrisDataSetIterator", "IteratorDataSetIterator", "LFWDataSetIterator",
-    "ListDataSetIterator", "MnistDataSetIterator", "MultiDataSet",
+    "ListDataSetIterator", "MnistDataSetIterator",
+    "MovingWindowDataSetIterator", "MultiDataSet",
     "MultipleEpochsIterator", "NormalizerMinMaxScaler",
     "NormalizerStandardize", "RecordReader", "RecordReaderDataSetIterator",
-    "RecordReaderMultiDataSetIterator", "SamplingDataSetIterator",
+    "ReconstructionDataSetIterator", "RecordReaderMultiDataSetIterator",
+    "SamplingDataSetIterator",
     "SequenceRecordReaderDataSetIterator",
 ]

@@ -295,3 +295,196 @@ class AsyncMultiDataSetIterator(AsyncDataSetIterator):
                                 for m in mds.labels_masks]
                                if mds.labels_masks else mds.labels_masks)
         return staged
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Adapt any python iterable of DataSets (or a factory callable) to the
+    DataSetIterator protocol. reference:
+    datasets/iterator/ExistingDataSetIterator.java (wraps an
+    Iterable<DataSet> so reset() restarts it).
+
+    One-shot sources (generators) are replayed from a cache on reset():
+    a bare generator cannot be restarted, and re-calling iter() on it
+    would silently drop already-prefetched batches."""
+
+    def __init__(self, iterable_or_factory, total_outcomes=-1):
+        self._source = iterable_or_factory
+        self._outcomes = int(total_outcomes)
+        src = iterable_or_factory
+        self._one_shot = (not callable(src)) and iter(src) is src
+        if self._one_shot:
+            self._consumed = []   # every item ever pulled from the source
+            self._pos = 0
+        self.reset()
+
+    def reset(self):
+        if self._one_shot:
+            self._pos = 0
+            return
+        src = self._source
+        self._it = iter(src() if callable(src) else src)
+        self._next = next(self._it, None)
+
+    def has_next(self):
+        if self._one_shot:
+            if self._pos < len(self._consumed):
+                return True
+            try:
+                self._consumed.append(next(self._source))
+                return True
+            except StopIteration:
+                return False
+        return self._next is not None
+
+    def next_batch(self):
+        if self._one_shot:
+            if not self.has_next():
+                return None
+            ds = self._consumed[self._pos]
+            self._pos += 1
+            return ds
+        ds = self._next
+        self._next = next(self._it, None)
+        return ds
+
+    def total_outcomes(self):
+        return self._outcomes
+
+
+class ArraysDataSetIterator(DataSetIterator):
+    """Batches over (features, labels) array pairs — reference
+    INDArrayDataSetIterator.java / DoublesDataSetIterator.java /
+    FloatsDataSetIterator.java collapse to one class here (numpy carries
+    the dtype; the reference needed one wrapper per java primitive)."""
+
+    def __init__(self, pairs, batch_size):
+        """pairs: iterable of (features_row, labels_row) examples, or a
+        single (features, labels) array tuple."""
+        if (isinstance(pairs, tuple) and len(pairs) == 2
+                and hasattr(pairs[0], "shape")):
+            feats, labs = pairs
+        else:
+            pairs = list(pairs)
+            feats = np.stack([np.asarray(f, np.float32) for f, _ in pairs])
+            labs = np.stack([np.asarray(l, np.float32) for _, l in pairs])
+        self._ds = DataSet(np.asarray(feats), np.asarray(labs))
+        self.batch_size = int(batch_size)
+        self.reset()
+
+    def reset(self):
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < self._ds.num_examples()
+
+    def next_batch(self):
+        i, j = self._pos, self._pos + self.batch_size
+        self._pos = j
+        return DataSet(self._ds.features[i:j], self._ds.labels[i:j])
+
+    def batch(self):
+        return self.batch_size
+
+    def input_columns(self):
+        return int(np.prod(self._ds.features.shape[1:]))
+
+    def total_outcomes(self):
+        return int(self._ds.labels.shape[-1])
+
+
+INDArrayDataSetIterator = ArraysDataSetIterator   # reference names
+DoublesDataSetIterator = ArraysDataSetIterator
+FloatsDataSetIterator = ArraysDataSetIterator
+
+
+class ReconstructionDataSetIterator(DataSetIterator):
+    """Wrap an iterator, replacing labels with the features (autoencoder
+    targets). reference: datasets/iterator/ReconstructionDataSetIterator.java."""
+
+    def __init__(self, backing):
+        self.backing = backing
+
+    def has_next(self):
+        return self.backing.has_next()
+
+    def next_batch(self):
+        ds = self.backing.next_batch()
+        return DataSet(ds.features, ds.features,
+                       ds.features_mask, ds.features_mask)
+
+    def reset(self):
+        self.backing.reset()
+
+    def batch(self):
+        return self.backing.batch()
+
+    def input_columns(self):
+        return self.backing.input_columns()
+
+    def total_outcomes(self):
+        return self.backing.input_columns()
+
+
+class MovingWindowDataSetIterator(DataSetIterator):
+    """Sliding windows over a sequence dataset: each batch element is a
+    [window, features] slice advanced by `stride`. reference:
+    datasets/iterator/MovingWindowBaseDataSetIterator.java (2-D moving
+    window over matrices)."""
+
+    def __init__(self, features, labels, window, stride=1, batch_size=32):
+        feats = np.asarray(features)
+        labs = np.asarray(labels)
+        xs, ys = [], []
+        for start in range(0, len(feats) - window + 1, int(stride)):
+            xs.append(feats[start:start + window])
+            ys.append(labs[start + window - 1])
+        self._x = np.stack(xs) if xs else np.zeros((0, window) +
+                                                   feats.shape[1:])
+        self._y = np.stack(ys) if ys else np.zeros((0,) + labs.shape[1:])
+        self.batch_size = int(batch_size)
+        self.reset()
+
+    def reset(self):
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._x)
+
+    def next_batch(self):
+        i, j = self._pos, self._pos + self.batch_size
+        self._pos = j
+        return DataSet(self._x[i:j], self._y[i:j])
+
+    def batch(self):
+        return self.batch_size
+
+
+class CombinedPreProcessor:
+    """Chain DataSet pre-processors — reference
+    datasets/iterator/CombinedPreProcessor.java (Builder.addPreProcessor).
+    A pre-processor is any object with pre_process(ds) (normalizers
+    qualify)."""
+
+    class Builder:
+        def __init__(self):
+            self._steps = []
+
+        def add_pre_processor(self, p):
+            self._steps.append(p); return self
+
+        addPreProcessor = add_pre_processor
+
+        def build(self):
+            return CombinedPreProcessor(self._steps)
+
+    def __init__(self, steps):
+        self.steps = list(steps)
+
+    def pre_process(self, ds):
+        for p in self.steps:
+            out = p.pre_process(ds) if hasattr(p, "pre_process") else p(ds)
+            if out is not None:
+                ds = out
+        return ds
+
+    preProcess = pre_process

@@ -123,6 +123,27 @@ def _sigmoid(x):
 # Host-side batch builders + algorithm classes
 # ---------------------------------------------------------------------------
 
+def window_indices(n, window, rng):
+    """Shared word2vec windowing: per-position reduced window b ~ U[1, w]
+    (word2vec semantics). Returns (j [n, 2w] neighbor indices, valid
+    [n, 2w] bools) — consumed by SkipGram (pair indices), CBOW (context
+    rows), and DM (context rows + label column)."""
+    b = rng.integers(1, window + 1, n)
+    offs = np.concatenate([np.arange(-window, 0), np.arange(1, window + 1)])
+    j = np.arange(n)[:, None] + offs[None, :]              # [n, 2w]
+    valid = ((np.abs(offs)[None, :] <= b[:, None])
+             & (j >= 0) & (j < n))
+    return j, valid
+
+
+def window_contexts(ids_arr, window, rng):
+    """(context [n, 2w] with -1 padding, ids) — the CBOW/DM row form."""
+    n = len(ids_arr)
+    j, valid = window_indices(n, window, rng)
+    return np.where(valid, ids_arr[np.clip(j, 0, n - 1)],
+                    -1).astype(np.int32), valid
+
+
 class BaseElementsLearning:
     """Shared batching machinery. Subclasses emit (center, context) training
     pairs; this class turns them into padded index arrays and runs the jitted
@@ -258,14 +279,8 @@ class SkipGram(BaseElementsLearning):
         n = len(ids)
         if n < 2:
             return
-        w = self.window
         ids_arr = np.asarray(ids, np.int32)
-        # per-position reduced window b ~ U[1, w] (word2vec semantics)
-        b = self._rng.integers(1, w + 1, n)
-        offs = np.concatenate([np.arange(-w, 0), np.arange(1, w + 1)])
-        j = np.arange(n)[:, None] + offs[None, :]          # [n, 2w]
-        valid = ((np.abs(offs)[None, :] <= b[:, None])
-                 & (j >= 0) & (j < n))
+        j, valid = window_indices(n, self.window, self._rng)
         pos_idx, off_idx = np.nonzero(valid)
         self.enqueue_pairs(ids_arr[pos_idx], ids_arr[j[pos_idx, off_idx]],
                            lr)
@@ -331,45 +346,65 @@ class CBOW(BaseElementsLearning):
         self.cbow_mean = cbow_mean
 
     def learn_sequence(self, ids, lr):
-        w = self.window
         n = len(ids)
-        for pos in range(n):
-            b = int(self._rng.integers(1, w + 1))
-            ctx = [ids[j] for j in range(max(0, pos - b),
-                                         min(n, pos + b + 1)) if j != pos]
-            if ctx:
-                self._pending.append((ctx, ids[pos], lr))
-        if len(self._pending) >= self.batch_pairs:
+        if n == 0:
+            return
+        ids_arr = np.asarray(ids, np.int32)
+        context, valid = window_contexts(ids_arr, self.window, self._rng)
+        keep = valid.any(axis=1)
+        self.enqueue_windows(context[keep], ids_arr[keep], lr)
+
+    def enqueue_windows(self, context, outs, lr):
+        """Queue (context-row, predicted) arrays: context [m, <=2w+1] with
+        -1 padding, outs [m]. External window sources (DM's label-augmented
+        contexts) call this — the buffer format stays private."""
+        context = np.asarray(context, np.int32)
+        outs = np.asarray(outs, np.int32)
+        if context.size == 0:
+            return
+        self._pending.append((context, outs, np.float32(lr)))
+        self._pending_count += len(outs)
+        if self._pending_count >= self.batch_pairs:
             self._flush()
 
     def _flush(self, force=False):
-        # CBOW's pending protocol: (context id list, out id, lr) TUPLES —
-        # variable-length contexts can't use SkipGram's array triples
         B = self.batch_pairs
-        C = 2 * self.window   # fixed width: no per-batch re-trace
-        while len(self._pending) >= B or (force and self._pending):
-            chunk = self._pending[:B]
-            self._pending = self._pending[B:]
-            self._flushed_pairs += len(chunk)
-            valid = np.zeros((B,), np.float32)
-            valid[:len(chunk)] = 1.0
-            while len(chunk) < B:
-                chunk.append(([0], 0, 0.0))
+        # fixed width 2w+1 (covers DM's appended label column): ONE
+        # compiled executable for both CBOW and DM batches
+        C = 2 * self.window + 1
+        if not self._pending:
+            return
+        ctx = np.concatenate([
+            np.pad(p[0][:, :C], ((0, 0), (0, max(0, C - p[0].shape[1]))),
+                   constant_values=-1) for p in self._pending])
+        outs = np.concatenate([p[1] for p in self._pending])
+        lrs = np.concatenate([
+            np.broadcast_to(np.asarray(p[2], np.float32),
+                            (len(p[1]),)) for p in self._pending])
+        self._pending = []
+        self._pending_count = 0
+        total = len(outs)
+        start = 0
+        while total - start >= B or (force and start < total):
+            take = min(B, total - start)
             context = np.full((B, C), -1, np.int32)
-            cmask = np.zeros((B, C), np.float32)
-            for i, (ctx, _, _) in enumerate(chunk):
-                ctx = ctx[:C]
-                context[i, :len(ctx)] = ctx
-                cmask[i, :len(ctx)] = 1.0
-            cmask = cmask * valid[:, None]
-            outs = np.array([p[1] for p in chunk], np.int32)
-            lrs = [p[2] for p in chunk if p[2] > 0]
-            lr = float(np.mean(lrs)) if lrs else 0.0
-            targets, labels, tmask = self._targets_labels(outs)
+            o = np.zeros((B,), np.int32)
+            context[:take] = ctx[start:start + take]
+            o[:take] = outs[start:start + take]
+            valid = np.zeros((B,), np.float32)
+            valid[:take] = 1.0
+            lr = float(lrs[start:start + take].mean()) if take else 0.0
+            start += take
+            cmask = (context >= 0).astype(np.float32) * valid[:, None]
+            targets, labels, tmask = self._targets_labels(o)
             tmask = tmask * valid[:, None]
             self._syn0, self._syn1 = _cbow_step(
                 self._syn0, self._syn1, context, cmask, targets, labels,
                 tmask, np.float32(lr))
+            self._flushed_pairs += take
+        if start < total:   # stash the sub-batch remainder
+            self._pending.append((ctx[start:], outs[start:], lrs[start:]))
+            self._pending_count = total - start
 
 
 ELEMENTS_LEARNING = {"skipgram": SkipGram, "cbow": CBOW}

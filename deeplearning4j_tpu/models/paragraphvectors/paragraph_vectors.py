@@ -181,16 +181,18 @@ class ParagraphVectors(SequenceVectors):
 
     def _learn_dm(self, algo, lab_id, ids, lr):
         """Mean(label + context) predicts center (CBOW with label)."""
-        w = self.window
+        import numpy as np
+
+        from ..embeddings.learning import window_contexts
         n = len(ids)
-        for pos in range(n):
-            b = int(self._rng.integers(1, w + 1))
-            ctx = [ids[j] for j in range(max(0, pos - b),
-                                         min(n, pos + b + 1)) if j != pos]
-            ctx.append(lab_id)
-            algo._pending.append((ctx, ids[pos], lr))
-        if len(algo._pending) >= algo.batch_pairs:
-            algo._flush()
+        if n == 0:
+            return
+        ids_arr = np.asarray(ids, np.int32)
+        context, _ = window_contexts(ids_arr, self.window, self._rng)
+        # the label vector joins every window (the DM doc-vector column)
+        context = np.concatenate(
+            [context, np.full((n, 1), lab_id, np.int32)], axis=1)
+        algo.enqueue_windows(context, ids_arr, lr)
 
     # ------------------------------------------------------------------
     def infer_vector(self, text_or_tokens, steps=10, lr=0.025):

@@ -1,0 +1,99 @@
+"""Classic CNN zoo configs: AlexNet, VGG-16.
+
+TPU-native equivalents of the model-zoo members of the reference era
+(dl4j model zoo AlexNet.java / VGG16.java configurations, built on the
+same layer stack the reference's examples wire by hand): sequential
+MultiLayerConfigurations in NHWC/bf16, ready for `fit()` on one chip or a
+mesh via ParallelWrapper.
+"""
+from __future__ import annotations
+
+from ...nn.conf.input_type import InputType
+from ...nn.conf.layers import (ConvolutionLayer, DenseLayer, DropoutLayer,
+                               LocalResponseNormalization, OutputLayer,
+                               SubsamplingLayer)
+from ...nn.conf.neural_net_configuration import NeuralNetConfiguration
+
+
+def alexnet_conf(height=224, width=224, channels=3, num_classes=1000,
+                 seed=123, learning_rate=0.01, data_type="bfloat16"):
+    """AlexNet (2012): 5 convs with LRN + maxpool, 3 dense with dropout."""
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed).updater("nesterovs").momentum(0.9)
+         .learning_rate(learning_rate).weight_init("relu")
+         .data_type(data_type)
+         .list())
+    li = 0
+
+    def add(layer):
+        nonlocal li
+        b.layer(li, layer)
+        li += 1
+
+    add(ConvolutionLayer(n_out=96, kernel_size=(11, 11), stride=(4, 4),
+                         convolution_mode="same", activation="relu"))
+    add(LocalResponseNormalization())
+    add(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                         stride=(2, 2)))
+    add(ConvolutionLayer(n_out=256, kernel_size=(5, 5),
+                         convolution_mode="same", activation="relu"))
+    add(LocalResponseNormalization())
+    add(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                         stride=(2, 2)))
+    add(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                         convolution_mode="same", activation="relu"))
+    add(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                         convolution_mode="same", activation="relu"))
+    add(ConvolutionLayer(n_out=256, kernel_size=(3, 3),
+                         convolution_mode="same", activation="relu"))
+    add(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                         stride=(2, 2)))
+    add(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+    add(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+    add(OutputLayer(n_out=num_classes, activation="softmax",
+                    loss_function="mcxent"))
+    return (b.set_input_type(InputType.convolutional(height, width,
+                                                     channels)).build())
+
+
+_VGG16_PLAN = ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
+
+
+def vgg16_conf(height=224, width=224, channels=3, num_classes=1000,
+               seed=123, learning_rate=0.01, data_type="bfloat16"):
+    """VGG-16: 13 3x3 convs in 5 blocks + 3 dense."""
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed).updater("nesterovs").momentum(0.9)
+         .learning_rate(learning_rate).weight_init("relu")
+         .data_type(data_type)
+         .list())
+    li = 0
+
+    def add(layer):
+        nonlocal li
+        b.layer(li, layer)
+        li += 1
+
+    for width_, convs in _VGG16_PLAN:
+        for _ in range(convs):
+            add(ConvolutionLayer(n_out=width_, kernel_size=(3, 3),
+                                 convolution_mode="same",
+                                 activation="relu"))
+        add(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                             stride=(2, 2)))
+    add(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+    add(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+    add(OutputLayer(n_out=num_classes, activation="softmax",
+                    loss_function="mcxent"))
+    return (b.set_input_type(InputType.convolutional(height, width,
+                                                     channels)).build())
+
+
+def alexnet(**kwargs):
+    from ...nn.multilayer import MultiLayerNetwork
+    return MultiLayerNetwork(alexnet_conf(**kwargs)).init()
+
+
+def vgg16(**kwargs):
+    from ...nn.multilayer import MultiLayerNetwork
+    return MultiLayerNetwork(vgg16_conf(**kwargs)).init()

@@ -269,3 +269,96 @@ class TestMultiInputPipeline:
         assert np.array_equal(np.asarray(staged.features_masks[0]), fm[0])
         assert np.array_equal(np.asarray(staged.labels_masks[0]), lm[0])
         assert not it.has_next()
+
+
+class TestUtilityIterators:
+    """Reference datasets/iterator utility long tail:
+    ExistingDataSetIterator, INDArray/Doubles/Floats (ArraysDataSetIterator
+    here), ReconstructionDataSetIterator, MovingWindowBaseDataSetIterator,
+    CombinedPreProcessor."""
+
+    def test_existing_iterator_resets_factories_and_iterables(self):
+        from deeplearning4j_tpu.datasets import ExistingDataSetIterator
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        batches = [DataSet(np.ones((2, 3)) * i, np.ones((2, 1)))
+                   for i in range(3)]
+        it = ExistingDataSetIterator(lambda: iter(batches))
+        assert len(list(it)) == 3
+        it.reset()
+        assert it.has_next()
+        assert float(it.next_batch().features[0, 0]) == 0.0
+
+    def test_arrays_iterator_from_pairs_and_arrays(self):
+        from deeplearning4j_tpu.datasets import ArraysDataSetIterator
+        rng = np.random.default_rng(0)
+        pairs = [(rng.random(4), rng.random(2)) for _ in range(5)]
+        it = ArraysDataSetIterator(pairs, batch_size=2)
+        sizes = [b.num_examples() for b in it]
+        assert sizes == [2, 2, 1]
+        assert it.input_columns() == 4 and it.total_outcomes() == 2
+        x = rng.random((6, 3)).astype(np.float32)
+        y = rng.random((6, 2)).astype(np.float32)
+        it2 = ArraysDataSetIterator((x, y), batch_size=4)
+        b = it2.next_batch()
+        assert np.array_equal(b.features, x[:4])
+
+    def test_reconstruction_iterator_targets_features(self):
+        from deeplearning4j_tpu.datasets import (ArraysDataSetIterator,
+                                                 ReconstructionDataSetIterator)
+        rng = np.random.default_rng(0)
+        x = rng.random((4, 3)).astype(np.float32)
+        y = rng.random((4, 2)).astype(np.float32)
+        it = ReconstructionDataSetIterator(
+            ArraysDataSetIterator((x, y), batch_size=4))
+        ds = it.next_batch()
+        assert np.array_equal(ds.labels, ds.features)
+        assert it.total_outcomes() == 3
+
+    def test_moving_window_iterator(self):
+        from deeplearning4j_tpu.datasets import MovingWindowDataSetIterator
+        feats = np.arange(10, dtype=np.float32).reshape(10, 1)
+        labs = np.arange(10, dtype=np.float32).reshape(10, 1) * 10
+        it = MovingWindowDataSetIterator(feats, labs, window=3, stride=2,
+                                         batch_size=2)
+        b1 = it.next_batch()
+        assert b1.features.shape == (2, 3, 1)
+        assert np.array_equal(b1.features[0].ravel(), [0, 1, 2])
+        assert np.array_equal(b1.features[1].ravel(), [2, 3, 4])
+        assert float(b1.labels[0, 0]) == 20.0   # label at window end
+        total = b1.num_examples() + sum(b.num_examples() for b in iter(
+            lambda: it.next_batch() if it.has_next() else None, None))
+        assert total == 4                        # (10-3)//2 + 1
+
+    def test_combined_preprocessor_chains(self):
+        from deeplearning4j_tpu.datasets import CombinedPreProcessor
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        class AddOne:
+            def pre_process(self, ds):
+                return DataSet(ds.features + 1, ds.labels)
+
+        pp = (CombinedPreProcessor.Builder()
+              .add_pre_processor(AddOne())
+              .add_pre_processor(lambda ds: DataSet(ds.features * 2,
+                                                    ds.labels))
+              .build())
+        out = pp.pre_process(DataSet(np.zeros((2, 2)), np.zeros((2, 1))))
+        assert np.array_equal(out.features, np.full((2, 2), 2.0))
+
+
+def test_existing_iterator_one_shot_generator_replays():
+    """A bare generator source must not lose batches to reset() (the
+    __iter__ protocol resets before iterating)."""
+    from deeplearning4j_tpu.datasets import ExistingDataSetIterator
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    def gen():
+        for i in range(3):
+            yield DataSet(np.full((1, 2), float(i)), np.ones((1, 1)))
+
+    it = ExistingDataSetIterator(gen())
+    vals = [float(ds.features[0, 0]) for ds in it]
+    assert vals == [0.0, 1.0, 2.0]
+    # and a second full pass replays identically
+    vals2 = [float(ds.features[0, 0]) for ds in it]
+    assert vals2 == [0.0, 1.0, 2.0]

@@ -57,3 +57,37 @@ def test_lenet_conf_shapes():
     x = np.random.default_rng(0).random((2, 784)).astype(np.float32)
     out = np.asarray(net.output(x))
     assert out.shape == (2, 10)
+
+
+class TestClassicCNNs:
+    """AlexNet / VGG-16 zoo configs (reference-era model zoo members)."""
+
+    def test_alexnet_trains_small(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.models.zoo import alexnet_conf
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = alexnet_conf(height=64, width=64, channels=3, num_classes=4,
+                            data_type="float32")
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.random((4, 64, 64, 3)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 4)]
+        net.fit(DataSet(x, y))
+        assert np.isfinite(float(net.score()))
+        out = np.asarray(net.output(x))
+        assert out.shape == (4, 4)
+        assert np.allclose(out.sum(1), 1.0, atol=1e-3)
+
+    def test_vgg16_structure_and_forward(self):
+        from deeplearning4j_tpu.models.zoo import vgg16_conf
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = vgg16_conf(height=32, width=32, channels=3, num_classes=5,
+                          data_type="float32")
+        conv_layers = [l for l in conf.layers
+                       if type(l).__name__ == "ConvolutionLayer"]
+        assert len(conv_layers) == 13            # VGG-16 = 13 convs
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        out = np.asarray(net.output(
+            rng.random((2, 32, 32, 3)).astype(np.float32)))
+        assert out.shape == (2, 5)
