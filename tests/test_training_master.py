@@ -5,6 +5,7 @@ run on a local-mode cluster (BaseSparkTest pattern)."""
 import json
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
 from deeplearning4j_tpu.datasets.dataset import DataSet
@@ -271,7 +272,6 @@ def test_parameter_server_worker_error_propagates():
                   np.zeros((8, 3), dtype=np.float32))  # wrong n_in
     psw = (ParameterServerParallelWrapper.Builder(net)
            .workers(2).queue_size(2).build())
-    import pytest
     with pytest.raises(Exception):
         psw.fit(ListDataSetIterator(list(good.batch_by(16)) + [bad]))
 
@@ -381,3 +381,72 @@ def test_early_stopping_over_training_master():
     holdout.reset()
     assert (MasterDataSetLossCalculator(holdout, num_shards=4)
             .calculate_score(best)) <= result.score_vs_epoch[0] + 1e-6
+
+
+def test_split_failure_recovery_semantics():
+    """SURVEY §5.3 parity: 're-run split from last averaged params' — a
+    split that fails mid-run leaves the network at the last completed
+    split's averaged parameters (proven against a state-identical twin
+    that runs ONLY that split), so re-running resumes training correctly
+    (the reference gets this from Spark re-executing the partition
+    against the re-broadcast params)."""
+    import jax
+    import jax.numpy as jnp
+
+    net = _net()
+    tm = (ParameterAveragingTrainingMaster.Builder(batch_size_per_worker=8)
+          .workers(4).averaging_frequency(2).rdd_training_approach("direct")
+          .build())
+    ds = _data()
+    tm.execute_training(net, ds)            # healthy run -> params P1
+    p_after_split = np.asarray(net.params()).copy()
+    it_after = net.conf.iteration_count
+    # full state snapshot: a twin must share params, optimizer moments,
+    # layer state, rng and iteration counter to reproduce the next split
+    snap = (jax.tree.map(jnp.copy, net._params),
+            jax.tree.map(jnp.copy, net._updater_state),
+            jax.tree.map(jnp.copy, net._model_state),
+            net._rng, net.conf.iteration_count)
+
+    # inject a failure inside the next run's second split
+    calls = {"n": 0}
+    orig = tm._train_split
+
+    def failing(net_, batches, hook, hook_trains):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("injected worker failure")
+        return orig(net_, batches, hook, hook_trains)
+
+    tm._train_split = failing
+    with pytest.raises(RuntimeError, match="injected"):
+        tm.execute_training(net, ds)
+    assert net.conf.iteration_count > it_after
+
+    # twin from the snapshot runs ONLY the first split: the failed net
+    # must sit at exactly that averaged state (nothing partially applied)
+    twin = _net()
+    (twin._params, twin._updater_state, twin._model_state, twin._rng,
+     twin.conf.iteration_count) = snap
+    tm2 = ParameterAveragingTrainingMaster.from_json(tm.to_json())
+    calls2 = {"n": 0}
+    orig2 = tm2._train_split
+
+    def one_split(net_, batches, hook, hook_trains):
+        calls2["n"] += 1
+        if calls2["n"] == 2:
+            raise RuntimeError("stop after first split")
+        return orig2(net_, batches, hook, hook_trains)
+
+    tm2._train_split = one_split
+    with pytest.raises(RuntimeError, match="stop after"):
+        tm2.execute_training(twin, ds)
+    np.testing.assert_allclose(np.asarray(net.params()),
+                               np.asarray(twin.params()), atol=1e-6)
+
+    # recovery = re-run; training continues and loss keeps improving
+    tm._train_split = orig
+    s_before = net.score(ds)
+    tm.execute_training(net, ds)
+    assert net.score(ds) < s_before
+    assert not np.allclose(np.asarray(net.params()), p_after_split)
