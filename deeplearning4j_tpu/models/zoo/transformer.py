@@ -1,0 +1,177 @@
+"""Decoder-only transformer LM — the pipeline-parallel flagship.
+
+The reference's sequence model family tops out at stacked GravesLSTM
+(e.g. GravesLSTMCharModellingExample); this model is the TPU-native
+modern-equivalent: uniform pre-LN causal-attention blocks whose identical
+[B, T, D] interface is exactly what pipeline parallelism
+(`parallel/pipeline.py`) and ring attention (`parallel/ring_attention.py`)
+need. Pure functional params (nested dicts) so the same block fn serves
+single-chip jit, the GPipe schedule, and ring-attention sequence sharding.
+
+Block = pre-LN multi-head causal self-attention + residual, then pre-LN
+GeLU MLP + residual — all matmuls MXU-shaped ([B*T, D] x [D, *]).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _layer_norm(x, g, b, eps=1e-5):
+    mu = jnp.mean(x, -1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, -1, keepdims=True)
+    return xc * jax.lax.rsqrt(var + eps) * g + b
+
+
+def init_block(rng, d_model, n_heads, d_ff, dtype=jnp.float32):
+    k = jax.random.split(rng, 4)
+    s_attn = 1.0 / math.sqrt(d_model)
+    s_ff = 1.0 / math.sqrt(d_ff)
+    return {
+        "ln1": {"g": jnp.ones(d_model, dtype), "b": jnp.zeros(d_model, dtype)},
+        "attn": {
+            "wqkv": (jax.random.normal(k[0], (d_model, 3 * d_model)) *
+                     s_attn).astype(dtype),
+            "wo": (jax.random.normal(k[1], (d_model, d_model)) *
+                   s_attn).astype(dtype),
+        },
+        "ln2": {"g": jnp.ones(d_model, dtype), "b": jnp.zeros(d_model, dtype)},
+        "mlp": {
+            "w1": (jax.random.normal(k[2], (d_model, d_ff)) *
+                   s_attn).astype(dtype),
+            "b1": jnp.zeros(d_ff, dtype),
+            "w2": (jax.random.normal(k[3], (d_ff, d_model)) *
+                   s_ff).astype(dtype),
+            "b2": jnp.zeros(d_model, dtype),
+        },
+    }
+
+
+def causal_attention(x, wqkv, wo, n_heads):
+    """[B, T, D] causal MHA; one fused qkv matmul, one output matmul."""
+    B, T, D = x.shape
+    H = n_heads
+    hd = D // H
+    qkv = x @ wqkv                                     # [B, T, 3D]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(a):
+        return a.reshape(B, T, H, hd).transpose(0, 2, 1, 3)  # [B, H, T, hd]
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = (q @ k.transpose(0, 1, 3, 2)) / math.sqrt(hd)   # [B, H, T, T]
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    att = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(x.dtype)
+    out = (att @ v).transpose(0, 2, 1, 3).reshape(B, T, D)
+    return out @ wo
+
+
+def make_block_fn(n_heads):
+    """Uniform transformer block closed over the (static) head count: the
+    pipeline stage function."""
+
+    def block_fn(p, x):
+        h = _layer_norm(x, p["ln1"]["g"], p["ln1"]["b"])
+        x = x + causal_attention(h, p["attn"]["wqkv"], p["attn"]["wo"],
+                                 n_heads)
+        h = _layer_norm(x, p["ln2"]["g"], p["ln2"]["b"])
+        m = jax.nn.gelu(h @ p["mlp"]["w1"] + p["mlp"]["b1"])
+        return x + m @ p["mlp"]["w2"] + p["mlp"]["b2"]
+
+    return block_fn
+
+
+def init_lm(vocab_size, d_model=128, n_heads=4, n_layers=4, d_ff=None,
+            max_len=256, seed=0, dtype=jnp.float32):
+    """Returns (aux, blocks): aux = embedding + final LN + LM head;
+    blocks = list of uniform block params (the pipeline stages)."""
+    d_ff = d_ff or 4 * d_model
+    rng = jax.random.PRNGKey(seed)
+    ks = jax.random.split(rng, n_layers + 3)
+    aux = {
+        "tok": (jax.random.normal(ks[0], (vocab_size, d_model)) *
+                0.02).astype(dtype),
+        "pos": (jax.random.normal(ks[1], (max_len, d_model)) *
+                0.02).astype(dtype),
+        "lnf": {"g": jnp.ones(d_model, dtype), "b": jnp.zeros(d_model, dtype)},
+        "head": (jax.random.normal(ks[2], (d_model, vocab_size)) /
+                 math.sqrt(d_model)).astype(dtype),
+    }
+    blocks = [init_block(ks[3 + i], d_model, n_heads, d_ff, dtype)
+              for i in range(n_layers)]
+    return aux, blocks
+
+
+def embed_fn(aux, tokens):
+    """[B, T] int tokens -> [B, T, D] activations."""
+    T = tokens.shape[-1]
+    return aux["tok"][tokens] + aux["pos"][:T]
+
+
+def logits_fn(aux, h):
+    h = _layer_norm(h, aux["lnf"]["g"], aux["lnf"]["b"])
+    return h @ aux["head"]
+
+
+def lm_loss(aux, h, targets):
+    """Mean next-token cross entropy; h [B, T, D], targets [B, T] ints."""
+    logits = logits_fn(aux, h).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, -1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    return jnp.mean(nll)
+
+
+class TransformerLM:
+    """Single-chip reference driver (the pipeline path lives in
+    `parallel.pipeline.PipelineParallel`; see tests/test_pipeline.py for the
+    dp+pp wiring)."""
+
+    def __init__(self, vocab_size, d_model=128, n_heads=4, n_layers=4,
+                 d_ff=None, max_len=256, seed=0, dtype=jnp.float32,
+                 learning_rate=0.1, momentum=0.9):
+        self.aux, self.blocks = init_lm(vocab_size, d_model, n_heads,
+                                        n_layers, d_ff, max_len, seed, dtype)
+        self.block_fn = make_block_fn(n_heads)
+        self.lr, self.mu = float(learning_rate), float(momentum)
+        self._vel = None
+        self._jit_step = None
+
+    def _loss(self, aux, blocks, x, y):
+        h = embed_fn(aux, x)
+        for p in blocks:
+            h = self.block_fn(p, h)
+        return lm_loss(aux, h, y)
+
+    def fit_batch(self, x, y):
+        if self._vel is None:
+            self._vel = jax.tree.map(jnp.zeros_like, (self.aux, self.blocks))
+        if self._jit_step is None:
+            lr, mu = self.lr, self.mu
+
+            from ...parallel.pipeline import sgd_momentum_update
+
+            def step(aux, blocks, vel, x, y):
+                loss, g = jax.value_and_grad(self._loss, argnums=(0, 1))(
+                    aux, blocks, x, y)
+                (aux, blocks), vel = sgd_momentum_update(
+                    (aux, blocks), vel, g, lr, mu)
+                return aux, blocks, vel, loss
+
+            self._jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
+        x = jnp.asarray(np.asarray(x), jnp.int32)
+        y = jnp.asarray(np.asarray(y), jnp.int32)
+        (self.aux, self.blocks, self._vel,
+         loss) = self._jit_step(self.aux, self.blocks, self._vel, x, y)
+        return float(loss)
+
+    def logits(self, x):
+        x = jnp.asarray(np.asarray(x), jnp.int32)
+        h = embed_fn(self.aux, x)
+        for p in self.blocks:
+            h = self.block_fn(p, h)
+        return logits_fn(self.aux, h)

@@ -4,17 +4,19 @@ from .early_stopping import (MasterDataSetLossCalculator,
                              TpuEarlyStoppingTrainer)
 from .magic_queue import MagicQueue
 from .parallel_wrapper import ParallelWrapper
+from .pipeline import PipelineParallel, gpipe, make_pipeline_mesh
 from .parameter_server import (GradientsAccumulator,
                                ParameterServerParallelWrapper)
 from .time_source import (NTPTimeSource, SystemClockTimeSource,
                           TimeSource)
 from .training_hook import ParameterServerTrainingHook, TrainingHook
-from .sharding import make_mesh, shard_params
+from .sharding import make_mesh, shard_params, zero_state_sharding
 from .training_master import (ParameterAveragingTrainingMaster,
                               TpuComputationGraph, TpuDl4jMultiLayer,
                               TrainingMasterStats)
 
-__all__ = ["GradientsAccumulator", "MagicQueue",
+__all__ = ["GradientsAccumulator", "MagicQueue", "PipelineParallel",
+           "gpipe", "make_pipeline_mesh",
            "MasterDataSetLossCalculator", "NTPTimeSource", "ParallelWrapper",
            "ParameterAveragingTrainingMaster",
            "ParameterServerParallelWrapper", "ParameterServerTrainingHook",
@@ -22,4 +24,4 @@ __all__ = ["GradientsAccumulator", "MagicQueue",
            "SystemClockTimeSource", "TimeSource",
            "TpuEarlyStoppingTrainer", "TrainingHook",
            "TpuDl4jMultiLayer", "TrainingMasterStats", "distributed",
-           "make_mesh", "shard_params"]
+           "make_mesh", "shard_params", "zero_state_sharding"]

@@ -51,6 +51,7 @@ class ParallelWrapper:
             self._prefetch = 2
             self._avg_updaters = True
             self._tensor_parallel = False
+            self._sharded_updater_state = False
             self._mesh = None
 
         def workers(self, n):
@@ -79,16 +80,24 @@ class ParallelWrapper:
         def tensor_parallel(self, v):
             self._tensor_parallel = bool(v); return self
 
+        def sharded_updater_state(self, v):
+            """ZeRO-1 analog: partition optimizer state over the data axis
+            (each device stores 1/N of the moments). Requires
+            averaging_frequency == 1 (the k-local-steps path carries state
+            device-locally inside shard_map)."""
+            self._sharded_updater_state = bool(v); return self
+
         def mesh(self, mesh):
             self._mesh = mesh; return self
 
         def build(self):
             return ParallelWrapper(self.model, self._workers, self._avg_freq,
                                    self._avg_updaters, self._tensor_parallel,
-                                   self._mesh)
+                                   self._mesh, self._sharded_updater_state)
 
     def __init__(self, model, workers=None, averaging_frequency=1,
-                 average_updaters=True, tensor_parallel=False, mesh=None):
+                 average_updaters=True, tensor_parallel=False, mesh=None,
+                 sharded_updater_state=False):
         self.model = model
         model._ensure_init()
         if mesh is None:
@@ -101,6 +110,11 @@ class ParallelWrapper:
         self.averaging_frequency = int(averaging_frequency)
         self.average_updaters = average_updaters
         self.tensor_parallel = tensor_parallel
+        self.sharded_updater_state = bool(sharded_updater_state)
+        if self.sharded_updater_state and self.averaging_frequency != 1:
+            raise ValueError(
+                "sharded_updater_state requires averaging_frequency=1 "
+                "(k-local-steps carries updater state device-locally)")
         self._sharded = False
         self._jit_step = None
         self._jit_kstep = None
@@ -112,7 +126,16 @@ class ParallelWrapper:
         net = self.model
         net._params, self._param_shardings = shard_params(
             net, self.mesh, self.tensor_parallel)
-        net._updater_state = replicate(net._updater_state, self.mesh)
+        if self.sharded_updater_state:
+            from .sharding import zero_state_sharding
+            self._ustate_shardings = zero_state_sharding(
+                net._updater_state, self.mesh)
+            net._updater_state = jax.tree.map(
+                lambda a, sh: put_sharded(a, sh, full_array=True),
+                net._updater_state, self._ustate_shardings)
+        else:
+            self._ustate_shardings = None
+            net._updater_state = replicate(net._updater_state, self.mesh)
         net._model_state = replicate(net._model_state, self.mesh)
         self._sharded = True
 
@@ -174,6 +197,17 @@ class ParallelWrapper:
         net = self.model
         if self._jit_step is None:
             raw = net.make_raw_step()
+            if self._ustate_shardings is not None:
+                inner, shardings = raw, self._ustate_shardings
+
+                def raw(params, ustate, state, batch):
+                    p, u, s, score, car = inner(params, ustate, state, batch)
+                    # pin the ZeRO layout on the state OUTPUT so GSPMD keeps
+                    # the optimizer update partitioned (and the donated input
+                    # buffer is reusable) instead of re-replicating it
+                    u = jax.tree.map(jax.lax.with_sharding_constraint, u,
+                                     shardings)
+                    return p, u, s, score, car
             self._jit_step = jax.jit(raw, donate_argnums=(0, 1, 2))
         while it.has_next():
             ds = it.next_batch()

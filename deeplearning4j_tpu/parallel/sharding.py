@@ -113,6 +113,31 @@ def shard_params(net, mesh, tensor_parallel=False):
     return sharded, shardings
 
 
+def zero_state_sharding(ustate, mesh, axis="data"):
+    """ZeRO-1-style shardings for the optimizer-state pytree: each leaf is
+    sharded over the `axis` mesh axis on its first evenly-dividing dimension
+    (replicated when none divides). Params stay replicated; only the
+    updater state (momentum/Adam moments — the largest persistent tensors
+    after params) is partitioned, so each device stores 1/N of it and XLA
+    GSPMD shards the optimizer update compute the same way.
+
+    The reference has no equivalent (updater state is replicated and
+    averaged, ParallelWrapper.java:200-212); this is a TPU-first extension
+    in the spirit of ZeRO stage 1 (SURVEY.md §2.5 "hybrid sharded
+    optimizer: optional")."""
+    n = mesh.shape[axis]
+
+    def leaf_sharding(a):
+        for dim, size in enumerate(a.shape):
+            if size % n == 0 and size >= n:
+                spec = [None] * a.ndim
+                spec[dim] = axis
+                return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(leaf_sharding, ustate)
+
+
 def is_multiprocess_mesh(mesh):
     return len({d.process_index for d in mesh.devices.flat}) > 1
 
