@@ -86,6 +86,37 @@ def make_block_fn(n_heads):
     return block_fn
 
 
+def make_moe_block_fn(n_heads, moe_apply):
+    """Transformer block whose MLP is a mixture-of-experts: attention as in
+    `make_block_fn`, the FFN replaced by `moe_apply(moe_params, tokens)`
+    (dense or expert-parallel — `parallel/moe.py`). Stage params must carry
+    a "moe" subtree instead of "mlp". Returns (y, aux_loss) so the trainer
+    can add the load-balance term."""
+
+    def block_fn(p, x):
+        B, T, D = x.shape
+        h = _layer_norm(x, p["ln1"]["g"], p["ln1"]["b"])
+        x = x + causal_attention(h, p["attn"]["wqkv"], p["attn"]["wo"],
+                                 n_heads)
+        h = _layer_norm(x, p["ln2"]["g"], p["ln2"]["b"])
+        y, aux = moe_apply(p["moe"], h.reshape(B * T, D))
+        return x + y.reshape(B, T, D), aux
+
+    return block_fn
+
+
+def init_moe_block(rng, d_model, n_heads, n_experts, d_ff,
+                   dtype=jnp.float32):
+    """Block params for `make_moe_block_fn`: attention + LNs as
+    `init_block`, "mlp" replaced by a "moe" subtree."""
+    from ...parallel.moe import init_moe
+    p = init_block(rng, d_model, n_heads, d_ff, dtype)
+    del p["mlp"]
+    p["moe"] = init_moe(jax.random.fold_in(rng, 7), d_model, n_experts,
+                        d_ff, dtype)
+    return p
+
+
 def init_lm(vocab_size, d_model=128, n_heads=4, n_layers=4, d_ff=None,
             max_len=256, seed=0, dtype=jnp.float32):
     """Returns (aux, blocks): aux = embedding + final LN + LM head;

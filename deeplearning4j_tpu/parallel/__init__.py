@@ -4,6 +4,8 @@ from .early_stopping import (MasterDataSetLossCalculator,
                              TpuEarlyStoppingTrainer)
 from .magic_queue import MagicQueue
 from .parallel_wrapper import ParallelWrapper
+from .moe import (init_moe, make_expert_mesh, moe_mlp_dense,
+                  moe_mlp_sharded, shard_moe_params)
 from .pipeline import PipelineParallel, gpipe, make_pipeline_mesh
 from .parameter_server import (GradientsAccumulator,
                                ParameterServerParallelWrapper)
@@ -16,7 +18,8 @@ from .training_master import (ParameterAveragingTrainingMaster,
                               TrainingMasterStats)
 
 __all__ = ["GradientsAccumulator", "MagicQueue", "PipelineParallel",
-           "gpipe", "make_pipeline_mesh",
+           "gpipe", "make_pipeline_mesh", "init_moe", "make_expert_mesh",
+           "moe_mlp_dense", "moe_mlp_sharded", "shard_moe_params",
            "MasterDataSetLossCalculator", "NTPTimeSource", "ParallelWrapper",
            "ParameterAveragingTrainingMaster",
            "ParameterServerParallelWrapper", "ParameterServerTrainingHook",

@@ -1,0 +1,170 @@
+"""Mixture-of-Experts with expert parallelism over an "expert" mesh axis.
+
+The reference has NO MoE / expert parallelism (SURVEY.md §2.5 marks EP as
+absent/optional) — this is a TPU-first extension following the Switch
+Transformer recipe: top-1 routing, fixed expert capacity, and an
+``lax.all_to_all`` token shuffle over ICI so each device hosts exactly one
+(or E/devices) expert's FFN. The dense einsum path (`moe_mlp_dense`) is the
+single-chip reference implementation the sharded path is tested against.
+
+Shapes: tokens [B, D]; E experts, capacity C per (source device, expert).
+Dispatch (per device, inside shard_map over axis "expert"):
+
+  1. gate logits -> top-1 expert + gate prob per token
+  2. tokens scatter into a [E, C, D] send buffer (position = rank of the
+     token within its expert group; overflow tokens are DROPPED — their
+     residual path passes them through, standard Switch behavior)
+  3. all_to_all: device e receives every device's buffer-for-e -> [n, C, D]
+  4. local expert FFN over the received tokens (one big MXU matmul)
+  5. reverse all_to_all; each token gathers its result * gate prob
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_expert_mesh(n_expert, devices=None):
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < n_expert:
+        raise ValueError(f"need {n_expert} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n_expert]), ("expert",))
+
+
+def init_moe(rng, d_model, n_experts, d_ff, dtype=jnp.float32):
+    """Gate + stacked expert FFN params ([E, ...] leading expert axis)."""
+    k = jax.random.split(rng, 3)
+    s_in = 1.0 / math.sqrt(d_model)
+    s_out = 1.0 / math.sqrt(d_ff)
+    return {
+        "gate": (jax.random.normal(k[0], (d_model, n_experts)) *
+                 s_in).astype(dtype),
+        "w1": (jax.random.normal(k[1], (n_experts, d_model, d_ff)) *
+               s_in).astype(dtype),
+        "b1": jnp.zeros((n_experts, d_ff), dtype),
+        "w2": (jax.random.normal(k[2], (n_experts, d_ff, d_model)) *
+               s_out).astype(dtype),
+        "b2": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def _expert_ffn(w1, b1, w2, b2, x):
+    return jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+
+
+def _route(gate_w, x):
+    """Top-1 routing: (expert id [B], gate prob [B], full probs [B, E])."""
+    probs = jax.nn.softmax((x @ gate_w).astype(jnp.float32), -1)
+    expert = jnp.argmax(probs, -1)
+    gate = jnp.take_along_axis(probs, expert[:, None], -1)[:, 0]
+    return expert, gate.astype(x.dtype), probs
+
+
+def load_balance_loss(probs, expert, n_experts):
+    """Switch aux loss: E * sum_e f_e * P_e (f = fraction of tokens routed
+    to e, P = mean router prob for e). Encourages uniform expert load."""
+    f = jnp.mean(jax.nn.one_hot(expert, n_experts, dtype=probs.dtype), 0)
+    p = jnp.mean(probs, 0)
+    return n_experts * jnp.sum(f * p)
+
+
+def moe_mlp_dense(params, x, capacity=None, n_shards=1):
+    """Single-chip reference: every expert computes every token, the top-1
+    mask selects. With `capacity`, tokens past an expert's capacity are
+    dropped; ranking is computed within each of `n_shards` contiguous
+    batch shards, matching how `moe_mlp_sharded` drops per (source shard,
+    expert) — set n_shards = the mesh axis size for exact equality with
+    the sharded dispatch. Returns (y, aux_loss)."""
+    E = params["w1"].shape[0]
+    expert, gate, probs = _route(params["gate"], x)
+    onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)           # [B, E]
+    if capacity is not None:
+        B = x.shape[0]
+        oh = onehot.reshape(n_shards, B // n_shards, E)
+        pos = (jnp.cumsum(oh, 1) - oh).reshape(B, E)    # rank within shard
+        keep = (jnp.take_along_axis(pos, expert[:, None], -1)[:, 0]
+                < capacity).astype(x.dtype)
+        gate = gate * keep
+    # [E, B, D] all-experts compute (fine for small E; the EP path exists
+    # for when it is not)
+    y_all = jax.vmap(_expert_ffn)(params["w1"], params["b1"], params["w2"],
+                                  params["b2"],
+                                  jnp.broadcast_to(x, (E,) + x.shape))
+    y = jnp.einsum("ebd,be->bd", y_all, onehot) * gate[:, None]
+    return y, load_balance_loss(probs, expert, E)
+
+
+def moe_mlp_sharded(mesh, axis="expert", capacity=None):
+    """Build the expert-parallel apply fn: tokens sharded over `axis`,
+    expert FFNs one-per-device-slice, all_to_all dispatch/return.
+
+    Returns fn(params_sharded, x[B, D]) -> (y[B, D], aux_loss). B must be
+    divisible by the axis size. `capacity` bounds tokens per (source
+    device, expert) buffer; tokens past it are dropped (output 0 — the
+    caller's residual connection passes them through, Switch-style).
+    Default None = B_local, which can never drop.
+    """
+    n = mesh.shape[axis]
+
+    def spmd(prm, x_local):
+        B_loc, D = x_local.shape
+        E = prm["w1"].shape[0] * n          # global expert count
+        e_per_dev = prm["w1"].shape[0]
+        C = B_loc if capacity is None else min(int(capacity), B_loc)
+        expert, gate, probs = _route(prm["gate"], x_local)
+        onehot = jax.nn.one_hot(expert, E, dtype=x_local.dtype)
+        pos = (jnp.cumsum(onehot, 0) - onehot)
+        pos_t = jnp.take_along_axis(
+            pos, expert[:, None], -1)[:, 0].astype(jnp.int32)
+        keep = pos_t < C
+        # scatter into [E, C, D] send buffer
+        buf = jnp.zeros((E, C, D), x_local.dtype)
+        buf = buf.at[expert, jnp.where(keep, pos_t, C - 1)].add(
+            x_local * keep[:, None].astype(x_local.dtype))
+        # group by destination device: [n, e_per_dev*C, D]
+        buf = buf.reshape(n, e_per_dev * C, D)
+        recv = jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)      # [n*e_per_dev*C, D] tiles
+        recv = recv.reshape(n, e_per_dev, C, D)    # [src, local_e, C, D]
+        # local experts compute over all sources' tokens
+        def one_expert(w1, b1, w2, b2, toks):      # toks [n, C, D]
+            t = toks.reshape(n * C, D)
+            return _expert_ffn(w1, b1, w2, b2, t).reshape(n, C, D)
+        y = jax.vmap(one_expert, in_axes=(0, 0, 0, 0, 1))(
+            prm["w1"], prm["b1"], prm["w2"], prm["b2"], recv)
+        # y [local_e, src, C, D] -> send back [src, local_e*C, D]
+        y = y.transpose(1, 0, 2, 3).reshape(n, e_per_dev * C, D)
+        back = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+        back = back.reshape(E, C, D)
+        out = back[expert, jnp.where(keep, pos_t, 0)] * \
+            (gate * keep.astype(gate.dtype))[:, None]
+        aux = jax.lax.pmean(load_balance_loss(probs, expert, E), axis)
+        return out, aux
+
+    pspec = {"gate": P(), "w1": P(axis), "b1": P(axis), "w2": P(axis),
+             "b2": P(axis)}
+    fn = shard_map(spmd, mesh=mesh,
+                   in_specs=(pspec, P(axis)),
+                   out_specs=(P(axis), P()),
+                   check_vma=False)
+
+    def apply(params, x):
+        return fn(params, x)
+
+    return apply
+
+
+def shard_moe_params(params, mesh, axis="expert"):
+    """Place MoE params on the mesh: gate replicated, expert stacks split
+    over `axis`."""
+    out = {}
+    for k, v in params.items():
+        spec = P() if k == "gate" else P(axis)
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out

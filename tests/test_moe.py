@@ -1,0 +1,127 @@
+"""Mixture-of-Experts / expert-parallelism tests (8-device CPU mesh).
+
+The reference has no MoE (SURVEY.md §2.5 EP: absent/optional); these pin the
+TPU-first extension: the all_to_all dispatch == the dense reference exactly,
+capacity overflow drops tokens (residual passthrough), and an expert-parallel
+MoE transformer trains.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.moe import (init_moe, load_balance_loss,
+                                             make_expert_mesh, moe_mlp_dense,
+                                             moe_mlp_sharded,
+                                             shard_moe_params)
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8 virtual devices")
+
+D, E, F, B = 16, 8, 32, 64
+
+
+def _setup(seed=0):
+    params = init_moe(jax.random.PRNGKey(seed), D, E, F)
+    x = jnp.asarray(np.random.default_rng(seed).standard_normal((B, D)),
+                    jnp.float32)
+    mesh = make_expert_mesh(8)
+    return params, shard_moe_params(params, mesh), x, mesh
+
+
+class TestExpertParallelDispatch:
+    def test_matches_dense_reference(self):
+        params, ps, x, mesh = _setup()
+        y_ep, _ = jax.jit(moe_mlp_sharded(mesh))(ps, x)
+        y_dense, _ = moe_mlp_dense(params, x)
+        np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
+                                   atol=1e-5)
+
+    def test_capacity_drops_to_residual_zero(self):
+        """All-identical tokens route to one expert; capacity=1 keeps one
+        token per source shard and zeroes the rest (Switch drop)."""
+        params, ps, _, mesh = _setup()
+        x = jnp.ones((B, D), jnp.float32)
+        y, _ = jax.jit(moe_mlp_sharded(mesh, capacity=1))(ps, x)
+        y = np.asarray(y)
+        per_shard = y.reshape(8, B // 8, D)
+        nonzero = (np.abs(per_shard).max(-1) > 0).sum(-1)
+        assert (nonzero == 1).all(), nonzero
+
+    def test_capacity_matches_dense_with_shard_ranking(self):
+        """Dense reference with n_shards = mesh size reproduces the sharded
+        drop pattern exactly."""
+        params, ps, _, mesh = _setup(2)
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.standard_normal((B, D)), jnp.float32)
+        cap = 2
+        y_ep, _ = jax.jit(moe_mlp_sharded(mesh, capacity=cap))(ps, x)
+        y_ref, _ = moe_mlp_dense(params, x, capacity=cap, n_shards=8)
+        np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref),
+                                   atol=1e-5)
+
+    def test_grads_flow_and_finite(self):
+        params, ps, x, mesh = _setup(1)
+        apply_ep = moe_mlp_sharded(mesh)
+
+        def loss(p, x):
+            y, aux = apply_ep(p, x)
+            return jnp.mean(y ** 2) + 0.01 * aux
+
+        g = jax.jit(jax.grad(loss))(ps, x)
+        for leaf in jax.tree.leaves(g):
+            assert bool(jnp.isfinite(leaf).all())
+        # expert grads stay sharded over the expert axis
+        assert "expert" in tuple(g["w1"].sharding.spec)
+
+    def test_load_balance_loss_uniform_is_one(self):
+        probs = jnp.full((B, E), 1.0 / E)
+        expert = jnp.arange(B) % E
+        lb = load_balance_loss(probs, expert, E)
+        np.testing.assert_allclose(float(lb), 1.0, atol=1e-6)
+
+
+class TestMoETransformer:
+    def test_ep_moe_transformer_learns(self):
+        from deeplearning4j_tpu.models.zoo.transformer import (
+            embed_fn, init_moe_block, lm_loss, logits_fn, make_moe_block_fn)
+        V, d_model, T = 11, 32, 8
+        mesh = make_expert_mesh(8)
+        rng = jax.random.PRNGKey(3)
+        aux = {
+            "tok": jax.random.normal(rng, (V, d_model)) * 0.02,
+            "pos": jax.random.normal(jax.random.fold_in(rng, 1),
+                                     (T, d_model)) * 0.02,
+            "lnf": {"g": jnp.ones(d_model), "b": jnp.zeros(d_model)},
+            "head": jax.random.normal(jax.random.fold_in(rng, 2),
+                                      (d_model, V)) / np.sqrt(d_model),
+        }
+        blk = init_moe_block(jax.random.fold_in(rng, 4), d_model,
+                             n_heads=4, n_experts=E, d_ff=64)
+        blk["moe"] = shard_moe_params(blk["moe"], mesh)
+        moe_apply = moe_mlp_sharded(mesh)
+        block_fn = make_moe_block_fn(4, moe_apply)
+
+        def loss_fn(aux, blk, x, y):
+            h = embed_fn(aux, x)
+            h, lb = block_fn(blk, h)
+            return lm_loss(aux, h, y) + 0.01 * lb
+
+        rng_np = np.random.default_rng(0)
+        x = rng_np.integers(0, V, (16, T)).astype(np.int32)
+        y = (x + 1) % V
+
+        lr = 0.2
+        @jax.jit
+        def step(aux, blk, x, y):
+            loss, g = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                aux, blk, x, y)
+            aux = jax.tree.map(lambda p, gg: p - lr * gg, aux, g[0])
+            blk = jax.tree.map(lambda p, gg: p - lr * gg, blk, g[1])
+            return aux, blk, loss
+
+        xj, yj = jnp.asarray(x), jnp.asarray(y)
+        aux, blk, first = step(aux, blk, xj, yj)
+        for _ in range(120):
+            aux, blk, last = step(aux, blk, xj, yj)
+        assert float(last) < float(first) * 0.5, (float(first), float(last))
