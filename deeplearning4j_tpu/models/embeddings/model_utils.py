@@ -28,7 +28,12 @@ def words_nearest(vocab, lookup, word_or_vec, top_n=10, exclude=()):
     else:
         vec = np.asarray(word_or_vec, np.float32)
     W = lookup.get_weights()
-    norms = np.linalg.norm(W, axis=1)
+    if hasattr(lookup, "row_norms"):
+        # memory-mapped lookups precompute norms at write time so nearest
+        # queries stream W @ v without materializing the matrix
+        norms = np.array(lookup.row_norms(), np.float32)
+    else:
+        norms = np.linalg.norm(W, axis=1)
     norms[norms == 0] = 1.0
     v = vec / max(np.linalg.norm(vec), 1e-12)
     sims = (W @ v) / norms

@@ -71,14 +71,30 @@ def causal_attention(x, wqkv, wo, n_heads):
     return out @ wo
 
 
-def make_block_fn(n_heads):
+def flash_causal_attention(x, wqkv, wo, n_heads):
+    """causal_attention via the Pallas flash kernel (`ops/flash_attention`):
+    never materializes the [T, T] scores — the long-context fast path."""
+    from ...ops import flash_attention
+    B, T, D = x.shape
+    H = n_heads
+    hd = D // H
+    qkv = x @ wqkv
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    split = lambda a: a.reshape(B, T, H, hd)      # [B, T, H, hd]
+    out = flash_attention(split(q), split(k), split(v), True)
+    return out.reshape(B, T, D) @ wo
+
+
+def make_block_fn(n_heads, attention="dense"):
     """Uniform transformer block closed over the (static) head count: the
-    pipeline stage function."""
+    pipeline stage function. attention: "dense" (XLA softmax) or "flash"
+    (Pallas kernel)."""
+    attn = (flash_causal_attention if attention == "flash"
+            else causal_attention)
 
     def block_fn(p, x):
         h = _layer_norm(x, p["ln1"]["g"], p["ln1"]["b"])
-        x = x + causal_attention(h, p["attn"]["wqkv"], p["attn"]["wo"],
-                                 n_heads)
+        x = x + attn(h, p["attn"]["wqkv"], p["attn"]["wo"], n_heads)
         h = _layer_norm(x, p["ln2"]["g"], p["ln2"]["b"])
         m = jax.nn.gelu(h @ p["mlp"]["w1"] + p["mlp"]["b1"])
         return x + m @ p["mlp"]["w2"] + p["mlp"]["b2"]
@@ -164,10 +180,10 @@ class TransformerLM:
 
     def __init__(self, vocab_size, d_model=128, n_heads=4, n_layers=4,
                  d_ff=None, max_len=256, seed=0, dtype=jnp.float32,
-                 learning_rate=0.1, momentum=0.9):
+                 learning_rate=0.1, momentum=0.9, attention="dense"):
         self.aux, self.blocks = init_lm(vocab_size, d_model, n_heads,
                                         n_layers, d_ff, max_len, seed, dtype)
-        self.block_fn = make_block_fn(n_heads)
+        self.block_fn = make_block_fn(n_heads, attention=attention)
         self.lr, self.mu = float(learning_rate), float(momentum)
         self._vel = None
         self._jit_step = None

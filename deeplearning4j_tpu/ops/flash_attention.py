@@ -1,0 +1,234 @@
+"""Flash attention — Pallas TPU kernel for the long-context hot op.
+
+The reference's attention-era equivalent is the hand-written native kernel
+seam (libnd4j custom ops / cuDNN helpers); on TPU the hot op worth a
+hand-written kernel is attention: XLA's lowering of softmax(QK^T)V
+materializes the [T, T] score matrix in HBM, so at long sequence length the
+op is bandwidth-bound on score traffic. This kernel never materializes it:
+K/V stream through VMEM in blocks, the online-softmax running max/sum live
+in VMEM scratch across the kv grid dimension, and only the [T, d] output
+leaves the chip — O(T) HBM traffic instead of O(T^2).
+
+Layout [B, T, H, D] matches `parallel/ring_attention.py`; this kernel is
+the per-device block-compute of ring attention (sequence parallelism) and
+the fast path for the transformer zoo model.
+
+Grid: (B*H, T/block_q, T/block_k) — the kv axis is innermost, so each
+(batch*head, q-block) revisits its output block while m/l/acc scratch
+carries the online-softmax state (the canonical Pallas accumulation
+pattern). Causal masking skips fully-masked kv blocks via `pl.when`.
+
+Backward: `jax.custom_vjp` recomputes attention blockwise with a
+`jax.checkpoint` block body (`_blockwise_attention_ckpt`): residuals are
+just q,k,v — nothing from the forward is stored, and the recompute never
+materializes more than one q-block's [bq, T] score panel, so TRAINING
+keeps the O(T) residual-memory contract too.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces (absent on CPU-only builds of pallas)
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+NEG_INF = float("-inf")
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+            scale, causal, block_q, block_k):
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    iq = pl.program_id(1)
+    q_start = iq * block_q
+    k_start = ik * block_k
+
+    def compute():
+        # native-dtype (bf16) MXU matmuls with f32 accumulation — an f32
+        # cast before the dot would quarter the MXU rate
+        q = q_ref[0]                                   # [bq, d]
+        k = k_ref[0]                                   # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
+        if causal:
+            row = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        m_prev = m_ref[:, :1]                          # [bq, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)                         # [bq, bk]
+        l_ref[:, :1] = l_ref[:, :1] * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, :1] = m_cur
+
+    if causal:
+        # skip kv blocks entirely above the diagonal
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_bthd(q, k, v, causal, scale, block_q, block_k, interpret):
+    """q,k,v: [BH, T, d] (batch*heads flattened)."""
+    BH, T, d = q.shape
+    # largest divisors of T within the requested block sizes (any T works;
+    # powers of two get the full-size blocks the chip numbers were swept at)
+    bq = _divisor_block(T, block_q)
+    bk = _divisor_block(T, block_k)
+    grid = (BH, T // bq, T // bk)
+    kw = {}
+    if _VMEM is not None:
+        kw["memory_space"] = _VMEM
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **kw)
+    kv_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), **kw)
+    o_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **kw)
+    if pltpu is None:
+        raise NotImplementedError("pallas TPU backend unavailable")
+    scratch = [
+        pltpu.VMEM((bq, 128), jnp.float32),   # m (col 0 used)
+        pltpu.VMEM((bq, 128), jnp.float32),   # l
+        pltpu.VMEM((bq, d), jnp.float32),     # acc
+    ]
+    kernel = functools.partial(_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk)
+    extra = {}
+    if not interpret and pltpu is not None:
+        # outer grid dims are independent; only the kv dim carries the
+        # online-softmax accumulation state
+        extra["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=o_spec,
+        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+        **extra,
+    )(q, k, v)
+
+
+def _divisor_block(T, requested):
+    b = min(requested, T)
+    while T % b:
+        b -= 1
+    return b
+
+
+def _reference_attention(q, k, v, causal, scale):
+    """Einsum reference ([B,T,H,D]); materializes [T,T] — test oracle and
+    small-T backward only."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    if causal:
+        T = q.shape[1]
+        pos = jnp.arange(T)
+        s = jnp.where(pos[:, None] >= pos[None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _blockwise_attention_ckpt(q, k, v, causal, scale, block_q=1024):
+    """Blockwise attention over q-blocks with a `jax.checkpoint` block body:
+    same values as `_reference_attention`, but autodiff residuals are only
+    (q_block, k, v) per block — O(T·d), not O(T²). Scores for one q-block
+    ([bq, T]) exist transiently and are recomputed in the backward. This is
+    the recompute target for flash_attention's custom VJP at long T, so
+    TRAINING keeps the flash memory contract, not just inference."""
+    B, T, H, D = q.shape
+    bq = block_q
+    while T % bq:
+        bq //= 2
+        if bq == 0:
+            bq = T
+            break
+    nq = T // bq
+    qb = q.reshape(B, nq, bq, H, D).transpose(1, 0, 2, 3, 4)  # [nq,B,bq,H,D]
+    starts = jnp.arange(nq) * bq
+
+    @jax.checkpoint
+    def one_block(q_blk, q_start):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q_blk.astype(jnp.float32) * scale,
+                       k.astype(jnp.float32))            # [B,H,bq,T]
+        if causal:
+            row = q_start + jnp.arange(bq)
+            col = jnp.arange(T)
+            s = jnp.where(row[:, None] >= col[None, :], s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        return out.astype(q_blk.dtype)                   # [B,bq,H,D]
+
+    out_blocks = jax.lax.map(lambda args: one_block(*args), (qb, starts))
+    return out_blocks.transpose(1, 0, 2, 3, 4).reshape(B, T, H, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal=True, scale=None, block_q=1024,
+                    block_k=1024, interpret=None):
+    """Flash attention over [B, T, H, D] (ring_attention layout).
+
+    scale defaults to 1/sqrt(D). `interpret=None` auto-selects: real
+    Mosaic kernel on TPU, Pallas interpreter elsewhere (so the same tests
+    run on the CPU mesh)."""
+    return _flash_apply(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_apply(q, k, v, causal, scale, block_q, block_k, interpret):
+    B, T, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    to_bhtd = lambda a: a.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    out = _flash_fwd_bthd(to_bhtd(q), to_bhtd(k), to_bhtd(v), causal,
+                          scale, block_q, block_k, interpret)
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    return (flash_attention(q, k, v, causal, scale, block_q, block_k,
+                            interpret), (q, k, v))
+
+
+def _bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    _, vjp = jax.vjp(
+        lambda q, k, v: _blockwise_attention_ckpt(q, k, v, causal, scale,
+                                                  block_q=block_q),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -9,13 +9,14 @@ from .legacy import (ConvolutionalIterationListener,
 from .server import UIServer
 from .stats import StatsListener, StatsUpdateConfiguration
 from .storage import (FileStatsStorage, InMemoryStatsStorage,
-                      RemoteUIStatsStorageRouter, StatsStorageRouter)
+                      RemoteUIStatsStorageRouter, SqliteStatsStorage,
+                      StatsStorageRouter)
 
 __all__ = ["ChartHistogram", "ChartLine", "ChartScatter",
            "ChartStackedArea", "ConvolutionalIterationListener",
            "FlowIterationListener", "HistogramIterationListener",
            "ChartTimeline", "Component", "ComponentDiv", "ComponentTable",
            "ComponentText", "FileStatsStorage", "InMemoryStatsStorage",
-           "RemoteUIStatsStorageRouter", "StatsListener",
+           "RemoteUIStatsStorageRouter", "SqliteStatsStorage", "StatsListener",
            "StatsStorageRouter", "StatsUpdateConfiguration", "UIServer",
            "components", "render_html"]

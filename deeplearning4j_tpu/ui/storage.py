@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import os
+import sqlite3
 import threading
 import urllib.request
 
@@ -123,6 +124,75 @@ class FileStatsStorage(BaseStatsStorage):
     def put_update(self, update):
         self._append("update", update)
         super().put_update(update)
+
+
+class SqliteStatsStorage(BaseStatsStorage):
+    """SQLite-backed persistence — parity with the reference's
+    J7FileStatsStorage (ui-model storage/sqlite/J7FileStatsStorage.java):
+    a single-file relational store that supports concurrent readers and
+    incremental queries, where the JSON-lines FileStatsStorage must replay
+    the whole log. Uses stdlib sqlite3 (the reference bundles a JDBC
+    driver); updates are indexed by (session, insertion order) so
+    `get_updates_since` is a range scan, not a replay."""
+
+    def __init__(self, path):
+        super().__init__()
+        self.path = str(path)
+        self._db = sqlite3.connect(self.path, check_same_thread=False)
+        self._db_lock = threading.Lock()   # separate from the (non-reentrant)
+        #                                    base listener/index lock
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS static_info ("
+            " session_id TEXT PRIMARY KEY, data TEXT NOT NULL)")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS updates ("
+            " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+            " session_id TEXT NOT NULL, data TEXT NOT NULL)")
+        self._db.execute(
+            "CREATE INDEX IF NOT EXISTS idx_updates_session"
+            " ON updates(session_id, id)")
+        self._db.commit()
+        self._load()
+
+    def _load(self):
+        for (data,) in self._db.execute("SELECT data FROM static_info"):
+            BaseStatsStorage.put_static_info(self, json.loads(data))
+        for (data,) in self._db.execute(
+                "SELECT data FROM updates ORDER BY id"):
+            BaseStatsStorage.put_update(self, json.loads(data))
+
+    def put_static_info(self, info):
+        with self._db_lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO static_info VALUES (?, ?)",
+                (info["sessionId"], json.dumps(info)))
+            self._db.commit()
+            super().put_static_info(info)
+
+    def put_update(self, update):
+        # both appends under ONE lock so DB rowid order == in-memory list
+        # order (get_updates_since's index contract) under concurrent
+        # writers; the base _lock nests inside and never takes _db_lock
+        with self._db_lock:
+            self._db.execute(
+                "INSERT INTO updates (session_id, data) VALUES (?, ?)",
+                (update["sessionId"], json.dumps(update)))
+            self._db.commit()
+            super().put_update(update)
+
+    def get_updates_since(self, session_id, after_index):
+        """Incremental poll: updates with insertion index > after_index
+        (0-based position in get_all_updates order) — the query pattern the
+        live UI uses instead of refetching everything."""
+        with self._db_lock:
+            rows = self._db.execute(
+                "SELECT data FROM updates WHERE session_id = ?"
+                " ORDER BY id LIMIT -1 OFFSET ?",
+                (session_id, int(after_index) + 1)).fetchall()
+        return [json.loads(d) for (d,) in rows]
+
+    def close(self):
+        self._db.close()
 
 
 class RemoteUIStatsStorageRouter(StatsStorageRouter):

@@ -11,6 +11,7 @@ from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfigurat
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
 from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   SqliteStatsStorage,
                                    RemoteUIStatsStorageRouter, StatsListener,
                                    StatsUpdateConfiguration, UIServer)
 
@@ -70,6 +71,36 @@ def test_file_storage_replay(tmp_path):
     assert len(storage2.get_all_updates("s1")) == 1
     assert storage2.get_static_info("s1")["model"]["class"] == \
         "MultiLayerNetwork"
+
+
+def test_sqlite_storage_persist_and_incremental(tmp_path):
+    """J7FileStatsStorage parity: single-file SQLite store reloads across
+    opens and serves incremental range queries."""
+    path = str(tmp_path / "stats.db")
+    storage = SqliteStatsStorage(path)
+    net = _net()
+    net.set_listeners(StatsListener(storage, session_id="s1"))
+    ds = _ds()
+    for _ in range(3):
+        net.fit(ds)
+    assert len(storage.get_all_updates("s1")) == 3
+    # incremental poll: nothing after the last seen index
+    assert storage.get_updates_since("s1", 2) == []
+    inc = storage.get_updates_since("s1", 0)
+    assert len(inc) == 2
+    assert inc == storage.get_all_updates("s1")[1:]
+    storage.close()
+    # reopen -> loaded from the database file
+    storage2 = SqliteStatsStorage(path)
+    assert storage2.list_session_ids() == ["s1"]
+    assert len(storage2.get_all_updates("s1")) == 3
+    assert storage2.get_static_info("s1")["model"]["class"] == \
+        "MultiLayerNetwork"
+    # appends after reopen extend the same session
+    net.set_listeners(StatsListener(storage2, session_id="s1"))
+    net.fit(ds)
+    assert len(storage2.get_updates_since("s1", 2)) == 1
+    storage2.close()
 
 
 def test_ui_server_and_remote_router():

@@ -287,3 +287,38 @@ class TestDistributedWord2Vec:
         # updates preserve the column sharding (donated buffers)
         assert sg._syn0.sharding.spec == \
             jax.sharding.PartitionSpec(None, "model")
+
+
+class TestStaticWord2Vec:
+    def test_static_mmap_queries_match_trained(self, tmp_path):
+        from deeplearning4j_tpu.models.word2vec.static_word2vec import (
+            StaticWord2Vec, write_static_model)
+        m = (Word2Vec.Builder()
+             .layer_size(32).window_size(3).negative_sample(5).epochs(3)
+             .seed(7).min_word_frequency(1).learning_rate(0.05).build())
+        m.fit(_toy_corpus())
+        d = str(tmp_path / "static_w2v")
+        write_static_model(m, d)
+        sm = StaticWord2Vec(d, mmap=True)
+        # vectors identical to the trained table
+        np.testing.assert_allclose(sm.word_vector("cat"),
+                                   m.lookup.vector("cat"), rtol=1e-6)
+        # similarity + nearest queries agree with the live model
+        assert abs(sm.similarity("cat", "dog") -
+                   m.similarity("cat", "dog")) < 1e-5
+        assert sm.words_nearest("cat", top_n=4) == \
+            m.words_nearest("cat", top_n=4)
+        _check_clusters(sm)
+        assert sm.has_word("cat") and not sm.has_word("zeppelin")
+
+    def test_static_is_read_only_surface(self, tmp_path):
+        from deeplearning4j_tpu.models.word2vec.static_word2vec import (
+            StaticWord2Vec, write_static_model)
+        m = (Word2Vec.Builder()
+             .layer_size(8).window_size(2).negative_sample(2).epochs(1)
+             .seed(1).min_word_frequency(1).build())
+        m.fit(_toy_corpus(n_repeat=5))
+        d = str(tmp_path / "s")
+        write_static_model(m, d)
+        sm = StaticWord2Vec(d)
+        assert not hasattr(sm, "fit") and not hasattr(sm, "train")
