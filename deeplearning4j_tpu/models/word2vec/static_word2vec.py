@@ -129,7 +129,8 @@ class _ReorderedView:
     def __getitem__(self, i):
         if isinstance(i, (int, np.integer)):
             return self._W[int(self._index[i])]
-        return np.asarray(self._W)[self._index[i]]
+        # memmap fancy indexing reads only the addressed rows
+        return self._W[self._index[i]]
 
     def __matmul__(self, v):
         # (view @ v)[i] == W[index[i]] . v — compute in disk order (one

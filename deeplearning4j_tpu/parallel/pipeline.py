@@ -162,18 +162,21 @@ class PipelineParallel:
                  data_axis=None, learning_rate=0.1, momentum=0.0):
         self.mesh = mesh
         self.axis = axis
+        self.data_axis = data_axis
         self.n_micro = int(n_micro)
         self.S = mesh.shape[axis]
         if len(stage_params) != self.S:
             raise ValueError(f"{len(stage_params)} stages != mesh "
                              f"{axis}={self.S}")
+        from .sharding import put_sharded, replicate
         stacked = stack_stage_params(stage_params)
         sh = NamedSharding(mesh, P(axis))
+        # put_sharded/replicate handle multi-host meshes (each process
+        # contributes its addressable shards; plain device_put cannot)
         self.stacked = jax.tree.map(
-            lambda a: jax.device_put(a, sh), stacked)
-        self.aux = jax.device_put(
-            aux_params if aux_params is not None else {},
-            NamedSharding(mesh, P()))
+            lambda a: put_sharded(a, sh, full_array=True), stacked)
+        self.aux = replicate(aux_params if aux_params is not None else {},
+                             mesh)
         self._pipe = gpipe(stage_fn, mesh, axis=axis, data_axis=data_axis)
         self.pre_fn = pre_fn
         self.loss_fn = loss_fn
@@ -201,7 +204,7 @@ class PipelineParallel:
         if self._jit_fwd is None:
             self._jit_fwd = jax.jit(
                 lambda stk, aux, xs: self._pipe(stk, self._embed(aux, xs)))
-        xs = microbatch(jnp.asarray(x), self.n_micro)
+        xs = self._put_micro(microbatch(np.asarray(x), self.n_micro))
         out = self._jit_fwd(self.stacked, self.aux, xs)
         return out.reshape((-1,) + out.shape[2:])
 
@@ -222,11 +225,25 @@ class PipelineParallel:
                 return stacked, aux, vel, loss
 
             self._jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
-        xs = microbatch(jnp.asarray(x), self.n_micro)
-        ys = microbatch(jnp.asarray(y), self.n_micro)
+        xs = self._put_micro(microbatch(np.asarray(x), self.n_micro))
+        ys = self._put_micro(microbatch(np.asarray(y), self.n_micro))
         (self.stacked, self.aux, self._vel,
          loss) = self._jit_step(self.stacked, self.aux, self._vel, xs, ys)
         return float(loss)
+
+    def _put_micro(self, a):
+        """Place a microbatched [M, B_local, ...] numpy array on the mesh.
+        On a multi-host mesh each process passes its LOCAL slice of the
+        batch dim (the data axis); single-host hands the host array to jit
+        directly (one H2D, no round-trip)."""
+        from .sharding import is_multiprocess_mesh, put_sharded
+        if not is_multiprocess_mesh(self.mesh):
+            return a
+        spec = [None] * a.ndim
+        if self.data_axis is not None:
+            spec[1] = self.data_axis
+        return put_sharded(a, NamedSharding(self.mesh, P(*spec)),
+                           full_array=self.data_axis is None)
 
     def stage_params(self):
         return unstack_stage_params(self.stacked, self.S)

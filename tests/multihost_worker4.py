@@ -1,13 +1,16 @@
 """Worker for the scaled multi-host test (test_multihost.py, 4 processes).
 
-Proves three things beyond the 2-process minimum (VERDICT r2 item 9):
+Proves four things beyond the 2-process minimum (VERDICT r2 item 9):
   A. a mesh whose MODEL axis spans process boundaries (2 local devices per
      process, mesh data=2 x model=4: each model row covers 2 processes)
      trains with tensor parallelism over the cross-process axis;
   B. a TrainingMaster run on the multi-host mesh with per-process input
      slices (each process feeds its local fraction of every global batch);
   C. MagicQueue stages per-device shards onto this process's local devices
-     (the per-process input-pipeline role).
+     (the per-process input-pipeline role);
+  D. GPipe pipeline parallelism with the PIPE axis spanning processes —
+     the stage-to-stage ppermute (and its autodiff transpose) rides the
+     DCN boundary, and the pipelined transformer LM trains.
 
 Usage: python tests/multihost_worker4.py <proc_id> <nproc> <coordinator>
 """
@@ -124,8 +127,40 @@ def main():
     assert rows == local.num_examples()
     assert devs_seen == set(jax.local_devices())
 
+    # --- D: pipeline parallelism with the pipe axis spanning processes -
+    # mesh (data=2, pipe=4): every pipe row covers 2 processes, so the
+    # GPipe ppermute hops (and the autodiff backward rotation) cross the
+    # DCN boundary
+    from deeplearning4j_tpu.models.zoo.transformer import (
+        embed_fn, init_lm, lm_loss, make_block_fn)
+    from deeplearning4j_tpu.parallel.pipeline import PipelineParallel
+    mesh_pp = Mesh(devices, ("data", "pipe"))
+    pipe_procs = {d.process_index for d in devices[0]}
+    assert len(pipe_procs) > 1, "pipe axis must span processes"
+    aux, blocks = init_lm(11, d_model=16, n_heads=2, n_layers=4,
+                          max_len=8, seed=3)
+    pp = PipelineParallel(make_block_fn(2), blocks, mesh_pp,
+                          loss_fn=lm_loss, aux_params=aux,
+                          pre_fn=embed_fn, n_micro=2, data_axis="data",
+                          learning_rate=0.3, momentum=0.9)
+    rng_pp = np.random.default_rng(0)
+    xt_global = rng_pp.integers(0, 11, (8, 8)).astype(np.int32)
+    yt_global = (xt_global + 1) % 11
+    # the batch dim shards over "data" (2 rows), each row spanning 2
+    # processes: this process feeds its DATA ROW's slice (row-mates feed
+    # identical copies — make_array_from_process_local_data semantics)
+    my_rows = [r for r in range(devices.shape[0])
+               if any(d.process_index == proc_id for d in devices[r])]
+    assert len(my_rows) == 1
+    per_row = 8 // devices.shape[0]
+    sl_pp = slice(my_rows[0] * per_row, (my_rows[0] + 1) * per_row)
+    first_pp = pp.fit_batch(xt_global[sl_pp], yt_global[sl_pp])
+    for _ in range(14):
+        last_pp = pp.fit_batch(xt_global[sl_pp], yt_global[sl_pp])
+    assert np.isfinite(last_pp) and last_pp < first_pp, (first_pp, last_pp)
+
     print(f"RESULT {proc_id} tp={sum_a:.10f} tm={sum_b:.10f} "
-          f"score={float(net_b._score):.10f}", flush=True)
+          f"score={float(net_b._score):.10f} pp={last_pp:.10f}", flush=True)
 
 
 if __name__ == "__main__":

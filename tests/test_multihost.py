@@ -77,10 +77,11 @@ def test_four_process_model_axis_and_training_master():
     for out in outs:
         for line in out.splitlines():
             if line.startswith("RESULT"):
-                _, pid, tp, tm, sc = line.split()
-                results[int(pid)] = (tp, tm, sc)
+                _, pid, tp, tm, sc, pp = line.split()
+                results[int(pid)] = (tp, tm, sc, pp)
     assert set(results) == {0, 1, 2, 3}, f"missing results: {outs}"
-    # every process holds identical parameters after both paths
+    # every process holds identical parameters after all paths (incl. the
+    # cross-process GPipe loss, replicated by the pipeline's masked psum)
     assert len({r for r in results.values()}) == 1
     vals = [float(v.split("=")[1]) for v in results[0]]
     assert all(np.isfinite(v) for v in vals)
