@@ -43,35 +43,37 @@ except Exception:  # pragma: no cover
 NEG_INF = float("-inf")
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-            scale, causal, block_q, block_k):
+def _online_softmax_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, *,
+                         scale, causal, block_q, block_k, q_start, k_start,
+                         neg):
+    """The shared flash-attention grid step: init scratch at the first kv
+    block, fold this (q-block, kv-block) pair into the running (m, l, acc)
+    with the online softmax, skipping kv blocks entirely above the causal
+    diagonal. `q_start`/`k_start` are GLOBAL positions (plain grid offsets
+    for single-device attention; SMEM-prefetched chunk offsets for the
+    ring-attention partial). `neg` is the masked-score constant (-inf for
+    the normalized kernel; a finite stand-in for partials so ring folding
+    of never-attended rows stays NaN-free)."""
     ik = pl.program_id(2)
-    nk = pl.num_programs(2)
 
     @pl.when(ik == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        m_ref[:] = jnp.full_like(m_ref, neg)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    iq = pl.program_id(1)
-    q_start = iq * block_q
-    k_start = ik * block_k
 
     def compute():
         # native-dtype (bf16) MXU matmuls with f32 accumulation — an f32
         # cast before the dot would quarter the MXU rate
-        q = q_ref[0]                                   # [bq, d]
-        k = k_ref[0]                                   # [bk, d]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
         if causal:
             row = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             col = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(row >= col, s, NEG_INF)
+            s = jnp.where(row >= col, s, neg)
         m_prev = m_ref[:, :1]                          # [bq, 1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)
@@ -90,7 +92,16 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     else:
         compute()
 
-    @pl.when(ik == nk - 1)
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+            scale, causal, block_q, block_k):
+    _online_softmax_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+                         scale=scale, causal=causal, block_q=block_q,
+                         block_k=block_k,
+                         q_start=pl.program_id(1) * block_q,
+                         k_start=pl.program_id(2) * block_k, neg=NEG_INF)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
     def _emit():
         o_ref[0] = (acc_ref[:] /
                     jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
@@ -135,6 +146,81 @@ def _flash_fwd_bthd(q, k, v, causal, scale, block_q, block_k, interpret):
         interpret=interpret,
         **extra,
     )(q, k, v)
+
+
+_FINITE_NEG = -1e30   # finite -inf stand-in: keeps exp(m - m_new) NaN-free
+#                       for rows that have seen no keys yet (ring warm-up)
+
+
+def _partial_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, mo_ref,
+                    lo_ref, m_ref, l_ref, acc_ref, *, scale, causal,
+                    block_q, block_k):
+    """Like `_kernel` but emits UNNORMALIZED (acc, m, l) so a ring-attention
+    hop can fold partials across devices; causal masking uses the global
+    offsets prefetched in SMEM (qo/ko: this chunk's global positions)."""
+    _online_softmax_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+                         scale=scale, causal=causal, block_q=block_q,
+                         block_k=block_k,
+                         q_start=pl.program_id(1) * block_q + qo_ref[0],
+                         k_start=pl.program_id(2) * block_k + ko_ref[0],
+                         neg=_FINITE_NEG)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[0] = acc_ref[:]
+        mo_ref[0] = jnp.broadcast_to(m_ref[:, :1], mo_ref.shape[1:])
+        lo_ref[0] = jnp.broadcast_to(l_ref[:, :1], lo_ref.shape[1:])
+
+
+def flash_attention_partial(q, k, v, q_off, k_off, causal=True, scale=None,
+                            block_q=1024, block_k=1024, interpret=None):
+    """Unnormalized flash partials for ring attention's per-hop compute.
+
+    q [BH, Tq, d]; k, v [BH, Tk, d]; q_off/k_off: traced int32 scalars —
+    the global sequence offset of this q chunk / visiting kv chunk (causal
+    masking across devices). Returns (acc [BH,Tq,d] f32, m [BH,Tq,1] f32,
+    l [BH,Tq,1] f32) for `_flash_fold`-style merging across hops."""
+    BH, Tq, d = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bq = _divisor_block(Tq, block_q)
+    bk = _divisor_block(Tk, block_k)
+    if pltpu is None:
+        raise NotImplementedError("pallas TPU backend unavailable")
+    grid = (BH, Tq // bq, Tk // bk)
+    kw = {"memory_space": _VMEM} if _VMEM is not None else {}
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **kw)
+    kv_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), **kw)
+    o_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **kw)
+    ml_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), **kw)
+    kernel = functools.partial(_partial_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk)
+    extra = {}
+    if not interpret:
+        extra["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[smem, smem, q_spec, kv_spec, kv_spec],
+        out_specs=[o_spec, ml_spec, ml_spec],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tq, d), jnp.float32),
+                   jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+        **extra,
+    )(jnp.asarray(q_off, jnp.int32).reshape(1),
+      jnp.asarray(k_off, jnp.int32).reshape(1), q, k, v)
+    return acc, m[..., 0], l[..., 0]
 
 
 def _divisor_block(T, requested):

@@ -46,24 +46,32 @@ def _flash_fold(o, m, l, s, v):
 
 
 def ring_attention_kernel(q, k, v, kv_mask, axis_name, causal=False,
-                          scale=None):
+                          scale=None, use_flash=False):
     """Per-device ring attention body (run under shard_map).
 
     q,k,v: [B, T_local, H, D] — this device's sequence chunk.
     kv_mask: [B, T_local] validity of this chunk's keys (rotates with K/V).
     Rotates K/V around `axis_name` N times, folding each block with the
     running-softmax accumulators. Causal masking uses global chunk offsets.
+
+    use_flash: compute each hop's partial with the Pallas flash kernel
+    (`ops/flash_attention.flash_attention_partial`) instead of the einsum
+    block — the full long-context stack: sequence parallelism across
+    devices x flash attention within each device. Requires an all-ones
+    kv_mask (ring_self_attention enforces this).
     """
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)
-    q = q * scale
+    acc_dt = jnp.float32 if use_flash else q.dtype
+    if not use_flash:
+        q = q * scale
 
-    o0 = jnp.zeros((B, H, Tq, D), q.dtype)
-    m0 = jnp.full((B, H, Tq), NEG_INF, q.dtype)
-    l0 = jnp.zeros((B, H, Tq), q.dtype)
+    o0 = jnp.zeros((B, H, Tq, D), acc_dt)
+    m0 = jnp.full((B, H, Tq), NEG_INF, acc_dt)
+    l0 = jnp.zeros((B, H, Tq), acc_dt)
     if hasattr(lax, "pvary"):
         # constants start replicated under shard_map; the loop carry becomes
         # axis-varying, so mark the initial accumulators varying too
@@ -71,20 +79,38 @@ def ring_attention_kernel(q, k, v, kv_mask, axis_name, causal=False,
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     qpos = my * Tq + jnp.arange(Tq)                    # global q positions
+    q_flat = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
 
     def body(i, carry):
         o, m, l, k_blk, v_blk, km_blk = carry
         src = (my - i) % n                             # origin chunk of k_blk
-        kpos = src * Tq + jnp.arange(Tq)
-        if causal:
-            bias = jnp.where(qpos[:, None] >= kpos[None, :], 0.0, NEG_INF)
+        if use_flash:
+            from ..ops.flash_attention import flash_attention_partial
+            flat = lambda a: a.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+            acc_b, m_b, l_b = flash_attention_partial(
+                q_flat, flat(k_blk), flat(v_blk), my * Tq, src * Tq,
+                causal=causal, scale=scale)
+            acc_b = acc_b.reshape(B, H, Tq, D)
+            m_b = m_b.reshape(B, H, Tq)
+            l_b = l_b.reshape(B, H, Tq)
+            m_new = jnp.maximum(m, m_b)
+            a_run = jnp.exp(m - m_new)
+            a_blk = jnp.exp(m_b - m_new)
+            o = o * a_run[..., None] + acc_b * a_blk[..., None]
+            l = l * a_run + l_b * a_blk
+            m = m_new
         else:
-            bias = jnp.zeros((Tq, Tq))
-        s = _attend_block(q, k_blk, v_blk, bias.astype(q.dtype))
-        # invalid keys: -inf for every query, per batch element
-        s = s + jnp.where(km_blk > 0, 0.0,
-                          NEG_INF)[:, None, None, :].astype(q.dtype)
-        o, m, l = _flash_fold(o, m, l, s, v_blk)
+            kpos = src * Tq + jnp.arange(Tq)
+            if causal:
+                bias = jnp.where(qpos[:, None] >= kpos[None, :], 0.0,
+                                 NEG_INF)
+            else:
+                bias = jnp.zeros((Tq, Tq))
+            s = _attend_block(q, k_blk, v_blk, bias.astype(q.dtype))
+            # invalid keys: -inf for every query, per batch element
+            s = s + jnp.where(km_blk > 0, 0.0,
+                              NEG_INF)[:, None, None, :].astype(q.dtype)
+            o, m, l = _flash_fold(o, m, l, s, v_blk)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         km_blk = lax.ppermute(km_blk, axis_name, perm)
@@ -92,7 +118,7 @@ def ring_attention_kernel(q, k, v, kv_mask, axis_name, causal=False,
 
     o, m, l, _, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v, kv_mask))
     out = o / jnp.maximum(l, 1e-30)[..., None]         # [B,H,Tq,D]
-    return jnp.transpose(out, (0, 2, 1, 3))            # [B,Tq,H,D]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Tq,H,D]
 
 
 def blockwise_attention(q, k, v, kv_mask=None, causal=False, scale=None):
@@ -115,20 +141,54 @@ def blockwise_attention(q, k, v, kv_mask=None, causal=False, scale=None):
 
 
 def ring_self_attention(q, k, v, mesh, axis="seq", causal=False,
-                        kv_mask=None):
+                        kv_mask=None, use_flash=False):
     """Sequence-parallel attention over `mesh[axis]`.
 
     q,k,v: GLOBAL [B,T,H,D] arrays (or already sharded); T must divide by
-    the axis size. kv_mask: [B,T] key validity. Returns global [B,T,H,D]."""
+    the axis size. kv_mask: [B,T] key validity. Returns global [B,T,H,D].
+    use_flash: per-hop compute via the Pallas flash kernel (kv_mask not
+    supported on that path)."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
+    if use_flash and kv_mask is not None:
+        raise ValueError("use_flash does not support kv_mask; pad-free "
+                         "sequences only")
     if kv_mask is None:
         kv_mask = jnp.ones(q.shape[:2], q.dtype)
     spec = P(None, axis, None, None)
     mspec = P(None, axis)
-    fn = shard_map(
-        functools.partial(ring_attention_kernel, axis_name=axis,
-                          causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec)
-    return fn(q, k, v, kv_mask)
+
+    def build(flash):
+        extra = {}
+        if flash:
+            # pallas_call outputs carry no vma annotation; disable the
+            # check for the kernel path (the einsum path keeps it)
+            extra["check_vma"] = False
+        return shard_map(
+            functools.partial(ring_attention_kernel, axis_name=axis,
+                              causal=causal, use_flash=flash),
+            mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
+            **extra)
+
+    if not use_flash:
+        return build(False)(q, k, v, kv_mask)
+
+    # The Pallas partial kernel has no VJP; differentiate the flash path
+    # by recomputing the backward through the (identical-math) einsum
+    # ring — forward stays on the kernel, training still works.
+    @jax.custom_vjp
+    def rsa(q, k, v):
+        return build(True)(q, k, v, kv_mask)
+
+    def rsa_fwd(q, k, v):
+        return rsa(q, k, v), (q, k, v)
+
+    def rsa_bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(lambda q, k, v: build(False)(q, k, v, kv_mask),
+                         q, k, v)
+        return vjp(g)
+
+    rsa.defvjp(rsa_fwd, rsa_bwd)
+    return rsa(q, k, v)

@@ -138,3 +138,55 @@ class TestSelfAttentionLayer:
         layer_sp.with_sequence_parallel(_seq_mesh(4), "seq")
         out_sp = np.asarray(layer_sp.forward(params, x))
         assert np.allclose(out_local, out_sp, atol=1e-5)
+
+
+class TestRingFlashPath:
+    """use_flash=True: per-hop compute via the Pallas partial kernel
+    (interpreter on CPU, Mosaic on TPU) — the full long-context stack
+    (sequence parallelism x flash attention)."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal):
+        q, k, v = _qkv(T=32, seed=3)
+        mesh = _seq_mesh(4)
+        full = blockwise_attention(q, k, v, causal=causal)
+        ring = ring_self_attention(q, k, v, mesh, axis="seq",
+                                   causal=causal, use_flash=True)
+        assert np.allclose(np.asarray(full), np.asarray(ring), atol=1e-5), \
+            np.abs(np.asarray(full) - np.asarray(ring)).max()
+
+    def test_eight_device_ring(self):
+        q, k, v = _qkv(T=64, seed=4)
+        mesh = _seq_mesh(8)
+        full = blockwise_attention(q, k, v, causal=True)
+        ring = ring_self_attention(q, k, v, mesh, axis="seq", causal=True,
+                                   use_flash=True)
+        assert np.allclose(np.asarray(full), np.asarray(ring), atol=1e-5)
+
+    def test_kv_mask_rejected(self):
+        q, k, v = _qkv(seed=5)
+        mesh = _seq_mesh(4)
+        with pytest.raises(ValueError):
+            ring_self_attention(q, k, v, mesh, axis="seq", use_flash=True,
+                                kv_mask=jnp.ones(q.shape[:2]))
+
+    def test_flash_path_differentiable(self):
+        """use_flash trains: grads come from the einsum-ring recompute VJP
+        and match the einsum path's grads."""
+        q, k, v = _qkv(T=32, seed=6)
+        mesh = _seq_mesh(4)
+
+        def loss_flash(q, k, v):
+            return jnp.mean(ring_self_attention(
+                q, k, v, mesh, axis="seq", causal=True,
+                use_flash=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.mean(ring_self_attention(
+                q, k, v, mesh, axis="seq", causal=True) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
