@@ -28,7 +28,7 @@ _lib_lock = threading.Lock()
 _build_attempted = False
 
 
-_ABI_VERSION = 2  # must match dl4j_abi_version() in dl4j_tpu_native.cpp
+_ABI_VERSION = 3  # must match dl4j_abi_version() in dl4j_tpu_native.cpp
 
 
 def _try_build(force=False):
@@ -91,6 +91,11 @@ def get_lib():
         lib.dl4j_pool_stats.restype = ctypes.c_int64
         lib.dl4j_pool_stats.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.dl4j_pool_destroy.argtypes = [ctypes.c_void_p]
+        lib.dl4j_skipgram_pairs.restype = ctypes.c_int64
+        lib.dl4j_skipgram_pairs.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
         _lib = lib
         return _lib
 
@@ -143,6 +148,30 @@ def parse_csv(path, delimiter=",", skip_lines=0):
         rows.value, cols.value).copy()
     lib.dl4j_free(ptr)
     return arr
+
+
+def skipgram_pairs(ids, offsets, window, seed):
+    """Corpus-level word2vec reduced-window pair generation in C++
+    (the host half of the reference's native AggregateSkipGram path).
+
+    ids: int32 concatenated tokens; offsets: int64 [n_seq+1]; returns
+    (centers, outs) int32 arrays, or None when the library is missing
+    (caller uses the vectorized numpy path)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    ids = np.ascontiguousarray(ids, np.int32)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    cap = int(ids.shape[0]) * 2 * int(window)
+    centers = np.empty(cap, np.int32)
+    outs = np.empty(cap, np.int32)
+    n = lib.dl4j_skipgram_pairs(
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        int(offsets.shape[0]) - 1, int(window), int(seed) & (2**64 - 1),
+        centers.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        outs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return centers[:n], outs[:n]
 
 
 class StagingBufferPool:

@@ -285,6 +285,30 @@ class SkipGram(BaseElementsLearning):
         self.enqueue_pairs(ids_arr[pos_idx], ids_arr[j[pos_idx, off_idx]],
                            lr)
 
+    def learn_sequences_batch(self, seqs_ids, lr):
+        """Corpus-chunk fast path: generate pairs for MANY sequences in one
+        native call (C++ `dl4j_skipgram_pairs` — the host half of the
+        reference's native AggregateSkipGram, SkipGram.java:258) at a
+        single lr. Falls back to the vectorized per-sequence path when the
+        native library is unavailable. Same reduced-window b ~ U[1, w]
+        semantics; the native path draws b from its own deterministic
+        xorshift stream seeded off this instance's rng."""
+        from ...common import native_ops
+        seqs_ids = [s for s in seqs_ids if len(s) >= 2]
+        if not seqs_ids:
+            return
+        ids = np.concatenate([np.asarray(s, np.int32) for s in seqs_ids])
+        offsets = np.zeros(len(seqs_ids) + 1, np.int64)
+        np.cumsum([len(s) for s in seqs_ids], out=offsets[1:])
+        res = native_ops.skipgram_pairs(
+            ids, offsets, self.window, seed=int(self._rng.integers(2**63)))
+        if res is None:
+            for s in seqs_ids:
+                self.learn_sequence(s, lr)
+            return
+        centers, outs = res
+        self.enqueue_pairs(centers, outs, lr)
+
     def enqueue_pairs(self, centers, outs, lr):
         """Queue (center, predicted) index arrays for the batched kernel —
         the buffer format is private to this class; external pair sources

@@ -100,10 +100,28 @@ class SequenceVectors:
         total_words = max(self.vocab.total_word_count * self.epochs
                           * self.iterations, 1)
         words_seen = 0
+        # corpus-chunk fast path: hand CHUNK sequences to the native pair
+        # generator per call (lr decays per chunk instead of per sequence —
+        # the reference's per-batch alpha behaves the same way)
+        use_batch = (self.iterations == 1
+                     and hasattr(algo, "learn_sequences_batch"))
+        CHUNK = 256
         for _epoch in range(self.epochs):
+            pending, pending_words = [], 0
             for seq in get_sequences():
                 ids = self._sequence_ids(seq)
                 if not ids:
+                    continue
+                if use_batch:
+                    pending.append(ids)
+                    pending_words += len(ids)
+                    if len(pending) >= CHUNK:
+                        frac = min(words_seen / total_words, 1.0)
+                        lr = max(self.min_learning_rate,
+                                 self.learning_rate * (1.0 - frac))
+                        algo.learn_sequences_batch(pending, lr)
+                        words_seen += pending_words
+                        pending, pending_words = [], 0
                     continue
                 for _ in range(self.iterations):
                     frac = min(words_seen / total_words, 1.0)
@@ -111,6 +129,12 @@ class SequenceVectors:
                              self.learning_rate * (1.0 - frac))
                     algo.learn_sequence(ids, lr)
                     words_seen += len(ids)
+            if pending:
+                frac = min(words_seen / total_words, 1.0)
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1.0 - frac))
+                algo.learn_sequences_batch(pending, lr)
+                words_seen += pending_words
         algo.finish()
         return self
 

@@ -24,7 +24,7 @@ extern "C" {
 
 // Bumped whenever an exported signature changes; the Python loader refuses
 // (and rebuilds) a library whose version doesn't match.
-int64_t dl4j_abi_version() { return 2; }
+int64_t dl4j_abi_version() { return 3; }
 
 // ---------------------------------------------------------------------------
 // IDX parsing (reference: datasets/mnist/MnistImageFile binary reader)
@@ -160,6 +160,58 @@ float* dl4j_parse_csv(const char* path, char delim, int64_t skip_lines,
   memcpy(out, values.data(), values.size() * sizeof(float));
   *rows_out = rows;
   *cols_out = cols;
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Word2Vec skip-gram pair generation (reference role: the host half of
+// libnd4j's AggregateSkipGram — SkipGram.java:258 builds native batch ops;
+// here the TPU kernel consumes (center, context) index arrays and this
+// generates them corpus-at-a-time, removing the per-sequence Python loop)
+// ---------------------------------------------------------------------------
+
+// xorshift64*: tiny deterministic PRNG for the reduced-window draw
+static inline uint64_t xs64(uint64_t* s) {
+  uint64_t x = *s;
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  *s = x;
+  return x * 0x2545F4914F6CDD1DULL;
+}
+
+// word2vec reduced-window pair generation over a whole corpus.
+// ids: concatenated sequence tokens (vocab indices, int32).
+// offsets: int64[n_seq + 1], sequence s spans ids[offsets[s]:offsets[s+1]].
+// Per position i a reduced window b ~ U[1, window] is drawn; pairs
+// (ids[i], ids[j]) are emitted for j in [i-b, i+b], j != i, clipped to the
+// sequence. centers_out/outs_out must hold offsets[n_seq] * 2 * window
+// entries (the worst case). Returns the number of pairs written.
+int64_t dl4j_skipgram_pairs(const int32_t* ids, const int64_t* offsets,
+                            int64_t n_seq, int32_t window, uint64_t seed,
+                            int32_t* centers_out, int32_t* outs_out) {
+  if (window <= 0) return 0;
+  uint64_t state = seed ? seed : 0x9E3779B97F4A7C15ULL;
+  int64_t out = 0;
+  for (int64_t s = 0; s < n_seq; ++s) {
+    const int64_t lo = offsets[s], hi = offsets[s + 1];
+    if (hi - lo < 2) {
+      // match the vectorized fallback: sequences shorter than 2 draw no b
+      continue;
+    }
+    for (int64_t i = lo; i < hi; ++i) {
+      const int64_t b = 1 + (int64_t)(xs64(&state) % (uint64_t)window);
+      const int64_t j0 = i - b < lo ? lo : i - b;
+      const int64_t j1 = i + b >= hi ? hi - 1 : i + b;
+      const int32_t c = ids[i];
+      for (int64_t j = j0; j <= j1; ++j) {
+        if (j == i) continue;
+        centers_out[out] = c;
+        outs_out[out] = ids[j];
+        ++out;
+      }
+    }
+  }
   return out;
 }
 
