@@ -323,6 +323,26 @@ class EarlyStoppingTrainer:
         self.net = net
         self.train_iterator = train_iterator
 
+    def _fit_batch(self, ds):
+        """Per-batch hook: how one training batch is executed (the
+        ParallelWrapper trainer routes this through the sharded step)."""
+        self.net.fit(ds)
+
+    @staticmethod
+    def _check_iteration_termination(c, last):
+        """Shared iteration-termination check + NaN divergence guard
+        (reference InvalidScoreIterationTerminationCondition role).
+        Returns (reason, details) or None."""
+        import math
+        if math.isnan(last):
+            return (EarlyStoppingResult.TerminationReason
+                    .IterationTerminationCondition, "score is NaN")
+        for t in c.iteration_terminations:
+            if t.terminate(last):
+                return (EarlyStoppingResult.TerminationReason
+                        .IterationTerminationCondition, str(t))
+        return None
+
     def _fit_epoch(self, c):
         """Template method: train one epoch, checking iteration
         terminations; returns (reason, details) on termination else None.
@@ -330,12 +350,11 @@ class EarlyStoppingTrainer:
         self.train_iterator.reset()
         while self.train_iterator.has_next():
             ds = self.train_iterator.next_batch()
-            self.net.fit(ds)
-            last = self.net.score()
-            for t in c.iteration_terminations:
-                if t.terminate(last):
-                    return (EarlyStoppingResult.TerminationReason
-                            .IterationTerminationCondition, str(t))
+            self._fit_batch(ds)
+            stop = self._check_iteration_termination(c,
+                                                     float(self.net.score()))
+            if stop is not None:
+                return stop
         return None
 
     def fit(self):

@@ -1,5 +1,6 @@
 from . import distributed
-from .early_stopping import (MasterDataSetLossCalculator,
+from .early_stopping import (EarlyStoppingParallelTrainer,
+                             MasterDataSetLossCalculator,
                              SparkEarlyStoppingTrainer,
                              TpuEarlyStoppingTrainer)
 from .magic_queue import MagicQueue
@@ -17,7 +18,8 @@ from .training_master import (ParameterAveragingTrainingMaster,
                               TpuComputationGraph, TpuDl4jMultiLayer,
                               TrainingMasterStats)
 
-__all__ = ["GradientsAccumulator", "MagicQueue", "PipelineParallel",
+__all__ = ["EarlyStoppingParallelTrainer",
+           "GradientsAccumulator", "MagicQueue", "PipelineParallel",
            "gpipe", "make_pipeline_mesh", "init_moe", "make_expert_mesh",
            "moe_mlp_dense", "moe_mlp_sharded", "shard_moe_params",
            "MasterDataSetLossCalculator", "NTPTimeSource", "ParallelWrapper",

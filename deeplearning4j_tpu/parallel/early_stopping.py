@@ -10,8 +10,6 @@ cluster-shaped (the template-method seam `_fit_epoch`).
 """
 from __future__ import annotations
 
-import math
-
 from ..earlystopping.early_stopping import (DataSetLossCalculator,
                                             EarlyStoppingResult,
                                             EarlyStoppingTrainer)
@@ -42,19 +40,43 @@ class TpuEarlyStoppingTrainer(EarlyStoppingTrainer):
     def _fit_epoch(self, c):
         """One epoch = one execute_training pass. Iteration terminations are
         checked at split-result granularity (the reference checks per
-        averaging round on the driver); a NaN score terminates regardless
-        of configured conditions (divergence guard, reference
-        InvalidScoreIterationTerminationCondition role)."""
+        averaging round on the driver); the shared check includes the NaN
+        divergence guard."""
         self.master.execute_training(self.net, self.data)
-        last = float(self.net.score())
-        if math.isnan(last):
-            return (EarlyStoppingResult.TerminationReason
-                    .IterationTerminationCondition, "score is NaN")
-        for t in c.iteration_terminations:
-            if t.terminate(last):
-                return (EarlyStoppingResult.TerminationReason
-                        .IterationTerminationCondition, str(t))
-        return None
+        return self._check_iteration_termination(c, float(self.net.score()))
 
 
 SparkEarlyStoppingTrainer = TpuEarlyStoppingTrainer   # reference name
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping over the multi-device ParallelWrapper — reference
+    deeplearning4j-scaleout-parallelwrapper
+    parallelism/EarlyStoppingParallelTrainer.java:46 (the reference wraps
+    replicas+averaging around the model and routes scores back through a
+    listener; here the sharded GSPMD step IS the wrapper).
+
+    averaging_frequency == 1: the inherited epoch loop feeds batches
+    through the sharded step one at a time (per-batch termination checks).
+    averaging_frequency k > 1: the whole epoch iterator goes to
+    `ParallelWrapper.fit` in one call so the k-local-steps batching
+    actually forms k-batch groups; terminations are then checked once per
+    epoch (the reference's per-averaging-round granularity)."""
+
+    def __init__(self, es_conf, net, train_iterator, workers=None,
+                 averaging_frequency=1, tensor_parallel=False, mesh=None):
+        super().__init__(es_conf, net, train_iterator)
+        from .parallel_wrapper import ParallelWrapper
+        self.wrapper = ParallelWrapper(
+            net, workers=workers, averaging_frequency=averaging_frequency,
+            tensor_parallel=tensor_parallel, mesh=mesh)
+
+    def _fit_batch(self, ds):
+        self.wrapper.fit(ds)
+
+    def _fit_epoch(self, c):
+        if self.wrapper.averaging_frequency == 1:
+            return super()._fit_epoch(c)
+        self.train_iterator.reset()
+        self.wrapper.fit(self.train_iterator)
+        return self._check_iteration_termination(c, float(self.net.score()))

@@ -192,3 +192,84 @@ class TestZeroShardedUpdaterState:
         with pytest.raises(ValueError):
             (ParallelWrapper.Builder(net).workers(8)
              .sharded_updater_state(True).averaging_frequency(4).build())
+
+
+def _cli_iterator():
+    """Factory target for the ParallelWrapperMain CLI test."""
+    x, y = blob_data(n=64, seed=3)
+    return ListDataSetIterator(DataSet(x, y), 32)
+
+
+class TestEarlyStoppingParallelTrainer:
+    def test_early_stops_over_parallel_wrapper(self, tmp_path):
+        from deeplearning4j_tpu.earlystopping.early_stopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            LocalFileModelSaver, MaxEpochsTerminationCondition)
+        from deeplearning4j_tpu.parallel.early_stopping import \
+            EarlyStoppingParallelTrainer
+        net = make_net(seed=3)
+        x, y = blob_data(n=128, seed=1)
+        train_it = ListDataSetIterator(DataSet(x, y), 32)
+        es = (EarlyStoppingConfiguration.Builder()
+              .model_saver(LocalFileModelSaver(str(tmp_path)))
+              .score_calculator(DataSetLossCalculator(
+                  ListDataSetIterator(DataSet(x, y), 64)))
+              .epoch_termination_conditions(
+                  MaxEpochsTerminationCondition(4))
+              .build())
+        trainer = EarlyStoppingParallelTrainer(es, net, train_it, workers=8)
+        result = trainer.fit()
+        assert result.total_epochs <= 5
+        assert result.get_best_model() is not None
+        assert np.isfinite(result.best_model_score)
+
+
+class TestParallelWrapperMain:
+    def test_cli_trains_and_saves(self, tmp_path):
+        """Full CLI path in-process: guessed model load -> ParallelWrapper
+        training via an iterator factory -> serialized output model."""
+        from deeplearning4j_tpu.parallel.main import run
+        from deeplearning4j_tpu.util.model_serializer import (
+            restore_multi_layer_network, write_model)
+        net = make_net(seed=9)
+        src = str(tmp_path / "in.zip")
+        dst = str(tmp_path / "out.zip")
+        write_model(net, src, save_updater=True)
+        x, y = blob_data(n=64, seed=3)
+        s0 = make_net(seed=9).score(DataSet(x, y))
+        trained = run([
+            "--model-path", src,
+            "--iterator-factory", "tests.test_parallel:_cli_iterator",
+            "--workers", "8", "--epochs", "6", "--report-score",
+            "--model-output-path", dst,
+        ])
+        assert trained.score(DataSet(x, y)) < s0
+        restored = restore_multi_layer_network(dst)
+        np.testing.assert_allclose(restored.params(), trained.params(),
+                                   rtol=1e-6)
+
+    def test_kstep_averaging_mode_forms_groups(self, tmp_path):
+        """averaging_frequency>1 must route the WHOLE epoch iterator
+        through ParallelWrapper so k-batch groups actually form."""
+        from deeplearning4j_tpu.earlystopping.early_stopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            LocalFileModelSaver, MaxEpochsTerminationCondition)
+        from deeplearning4j_tpu.parallel.early_stopping import \
+            EarlyStoppingParallelTrainer
+        net = make_net(seed=5)
+        x, y = blob_data(n=128, seed=2)
+        train_it = ListDataSetIterator(DataSet(x, y), 16)  # 8 batches
+        es = (EarlyStoppingConfiguration.Builder()
+              .model_saver(LocalFileModelSaver(str(tmp_path)))
+              .score_calculator(DataSetLossCalculator(
+                  ListDataSetIterator(DataSet(x, y), 64)))
+              .epoch_termination_conditions(
+                  MaxEpochsTerminationCondition(3))
+              .build())
+        trainer = EarlyStoppingParallelTrainer(
+            es, net, train_it, workers=8, averaging_frequency=4)
+        result = trainer.fit()
+        assert result.get_best_model() is not None
+        # 3 epochs x 8 batches in k=4 groups -> iteration_count advanced
+        # by k per group: 8 per epoch
+        assert net.conf.iteration_count == 3 * 8
