@@ -330,13 +330,16 @@ class EarlyStoppingTrainer:
 
     @staticmethod
     def _check_iteration_termination(c, last):
-        """Shared iteration-termination check + NaN divergence guard
-        (reference InvalidScoreIterationTerminationCondition role).
-        Returns (reason, details) or None."""
-        import math
-        if math.isnan(last):
+        """Shared iteration-termination check + divergence guard: a
+        non-finite score (NaN or +/-Inf) always terminates — the
+        reference InvalidScoreIterationTerminationCondition role, applied
+        unconditionally here because a non-finite score can never recover
+        information for best-model selection. Returns (reason, details)
+        or None."""
+        if not math.isfinite(last):
             return (EarlyStoppingResult.TerminationReason
-                    .IterationTerminationCondition, "score is NaN")
+                    .IterationTerminationCondition,
+                    f"score is non-finite ({last})")
         for t in c.iteration_terminations:
             if t.terminate(last):
                 return (EarlyStoppingResult.TerminationReason

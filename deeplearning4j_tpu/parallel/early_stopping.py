@@ -77,6 +77,5 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
     def _fit_epoch(self, c):
         if self.wrapper.averaging_frequency == 1:
             return super()._fit_epoch(c)
-        self.train_iterator.reset()
-        self.wrapper.fit(self.train_iterator)
+        self.wrapper.fit(self.train_iterator)   # fit() resets the iterator
         return self._check_iteration_termination(c, float(self.net.score()))

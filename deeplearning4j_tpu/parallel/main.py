@@ -75,8 +75,7 @@ def run(argv=None):
         averaging_frequency=args.averaging_frequency,
         average_updaters=not args.no_average_updaters,
         tensor_parallel=args.tensor_parallel)
-    for epoch in range(args.epochs):
-        it.reset()
+    for epoch in range(args.epochs):   # fit() resets the iterator
         pw.fit(it)
         if args.report_score:
             print(f"epoch {epoch}: score={float(net.score()):.6f}",

@@ -1,0 +1,26 @@
+"""Shared example setup.
+
+Examples default to the CPU backend with a virtual 8-device mesh so every
+script runs anywhere (several demonstrate multi-device parallelism). Set
+DL4J_EXAMPLES_HW=1 to use whatever accelerator the environment configures
+instead (single-accelerator hosts can't run the mesh examples).
+"""
+import os
+
+if not os.environ.get("DL4J_EXAMPLES_HW"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# a sitecustomize may have pinned a hardware platform before env vars are
+# read; the config update wins (same pattern as tests/conftest.py)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

@@ -1,0 +1,45 @@
+"""Character LSTM: GravesLSTM + RnnOutputLayer trained with truncated BPTT
+on a toy shift task, then streamed generation via `rnn_time_step`.
+
+(reference pattern: dl4j-examples GravesLSTMCharModellingExample)
+"""
+import _common  # noqa: F401
+
+import numpy as np
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.layers import (GravesLSTM, RnnOutputLayer)
+
+V, B, T = 12, 16, 32
+conf = (NeuralNetConfiguration.Builder()
+        .seed(12).updater("adam").learning_rate(5e-3)
+        .list()
+        .layer(0, GravesLSTM(n_out=48, activation="tanh"))
+        .layer(1, RnnOutputLayer(n_out=V, activation="softmax",
+                                 loss_function="mcxent"))
+        .set_input_type(InputType.recurrent(V))
+        .backprop_type("tbptt").t_bptt_forward_length(16)
+        .build())
+net = MultiLayerNetwork(conf).init()
+
+rng = np.random.default_rng(0)
+ids = rng.integers(0, V, (B, T))
+x = np.eye(V, dtype=np.float32)[ids]          # [B, T, V] one-hot
+y = np.eye(V, dtype=np.float32)[(ids + 1) % V]
+ds = DataSet(x, y)
+for epoch in range(60):
+    net.fit(ds)
+print("final score:", float(net.score(ds)))
+
+# streamed generation, one step at a time (state carried inside)
+net.rnn_clear_previous_state()
+step = np.eye(V, dtype=np.float32)[[3]][:, None, :]   # [1, 1, V]
+seq = [3]
+for _ in range(8):
+    out = net.rnn_time_step(step)                     # [1, 1, V]
+    nxt = int(out[0, -1].argmax())
+    seq.append(nxt)
+    step = np.eye(V, dtype=np.float32)[[nxt]][:, None, :]
+print("greedy rollout from 3:", seq)

@@ -1,0 +1,60 @@
+"""Early stopping + training UI: StatsListener streams per-iteration stats
+into a storage backend served by the web UI while an early-stopping
+trainer drives the run and keeps the best checkpoint.
+
+(reference pattern: dl4j-examples EarlyStoppingMNIST + UIExample)
+"""
+import _common  # noqa: F401
+
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.earlystopping.early_stopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration,
+    EarlyStoppingTrainer, LocalFileModelSaver,
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener, UIServer
+
+conf = (NeuralNetConfiguration.Builder()
+        .seed(7).updater("adam").learning_rate(5e-3)
+        .list()
+        .layer(0, DenseLayer(n_out=32, activation="relu"))
+        .layer(1, OutputLayer(n_out=3, activation="softmax",
+                              loss_function="mcxent"))
+        .set_input_type(InputType.feed_forward(4))
+        .build())
+net = MultiLayerNetwork(conf).init()
+
+storage = InMemoryStatsStorage()
+net.set_listeners(StatsListener(storage, session_id="example"))
+server = UIServer(port=0).attach(storage)
+print(f"UI live at http://127.0.0.1:{server.port} "
+      f"(overview/model/histograms/flow/system)")
+
+rng = np.random.default_rng(0)
+centers = rng.normal(0, 3, (3, 4))
+c = rng.integers(0, 3, 256)
+x = (centers[c] + rng.normal(0, 0.5, (256, 4))).astype(np.float32)
+y = np.eye(3, dtype=np.float32)[c]
+
+savedir = tempfile.mkdtemp()
+es = (EarlyStoppingConfiguration.Builder()
+      .model_saver(LocalFileModelSaver(savedir))
+      .score_calculator(DataSetLossCalculator(
+          ListDataSetIterator(DataSet(x, y), 128)))
+      .epoch_termination_conditions(
+          MaxEpochsTerminationCondition(30),
+          ScoreImprovementEpochTerminationCondition(5))
+      .build())
+result = EarlyStoppingTrainer(es, net,
+                              ListDataSetIterator(DataSet(x, y), 64)).fit()
+print(result)
+print("updates collected by the UI:",
+      len(storage.get_all_updates("example")))
+server.stop()

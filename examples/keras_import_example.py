@@ -1,0 +1,23 @@
+"""Keras import: load the committed real-Keras HDF5 fixture (a functional
+residual model) into a ComputationGraph and run inference.
+
+(reference pattern: deeplearning4j-modelimport KerasModelImport)
+"""
+import _common  # noqa: F401
+
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu.keras.keras_import import \
+    import_keras_model_and_weights
+
+fixtures = os.path.join(os.path.dirname(__file__), "..", "tests",
+                        "fixtures")
+net = import_keras_model_and_weights(
+    os.path.join(fixtures, "keras_toy_residual.h5"))
+io = np.load(os.path.join(fixtures, "keras_toy_residual_io.npz"))
+out = np.asarray(net.output(io["x"])[0])
+print("imported model output shape:", out.shape)
+print("matches Keras prediction:",
+      bool(np.allclose(out, io["y"], atol=1e-4)))

@@ -1,0 +1,18 @@
+"""LeNet CNN from the model zoo: conv/pool layers with InputType shape
+inference, bf16 compute.
+
+(reference pattern: dl4j-examples LenetMnistExample)
+"""
+import _common  # noqa: F401
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.models.zoo.lenet import lenet
+
+net = lenet(data_type="bfloat16")
+train = MnistDataSetIterator(128, train=True)
+print("data source:", "synthetic stand-in" if train.synthetic else "MNIST")
+net.fit(train, num_epochs=1)
+ev = net.evaluate(MnistDataSetIterator(128, train=False))
+print("accuracy:", round(ev.accuracy(), 3))

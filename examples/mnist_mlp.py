@@ -1,0 +1,28 @@
+"""MNIST MLP: builder DSL -> MultiLayerNetwork -> fit -> evaluate.
+
+(reference pattern: dl4j-examples MLPMnistSingleLayerExample)
+"""
+import _common  # noqa: F401
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+conf = (NeuralNetConfiguration.Builder()
+        .seed(123)
+        .updater("adam").learning_rate(1e-3)
+        .list()
+        .layer(0, DenseLayer(n_out=256, activation="relu"))
+        .layer(1, OutputLayer(n_out=10, activation="softmax",
+                              loss_function="mcxent"))
+        .set_input_type(InputType.feed_forward(784))
+        .build())
+
+net = MultiLayerNetwork(conf).init()
+train = MnistDataSetIterator(128, train=True)
+print("data source:", "synthetic stand-in" if train.synthetic else "MNIST")
+net.fit(train, num_epochs=2)
+
+ev = net.evaluate(MnistDataSetIterator(128, train=False))
+print(ev.stats())

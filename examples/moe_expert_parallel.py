@@ -1,0 +1,30 @@
+"""Mixture-of-Experts with expert parallelism: Switch-style top-1 routing,
+one expert FFN per device over an ("expert",) mesh, tokens exchanged with
+`lax.all_to_all` over ICI.
+
+No reference equivalent (SURVEY.md §2.5: EP absent) — TPU-first extension.
+"""
+import _common  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.parallel import (init_moe, make_expert_mesh,
+                                         moe_mlp_dense, moe_mlp_sharded,
+                                         shard_moe_params)
+
+D, E, F, B = 16, 8, 64, 64
+mesh = make_expert_mesh(E)
+params = init_moe(jax.random.PRNGKey(0), D, E, F)
+sharded = shard_moe_params(params, mesh)
+x = jnp.asarray(np.random.default_rng(0).standard_normal((B, D)),
+                jnp.float32)
+
+apply_ep = jax.jit(moe_mlp_sharded(mesh))
+y_ep, aux = apply_ep(sharded, x)
+y_ref, _ = moe_mlp_dense(params, x)
+print("expert-parallel == dense reference:",
+      bool(jnp.allclose(y_ep, y_ref, atol=1e-5)))
+print("load-balance aux loss:", float(aux))
+print("expert weights sharding:", sharded["w1"].sharding.spec)

@@ -1,0 +1,32 @@
+"""Pipeline parallelism: a decoder-only transformer LM with its uniform
+blocks sharded one-per-device over the "pipe" mesh axis (GPipe schedule,
+microbatches rotating over ICI, backward by autodiff) combined with data
+parallelism on a second axis.
+
+No reference equivalent (SURVEY.md §2.5: PP absent) — TPU-first extension.
+"""
+import _common  # noqa: F401
+
+import numpy as np
+
+from deeplearning4j_tpu.models.zoo.transformer import (embed_fn, init_lm,
+                                                       lm_loss,
+                                                       make_block_fn)
+from deeplearning4j_tpu.parallel import (PipelineParallel,
+                                         make_pipeline_mesh)
+
+mesh = make_pipeline_mesh(n_pipe=4, n_data=2)   # 8 devices: dp=2 x pp=4
+aux, blocks = init_lm(vocab_size=11, d_model=32, n_heads=4, n_layers=4,
+                      max_len=16, seed=7)
+pp = PipelineParallel(make_block_fn(4), blocks, mesh, loss_fn=lm_loss,
+                      aux_params=aux, pre_fn=embed_fn, n_micro=4,
+                      data_axis="data", learning_rate=0.2, momentum=0.9)
+
+rng = np.random.default_rng(0)
+x = rng.integers(0, 11, (32, 16)).astype(np.int32)
+y = (x + 1) % 11                                # learn the +1 shift task
+first = pp.fit_batch(x, y)
+for step in range(40):
+    last = pp.fit_batch(x, y)
+print(f"loss {first:.3f} -> {last:.4f} "
+      f"(stage params sharded: {pp.stacked['attn']['wqkv'].sharding.spec})")

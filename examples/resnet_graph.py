@@ -1,0 +1,53 @@
+"""A residual network as a ComputationGraph DAG: multi-branch vertices
+(ElementWiseVertex add), bias-free convs before BN, one fused bf16
+training step. The zoo `resnet50()` is the full benchmark model built from
+the same pieces.
+
+(reference pattern: ComputationGraph residual configuration)
+"""
+import _common  # noqa: F401
+
+import numpy as np
+
+from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.graph_vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                               BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               GlobalPoolingLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+gb = (NeuralNetConfiguration.Builder()
+      .seed(11).updater("adam").learning_rate(2e-3)
+      .graph_builder()
+      .add_inputs("in")
+      .add_layer("conv1", ConvolutionLayer(n_out=16, kernel_size=(3, 3),
+                                           padding=(1, 1), has_bias=False),
+                 "in")
+      .add_layer("bn1", BatchNormalization(), "conv1")
+      .add_layer("relu1", ActivationLayer(activation="relu"), "bn1")
+      # residual branch
+      .add_layer("conv2", ConvolutionLayer(n_out=16, kernel_size=(3, 3),
+                                           padding=(1, 1), has_bias=False),
+                 "relu1")
+      .add_layer("bn2", BatchNormalization(), "conv2")
+      .add_vertex("add", ElementWiseVertex(op="add"), "bn2", "relu1")
+      .add_layer("relu2", ActivationLayer(activation="relu"), "add")
+      .add_layer("pool", GlobalPoolingLayer(pooling_type="AVG"), "relu2")
+      .add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                    loss_function="mcxent"), "pool")
+      .set_outputs("out")
+      .set_input_types(InputType.convolutional(16, 16, 3))
+      .build())
+net = ComputationGraph(gb).init()
+
+rng = np.random.default_rng(0)
+x = rng.random((32, 16, 16, 3)).astype(np.float32)
+y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+ds = DataSet(x, y)
+s0 = float(net.score(ds))
+for _ in range(15):
+    net.fit(ds)
+print(f"score {s0:.3f} -> {float(net.score(ds)):.3f}")

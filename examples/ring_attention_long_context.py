@@ -1,0 +1,28 @@
+"""Long-context attention: the sequence axis sharded over the mesh (ring
+attention — K/V blocks rotate over ICI while an online softmax folds each
+block), with the Pallas flash kernel as each device's block compute.
+
+No reference equivalent (the 2016 stack predates attention; its only
+long-sequence tool is truncated BPTT) — TPU-first extension.
+"""
+import _common  # noqa: F401
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.parallel.ring_attention import (
+    blockwise_attention, ring_self_attention)
+
+mesh = Mesh(np.array(jax.devices()), ("seq",))
+rng = np.random.default_rng(1)
+B, T, H, D = 2, 128, 4, 16                      # T shards over 8 devices
+q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+
+ring = ring_self_attention(q, q, q, mesh, axis="seq", causal=True)
+flash = ring_self_attention(q, q, q, mesh, axis="seq", causal=True,
+                            use_flash=True)
+full = blockwise_attention(q, q, q, causal=True)
+print("ring == full:", bool(jnp.allclose(ring, full, atol=1e-4)),
+      " ring+flash == full:", bool(jnp.allclose(flash, full, atol=1e-4)))

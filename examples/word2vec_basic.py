@@ -1,0 +1,38 @@
+"""Word2Vec: fit on a toy two-topic corpus, query nearest words, then save
+and serve the table read-only via the memory-mapped StaticWord2Vec.
+
+(reference pattern: dl4j-examples Word2VecRawTextExample)
+"""
+import _common  # noqa: F401
+
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.models import Word2Vec
+from deeplearning4j_tpu.models.word2vec import (StaticWord2Vec,
+                                                write_static_model)
+
+ANIMALS = ["cat", "dog", "pet", "fur", "tail", "paw", "claw", "kitten",
+           "puppy", "whisker", "leash", "collar"]
+VEHICLES = ["car", "truck", "road", "wheel", "engine", "tire", "brake",
+            "gear", "fuel", "driver", "lane", "horn"]
+rng = np.random.default_rng(0)
+corpus = []
+for _ in range(150):
+    corpus.append(list(rng.choice(ANIMALS, 6, replace=False)))
+    corpus.append(list(rng.choice(VEHICLES, 6, replace=False)))
+
+w2v = (Word2Vec.Builder()
+       .layer_size(32).window_size(3).negative_sample(5)
+       .learning_rate(0.05).epochs(5).min_word_frequency(1).seed(7)
+       .build())
+w2v.fit(corpus)
+print("nearest(cat):", w2v.words_nearest("cat", top_n=5))
+print("sim(cat, dog) =", round(w2v.similarity("cat", "dog"), 3),
+      " sim(cat, car) =", round(w2v.similarity("cat", "car"), 3))
+
+d = tempfile.mkdtemp()
+write_static_model(w2v, d)
+static = StaticWord2Vec(d, mmap=True)
+print("static nearest(engine):", static.words_nearest("engine", top_n=5))

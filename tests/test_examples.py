@@ -1,0 +1,35 @@
+"""Examples bitrot guard: every example must at least byte-compile; the two
+fastest run end-to-end as subprocesses (the full set is exercised manually —
+each prints a success line; see examples/README.md)."""
+import os
+import py_compile
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _example_files():
+    return sorted(f for f in os.listdir(EXAMPLES)
+                  if f.endswith(".py") and not f.startswith("_"))
+
+
+def test_all_examples_compile():
+    files = _example_files()
+    assert len(files) >= 10
+    for f in files:
+        py_compile.compile(os.path.join(EXAMPLES, f), doraise=True)
+
+
+@pytest.mark.parametrize("name", ["ring_attention_long_context.py",
+                                  "moe_expert_parallel.py"])
+def test_fast_examples_run(name):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    p = subprocess.run([sys.executable, name], cwd=EXAMPLES, env=env,
+                       capture_output=True, text=True, timeout=280)
+    assert p.returncode == 0, p.stderr[-800:]
+    assert "True" in p.stdout or "==" in p.stdout
