@@ -28,7 +28,7 @@ _lib_lock = threading.Lock()
 _build_attempted = False
 
 
-_ABI_VERSION = 3  # must match dl4j_abi_version() in dl4j_tpu_native.cpp
+_ABI_VERSION = 4  # must match dl4j_abi_version() in dl4j_tpu_native.cpp
 
 
 def _try_build(force=False):
@@ -91,6 +91,15 @@ def get_lib():
         lib.dl4j_pool_stats.restype = ctypes.c_int64
         lib.dl4j_pool_stats.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.dl4j_pool_destroy.argtypes = [ctypes.c_void_p]
+        lib.dl4j_loader_create.restype = ctypes.c_void_p
+        lib.dl4j_loader_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32]
+        lib.dl4j_loader_next.restype = ctypes.POINTER(ctypes.c_float)
+        lib.dl4j_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.dl4j_loader_destroy.argtypes = [ctypes.c_void_p]
         lib.dl4j_skipgram_pairs.restype = ctypes.c_int64
         lib.dl4j_skipgram_pairs.argtypes = [
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
@@ -144,6 +153,9 @@ def parse_csv(path, delimiter=",", skip_lines=0):
     if not ptr:
         return None
     n = rows.value * cols.value
+    if n == 0:            # empty-but-valid file sentinel
+        lib.dl4j_free(ptr)
+        return np.zeros((0, 0), np.float32)
     arr = np.ctypeslib.as_array(ptr, shape=(n,)).reshape(
         rows.value, cols.value).copy()
     lib.dl4j_free(ptr)
@@ -172,6 +184,73 @@ def skipgram_pairs(ids, offsets, window, seed):
         centers.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         outs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     return centers[:n], outs[:n]
+
+
+class PrefetchCsvLoader:
+    """Multi-threaded native CSV prefetcher: worker threads parse files
+    into float32 matrices off the GIL; `next()` yields them in submission
+    order (the DataVec-reader + AsyncDataSetIterator host role, kept
+    native per SURVEY.md §2.9). Context-manage or call close()."""
+
+    def __init__(self, paths, delimiter=",", skip_lines=0, n_threads=4,
+                 capacity=8):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        paths = [str(p) for p in paths]
+        joined = "\n".join(paths).encode()
+        self._handle = lib.dl4j_loader_create(
+            joined, ctypes.c_char(delimiter.encode()), int(skip_lines),
+            int(n_threads), int(capacity))
+        if not self._handle:
+            raise RuntimeError("loader creation failed")
+
+    def next(self):
+        """Next file's float32 [rows, cols] array; None when exhausted.
+        Raises on a file that failed to parse."""
+        if self._handle is None:
+            return None
+        rows = ctypes.c_int64()
+        cols = ctypes.c_int64()
+        ptr = self._lib.dl4j_loader_next(self._handle, ctypes.byref(rows),
+                                         ctypes.byref(cols))
+        if not ptr:
+            if rows.value == -1:
+                return None
+            raise IOError("native CSV parse failed for next file")
+        n = rows.value * cols.value
+        if n == 0:        # empty-but-valid file sentinel
+            self._lib.dl4j_free(ptr)
+            return np.zeros((0, 0), np.float32)
+        arr = np.ctypeslib.as_array(ptr, shape=(n,)).reshape(
+            rows.value, cols.value).copy()
+        self._lib.dl4j_free(ptr)
+        return arr
+
+    def __iter__(self):
+        while True:
+            a = self.next()
+            if a is None:
+                return
+            yield a
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.dl4j_loader_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class StagingBufferPool:

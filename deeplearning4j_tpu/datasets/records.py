@@ -102,12 +102,22 @@ class CSVRecordReader(RecordReader):
 
 class CSVSequenceRecordReader(RecordReader):
     """One CSV file per sequence (DataVec CSVSequenceRecordReader). Files are
-    visited in sorted order under `directory` (or from an explicit list)."""
+    visited in sorted order under `directory` (or from an explicit list).
+
+    prefetch > 0 (numeric files only): that many native worker threads
+    parse files concurrently off the GIL (`common/native_ops
+    PrefetchCsvLoader`, the DataVec-reader host pipeline kept native per
+    SURVEY.md §2.9); sequences still arrive in file order. NOTE: the
+    prefetch path yields FLOAT values where the python csv path yields
+    strings — identical once consumed numerically (every framework
+    iterator does), different for string-typed consumers. Falls back to
+    the python csv path when the native library is unavailable."""
 
     def __init__(self, directory=None, files=None, skip_lines=0,
-                 delimiter=","):
+                 delimiter=",", prefetch=0):
         self.skip_lines = int(skip_lines)
         self.delimiter = delimiter
+        self.prefetch = int(prefetch)
         if files is not None:
             self.files = [str(f) for f in files]
         elif directory is not None:
@@ -116,11 +126,32 @@ class CSVSequenceRecordReader(RecordReader):
         else:
             self.files = []
         self._pos = 0
+        self._loader = None
 
     def has_next(self):
         return self._pos < len(self.files)
 
+    def _native_loader(self):
+        if self._loader is None:
+            from ..common import native_ops
+            if not native_ops.available():
+                return None
+            self._loader = native_ops.PrefetchCsvLoader(
+                self.files, delimiter=self.delimiter,
+                skip_lines=self.skip_lines, n_threads=self.prefetch,
+                capacity=max(2 * self.prefetch, 4))
+        return self._loader
+
     def next_sequence(self):
+        if self.prefetch > 0:
+            loader = self._native_loader()
+            if loader is not None:
+                # advance BEFORE the native call: the loader's emit cursor
+                # moves even when a file fails to parse, so _pos must too
+                # (a caller catching the error stays aligned)
+                self._pos += 1
+                mat = loader.next()
+                return mat.tolist()
         path = self.files[self._pos]
         self._pos += 1
         with open(path, "r", encoding="utf-8", newline="") as fh:
@@ -131,6 +162,9 @@ class CSVSequenceRecordReader(RecordReader):
 
     def reset(self):
         self._pos = 0
+        if self._loader is not None:
+            self._loader.close()
+            self._loader = None
 
 
 class RecordReaderDataSetIterator(DataSetIterator):

@@ -12,19 +12,22 @@
 // Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
 // Build: make -C native   (g++ -O3 -shared -fPIC)
 
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 extern "C" {
 
 // Bumped whenever an exported signature changes; the Python loader refuses
 // (and rebuilds) a library whose version doesn't match.
-int64_t dl4j_abi_version() { return 3; }
+int64_t dl4j_abi_version() { return 4; }
 
 // ---------------------------------------------------------------------------
 // IDX parsing (reference: datasets/mnist/MnistImageFile binary reader)
@@ -154,7 +157,14 @@ float* dl4j_parse_csv(const char* path, char delim, int64_t skip_lines,
     ++rows;
     p = line_end + 1;
   }
-  if (rows == 0 || cols <= 0) return nullptr;
+  if (rows == 0) {
+    // empty-but-valid (no data lines): non-null sentinel so callers can
+    // distinguish it from a parse failure
+    *rows_out = 0;
+    *cols_out = 0;
+    return (float*)malloc(1);
+  }
+  if (cols <= 0) return nullptr;
   float* out = (float*)malloc(values.size() * sizeof(float));
   if (!out) return nullptr;
   memcpy(out, values.data(), values.size() * sizeof(float));
@@ -270,6 +280,120 @@ void dl4j_pool_destroy(void* pool_ptr) {
   Pool* pool = (Pool*)pool_ptr;
   for (auto& kv : pool->free_list) free(kv.first);
   delete pool;
+}
+
+// ---------------------------------------------------------------------------
+// Multi-threaded prefetch loader (reference role: DataVec record readers
+// feeding AsyncDataSetIterator — the host data pipeline kept native and off
+// the Python GIL: worker threads parse CSV files into float32 matrices and
+// a bounded queue hands them over in submission order)
+// ---------------------------------------------------------------------------
+
+struct LoaderItem {
+  float* data = nullptr;
+  int64_t rows = 0, cols = 0;
+  bool done = false;   // parse finished (data may be null on parse failure)
+};
+
+struct Loader {
+  std::vector<std::string> paths;
+  char delim;
+  int64_t skip_lines;
+  size_t capacity;          // max parsed-but-unconsumed items
+  std::mutex mu;
+  std::condition_variable cv_space, cv_item;
+  std::vector<LoaderItem> items;   // one slot per path, filled by workers
+  size_t next_claim = 0;           // next path index to parse
+  size_t next_emit = 0;            // next index the consumer receives
+  size_t inflight_or_ready = 0;    // claimed-but-unconsumed count
+  bool stopping = false;
+  std::vector<std::thread> workers;
+};
+
+static void loader_worker(Loader* L) {
+  for (;;) {
+    size_t idx;
+    {
+      std::unique_lock<std::mutex> lock(L->mu);
+      L->cv_space.wait(lock, [&] {
+        return L->stopping || (L->next_claim < L->paths.size() &&
+                               L->inflight_or_ready < L->capacity);
+      });
+      if (L->stopping || L->next_claim >= L->paths.size()) return;
+      idx = L->next_claim++;
+      L->inflight_or_ready++;
+    }
+    int64_t rows = 0, cols = 0;
+    float* data = dl4j_parse_csv(L->paths[idx].c_str(), L->delim,
+                                 L->skip_lines, &rows, &cols);
+    {
+      std::lock_guard<std::mutex> lock(L->mu);
+      L->items[idx].data = data;
+      L->items[idx].rows = rows;
+      L->items[idx].cols = cols;
+      L->items[idx].done = true;
+    }
+    L->cv_item.notify_all();
+  }
+}
+
+// paths: '\n'-joined file list. Returns an opaque loader handle.
+void* dl4j_loader_create(const char* paths_joined, char delim,
+                         int64_t skip_lines, int32_t n_threads,
+                         int32_t capacity) {
+  Loader* L = new Loader();
+  const char* p = paths_joined;
+  while (*p) {
+    const char* nl = strchr(p, '\n');
+    size_t len = nl ? (size_t)(nl - p) : strlen(p);
+    if (len) L->paths.emplace_back(p, len);
+    p += len + (nl ? 1 : 0);
+  }
+  L->delim = delim;
+  L->skip_lines = skip_lines;
+  L->capacity = capacity < 1 ? 1 : (size_t)capacity;
+  L->items.resize(L->paths.size());
+  int nt = n_threads < 1 ? 1 : n_threads;
+  for (int i = 0; i < nt; ++i) L->workers.emplace_back(loader_worker, L);
+  return L;
+}
+
+// Blocks until the next file (in submission order) is parsed. Returns the
+// malloc'd float32 buffer (caller frees via dl4j_free) and fills
+// rows/cols; returns nullptr with rows=-1 when the file list is exhausted,
+// nullptr with rows=0 when that file failed to parse.
+float* dl4j_loader_next(void* handle, int64_t* rows, int64_t* cols) {
+  Loader* L = (Loader*)handle;
+  std::unique_lock<std::mutex> lock(L->mu);
+  if (L->next_emit >= L->paths.size()) {
+    *rows = -1;
+    *cols = -1;
+    return nullptr;
+  }
+  size_t idx = L->next_emit;
+  L->cv_item.wait(lock, [&] { return L->items[idx].done; });
+  LoaderItem it = L->items[idx];
+  L->items[idx] = LoaderItem();   // drop our reference
+  L->next_emit++;
+  L->inflight_or_ready--;
+  lock.unlock();
+  L->cv_space.notify_all();
+  *rows = it.rows;
+  *cols = it.cols;
+  return it.data;
+}
+
+void dl4j_loader_destroy(void* handle) {
+  Loader* L = (Loader*)handle;
+  {
+    std::lock_guard<std::mutex> lock(L->mu);
+    L->stopping = true;
+  }
+  L->cv_space.notify_all();
+  for (auto& t : L->workers) t.join();
+  for (auto& it : L->items)
+    if (it.data) free(it.data);
+  delete L;
 }
 
 }  // extern "C"

@@ -155,3 +155,80 @@ class TestSkipgramPairs:
         intra = cosine_sim(v("w0"), v("w1"))
         inter = cosine_sim(v("w0"), v("w20"))
         assert intra > inter + 0.2, (intra, inter)
+
+
+class TestPrefetchCsvLoader:
+    def _write_files(self, tmp_path, n=10):
+        rng = np.random.default_rng(0)
+        paths, mats = [], []
+        for i in range(n):
+            m = rng.random((15 + i, 4)).astype(np.float32).round(4)
+            p = str(tmp_path / f"f{i:02d}.csv")
+            np.savetxt(p, m, delimiter=",", fmt="%.4f")
+            paths.append(p)
+            mats.append(m)
+        return paths, mats
+
+    def test_order_and_values(self, tmp_path):
+        if not native_ops.available():
+            pytest.skip("native library unavailable")
+        paths, mats = self._write_files(tmp_path)
+        with native_ops.PrefetchCsvLoader(paths, n_threads=3,
+                                          capacity=3) as ld:
+            outs = list(ld)
+        assert len(outs) == len(mats)
+        for a, b in zip(outs, mats):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
+    def test_more_threads_than_files(self, tmp_path):
+        if not native_ops.available():
+            pytest.skip("native library unavailable")
+        paths, mats = self._write_files(tmp_path, n=2)
+        with native_ops.PrefetchCsvLoader(paths, n_threads=8) as ld:
+            outs = list(ld)
+        assert len(outs) == 2
+
+    def test_parse_failure_raises(self, tmp_path):
+        if not native_ops.available():
+            pytest.skip("native library unavailable")
+        bad = str(tmp_path / "bad.csv")
+        with open(bad, "w") as fh:
+            fh.write("1,2,3\nnot,numbers,here_x\n4\n")
+        with native_ops.PrefetchCsvLoader([bad]) as ld:
+            with pytest.raises(IOError):
+                ld.next()
+
+    def test_sequence_reader_prefetch_matches_python(self, tmp_path):
+        """CSVSequenceRecordReader(prefetch=N) yields the same sequences
+        as the python csv path, in the same order."""
+        from deeplearning4j_tpu.datasets.records import \
+            CSVSequenceRecordReader
+        if not native_ops.available():
+            pytest.skip("native library unavailable")
+        paths, _ = self._write_files(tmp_path, n=6)
+        plain = CSVSequenceRecordReader(files=paths)
+        fast = CSVSequenceRecordReader(files=paths, prefetch=3)
+        for _ in range(2):      # includes a reset cycle
+            while plain.has_next():
+                a = np.asarray(plain.next_sequence(), np.float32)
+                b = np.asarray(fast.next_sequence(), np.float32)
+                np.testing.assert_allclose(a, b, atol=1e-4)
+            assert not fast.has_next()
+            plain.reset()
+            fast.reset()
+
+    def test_empty_file_matches_python_path(self, tmp_path):
+        """A zero-row file yields [] on BOTH the prefetch and python
+        paths (the native parser's empty sentinel, not a parse error)."""
+        from deeplearning4j_tpu.datasets.records import \
+            CSVSequenceRecordReader
+        if not native_ops.available():
+            pytest.skip("native library unavailable")
+        good = str(tmp_path / "a.csv")
+        np.savetxt(good, np.ones((3, 2)), delimiter=",", fmt="%.1f")
+        empty = str(tmp_path / "b.csv")
+        open(empty, "w").close()
+        plain = CSVSequenceRecordReader(files=[good, empty])
+        fast = CSVSequenceRecordReader(files=[good, empty], prefetch=2)
+        assert len(plain.next_sequence()) == len(fast.next_sequence()) == 3
+        assert plain.next_sequence() == fast.next_sequence() == []
