@@ -222,3 +222,25 @@ class TransformerLM:
         for p in self.blocks:
             h = self.block_fn(p, h)
         return logits_fn(self.aux, h)
+
+    def generate(self, prompt, max_new_tokens=32, temperature=0.0, seed=0):
+        """Autoregressive continuation of `prompt` (list/array of token
+        ids). temperature 0 = greedy argmax; >0 = sampled. The context is
+        re-encoded per step (prefill-style; fine at zoo scale — a KV cache
+        is the known optimization for serving)."""
+        toks = list(np.asarray(prompt).ravel().astype(int))
+        if not toks:
+            raise ValueError("prompt must contain at least one token")
+        rng = np.random.default_rng(seed)
+        max_len = self.aux["pos"].shape[0]
+        for _ in range(int(max_new_tokens)):
+            ctx = toks[-max_len:]
+            logit = np.asarray(self.logits(np.asarray(ctx)[None, :])
+                               [0, -1], np.float32)
+            if temperature <= 0.0:
+                nxt = int(logit.argmax())
+            else:
+                p = np.exp((logit - logit.max()) / temperature)
+                nxt = int(rng.choice(len(p), p=p / p.sum()))
+            toks.append(nxt)
+        return toks

@@ -140,3 +140,17 @@ class TestPipelinedTransformer:
         # greedy argmax solves the shift task after training
         pred = np.asarray(jnp.argmax(lm.logits(x), -1))
         assert (pred == y).mean() > 0.8
+
+    def test_generate_continues_learned_pattern(self):
+        """After learning the +1 shift task, greedy generate() continues
+        the arithmetic sequence."""
+        lm = TransformerLM(11, d_model=32, n_heads=4, n_layers=2,
+                           max_len=16, learning_rate=0.2, momentum=0.9)
+        x, y = _char_data()
+        for _ in range(80):
+            lm.fit_batch(x, y)
+        out = lm.generate([2, 3, 4], max_new_tokens=5)
+        assert out == [2, 3, 4, 5, 6, 7, 8, 9]
+        sampled = lm.generate([0], max_new_tokens=4, temperature=0.5,
+                              seed=1)
+        assert len(sampled) == 5 and all(0 <= t < 11 for t in sampled)
