@@ -162,9 +162,11 @@ def moe_mlp_sharded(mesh, axis="expert", capacity=None):
 
 def shard_moe_params(params, mesh, axis="expert"):
     """Place MoE params on the mesh: gate replicated, expert stacks split
-    over `axis`."""
+    over `axis`. Works on multi-host meshes (each process contributes its
+    addressable shards via `sharding.put_sharded`)."""
+    from .sharding import put_sharded
     out = {}
     for k, v in params.items():
         spec = P() if k == "gate" else P(axis)
-        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+        out[k] = put_sharded(v, NamedSharding(mesh, spec), full_array=True)
     return out

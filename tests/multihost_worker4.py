@@ -1,6 +1,6 @@
 """Worker for the scaled multi-host test (test_multihost.py, 4 processes).
 
-Proves four things beyond the 2-process minimum (VERDICT r2 item 9):
+Proves six things beyond the 2-process minimum (VERDICT r2 item 9):
   A. a mesh whose MODEL axis spans process boundaries (2 local devices per
      process, mesh data=2 x model=4: each model row covers 2 processes)
      trains with tensor parallelism over the cross-process axis;
@@ -10,7 +10,13 @@ Proves four things beyond the 2-process minimum (VERDICT r2 item 9):
      (the per-process input-pipeline role);
   D. GPipe pipeline parallelism with the PIPE axis spanning processes —
      the stage-to-stage ppermute (and its autodiff transpose) rides the
-     DCN boundary, and the pipelined transformer LM trains.
+     DCN boundary, and the pipelined transformer LM trains;
+  E. Switch-MoE expert parallelism with 8 experts over the 8 global
+     devices — the token-dispatch all_to_all crosses processes, and the
+     output checksum matches the dense single-host reference;
+  F. ring-attention sequence parallelism with the seq axis spanning
+     processes — K/V ppermute hops ride DCN, output == the single-device
+     reference.
 
 Usage: python tests/multihost_worker4.py <proc_id> <nproc> <coordinator>
 """
@@ -159,8 +165,61 @@ def main():
         last_pp = pp.fit_batch(xt_global[sl_pp], yt_global[sl_pp])
     assert np.isfinite(last_pp) and last_pp < first_pp, (first_pp, last_pp)
 
+    # --- E: expert parallelism with all_to_all crossing processes ------
+    # 8 experts over 8 global devices (2 per process): the token dispatch
+    # all_to_all and the return hop both ride the DCN boundary
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deeplearning4j_tpu.parallel.moe import (init_moe,
+                                                 make_expert_mesh,
+                                                 moe_mlp_dense,
+                                                 moe_mlp_sharded,
+                                                 shard_moe_params)
+    from deeplearning4j_tpu.parallel.sharding import put_sharded
+    ep_mesh = make_expert_mesh(n_dev)
+    moe_p = init_moe(jax.random.PRNGKey(0), 8, n_dev, 16)
+    moe_ps = shard_moe_params(moe_p, ep_mesh)
+    rng_ep = np.random.default_rng(3)
+    x_glob = rng_ep.standard_normal((8 * n_dev, 8)).astype(np.float32)
+    sl_ep = distributed.process_local_batch_slice(8 * n_dev)
+    x_sh = put_sharded(x_glob[sl_ep], NamedSharding(ep_mesh, P("expert")))
+    apply_ep = moe_mlp_sharded(ep_mesh)
+
+    @jax.jit
+    def ep_checksum(ps, x):
+        y, aux = apply_ep(ps, x)
+        return jnp.sum(y), aux
+
+    cs_ep, _ = ep_checksum(moe_ps, x_sh)
+    y_ref, _ = moe_mlp_dense(moe_p, jnp.asarray(x_glob))
+    assert abs(float(cs_ep) - float(jnp.sum(y_ref))) < 1e-2, \
+        (float(cs_ep), float(jnp.sum(y_ref)))
+
+    # --- F: ring attention with the sequence axis spanning processes ---
+    # K/V blocks rotate over the DCN boundary via ppermute; the folded
+    # output must equal the single-device reference on the global batch
+    from deeplearning4j_tpu.parallel.ring_attention import (
+        blockwise_attention, ring_self_attention)
+    seq_mesh = Mesh(np.array(jax.devices()), ("seq",))
+    rng_sp = np.random.default_rng(4)
+    T_glob = 4 * n_dev
+    q_glob = rng_sp.standard_normal((2, T_glob, 2, 8)).astype(np.float32)
+    t_sl = distributed.process_local_batch_slice(T_glob)
+    q_sh = put_sharded(q_glob[:, t_sl],
+                       NamedSharding(seq_mesh, P(None, "seq")))
+    mask_sh = put_sharded(np.ones((2, T_glob // nproc), np.float32),
+                          NamedSharding(seq_mesh, P(None, "seq")))
+    ring = ring_self_attention(q_sh, q_sh, q_sh, seq_mesh, axis="seq",
+                               causal=True, kv_mask=mask_sh)
+    cs_ring = float(jax.jit(jnp.sum)(ring))
+    full = blockwise_attention(jnp.asarray(q_glob), jnp.asarray(q_glob),
+                               jnp.asarray(q_glob), causal=True)
+    assert abs(cs_ring - float(jnp.sum(full))) < 1e-2, \
+        (cs_ring, float(jnp.sum(full)))
+
     print(f"RESULT {proc_id} tp={sum_a:.10f} tm={sum_b:.10f} "
-          f"score={float(net_b._score):.10f} pp={last_pp:.10f}", flush=True)
+          f"score={float(net_b._score):.10f} pp={last_pp:.10f} "
+          f"ep={float(cs_ep):.6f} sp={cs_ring:.6f}", flush=True)
 
 
 if __name__ == "__main__":

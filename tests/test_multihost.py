@@ -77,8 +77,8 @@ def test_four_process_model_axis_and_training_master():
     for out in outs:
         for line in out.splitlines():
             if line.startswith("RESULT"):
-                _, pid, tp, tm, sc, pp = line.split()
-                results[int(pid)] = (tp, tm, sc, pp)
+                _, pid, tp, tm, sc, pp, ep, sp = line.split()
+                results[int(pid)] = (tp, tm, sc, pp, ep, sp)
     assert set(results) == {0, 1, 2, 3}, f"missing results: {outs}"
     # every process holds identical parameters after all paths (incl. the
     # cross-process GPipe loss, replicated by the pipeline's masked psum)
