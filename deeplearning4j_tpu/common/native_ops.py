@@ -28,7 +28,7 @@ _lib_lock = threading.Lock()
 _build_attempted = False
 
 
-_ABI_VERSION = 4  # must match dl4j_abi_version() in dl4j_tpu_native.cpp
+_ABI_VERSION = 5  # must match dl4j_abi_version() in dl4j_tpu_native.cpp
 
 
 def _try_build(force=False):
@@ -91,6 +91,11 @@ def get_lib():
         lib.dl4j_pool_stats.restype = ctypes.c_int64
         lib.dl4j_pool_stats.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.dl4j_pool_destroy.argtypes = [ctypes.c_void_p]
+        lib.dl4j_cbow_contexts.restype = ctypes.c_int64
+        lib.dl4j_cbow_contexts.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
         lib.dl4j_loader_create.restype = ctypes.c_void_p
         lib.dl4j_loader_create.argtypes = [
             ctypes.c_char_p, ctypes.c_char, ctypes.c_int64,
@@ -184,6 +189,28 @@ def skipgram_pairs(ids, offsets, window, seed):
         centers.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         outs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     return centers[:n], outs[:n]
+
+
+def cbow_contexts(ids, offsets, window, seed):
+    """Corpus-level CBOW context-row generation in C++ (sibling of
+    `skipgram_pairs` for the context->center objective). Returns
+    (context [rows, 2*window] int32 with -1 padding, targets [rows]
+    int32), or None when the library is missing."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    ids = np.ascontiguousarray(ids, np.int32)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    cap = int(ids.shape[0])
+    context = np.empty((cap, 2 * int(window)), np.int32)
+    targets = np.empty(cap, np.int32)
+    n = lib.dl4j_cbow_contexts(
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        int(offsets.shape[0]) - 1, int(window), int(seed) & (2**64 - 1),
+        context.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        targets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return context[:n], targets[:n]
 
 
 class PrefetchCsvLoader:

@@ -149,6 +149,23 @@ class BaseElementsLearning:
     pairs; this class turns them into padded index arrays and runs the jitted
     step."""
 
+    def _corpus_chunk(self, seqs_ids, native_fn):
+        """Shared corpus-chunk scaffolding for the native generators:
+        filters len<2 sequences, concatenates ids, builds offsets, draws
+        the seed, and calls `native_fn(ids, offsets, window, seed)`.
+        Returns (kept_seqs, result); result is None when the native
+        library is unavailable (caller runs the per-sequence fallback)."""
+        import numpy as _np
+        seqs_ids = [s for s in seqs_ids if len(s) >= 2]
+        if not seqs_ids:
+            return [], None
+        ids = _np.concatenate([_np.asarray(s, _np.int32)
+                               for s in seqs_ids])
+        offsets = _np.zeros(len(seqs_ids) + 1, _np.int64)
+        _np.cumsum([len(s) for s in seqs_ids], out=offsets[1:])
+        return seqs_ids, native_fn(ids, offsets, self.window,
+                                   seed=int(self._rng.integers(2**63)))
+
     def __init__(self, batch_pairs=4096):
         self.batch_pairs = int(batch_pairs)
         self.lookup = None
@@ -294,16 +311,9 @@ class SkipGram(BaseElementsLearning):
         semantics; the native path draws b from its own deterministic
         xorshift stream seeded off this instance's rng."""
         from ...common import native_ops
-        seqs_ids = [s for s in seqs_ids if len(s) >= 2]
-        if not seqs_ids:
-            return
-        ids = np.concatenate([np.asarray(s, np.int32) for s in seqs_ids])
-        offsets = np.zeros(len(seqs_ids) + 1, np.int64)
-        np.cumsum([len(s) for s in seqs_ids], out=offsets[1:])
-        res = native_ops.skipgram_pairs(
-            ids, offsets, self.window, seed=int(self._rng.integers(2**63)))
+        kept, res = self._corpus_chunk(seqs_ids, native_ops.skipgram_pairs)
         if res is None:
-            for s in seqs_ids:
+            for s in kept:
                 self.learn_sequence(s, lr)
             return
         centers, outs = res
@@ -377,6 +387,20 @@ class CBOW(BaseElementsLearning):
         context, valid = window_contexts(ids_arr, self.window, self._rng)
         keep = valid.any(axis=1)
         self.enqueue_windows(context[keep], ids_arr[keep], lr)
+
+    def learn_sequences_batch(self, seqs_ids, lr):
+        """Corpus-chunk fast path (sibling of SkipGram's): C++
+        `dl4j_cbow_contexts` emits padded context rows + targets for many
+        sequences in one call; falls back to the vectorized per-sequence
+        path without the native library."""
+        from ...common import native_ops
+        kept, res = self._corpus_chunk(seqs_ids, native_ops.cbow_contexts)
+        if res is None:
+            for s in kept:
+                self.learn_sequence(s, lr)
+            return
+        context, targets = res
+        self.enqueue_windows(context, targets, lr)
 
     def enqueue_windows(self, context, outs, lr):
         """Queue (context-row, predicted) arrays: context [m, <=2w+1] with

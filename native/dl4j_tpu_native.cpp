@@ -27,7 +27,7 @@ extern "C" {
 
 // Bumped whenever an exported signature changes; the Python loader refuses
 // (and rebuilds) a library whose version doesn't match.
-int64_t dl4j_abi_version() { return 4; }
+int64_t dl4j_abi_version() { return 5; }
 
 // ---------------------------------------------------------------------------
 // IDX parsing (reference: datasets/mnist/MnistImageFile binary reader)
@@ -280,6 +280,41 @@ void dl4j_pool_destroy(void* pool_ptr) {
   Pool* pool = (Pool*)pool_ptr;
   for (auto& kv : pool->free_list) free(kv.first);
   delete pool;
+}
+
+// CBOW context-row generation over a whole corpus (the sibling of
+// dl4j_skipgram_pairs for the context->center objective). For each
+// position i with reduced window b ~ U[1, window], emits one row of up to
+// 2*window context ids (-1 padding) plus the center id as the target;
+// positions with no in-range context (length-1 sequences) are skipped.
+// context_out must hold rows*2*window int32; targets_out rows int32, where
+// rows <= offsets[n_seq]. Returns the number of rows written.
+int64_t dl4j_cbow_contexts(const int32_t* ids, const int64_t* offsets,
+                           int64_t n_seq, int32_t window, uint64_t seed,
+                           int32_t* context_out, int32_t* targets_out) {
+  if (window <= 0) return 0;
+  uint64_t state = seed ? seed : 0x9E3779B97F4A7C15ULL;
+  const int64_t W2 = 2 * (int64_t)window;
+  int64_t rows = 0;
+  for (int64_t s = 0; s < n_seq; ++s) {
+    const int64_t lo = offsets[s], hi = offsets[s + 1];
+    if (hi - lo < 2) continue;   // matches the vectorized fallback
+    for (int64_t i = lo; i < hi; ++i) {
+      const int64_t b = 1 + (int64_t)(xs64(&state) % (uint64_t)window);
+      const int64_t j0 = i - b < lo ? lo : i - b;
+      const int64_t j1 = i + b >= hi ? hi - 1 : i + b;
+      int32_t* row = context_out + rows * W2;
+      int64_t c = 0;
+      for (int64_t j = j0; j <= j1; ++j) {
+        if (j == i) continue;
+        row[c++] = ids[j];
+      }
+      for (; c < W2; ++c) row[c] = -1;
+      targets_out[rows] = ids[i];
+      ++rows;
+    }
+  }
+  return rows;
 }
 
 // ---------------------------------------------------------------------------

@@ -232,3 +232,55 @@ class TestPrefetchCsvLoader:
         fast = CSVSequenceRecordReader(files=[good, empty], prefetch=2)
         assert len(plain.next_sequence()) == len(fast.next_sequence()) == 3
         assert plain.next_sequence() == fast.next_sequence() == []
+
+
+class TestCbowContexts:
+    def test_window1_rows(self):
+        if not native_ops.available():
+            pytest.skip("native library unavailable")
+        ids = np.array([5, 6, 7], np.int32)
+        offs = np.array([0, 3], np.int64)
+        ctx, tgt = native_ops.cbow_contexts(ids, offs, window=1, seed=1)
+        assert tgt.tolist() == [5, 6, 7]
+        assert ctx.shape == (3, 2)
+        assert ctx[0].tolist() == [6, -1]         # only right neighbor
+        assert sorted(ctx[1].tolist()) == [5, 7]  # both neighbors
+        assert ctx[2].tolist() == [6, -1]
+
+    def test_cbow_batch_trains_to_cluster_quality(self):
+        """Native context rows train CBOW embeddings to the same
+        topic-cluster structure as the per-sequence path."""
+        from deeplearning4j_tpu.models.embeddings.learning import CBOW
+        from deeplearning4j_tpu.models.embeddings.lookup_table import \
+            InMemoryLookupTable
+        from deeplearning4j_tpu.models.embeddings.model_utils import \
+            cosine_sim
+        from deeplearning4j_tpu.models.word2vec.vocab import VocabCache
+        if not native_ops.available():
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(0)
+        vocab = VocabCache()
+        for i in range(40):
+            vocab.add_token(f"w{i}", count=5)
+        vocab.finish()
+        idx = {f"w{i}": vocab.index_of(f"w{i}") for i in range(40)}
+        seqs = []
+        for _ in range(300):
+            seqs.append([idx[f"w{i}"] for i in rng.choice(
+                20, 8, replace=False)])
+            seqs.append([idx[f"w{i + 20}"] for i in rng.choice(
+                20, 8, replace=False)])
+        table = InMemoryLookupTable(vocab, vector_length=24, seed=1,
+                                    negative=5,
+                                    use_hs=False).reset_weights()
+        cb = CBOW(batch_pairs=2048)
+        cb.configure(vocab, table, window=3, negative=5, use_hs=False,
+                     seed=1)
+        for _ in range(6):
+            for i in range(0, len(seqs), 128):
+                cb.learn_sequences_batch(seqs[i:i + 128], 0.05)
+        cb.finish()
+        v = lambda w: table.syn0[idx[w]]
+        intra = cosine_sim(v("w0"), v("w1"))
+        inter = cosine_sim(v("w0"), v("w20"))
+        assert intra > inter + 0.2, (intra, inter)
