@@ -155,14 +155,12 @@ class BaseElementsLearning:
         the seed, and calls `native_fn(ids, offsets, window, seed)`.
         Returns (kept_seqs, result); result is None when the native
         library is unavailable (caller runs the per-sequence fallback)."""
-        import numpy as _np
         seqs_ids = [s for s in seqs_ids if len(s) >= 2]
         if not seqs_ids:
             return [], None
-        ids = _np.concatenate([_np.asarray(s, _np.int32)
-                               for s in seqs_ids])
-        offsets = _np.zeros(len(seqs_ids) + 1, _np.int64)
-        _np.cumsum([len(s) for s in seqs_ids], out=offsets[1:])
+        ids = np.concatenate([np.asarray(s, np.int32) for s in seqs_ids])
+        offsets = np.zeros(len(seqs_ids) + 1, np.int64)
+        np.cumsum([len(s) for s in seqs_ids], out=offsets[1:])
         return seqs_ids, native_fn(ids, offsets, self.window,
                                    seed=int(self._rng.integers(2**63)))
 

@@ -133,6 +133,52 @@ def init_moe_block(rng, d_model, n_heads, n_experts, d_ff,
     return p
 
 
+def make_decode_block_fn(n_heads):
+    """Single-token decode step for one block with a KV cache.
+
+    block_decode(p, x [B, D], cache {k,v: [B, L, H, hd]}, pos scalar)
+      -> (y [B, D], updated cache)
+    The query attends to cache positions <= pos (the new token's k/v are
+    written at `pos` first). Shapes are static, so ONE compiled step
+    serves the whole generation loop — the TPU serving pattern (contrast
+    the O(T²)-per-token re-encode path)."""
+
+    def block_decode(p, x, cache, pos):
+        B, D = x.shape
+        H = n_heads
+        hd = D // H
+        h = _layer_norm(x, p["ln1"]["g"], p["ln1"]["b"])
+        qkv = h @ p["attn"]["wqkv"]                     # [B, 3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        k_cache = jax.lax.dynamic_update_index_in_dim(
+            cache["k"], k.reshape(B, H, hd), pos, axis=1)
+        v_cache = jax.lax.dynamic_update_index_in_dim(
+            cache["v"], v.reshape(B, H, hd), pos, axis=1)
+        qh = q.reshape(B, H, hd)
+        scores = jnp.einsum("bhd,blhd->bhl", qh,
+                            k_cache) / math.sqrt(hd)    # [B, H, L]
+        L = k_cache.shape[1]
+        mask = jnp.arange(L)[None, None, :] <= pos
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        att = jax.nn.softmax(scores.astype(jnp.float32),
+                             -1).astype(x.dtype)
+        out = jnp.einsum("bhl,blhd->bhd", att, v_cache).reshape(B, D)
+        x = x + out @ p["attn"]["wo"]
+        h = _layer_norm(x, p["ln2"]["g"], p["ln2"]["b"])
+        m = jax.nn.gelu(h @ p["mlp"]["w1"] + p["mlp"]["b1"])
+        y = x + m @ p["mlp"]["w2"] + p["mlp"]["b2"]
+        return y, {"k": k_cache, "v": v_cache}
+
+    return block_decode
+
+
+def init_kv_cache(n_layers, batch, max_len, d_model, n_heads,
+                  dtype=jnp.float32):
+    hd = d_model // n_heads
+    z = lambda: jnp.zeros((batch, max_len, n_heads, hd), dtype)
+    return [{"k": z(), "v": z()} for _ in range(n_layers)]
+
+
 def init_lm(vocab_size, d_model=128, n_heads=4, n_layers=4, d_ff=None,
             max_len=256, seed=0, dtype=jnp.float32):
     """Returns (aux, blocks): aux = embedding + final LN + LM head;
@@ -184,9 +230,11 @@ class TransformerLM:
         self.aux, self.blocks = init_lm(vocab_size, d_model, n_heads,
                                         n_layers, d_ff, max_len, seed, dtype)
         self.block_fn = make_block_fn(n_heads, attention=attention)
+        self.n_heads = int(n_heads)
         self.lr, self.mu = float(learning_rate), float(momentum)
         self._vel = None
         self._jit_step = None
+        self._jit_decode = None
 
     def _loss(self, aux, blocks, x, y):
         h = embed_fn(aux, x)
@@ -223,24 +271,69 @@ class TransformerLM:
             h = self.block_fn(p, h)
         return logits_fn(self.aux, h)
 
-    def generate(self, prompt, max_new_tokens=32, temperature=0.0, seed=0):
+    def generate(self, prompt, max_new_tokens=32, temperature=0.0, seed=0,
+                 use_cache=False):
         """Autoregressive continuation of `prompt` (list/array of token
-        ids). temperature 0 = greedy argmax; >0 = sampled. The context is
-        re-encoded per step (prefill-style; fine at zoo scale — a KV cache
-        is the known optimization for serving)."""
+        ids). temperature 0 = greedy argmax; >0 = sampled.
+
+        use_cache=False: the context is re-encoded per step (simple,
+        O(T²) per token). use_cache=True: ONE jitted single-token decode
+        step with a device-resident KV cache (`make_decode_block_fn`) —
+        O(T) per token, the serving path. Both produce identical greedy
+        outputs (pinned by test); generation is capped at max_len with a
+        cache (no sliding window)."""
         toks = list(np.asarray(prompt).ravel().astype(int))
         if not toks:
             raise ValueError("prompt must contain at least one token")
         rng = np.random.default_rng(seed)
         max_len = self.aux["pos"].shape[0]
-        for _ in range(int(max_new_tokens)):
-            ctx = toks[-max_len:]
-            logit = np.asarray(self.logits(np.asarray(ctx)[None, :])
-                               [0, -1], np.float32)
+
+        def pick(logit):
+            logit = np.asarray(logit, np.float32)
             if temperature <= 0.0:
-                nxt = int(logit.argmax())
-            else:
-                p = np.exp((logit - logit.max()) / temperature)
-                nxt = int(rng.choice(len(p), p=p / p.sum()))
-            toks.append(nxt)
+                return int(logit.argmax())
+            p = np.exp((logit - logit.max()) / temperature)
+            return int(rng.choice(len(p), p=p / p.sum()))
+
+        if not use_cache:
+            for _ in range(int(max_new_tokens)):
+                ctx = toks[-max_len:]
+                toks.append(pick(self.logits(
+                    np.asarray(ctx)[None, :])[0, -1]))
+            return toks
+
+        if len(toks) + int(max_new_tokens) > max_len:
+            raise ValueError(
+                f"prompt+new tokens ({len(toks)}+{max_new_tokens}) exceed "
+                f"max_len {max_len} (the KV cache has no sliding window)")
+        if self._jit_decode is None:
+            block_decode = make_decode_block_fn(self.n_heads)
+
+            def step(aux, blocks, cache, pos, token):
+                x = aux["tok"][token] + aux["pos"][pos]      # [1, D]
+                new_cache = []
+                for p, c in zip(blocks, cache):
+                    x, c = block_decode(p, x, c, pos)
+                    new_cache.append(c)
+                return logits_fn(aux, x)[0], new_cache
+
+            self._jit_decode = jax.jit(step, donate_argnums=(2,))
+        cache = init_kv_cache(len(self.blocks), 1, max_len,
+                              self.aux["tok"].shape[1], self.n_heads,
+                              self.aux["tok"].dtype)
+        # prefill: feed the prompt one token at a time through the same
+        # compiled step (simple; a batched prefill is the known next step)
+        logit = None
+        for pos, t in enumerate(toks):
+            logit, cache = self._jit_decode(
+                self.aux, self.blocks, cache, jnp.asarray(pos, jnp.int32),
+                jnp.asarray([t], jnp.int32))
+        n_new = int(max_new_tokens)
+        for i in range(n_new):
+            toks.append(pick(logit))
+            if i < n_new - 1:    # no decode needed after the last token
+                logit, cache = self._jit_decode(
+                    self.aux, self.blocks, cache,
+                    jnp.asarray(len(toks) - 1, jnp.int32),
+                    jnp.asarray([toks[-1]], jnp.int32))
         return toks

@@ -154,3 +154,10 @@ class TestPipelinedTransformer:
         sampled = lm.generate([0], max_new_tokens=4, temperature=0.5,
                               seed=1)
         assert len(sampled) == 5 and all(0 <= t < 11 for t in sampled)
+        # the jitted KV-cache decode path produces IDENTICAL tokens
+        cached = lm.generate([2, 3, 4], max_new_tokens=5, use_cache=True)
+        assert cached == out
+        assert lm.generate([0], max_new_tokens=4, temperature=0.5, seed=1,
+                           use_cache=True) == sampled
+        with pytest.raises(ValueError):
+            lm.generate([1] * 10, max_new_tokens=10, use_cache=True)
