@@ -28,7 +28,7 @@ _lib_lock = threading.Lock()
 _build_attempted = False
 
 
-_ABI_VERSION = 5  # must match dl4j_abi_version() in dl4j_tpu_native.cpp
+_ABI_VERSION = 6  # must match dl4j_abi_version() in dl4j_tpu_native.cpp
 
 
 def _try_build(force=False):
@@ -96,6 +96,13 @@ def get_lib():
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+        lib.dl4j_glove_cooc.restype = ctypes.c_int64
+        lib.dl4j_glove_cooc.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float))]
         lib.dl4j_loader_create.restype = ctypes.c_void_p
         lib.dl4j_loader_create.argtypes = [
             ctypes.c_char_p, ctypes.c_char, ctypes.c_int64,
@@ -211,6 +218,47 @@ def cbow_contexts(ids, offsets, window, seed):
         context.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         targets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     return context[:n], targets[:n]
+
+
+def pack_corpus(id_lists):
+    """Concatenate per-sequence id lists into (ids int32, offsets int64)
+    — the corpus layout every native generator consumes."""
+    ids = np.concatenate([np.asarray(s, np.int32) for s in id_lists])
+    offsets = np.zeros(len(id_lists) + 1, np.int64)
+    np.cumsum([len(s) for s in id_lists], out=offsets[1:])
+    return ids, offsets
+
+
+def glove_cooc(ids, offsets, window, symmetric):
+    """Windowed 1/distance co-occurrence counting in C++ (reference
+    AbstractCoOccurrences role). Returns (i, j, x) COO arrays or None when
+    the library is missing."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    ids = np.ascontiguousarray(ids, np.int32)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    pi = ctypes.POINTER(ctypes.c_int32)()
+    pj = ctypes.POINTER(ctypes.c_int32)()
+    px = ctypes.POINTER(ctypes.c_float)()
+    n = lib.dl4j_glove_cooc(
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        int(offsets.shape[0]) - 1, int(window), int(bool(symmetric)),
+        ctypes.byref(pi), ctypes.byref(pj), ctypes.byref(px))
+    if n < 0:
+        return None
+    if n == 0:
+        for p in (pi, pj, px):
+            lib.dl4j_free(p)
+        z = np.zeros(0, np.int32)
+        return z, z.copy(), np.zeros(0, np.float32)
+    i = np.ctypeslib.as_array(pi, shape=(n,)).copy()
+    j = np.ctypeslib.as_array(pj, shape=(n,)).copy()
+    x = np.ctypeslib.as_array(px, shape=(n,)).copy()
+    for p in (pi, pj, px):
+        lib.dl4j_free(p)
+    return i, j, x
 
 
 class PrefetchCsvLoader:

@@ -155,12 +155,11 @@ class BaseElementsLearning:
         the seed, and calls `native_fn(ids, offsets, window, seed)`.
         Returns (kept_seqs, result); result is None when the native
         library is unavailable (caller runs the per-sequence fallback)."""
+        from ...common.native_ops import pack_corpus
         seqs_ids = [s for s in seqs_ids if len(s) >= 2]
         if not seqs_ids:
             return [], None
-        ids = np.concatenate([np.asarray(s, np.int32) for s in seqs_ids])
-        offsets = np.zeros(len(seqs_ids) + 1, np.int64)
-        np.cumsum([len(s) for s in seqs_ids], out=offsets[1:])
+        ids, offsets = pack_corpus(seqs_ids)
         return seqs_ids, native_fn(ids, offsets, self.window,
                                    seed=int(self._rng.integers(2**63)))
 

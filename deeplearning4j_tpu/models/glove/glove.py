@@ -108,13 +108,33 @@ class Glove(SequenceVectors):
         self.batch_size = 8192
 
     # ------------------------------------------------------------------
-    def build_cooccurrences(self, sequences):
-        """reference: AbstractCoOccurrences — windowed counts with 1/distance
-        weighting (and symmetric counting)."""
+    def _cooc_arrays(self, sequences):
+        """(i, j, x) COO arrays of windowed 1/distance co-occurrence
+        counts. Counting runs in C++ when the native library is available
+        (`native_ops.glove_cooc`, arrays end-to-end); the python fallback
+        streams one sequence at a time through the dict loop."""
+        from ...common import native_ops
+        if native_ops.available():
+            id_lists = [ids for ids in (self._sequence_ids(seq)
+                                        for seq in sequences) if ids]
+            if not id_lists:
+                z = np.zeros(0, np.int32)
+                return z, z.copy(), np.zeros(0, np.float32)
+            ids, offsets = native_ops.pack_corpus(id_lists)
+            res = native_ops.glove_cooc(ids, offsets, self.window,
+                                        self.symmetric)
+            if res is not None:
+                return res
+            sequences = id_lists          # fall through, ids precomputed
+
+            def _ids_iter():
+                return sequences
+        else:
+            def _ids_iter():
+                return (self._sequence_ids(seq) for seq in sequences)
         cooc = {}
         w = self.window
-        for seq in sequences:
-            ids = self._sequence_ids(seq)
+        for ids in _ids_iter():
             n = len(ids)
             for i in range(n):
                 for off in range(1, w + 1):
@@ -126,7 +146,17 @@ class Glove(SequenceVectors):
                     cooc[(a, b)] = cooc.get((a, b), 0.0) + weight
                     if self.symmetric:
                         cooc[(b, a)] = cooc.get((b, a), 0.0) + weight
-        return cooc
+        ci = np.fromiter((k[0] for k in cooc), np.int32, len(cooc))
+        cj = np.fromiter((k[1] for k in cooc), np.int32, len(cooc))
+        cx = np.fromiter(cooc.values(), np.float32, len(cooc))
+        return ci, cj, cx
+
+    def build_cooccurrences(self, sequences):
+        """reference: AbstractCoOccurrences — dict view of the counts
+        (kept for API parity; `fit` consumes the arrays directly)."""
+        ci, cj, cx = self._cooc_arrays(sequences)
+        return {(int(a), int(b)): float(x)
+                for a, b, x in zip(ci, cj, cx)}
 
     # ------------------------------------------------------------------
     def fit(self, sequence_source):
@@ -141,9 +171,10 @@ class Glove(SequenceVectors):
         if V == 0:
             raise ValueError("Empty vocabulary")
 
-        cooc = self.build_cooccurrences(get_sequences())
-        entries = np.array([(i, j, x) for (i, j), x in cooc.items()],
-                          np.float64)
+        ci, cj, cx = self._cooc_arrays(get_sequences())
+        entries = np.column_stack([ci.astype(np.float64),
+                                   cj.astype(np.float64),
+                                   cx.astype(np.float64)])
         if entries.size == 0:
             raise ValueError("No co-occurrences found")
         rng = np.random.default_rng(self.seed)

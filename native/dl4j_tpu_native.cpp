@@ -21,13 +21,14 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 extern "C" {
 
 // Bumped whenever an exported signature changes; the Python loader refuses
 // (and rebuilds) a library whose version doesn't match.
-int64_t dl4j_abi_version() { return 5; }
+int64_t dl4j_abi_version() { return 6; }
 
 // ---------------------------------------------------------------------------
 // IDX parsing (reference: datasets/mnist/MnistImageFile binary reader)
@@ -315,6 +316,51 @@ int64_t dl4j_cbow_contexts(const int32_t* ids, const int64_t* offsets,
     }
   }
   return rows;
+}
+
+// GloVe windowed co-occurrence counting with 1/distance weighting
+// (reference role: AbstractCoOccurrences — the count pass over the corpus
+// that feeds GloVe's weighted-least-squares step). Accumulates into a hash
+// map, then emits COO triples. Outputs are malloc'd arrays (caller frees
+// each with dl4j_free); returns the number of entries, or -1 on alloc
+// failure.
+int64_t dl4j_glove_cooc(const int32_t* ids, const int64_t* offsets,
+                        int64_t n_seq, int32_t window, int32_t symmetric,
+                        int32_t** i_out, int32_t** j_out, float** x_out) {
+  std::unordered_map<int64_t, double> counts;
+  for (int64_t s = 0; s < n_seq; ++s) {
+    const int64_t lo = offsets[s], hi = offsets[s + 1];
+    for (int64_t i = lo; i < hi; ++i) {
+      const int64_t jmax = i + window < hi - 1 ? i + window : hi - 1;
+      for (int64_t j = i + 1; j <= jmax; ++j) {
+        const double w = 1.0 / (double)(j - i);
+        const int64_t a = ids[i], b = ids[j];
+        counts[(a << 32) | (uint32_t)b] += w;
+        if (symmetric) counts[(b << 32) | (uint32_t)a] += w;
+      }
+    }
+  }
+  const int64_t n = (int64_t)counts.size();
+  int32_t* ci = (int32_t*)malloc(n * sizeof(int32_t));
+  int32_t* cj = (int32_t*)malloc(n * sizeof(int32_t));
+  float* cx = (float*)malloc(n * sizeof(float));
+  if (!ci || !cj || !cx) {
+    free(ci);
+    free(cj);
+    free(cx);
+    return -1;
+  }
+  int64_t k = 0;
+  for (const auto& kv : counts) {
+    ci[k] = (int32_t)(kv.first >> 32);
+    cj[k] = (int32_t)(kv.first & 0xFFFFFFFF);
+    cx[k] = (float)kv.second;
+    ++k;
+  }
+  *i_out = ci;
+  *j_out = cj;
+  *x_out = cx;
+  return n;
 }
 
 // ---------------------------------------------------------------------------

@@ -284,3 +284,39 @@ class TestCbowContexts:
         intra = cosine_sim(v("w0"), v("w1"))
         inter = cosine_sim(v("w0"), v("w20"))
         assert intra > inter + 0.2, (intra, inter)
+
+
+class TestGloveCooc:
+    def test_matches_python_counts(self):
+        """Native co-occurrence counting == the python dict loop exactly
+        (same windowed 1/distance weights, symmetric counting)."""
+        if not native_ops.available():
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(0)
+        seqs = [rng.integers(0, 30, rng.integers(2, 15)).astype(np.int32)
+                for _ in range(40)]
+        ids = np.concatenate(seqs)
+        offs = np.zeros(len(seqs) + 1, np.int64)
+        np.cumsum([len(s) for s in seqs], out=offs[1:])
+        for symmetric in (True, False):
+            ci, cj, cx = native_ops.glove_cooc(ids, offs, window=4,
+                                               symmetric=symmetric)
+            native = {(int(a), int(b)): float(x)
+                      for a, b, x in zip(ci, cj, cx)}
+            python = {}
+            for s in seqs:
+                n = len(s)
+                for i in range(n):
+                    for off in range(1, 5):
+                        j = i + off
+                        if j >= n:
+                            break
+                        w = 1.0 / off
+                        python[(int(s[i]), int(s[j]))] = python.get(
+                            (int(s[i]), int(s[j])), 0.0) + w
+                        if symmetric:
+                            python[(int(s[j]), int(s[i]))] = python.get(
+                                (int(s[j]), int(s[i])), 0.0) + w
+            assert set(native) == set(python)
+            for k in python:
+                assert abs(native[k] - python[k]) < 1e-4, k
