@@ -10,47 +10,62 @@ Measures the five BASELINE.md configs on the attached accelerator:
                         on a single chip this exercises the sharded program
                         with a 1-device mesh)
 
-plus one beyond-reference extra (budget permitting, skipped first):
+plus beyond-reference extras (budget permitting, skipped first):
 
-  6. flash_attention_8k Pallas flash kernel vs XLA softmax at T=8192
+  6. resnet50_pipeline  ResNet-50 fit() fed by the REAL AsyncDataSetIterator
+                        host->HBM path (the number users get) next to the
+                        staged-batch primary
+  7. flash_attention_8k Pallas flash kernel vs XLA softmax at T=8192
                         (vs_baseline = measured speedup over XLA)
+  8. decode_tokens_sec  TransformerLM KV-cache decode tokens/s (batch 1 / 8)
 
-Output protocol (round-3 restructure — round 2's single buffered line at
-the very end was lost to the driver's timeout, rc=124, BENCH_r02.json):
+Output protocol (round-4 restructure — the r2 record died to a driver
+timeout with output buffered (rc=124) and the r3 record died to an
+unguarded `jax.devices()` raising when the TPU plugin reported
+UNAVAILABLE (rc=1). The invariants now are):
 
-  * The PRIMARY ResNet-50 config runs FIRST and its complete JSON line is
-    printed immediately, flushed. Whatever happens afterwards, the perf
-    record exists.
-  * After each secondary config finishes, the FULL line (same primary
-    values, `secondary` grown by one entry) is re-printed, flushed. Every
-    printed line is a complete, parseable record; a parser taking either
-    the first or the last JSON line gets a valid result.
-  * A hard wall-clock budget (BENCH_BUDGET_S, default 480 s) gates each
-    secondary: a config whose estimated cost exceeds the remaining budget
-    is recorded as {"skipped": ...} instead of risking a timeout with
-    output half-written.
+  * The parent process NEVER imports jax. Every config — including the
+    primary — runs in a subprocess with a hard timeout. A wedged or
+    crashing backend can take down one config, never the record.
+  * A complete, parseable stub line is printed BEFORE any backend is
+    touched, so a parser always finds a record no matter what happens.
+  * Backend acquisition is probed in a subprocess with retries+backoff;
+    on persistent TPU failure every config still runs (reduced shapes)
+    under JAX_PLATFORMS=cpu, and every record carries
+    `"platform": "cpu", "tpu_init_error": "..."` so the fallback is
+    honest and visible.
+  * After each config finishes, the FULL line (same primary values,
+    `secondary` grown by one entry) is re-printed, flushed. Every
+    printed line is a complete record; a parser taking the last JSON
+    line gets the most complete result, one taking the first still gets
+    a valid (flagged) record.
+  * A hard wall-clock budget (BENCH_BUDGET_S, default 660 s) gates each
+    config; the process always exits 0.
 
 vs_baseline: the reference publishes no numbers (BASELINE.md). Stand-in
 figures below are conservative estimates for the 2016 dl4j stack on V100
 (ResNet-50: 300 img/s with cuDNN 5) / host CPU (others); they are floors to
 beat, not measured reference numbers — see PERF.md for the roofline analysis
 of what the TPU numbers mean.
-
-On CPU (no accelerator) a reduced LeNet-only config runs so the line still
-prints quickly.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 BASELINE_RESNET50_IMAGES_PER_SEC = 300.0     # dl4j-0.6-era V100 stand-in
 BASELINE_LENET_IMAGES_PER_SEC = 3000.0       # nd4j-native host stand-in
 BASELINE_CHARRNN_CHARS_PER_SEC = 20000.0     # LSTMHelpers per-step loop stand-in
 BASELINE_W2V_PAIRS_PER_SEC = 500000.0        # native hogwild AggregateSkipGram stand-in
+BASELINE_DECODE_TOKENS_PER_SEC = 1000.0      # rnnTimeStep-era streaming stand-in
+
+# ResNet-50 batch-128 training step: 2.86 TFLOP by XLA cost analysis
+# (PERF.md); v5e bf16 peak ~197 TFLOP/s. Used for the primary's "mfu" field.
+RESNET50_FLOPS_PER_IMAGE = 2.86e12 / 128
+TPU_V5E_PEAK_FLOPS = 197e12
 
 
 def _bench_net(net, x, y, warmup=2, iters=10, reps=2):
@@ -78,49 +93,93 @@ def _bench_net(net, x, y, warmup=2, iters=10, reps=2):
     return best
 
 
-def bench_lenet(rng):
+def bench_lenet(rng, small=False):
+    import numpy as np
+
     from deeplearning4j_tpu.models.zoo.lenet import lenet
-    batch = 512
+    batch = 64 if small else 512
     net = lenet(data_type="bfloat16")
     x = rng.random((batch, 784)).astype(np.float32)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
-    ips = _bench_net(net, x, y, warmup=3, iters=30)
+    ips = _bench_net(net, x, y, warmup=1 if small else 3,
+                     iters=5 if small else 30, reps=1 if small else 2)
     return {"value": round(ips, 1), "unit": "images/sec",
             "config": f"batch {batch}, bf16",
             "vs_baseline": round(ips / BASELINE_LENET_IMAGES_PER_SEC, 3)}
 
 
-def bench_resnet50(rng):
+def bench_resnet50(rng, small=False):
+    import numpy as np
+
     from deeplearning4j_tpu.models.zoo.resnet import resnet50
-    batch = 128   # r3 interleaved sweep: 128 -> 2633-2641 img/s,
-    #               256 -> ~2535, 192 -> ~2350 (bias-free convs + fused BN)
+    batch = 4 if small else 128
+    # r3 interleaved sweep: 128 -> 2633-2641 img/s, 256 -> ~2535,
+    # 192 -> ~2350 (bias-free convs + fused BN)
     net = resnet50(data_type="bfloat16")
     x = rng.random((batch, 224, 224, 3)).astype(np.float32)
     y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
     # 3 reps x 15 iters: the first timed segments run slower while the
     # pipeline warms; best-of-3 matches the interleaved steady state
-    ips = _bench_net(net, x, y, warmup=3, iters=15, reps=3)
+    ips = _bench_net(net, x, y, warmup=1 if small else 3,
+                     iters=2 if small else 15, reps=1 if small else 3)
     return {"value": round(ips, 1), "unit": "images/sec",
             "config": f"batch {batch}, 224x224, bf16",
+            "mfu": round(ips * RESNET50_FLOPS_PER_IMAGE
+                         / TPU_V5E_PEAK_FLOPS, 4),
             "vs_baseline": round(ips / BASELINE_RESNET50_IMAGES_PER_SEC, 3)}
 
 
-def bench_char_rnn(rng):
+def bench_resnet50_pipeline(rng, small=False):
+    """ResNet-50 fit() fed by the real AsyncDataSetIterator host->HBM
+    pipeline — the number reference users get from
+    MultiLayerNetwork.fit(DataSetIterator) with async prefetch
+    (AsyncDataSetIterator.java:75-76) — vs the staged-batch primary that
+    isolates step time."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.iterators import (
+        ArraysDataSetIterator, AsyncDataSetIterator)
+    from deeplearning4j_tpu.models.zoo.resnet import resnet50
+
+    batch = 4 if small else 128
+    n_batches = 2 if small else 12
+    net = resnet50(data_type="bfloat16")
+    x = rng.random((batch * n_batches, 224, 224, 3)).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[
+        rng.integers(0, 1000, batch * n_batches)]
+    base = ArraysDataSetIterator((x, y), batch_size=batch)
+    # one full epoch to compile + warm the prefetch thread
+    net.fit(AsyncDataSetIterator(base, queue_size=4))
+    float(net._score)
+    epochs = 1 if small else 3
+    t0 = time.perf_counter()
+    net.fit(AsyncDataSetIterator(base, queue_size=4), num_epochs=epochs)
+    float(net._score)
+    dt = time.perf_counter() - t0
+    ips = batch * n_batches * epochs / dt
+    return {"value": round(ips, 1), "unit": "images/sec",
+            "config": f"fit(AsyncDataSetIterator), host->HBM per step, "
+                      f"batch {batch}, bf16",
+            "vs_baseline": round(ips / BASELINE_RESNET50_IMAGES_PER_SEC, 3)}
+
+
+def bench_char_rnn(rng, small=False):
     import jax
+    import numpy as np
 
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.models.zoo.char_rnn import char_rnn
-    V, B, T = 77, 64, 200
+    V, B, T = (77, 8, 50) if small else (77, 64, 200)
     net = char_rnn(data_type="bfloat16")
     x = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
     y = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
     ds = DataSet(jax.device_put(x), jax.device_put(y))
-    for _ in range(3):
+    for _ in range(1 if small else 3):
         net.fit(ds)
     float(net._score)
-    iters = 20
+    iters = 3 if small else 20
     cps = 0.0
-    for _ in range(2):   # best-of-2 (see _bench_net)
+    for _ in range(1 if small else 2):   # best-of-2 (see _bench_net)
         t0 = time.perf_counter()
         for _ in range(iters):
             net.fit(ds)
@@ -132,15 +191,16 @@ def bench_char_rnn(rng):
             "vs_baseline": round(cps / BASELINE_CHARRNN_CHARS_PER_SEC, 3)}
 
 
-def bench_word2vec(rng):
+def bench_word2vec(rng, small=False):
     import jax
+    import numpy as np
 
     from deeplearning4j_tpu.models.embeddings.learning import SkipGram
     from deeplearning4j_tpu.models.embeddings.lookup_table import \
         InMemoryLookupTable
     from deeplearning4j_tpu.models.word2vec.vocab import VocabCache
 
-    V, D = 10000, 100
+    V, D = (2000, 50) if small else (10000, 100)
     vocab = VocabCache()
     for i in range(V):
         vocab.add_token(f"w{i}", count=int(rng.zipf(1.5)))
@@ -156,14 +216,16 @@ def bench_word2vec(rng):
 
     sg = SkipGram(batch_pairs=65536)   # large flushes amortize dispatch
     sg.configure(vocab, table, window=5, negative=5, use_hs=False, seed=1)
-    seqs = [rng.integers(0, V, 40).tolist() for _ in range(3200)]
+    n_seqs = 400 if small else 3200
+    seqs = [rng.integers(0, V, 40).tolist() for _ in range(n_seqs)]
     for s in seqs[:100]:
         sg.learn_sequence(s, 0.025)
     sg._flush(force=True)
     jax.block_until_ready(sg._syn0)
     pps = 0.0
+    per_rep = 150 if small else 1500
     for rep in range(2):   # best-of-2 (see _bench_net)
-        chunk = seqs[100 + 1500 * rep:100 + 1500 * (rep + 1)]
+        chunk = seqs[100 + per_rep * rep:100 + per_rep * (rep + 1)]
         base = sg._flushed_pairs
         t0 = time.perf_counter()
         # corpus-chunk path: C++ pair generation feeding the batched TPU
@@ -182,7 +244,7 @@ def bench_word2vec(rng):
             "vs_baseline": round(pps / BASELINE_W2V_PAIRS_PER_SEC, 3)}
 
 
-def bench_flash_attention(rng):
+def bench_flash_attention(rng, small=False):
     """Long-context attention: the Pallas flash kernel vs XLA's softmax
     lowering at T=8192 (beyond-reference workload — the 2016 stack predates
     attention; vs_baseline reports the measured speedup over XLA)."""
@@ -192,6 +254,11 @@ def bench_flash_attention(rng):
     from deeplearning4j_tpu.ops import flash_attention
     from deeplearning4j_tpu.parallel.ring_attention import \
         blockwise_attention
+
+    if small:
+        # the Pallas kernel needs a real TPU (interpreter mode is minutes
+        # at any useful T); keep the record honest instead of fake-fast
+        return {"skipped": "flash kernel requires TPU (cpu fallback run)"}
 
     B, T, H, D = 4, 8192, 8, 64
     mk = lambda: jnp.asarray(rng.standard_normal((B, T, H, D)),
@@ -221,28 +288,62 @@ def bench_flash_attention(rng):
             "vs_baseline": round(t_xla / t_flash, 3)}
 
 
-def bench_parallel_wrapper(rng):
+def bench_decode(rng, small=False):
+    """KV-cache incremental decode throughput — the attention-era
+    equivalent of the reference's O(1)-per-step streaming inference
+    (MultiLayerNetwork.rnnTimeStep, MultiLayerNetwork.java:2196)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+
+    V, L, D, H = (256, 2, 128, 4) if small else (512, 4, 512, 8)
+    steps = 16 if small else 128
+    lm = TransformerLM(V, d_model=D, n_heads=H, n_layers=L,
+                       max_len=max(steps + 16, 64), dtype=jnp.bfloat16)
+    results = {}
+    for batch in (1, 8):
+        prompt = rng.integers(0, V, (batch, 8)).astype(np.int32)
+        # first call compiles the single fused prefill+decode scan program
+        lm.generate_batch(prompt, max_new_tokens=steps)
+        t0 = time.perf_counter()
+        reps = 2 if small else 5
+        for _ in range(reps):
+            lm.generate_batch(prompt, max_new_tokens=steps)
+        dt = time.perf_counter() - t0
+        results[f"batch{batch}"] = round(batch * steps * reps / dt, 1)
+    return {"value": results["batch8"], "unit": "tokens/sec",
+            "config": f"KV-cache decode (one on-device scan program), "
+                      f"TransformerLM L={L} d={D}, {steps} new tokens; "
+                      f"batch1={results['batch1']} tok/s",
+            "vs_baseline": round(results["batch8"]
+                                 / BASELINE_DECODE_TOKENS_PER_SEC, 3)}
+
+
+def bench_parallel_wrapper(rng, small=False):
     import jax
+    import numpy as np
 
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.models.zoo.resnet import resnet50
     from deeplearning4j_tpu.parallel.parallel_wrapper import ParallelWrapper
 
     n_dev = len(jax.devices())
-    batch = 128 * n_dev
+    batch = (4 if small else 128) * n_dev
     net = resnet50(data_type="bfloat16")
     pw = (ParallelWrapper.Builder(net)
           .workers(n_dev).averaging_frequency(1).build())
     x = rng.random((batch, 224, 224, 3)).astype(np.float32)
     y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
     # stage once: steady-state input feeding is double-buffered off the timed
-    # path (AsyncDataSetIterator role); re-transferring 77MB/step over a
-    # remote-attach tunnel would measure the tunnel, not the training step
+    # path (AsyncDataSetIterator role; bench_resnet50_pipeline measures the
+    # fed path); re-transferring 77MB/step over a remote-attach tunnel
+    # would measure the tunnel, not the training step
     ds = DataSet(jax.device_put(x), jax.device_put(y))
-    for _ in range(2):
+    for _ in range(1 if small else 2):
         pw.fit(ds)
     float(net._score)
-    iters = 10
+    iters = 2 if small else 10
     t0 = time.perf_counter()
     for _ in range(iters):
         pw.fit(ds)
@@ -262,88 +363,72 @@ SECONDARY_CONFIGS = {
     "lenet_mnist": (bench_lenet, 90),
     "char_rnn_lstm": (bench_char_rnn, 120),
     "word2vec_skipgram": (bench_word2vec, 90),
+    "decode_tokens_sec": (bench_decode, 90),
+    "resnet50_fit_pipeline": (bench_resnet50_pipeline, 180),
     "parallel_wrapper_resnet50": (bench_parallel_wrapper, 240),
     # beyond-reference extra, LAST: skipped first when the budget is tight
     # so the five BASELINE configs keep priority
     "flash_attention_8k": (bench_flash_attention, 180),
 }
 
-
-def main():
-    import jax
-
-    t_start = time.perf_counter()
-    # r3 measured: 5 configs ≈ 390 s end-to-end on the remote-attached
-    # chip; 660 leaves room for the flash extra. Safe against any driver
-    # timeout because every line printed so far is a complete record.
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "660"))
-
-    platform = jax.devices()[0].platform
-    on_accel = platform not in ("cpu",)
-    rng = np.random.default_rng(0)
-
-    if not on_accel:
-        # CPU fallback: LeNet only, reduced, so the line still prints fast
-        from deeplearning4j_tpu.models.zoo.lenet import lenet_conf
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        batch = 256
-        net = MultiLayerNetwork(lenet_conf(data_type="bfloat16",
-                                           updater="nesterovs")).init()
-        x = rng.random((batch, 784)).astype(np.float32)
-        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
-        ips = _bench_net(net, x, y, warmup=3, iters=30)
-        print(json.dumps({
-            "metric": f"LeNet-MNIST train images/sec (batch {batch}, bf16, "
-                      f"{platform})",
-            "value": round(ips, 1),
-            "unit": "images/sec",
-            "vs_baseline": round(ips / BASELINE_LENET_IMAGES_PER_SEC, 3),
-        }), flush=True)
-        return
-
-    # --- primary FIRST: its line must exist no matter what happens later ---
-    secondary = {}
-    primary = bench_resnet50(rng)
-
-    def emit():
-        print(json.dumps({
-            "metric": f"ResNet-50 train images/sec (batch 128, 224x224, "
-                      f"bf16, {platform})",
-            "value": primary["value"],
-            "unit": "images/sec",
-            "vs_baseline": primary["vs_baseline"],
-            "secondary": secondary,
-        }), flush=True)
-
-    emit()
-
-    # --- secondaries, cheapest first, each gated by the remaining budget.
-    # Each runs in a FRESH SUBPROCESS: measured on the chip, dispatch-bound
-    # configs run up to 5x slower inside a process that already compiled
-    # and ran the big ResNet program (standalone w2v: 3.5M pairs/s; same
-    # code after the primary in-process: 0.5-0.6M). A subprocess pays
-    # ~10-20s backend init but measures the hardware, and a crash cannot
-    # take the record down. est_s: conservative compile+run cost.
-    for name, (_, est_s) in SECONDARY_CONFIGS.items():
-        remaining = budget_s - (time.perf_counter() - t_start)
-        if remaining < est_s:
-            secondary[name] = {
-                "skipped": f"time budget ({remaining:.0f}s left < "
-                           f"{est_s}s estimate)"}
-            emit()
-            continue
-        secondary[name] = _run_config_subprocess(
-            name, timeout=min(remaining, est_s * 2.5))
-        emit()
+_PROBE_SRC = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
 
 
-def _run_config_subprocess(name, timeout):
-    import subprocess
-    import sys
+def _probe_backend(deadline):
+    """Probe accelerator availability in a SUBPROCESS (a wedged PJRT init
+    cannot hang the orchestrator) with retries+backoff for transient
+    UNAVAILABLE (the r3 failure: jax.errors.JaxRuntimeError UNAVAILABLE
+    raised straight through bench.py:281). Returns (platform, error):
+    ('tpu'/'axon'-like, None) on success, ('cpu', reason) on give-up."""
+    err = "no probe attempt ran (budget exhausted before first try)"
+    attempt = 0
+    while True:
+        remaining = deadline - time.perf_counter()
+        if remaining < 10:
+            return "cpu", err
+        attempt += 1
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True,
+                timeout=min(90, remaining),
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            out = p.stdout.strip().splitlines()
+            plat = next((l[len("PLATFORM="):] for l in reversed(out)
+                         if l.startswith("PLATFORM=")), None)
+            if p.returncode == 0 and plat and plat != "cpu":
+                return plat, None
+            if p.returncode == 0:
+                # DEFINITIVE answer: backend init succeeded and only cpu
+                # exists — retrying cannot conjure an accelerator; fall
+                # back immediately instead of burning the probe budget
+                return ("cpu",
+                        f"probe attempt {attempt}: only cpu devices "
+                        f"visible (no accelerator attached)")
+            else:
+                err = (f"probe attempt {attempt}: rc={p.returncode}: "
+                       f"{(p.stderr or p.stdout).strip()[-300:]}")
+        except subprocess.TimeoutExpired:
+            err = f"probe attempt {attempt}: backend init timed out"
+        except Exception as e:  # noqa: BLE001 — record must survive anything
+            err = f"probe attempt {attempt}: {e!r:.300}"
+        time.sleep(min(5 * attempt, 20))
+
+
+def _run_config_subprocess(name, timeout, env_overlay=None, small=False):
+    """Run one config in a fresh subprocess. Two reasons: (a) isolation —
+    a crash or hang costs one config, not the record; (b) fidelity —
+    dispatch-bound configs measured in-process after the big ResNet
+    program run up to 5x slower (r3: standalone w2v 3.5M pairs/s vs
+    0.5-0.6M in-process)."""
+    argv = [sys.executable, os.path.abspath(__file__), "--config", name]
+    if small:
+        argv.append("--small")
+    env = dict(os.environ)
+    env.update(env_overlay or {})
     try:
         p = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--config", name],
-            capture_output=True, text=True, timeout=timeout,
+            argv, capture_output=True, text=True, timeout=timeout, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         for line in reversed(p.stdout.strip().splitlines()):
             try:
@@ -351,23 +436,111 @@ def _run_config_subprocess(name, timeout):
             except ValueError:
                 continue
         return {"error": f"rc={p.returncode}: "
-                         f"{(p.stderr or p.stdout)[-200:]}"}
+                         f"{(p.stderr or p.stdout)[-300:]}"}
     except subprocess.TimeoutExpired:
         return {"error": f"config timed out after {timeout:.0f}s"}
-    except Exception as e:
-        return {"error": str(e)[:200]}
+    except Exception as e:  # noqa: BLE001 — record must survive anything
+        return {"error": str(e)[:300]}
 
 
-def run_single_config(name):
+def main():
+    t_start = time.perf_counter()
+    # r3 measured: 5 configs ≈ 390 s end-to-end on the remote-attached
+    # chip; 660 leaves room for the extras. Safe against any driver
+    # timeout because every line printed so far is a complete record.
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "660"))
+    deadline = t_start + budget_s
+
+    record = {
+        "metric": "ResNet-50 train images/sec (batch 128, 224x224, bf16)",
+        "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
+        "status": "starting (stub printed before backend init)",
+        "secondary": {},
+    }
+
+    def emit():
+        print(json.dumps(record), flush=True)
+
+    # --- invariant 1: a complete line exists BEFORE any backend init ---
+    emit()
+
+    # --- invariant 2: backend acquisition cannot raise or hang here ---
+    probe_budget = float(os.environ.get("BENCH_PROBE_BUDGET_S", "300"))
+    platform, tpu_err = _probe_backend(
+        deadline=min(deadline - 120, t_start + probe_budget))
+    env_overlay, small = {}, False
+    if tpu_err is not None:
+        # persistent TPU failure: fall back to CPU, reduced shapes, and
+        # say so on every record — an honest flagged number beats rc=1.
+        # NOTE: JAX_PLATFORMS=cpu alone does NOT stop a hung TPU-plugin
+        # init under this interpreter's sitecustomize; run_single_config
+        # additionally calls jax.config.update("jax_platforms", "cpu")
+        # when DL4J_TPU_BENCH_CPU is set (measured: env-only still hangs,
+        # config update returns instantly)
+        env_overlay = {"JAX_PLATFORMS": "cpu", "DL4J_TPU_BENCH_CPU": "1"}
+        small = True
+        record["platform"] = "cpu"
+        record["tpu_init_error"] = tpu_err
+    record["metric"] = (f"ResNet-50 train images/sec "
+                        f"(batch {4 if small else 128}, 224x224, bf16, "
+                        f"{platform})")
+
+    # --- primary FIRST, in a subprocess, with retries on failure ---
+    for attempt in range(3):
+        remaining = deadline - time.perf_counter()
+        if remaining < 30:
+            break
+        res = _run_config_subprocess(
+            "resnet50", timeout=min(remaining, 240 if small else 300),
+            env_overlay=env_overlay, small=small)
+        if "value" in res:
+            record["value"] = res["value"]
+            record["vs_baseline"] = res["vs_baseline"]
+            if "mfu" in res:
+                record["mfu"] = res["mfu"]
+            record["status"] = "primary complete"
+            break
+        record["status"] = (f"primary attempt {attempt + 1} failed: "
+                            f"{res.get('error', res)!s:.300}")
+        emit()
+        time.sleep(5)
+    emit()
+
+    # --- secondaries, cheapest first, each gated by the remaining budget ---
+    for name, (_, est_s) in SECONDARY_CONFIGS.items():
+        remaining = deadline - time.perf_counter()
+        if remaining < (30 if small else est_s):
+            record["secondary"][name] = {
+                "skipped": f"time budget ({remaining:.0f}s left < "
+                           f"{est_s}s estimate)"}
+            emit()
+            continue
+        record["secondary"][name] = _run_config_subprocess(
+            name, timeout=min(remaining, est_s * 2.5),
+            env_overlay=env_overlay, small=small)
+        emit()
+
+
+def run_single_config(name, small=False):
+    if os.environ.get("DL4J_TPU_BENCH_CPU"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
     rng = np.random.default_rng(0)
     fn = (bench_resnet50 if name == "resnet50"
           else SECONDARY_CONFIGS[name][0])
-    print(json.dumps(fn(rng)), flush=True)
+    print(json.dumps(fn(rng, small=small)), flush=True)
 
 
 if __name__ == "__main__":
-    import sys
-    if len(sys.argv) == 3 and sys.argv[1] == "--config":
-        run_single_config(sys.argv[2])
+    if len(sys.argv) >= 3 and sys.argv[1] == "--config":
+        run_single_config(sys.argv[2], small="--small" in sys.argv[3:])
     else:
-        main()
+        try:
+            main()
+        except BaseException as e:  # noqa: BLE001
+            # the record lines already printed are complete; never let an
+            # orchestrator bug turn into rc!=0 (the r3 failure mode)
+            print(f"bench orchestrator error (records above are valid): "
+                  f"{e!r}", file=sys.stderr)
+        sys.exit(0)

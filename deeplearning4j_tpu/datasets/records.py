@@ -107,11 +107,12 @@ class CSVSequenceRecordReader(RecordReader):
     prefetch > 0 (numeric files only): that many native worker threads
     parse files concurrently off the GIL (`common/native_ops
     PrefetchCsvLoader`, the DataVec-reader host pipeline kept native per
-    SURVEY.md §2.9); sequences still arrive in file order. NOTE: the
-    prefetch path yields FLOAT values where the python csv path yields
-    strings — identical once consumed numerically (every framework
-    iterator does), different for string-typed consumers. Falls back to
-    the python csv path when the native library is unavailable."""
+    SURVEY.md §2.9); sequences still arrive in file order. Type contract:
+    prefetch > 0 declares the files numeric and ALWAYS yields float
+    values — including on the python fallback when the native library is
+    unavailable (which then raises ValueError on non-numeric content
+    instead of silently changing element types). prefetch == 0 yields
+    raw strings."""
 
     def __init__(self, directory=None, files=None, skip_lines=0,
                  delimiter=",", prefetch=0):
@@ -156,7 +157,17 @@ class CSVSequenceRecordReader(RecordReader):
         self._pos += 1
         with open(path, "r", encoding="utf-8", newline="") as fh:
             rows = list(csv.reader(fh, delimiter=self.delimiter))
-        return [r for r in rows[self.skip_lines:] if r]
+        rows = [r for r in rows[self.skip_lines:] if r]
+        if self.prefetch > 0:
+            # keep the prefetch type contract (floats) on the fallback
+            try:
+                return [[float(v) for v in r] for r in rows]
+            except ValueError as e:
+                raise ValueError(
+                    f"prefetch>0 declares numeric files, but {path} has "
+                    f"non-numeric content; use prefetch=0 for raw string "
+                    f"records") from e
+        return rows
 
     next_record = next_sequence
 

@@ -237,6 +237,7 @@ class EarlyStoppingConfiguration:
             self._saver = None
             self._eval_every_n = 1
             self._save_last = False
+            self._terminate_on_invalid_score = True
 
         def score_calculator(self, sc):
             self._score_calculator = sc; return self
@@ -268,6 +269,16 @@ class EarlyStoppingConfiguration:
 
         saveLastModel = save_last_model
 
+        def terminate_on_invalid_score(self, v):
+            """Default True: a NaN/Inf score stops training (the guard the
+            reference makes opt-in via
+            InvalidScoreIterationTerminationCondition). Pass False for
+            reference parity — training then survives transient non-finite
+            scores unless an explicit condition is configured."""
+            self._terminate_on_invalid_score = bool(v); return self
+
+        terminateOnInvalidScore = terminate_on_invalid_score
+
         def build(self):
             c = EarlyStoppingConfiguration()
             c.score_calculator = self._score_calculator
@@ -276,6 +287,7 @@ class EarlyStoppingConfiguration:
             c.saver = self._saver or InMemoryModelSaver()
             c.eval_every_n = self._eval_every_n
             c.save_last = self._save_last
+            c.terminate_on_invalid_score = self._terminate_on_invalid_score
             return c
 
 
@@ -330,13 +342,16 @@ class EarlyStoppingTrainer:
 
     @staticmethod
     def _check_iteration_termination(c, last):
-        """Shared iteration-termination check + divergence guard: a
-        non-finite score (NaN or +/-Inf) always terminates — the
-        reference InvalidScoreIterationTerminationCondition role, applied
-        unconditionally here because a non-finite score can never recover
-        information for best-model selection. Returns (reason, details)
+        """Shared iteration-termination check + divergence guard: by
+        default a non-finite score (NaN or +/-Inf) terminates — the
+        reference InvalidScoreIterationTerminationCondition role, on by
+        default here because a non-finite score can never recover
+        information for best-model selection. Builders that need the
+        reference's opt-in semantics pass
+        terminate_on_invalid_score(False). Returns (reason, details)
         or None."""
-        if not math.isfinite(last):
+        if getattr(c, "terminate_on_invalid_score", True) \
+                and not math.isfinite(last):
             return (EarlyStoppingResult.TerminationReason
                     .IterationTerminationCondition,
                     f"score is non-finite ({last})")

@@ -21,7 +21,7 @@ from dataclasses import dataclass, field
 import jax
 import jax.numpy as jnp
 
-from ... import activations, weights
+from ... import activations, losses, weights
 from ..input_type import InputType
 from .base import LayerConf, register_layer
 from .feedforward import _ff_size
@@ -42,6 +42,9 @@ class GaussianReconstructionDistribution:
 
     def params_per_feature(self):
         return 2
+
+    def total_params(self, n):
+        return n * self.params_per_feature()
 
     def neg_log_prob(self, x, dist_params):
         n = x.shape[-1]
@@ -67,6 +70,9 @@ class BernoulliReconstructionDistribution:
     def params_per_feature(self):
         return 1
 
+    def total_params(self, n):
+        return n
+
     def neg_log_prob(self, x, dist_params):
         logits = dist_params
         # stable BCE with logits
@@ -80,12 +86,136 @@ class BernoulliReconstructionDistribution:
         return {"type": "bernoulli"}
 
 
+class ExponentialReconstructionDistribution:
+    """p(x|z) = λ·exp(-λx) for x ≥ 0; the decoder outputs γ = log λ
+    (optionally through `activation`), so log p(x) = γ - exp(γ)·x and
+    positivity of λ is free. reference:
+    ExponentialReconstructionDistribution.java."""
+
+    def __init__(self, activation="identity"):
+        self.activation = activation
+
+    def params_per_feature(self):
+        return 1
+
+    def total_params(self, n):
+        return n
+
+    def _gamma(self, dist_params):
+        g = activations.get(self.activation)(dist_params)
+        return jnp.clip(g, -20.0, 20.0)
+
+    def neg_log_prob(self, x, dist_params):
+        gamma = self._gamma(dist_params)
+        ll = gamma - jnp.exp(gamma) * x
+        return -jnp.sum(ll, axis=-1)
+
+    def sample_mean(self, dist_params, n):
+        # E[x] = 1/λ = exp(-γ)
+        return jnp.exp(-self._gamma(dist_params))
+
+    def to_dict(self):
+        return {"type": "exponential", "activation": self.activation}
+
+
+class CompositeReconstructionDistribution:
+    """Different distributions over different feature slices — e.g. 10
+    Gaussian features followed by 5 Bernoulli ones. Components see
+    disjoint slices of both the data and the decoder output; losses add.
+    reference: CompositeReconstructionDistribution.java (addDistribution
+    builder)."""
+
+    def __init__(self, components):
+        """components: list of (n_features, distribution) pairs, in
+        feature order."""
+        self.components = [(int(n), d) for n, d in components]
+
+    def total_params(self, n):
+        expect = sum(nc for nc, _ in self.components)
+        if n != expect:
+            raise ValueError(
+                f"composite components cover {expect} features, layer "
+                f"has {n}")
+        return sum(d.total_params(nc) for nc, d in self.components)
+
+    def neg_log_prob(self, x, dist_params):
+        xi = pi = 0
+        total = 0.0
+        for nc, d in self.components:
+            npar = d.total_params(nc)
+            total = total + d.neg_log_prob(
+                x[..., xi:xi + nc], dist_params[..., pi:pi + npar])
+            xi += nc
+            pi += npar
+        return total
+
+    def sample_mean(self, dist_params, n):
+        outs, pi = [], 0
+        for nc, d in self.components:
+            npar = d.total_params(nc)
+            outs.append(d.sample_mean(dist_params[..., pi:pi + npar], nc))
+            pi += npar
+        return jnp.concatenate(outs, axis=-1)
+
+    def to_dict(self):
+        return {"type": "composite",
+                "components": [[n, d.to_dict()]
+                               for n, d in self.components]}
+
+
+class LossFunctionWrapper:
+    """Treat a standard ILossFunction as a (non-probabilistic)
+    reconstruction term — the reference's escape hatch for training a
+    plain autoencoder inside the VAE machinery. Not a normalized density:
+    reconstruction_probability is undefined with this wrapper (the
+    reference throws there too; here the 'neg log prob' is simply the
+    loss value, which is what pretrain_loss needs).
+    reference: LossFunctionWrapper.java."""
+
+    def __init__(self, loss="mse", activation="identity"):
+        self.loss = loss
+        self.activation = activation
+
+    def params_per_feature(self):
+        return 1
+
+    def total_params(self, n):
+        return n
+
+    def neg_log_prob(self, x, dist_params):
+        # ILossFunction signature: (labels, preout, activation, mask) ->
+        # per-example vector — exactly this contract
+        return losses.get(self.loss)(x, dist_params, self.activation)
+
+    def sample_mean(self, dist_params, n):
+        return activations.get(self.activation)(dist_params)
+
+    def to_dict(self):
+        return {"type": "loss_wrapper", "loss": self.loss,
+                "activation": self.activation}
+
+
 def _dist_from_dict(d):
+    if isinstance(d, (GaussianReconstructionDistribution,
+                      BernoulliReconstructionDistribution,
+                      ExponentialReconstructionDistribution,
+                      CompositeReconstructionDistribution,
+                      LossFunctionWrapper)):
+        return d
     if d is None or d.get("type") == "gaussian":
         return GaussianReconstructionDistribution(
             (d or {}).get("activation", "identity"))
     if d["type"] == "bernoulli":
         return BernoulliReconstructionDistribution()
+    if d["type"] == "exponential":
+        return ExponentialReconstructionDistribution(
+            d.get("activation", "identity"))
+    if d["type"] == "composite":
+        return CompositeReconstructionDistribution(
+            [(n, _dist_from_dict(c)) for n, c in d["components"]])
+    if d["type"] == "loss_wrapper":
+        return LossFunctionWrapper(d.get("loss", "mse"),
+                                   d.get("activation", "identity"))
     raise ValueError(f"Unknown reconstruction distribution {d}")
 
 
@@ -106,6 +236,11 @@ class VariationalAutoencoder(LayerConf):
     def __post_init__(self):
         self.encoder_layer_sizes = tuple(self.encoder_layer_sizes)
         self.decoder_layer_sizes = tuple(self.decoder_layer_sizes)
+        # accept distribution objects; normalize to the serde dict so
+        # to_json round-trips regardless of how the conf was built
+        if hasattr(self.reconstruction_distribution, "to_dict"):
+            self.reconstruction_distribution = \
+                self.reconstruction_distribution.to_dict()
 
     def _dist(self):
         return _dist_from_dict(self.reconstruction_distribution)
@@ -138,7 +273,7 @@ class VariationalAutoencoder(LayerConf):
         for i, h in enumerate(self.decoder_layer_sizes):
             mk(f"d{i}", prev, h)
             prev = h
-        mk("pXZ", prev, self.n_in * self._dist().params_per_feature())
+        mk("pXZ", prev, self._dist().total_params(self.n_in))
         return d
 
     # ------------------------------------------------------------------
@@ -189,6 +324,14 @@ class VariationalAutoencoder(LayerConf):
     def reconstruction_probability(self, params, x, num_samples=5, rng=None):
         """Monte-Carlo estimate of log p(x) (importance-weighted).
         reference: VariationalAutoencoder.reconstructionLogProbability."""
+        if isinstance(self._dist(), LossFunctionWrapper):
+            # a wrapped ILossFunction is not a normalized density — the
+            # quantity is undefined (the reference throws here too)
+            raise ValueError(
+                "reconstruction_probability is undefined with "
+                "LossFunctionWrapper (not a probability distribution); "
+                "use a Gaussian/Bernoulli/Exponential/Composite "
+                "reconstruction distribution")
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         mean, logvar = self._encode(params, x)
         lse = []

@@ -120,6 +120,29 @@ def poisson(labels, preout, activation_fn="identity", mask=None):
     return _reduce_per_example(per_elem, mask)
 
 
+def mape(labels, preout, activation_fn="identity", mask=None):
+    """Mean absolute percentage error: 100 * |y - yhat| / max(|y|, eps),
+    column-mean over the output features (reference: nd4j LossMAPE —
+    abs-error scaled by abs label, epsilon-clamped so zero labels don't
+    produce infinities)."""
+    out = _apply_activation(preout, activation_fn)
+    per_elem = 100.0 * jnp.abs(out - labels) / jnp.clip(
+        jnp.abs(labels), _EPS, None)
+    n_out = labels.shape[-1]
+    return _reduce_per_example(per_elem, mask) / n_out
+
+
+def msle(labels, preout, activation_fn="identity", mask=None):
+    """Mean squared logarithmic error: (log((y+1)/(yhat+1)))², column-mean
+    (reference: nd4j LossMSLE — log1p-ratio squared; inputs expected
+    non-negative, clamped at -1+eps so log stays finite)."""
+    out = _apply_activation(preout, activation_fn)
+    d = (jnp.log1p(jnp.clip(out, _EPS - 1.0, None))
+         - jnp.log1p(jnp.clip(labels, _EPS - 1.0, None)))
+    n_out = labels.shape[-1]
+    return _reduce_per_example(d * d, mask) / n_out
+
+
 def cosine_proximity(labels, preout, activation_fn="identity", mask=None):
     out = _apply_activation(preout, activation_fn)
     if mask is not None:
@@ -146,6 +169,8 @@ LOSSES = {
     "squaredhinge": squared_hinge,
     "kl_divergence": kl_divergence,
     "kld": kl_divergence,
+    "mape": mape,
+    "msle": msle,
     "reconstruction_crossentropy": xent,
     "poisson": poisson,
     "cosine_proximity": cosine_proximity,

@@ -18,11 +18,15 @@ Grid: (B*H, T/block_q, T/block_k) — the kv axis is innermost, so each
 carries the online-softmax state (the canonical Pallas accumulation
 pattern). Causal masking skips fully-masked kv blocks via `pl.when`.
 
-Backward: `jax.custom_vjp` recomputes attention blockwise with a
-`jax.checkpoint` block body (`_blockwise_attention_ckpt`): residuals are
-just q,k,v — nothing from the forward is stored, and the recompute never
-materializes more than one q-block's [bq, T] score panel, so TRAINING
-keeps the O(T) residual-memory contract too.
+Backward: fused Pallas kernels (`_bwd_dq_kernel`, `_bwd_dkv_kernel`) in
+the FlashAttention-2 split — the forward additionally saves the per-row
+logsumexp, the backward reconstructs each probability block as
+exp(qkᵀ·scale − lse) and fuses dO·Vᵀ / Pᵀ·dO / dSᵀ·Q inside the grid, so
+dQ accumulates across the kv dimension and dK/dV across the q dimension
+entirely in VMEM scratch. Residual memory stays O(T·d) (q, k, v, o, lse)
+and, unlike the r3 einsum-recompute VJP, no [bq, T] score panel ever
+round-trips through autodiff. `_blockwise_attention_ckpt` remains as the
+XLA-side long-T attention (ring attention's local fallback + test oracle).
 """
 from __future__ import annotations
 
@@ -78,6 +82,14 @@ def _online_softmax_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, *,
         m_cur = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)
         p = jnp.exp(s - m_cur)                         # [bq, bk]
+        if neg != NEG_INF:
+            # finite masked-score stand-in (ring partials): a row that has
+            # attended to NOTHING so far still has m_cur == neg, so the
+            # masked entries' exp(s - m_cur) = exp(0) = 1 would pour
+            # garbage into l/acc. Zero them: never-attended rows keep
+            # l = 0 / acc = 0 and the cross-hop fold treats them as empty
+            # (real scores never approach neg/2, so the cut is safe).
+            p = jnp.where(s > neg * 0.5, p, 0.0)
         l_ref[:, :1] = l_ref[:, :1] * alpha + jnp.sum(p, -1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
@@ -107,8 +119,29 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
                     jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
 
 
-def _flash_fwd_bthd(q, k, v, causal, scale, block_q, block_k, interpret):
-    """q,k,v: [BH, T, d] (batch*heads flattened)."""
+def _kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                *, scale, causal, block_q, block_k):
+    """Forward kernel that ALSO emits the per-row logsumexp (m + log l) —
+    the only forward residual the flash backward kernels need beyond
+    q,k,v,o (FlashAttention-2's softmax_lse)."""
+    _online_softmax_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+                         scale=scale, causal=causal, block_q=block_q,
+                         block_k=block_k,
+                         q_start=pl.program_id(1) * block_q,
+                         k_start=pl.program_id(2) * block_k, neg=NEG_INF)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _emit():
+        l_fin = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_fin).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, :1] + jnp.log(l_fin)
+
+
+def _flash_fwd_bthd(q, k, v, causal, scale, block_q, block_k, interpret,
+                    with_lse=False):
+    """q,k,v: [BH, T, d] (batch*heads flattened). with_lse=True adds the
+    [BH, T, 1] f32 logsumexp output (training forward); inference keeps
+    the single-output kernel r3 was measured with."""
     BH, T, d = q.shape
     # largest divisors of T within the requested block sizes (any T works;
     # powers of two get the full-size blocks the chip numbers were swept at)
@@ -128,14 +161,29 @@ def _flash_fwd_bthd(q, k, v, causal, scale, block_q, block_k, interpret):
         pltpu.VMEM((bq, 128), jnp.float32),   # l
         pltpu.VMEM((bq, d), jnp.float32),     # acc
     ]
-    kernel = functools.partial(_kernel, scale=scale, causal=causal,
-                               block_q=bq, block_k=bk)
     extra = {}
     if not interpret and pltpu is not None:
         # outer grid dims are independent; only the kv dim carries the
         # online-softmax accumulation state
         extra["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
+    if with_lse:
+        lse_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), **kw)
+        kernel = functools.partial(_kernel_lse, scale=scale, causal=causal,
+                                   block_q=bq, block_k=bk)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=[o_spec, lse_spec],
+            out_shape=[jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+                       jax.ShapeDtypeStruct((BH, T, 1), jnp.float32)],
+            scratch_shapes=scratch,
+            interpret=interpret,
+            **extra,
+        )(q, k, v)
+    kernel = functools.partial(_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -230,6 +278,179 @@ def _divisor_block(T, requested):
     return b
 
 
+# ---------------------------------------------------------------------------
+# Flash backward — fused Pallas dQ / dK+dV kernels (FlashAttention-2 split)
+#
+# Residuals: q, k, v, o, lse (lse = per-row logsumexp from `_kernel_lse`).
+# Per (q-block i, kv-block j) the probabilities are reconstructed exactly as
+#   p = exp(q_i k_jᵀ·scale − lse_i)            (no second online softmax)
+# and with D_i = rowsum(dO_i ∘ O_i):
+#   dV_j = Σ_i p ᵀ dO_i
+#   dS   = p ∘ (dO_i V_jᵀ − D_i)
+#   dQ_i = Σ_j dS K_j · scale        (kv innermost — dq accumulates in VMEM)
+#   dK_j = Σ_i dSᵀ Q_i · scale       (q innermost — dk/dv accumulate in VMEM)
+# Two passes so every accumulator lives in VMEM scratch across its inner
+# grid dimension — no HBM read-modify-write, O(T) HBM traffic like the
+# forward. Causal skipping drops the strictly-masked half of each grid.
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dq_ref,
+                   dq_acc_ref, *, scale, causal, block_q, block_k):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    q_start = pl.program_id(1) * block_q
+    k_start = ik * block_k
+
+    def compute():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # [bq, bk]
+        if causal:
+            row = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])       # exact probs; masked -> exp(-inf)=0
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bq, bk]
+        ds = p * (dp - delta_ref[0])
+        dq_acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ik == pl.num_programs(2) - 1)
+    def _emit():
+        dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dk_ref,
+                    dv_ref, dk_acc_ref, dv_acc_ref, *, scale, causal,
+                    block_q, block_k):
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    k_start = pl.program_id(1) * block_k
+    q_start = iq * block_q
+
+    def compute():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # [bq, bk]
+        if causal:
+            row = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                            # [bq, bk]
+        # dV_j += pᵀ dO  (contract the q dim — no explicit transpose)
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bk, d]
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bq, bk]
+        ds = p * (dp - delta_ref[0])
+        # dK_j += dSᵀ Q · scale
+        dk_acc_ref[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        # skip q blocks strictly above this kv block's diagonal reach
+        @pl.when(q_start + block_q - 1 >= k_start)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(iq == pl.num_programs(2) - 1)
+    def _emit():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_bthd(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                    interpret):
+    """q,k,v,o,do: [BH, T, d]; lse: [BH, T, 1] f32. Returns (dq, dk, dv).
+
+    delta = rowsum(dO ∘ O) is precomputed ONCE as [BH, T, 1] (XLA fuses
+    the elementwise+reduce) and streamed into both kernels like lse —
+    FlashAttention-2's delta pass; recomputing it per (kv, q) grid pair
+    would redo the full [T] reduction T/bk times.
+
+    Backward default blocks are half the forward's: the backward keeps
+    three [bq, bk] f32 panels (p, dp, ds) live per step, so 512² blocks
+    fit VMEM where the forward ran 1024² with one panel."""
+    BH, T, d = q.shape
+    bq = _divisor_block(T, block_q)
+    bk = _divisor_block(T, block_k)
+    if pltpu is None:
+        raise NotImplementedError("pallas TPU backend unavailable")
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    -1, keepdims=True)                    # [BH, T, 1]
+    kw = {"memory_space": _VMEM} if _VMEM is not None else {}
+    extra = {}
+    if not interpret:
+        extra["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    # --- pass 1: dQ (grid kv-innermost) ---
+    qb_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **kw)
+    kvb_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), **kw)
+    lse_q_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), **kw)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(BH, T // bq, T // bk),
+        in_specs=[qb_spec, kvb_spec, kvb_spec, lse_q_spec, qb_spec,
+                  lse_q_spec],
+        out_specs=qb_spec,
+        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+        **extra,
+    )(q, k, v, delta, do, lse)
+
+    # --- pass 2: dK + dV (grid q-innermost) ---
+    q_in_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0), **kw)
+    kv_out_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0), **kw)
+    lse_in_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0), **kw)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(BH, T // bk, T // bq),
+        in_specs=[q_in_spec, kv_out_spec, kv_out_spec, lse_in_spec,
+                  q_in_spec, lse_in_spec],
+        out_specs=[kv_out_spec, kv_out_spec],
+        out_shape=[jax.ShapeDtypeStruct((BH, T, d), k.dtype),
+                   jax.ShapeDtypeStruct((BH, T, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+        **extra,
+    )(q, k, v, delta, do, lse)
+    return dq, dk, dv
+
+
 def _reference_attention(q, k, v, causal, scale):
     """Einsum reference ([B,T,H,D]); materializes [T,T] — test oracle and
     small-T backward only."""
@@ -302,19 +523,38 @@ def _flash_apply(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    return (flash_attention(q, k, v, causal, scale, block_q, block_k,
-                            interpret), (q, k, v))
+    """Training forward: same grid as inference plus the [BH, T, 1] lse
+    output — the residuals (q, k, v, o, lse) are everything the fused
+    backward kernels need, keeping the O(T)-residual-memory contract."""
+    B, T, H, D = q.shape
+    sc = 1.0 / math.sqrt(D) if scale is None else scale
+    interp = (jax.default_backend() != "tpu" if interpret is None
+              else interpret)
+    to_bhtd = lambda a: a.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    out, lse = _flash_fwd_bthd(to_bhtd(q), to_bhtd(k), to_bhtd(v), causal,
+                               sc, block_q, block_k, interp, with_lse=True)
+    out_bthd = out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    return out_bthd, (q, k, v, out_bthd, lse)
 
 
 def _bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    _, vjp = jax.vjp(
-        lambda q, k, v: _blockwise_attention_ckpt(q, k, v, causal, scale,
-                                                  block_q=block_q),
-        q, k, v)
-    return vjp(g)
+    """Fused Pallas dQ/dK/dV (replaces the r3 einsum-recompute VJP, which
+    paid a full re-softmax through autodiff: 0.86x/0.71x of dense training
+    tok/s at T=2048/4096 — PERF.md 'Training trade-off')."""
+    q, k, v, o, lse = res
+    B, T, H, D = q.shape
+    sc = 1.0 / math.sqrt(D) if scale is None else scale
+    interp = (jax.default_backend() != "tpu" if interpret is None
+              else interpret)
+    to_bhtd = lambda a: a.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    # backward blocks: half the forward's (three f32 [bq,bk] panels live)
+    bwd_bq = max(block_q // 2, 256)
+    bwd_bk = max(block_k // 2, 256)
+    dq, dk, dv = _flash_bwd_bthd(
+        to_bhtd(q), to_bhtd(k), to_bhtd(v), to_bhtd(o), lse, to_bhtd(g),
+        causal, sc, bwd_bq, bwd_bk, interp)
+    back = lambda a: a.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    return back(dq), back(dk), back(dv)
 
 
 flash_attention.defvjp(_fwd, _bwd)

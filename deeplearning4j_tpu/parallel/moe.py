@@ -65,11 +65,19 @@ def _route(gate_w, x):
     return expert, gate.astype(x.dtype), probs
 
 
+def _route_fractions(probs, expert, n_experts):
+    """(f, P): fraction of tokens routed to each expert, mean router prob
+    per expert — the two means the Switch aux loss is built from (shared
+    by the dense loss and the sharded pmean-then-multiply path)."""
+    f = jnp.mean(jax.nn.one_hot(expert, n_experts, dtype=probs.dtype), 0)
+    p = jnp.mean(probs, 0)
+    return f, p
+
+
 def load_balance_loss(probs, expert, n_experts):
     """Switch aux loss: E * sum_e f_e * P_e (f = fraction of tokens routed
     to e, P = mean router prob for e). Encourages uniform expert load."""
-    f = jnp.mean(jax.nn.one_hot(expert, n_experts, dtype=probs.dtype), 0)
-    p = jnp.mean(probs, 0)
+    f, p = _route_fractions(probs, expert, n_experts)
     return n_experts * jnp.sum(f * p)
 
 
@@ -144,7 +152,14 @@ def moe_mlp_sharded(mesh, axis="expert", capacity=None):
         back = back.reshape(E, C, D)
         out = back[expert, jnp.where(keep, pos_t, 0)] * \
             (gate * keep.astype(gate.dtype))[:, None]
-        aux = jax.lax.pmean(load_balance_loss(probs, expert, E), axis)
+        # global-batch aux loss: pmean f and P separately FIRST, then form
+        # E*sum(f*P). pmean of per-shard losses would differ (the product
+        # is nonlinear in f, P); shards hold equal token counts, so the
+        # pmean of per-shard means IS the global mean and aux matches
+        # moe_mlp_dense exactly (pinned by test).
+        f_loc, p_loc = _route_fractions(probs, expert, E)
+        aux = E * jnp.sum(jax.lax.pmean(f_loc, axis) *
+                          jax.lax.pmean(p_loc, axis))
         return out, aux
 
     pspec = {"gate": P(), "w1": P(axis), "b1": P(axis), "w2": P(axis),

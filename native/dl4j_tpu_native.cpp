@@ -341,9 +341,13 @@ int64_t dl4j_glove_cooc(const int32_t* ids, const int64_t* offsets,
     }
   }
   const int64_t n = (int64_t)counts.size();
-  int32_t* ci = (int32_t*)malloc(n * sizeof(int32_t));
-  int32_t* cj = (int32_t*)malloc(n * sizeof(int32_t));
-  float* cx = (float*)malloc(n * sizeof(float));
+  // malloc(0) may legally return NULL, which the failure check below would
+  // misread as out-of-memory (-1, silent python fallback); allocate at
+  // least one element so n == 0 still returns valid (empty) buffers
+  const size_t n_alloc = n > 0 ? (size_t)n : 1;
+  int32_t* ci = (int32_t*)malloc(n_alloc * sizeof(int32_t));
+  int32_t* cj = (int32_t*)malloc(n_alloc * sizeof(int32_t));
+  float* cx = (float*)malloc(n_alloc * sizeof(float));
   if (!ci || !cj || !cx) {
     free(ci);
     free(cj);

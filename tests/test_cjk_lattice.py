@@ -1,0 +1,107 @@
+"""Japanese lattice (trie + Viterbi) tokenizer — dictionary-based
+morphological segmentation the script-transition baseline cannot do
+(reference: deeplearning4j-nlp-japanese Kuromoji ViterbiSearcher.java /
+PatriciaTrie.java), plus Korean particle-stripping behavior
+(deeplearning4j-nlp-korean KoreanTokenizer.java)."""
+import pytest
+
+from deeplearning4j_tpu.text.cjk_tokenization import (JapaneseTokenizer,
+                                                      KoreanTokenizer)
+from deeplearning4j_tpu.text.ja_lattice import (
+    JapaneseLatticeTokenizer, JapaneseLatticeTokenizerFactory,
+    viterbi_segment)
+from deeplearning4j_tpu.text.ja_lexicon import build_entries
+
+
+class TestLexicon:
+    def test_conjugation_expansion_scale(self):
+        """A few hundred lemmas expand to thousands of surface forms —
+        the Kuromoji dictionary shape at 1/20 scale."""
+        entries = build_entries()
+        assert len(entries) > 2000
+        surfaces = {s for s, _, _ in entries}
+        # expanded godan forms (never written in the lexicon literally)
+        for form in ("行きました", "書いて", "読んだ", "買った", "話して",
+                     "飲みません", "待って", "遊んで", "泳いだ"):
+            assert form in surfaces, form
+        # expanded i-adjective forms
+        for form in ("高かった", "新しくない", "暑くて"):
+            assert form in surfaces, form
+
+
+class TestLatticeSegmentation:
+    def test_all_hiragana_classic(self):
+        """The classic: one unbroken hiragana run — script-transition
+        splitting yields a single token; the lattice segments the words."""
+        text = "すもももももももものうち"
+        assert JapaneseTokenizer(text)._tokens == [text]  # baseline fails
+        assert JapaneseLatticeTokenizer(text)._tokens == \
+            ["すもも", "も", "もも", "も", "もも", "の", "うち"]
+
+    def test_mixed_script_sentence(self):
+        """は after 私 is a particle boundary the script splitter merges
+        (私は is one hiragana-adjacent run boundary, but 行きました is
+        split mid-verb by the han->hiragana transition)."""
+        got = JapaneseLatticeTokenizer("私は学校に行きました")._tokens
+        assert got == ["私", "は", "学校", "に", "行きました"]
+        # the baseline splits the verb 行きました after the kanji stem
+        base = JapaneseTokenizer("私は学校に行きました")._tokens
+        assert "行きました" not in base
+
+    def test_katakana_unknown_word_grouped(self):
+        got = JapaneseLatticeTokenizer("東京でラーメンを食べた")._tokens
+        assert got == ["東京", "で", "ラーメン", "を", "食べた"]
+
+    def test_adjective_and_final_particles(self):
+        got = JapaneseLatticeTokenizer("今日はとても暑いですね")._tokens
+        assert got == ["今日", "は", "とても", "暑い", "です", "ね"]
+
+    def test_te_iru_progressive(self):
+        got = JapaneseLatticeTokenizer("彼女は新しい本を読んでいます")._tokens
+        assert got == ["彼女", "は", "新しい", "本", "を", "読んでいます"]
+
+    def test_punctuation_splits_chunks(self):
+        got = JapaneseLatticeTokenizer("今日は雨です。明日は晴れます。")._tokens
+        assert got == ["今日", "は", "雨", "です", "明日", "は", "晴れます"]
+
+    def test_pos_tags_exposed(self):
+        t = JapaneseLatticeTokenizer("私は学校に行きました")
+        assert t.pos_tags == ["pron", "particle", "noun", "particle",
+                              "verb"]
+
+    def test_unknown_model_always_connects(self):
+        # out-of-vocabulary everything still yields a segmentation
+        toks = JapaneseLatticeTokenizer("燚燚燚がヘンテコだ")._tokens
+        assert toks and "".join(toks) == "燚燚燚がヘンテコだ"
+
+    def test_viterbi_segment_empty(self):
+        assert viterbi_segment("") == []
+
+    def test_factory_spi(self):
+        f = JapaneseLatticeTokenizerFactory()
+        t = f.create("水を飲みたいです")
+        out = []
+        while t.has_more_tokens():
+            out.append(t.next_token())
+        assert out == ["水", "を", "飲みたい", "です"]
+
+
+class TestKoreanParticles:
+    def test_strips_common_particles(self):
+        got = KoreanTokenizer("학교에서 공부를 했다")._tokens
+        assert got == ["학교", "공부", "했다"]
+
+    def test_longest_particle_wins(self):
+        # 에서 must strip before 에 (longest-match ordering)
+        assert KoreanTokenizer("도서관에서")._tokens == ["도서관"]
+        assert KoreanTokenizer("도서관에")._tokens == ["도서관"]
+
+    def test_no_strip_mode(self):
+        got = KoreanTokenizer("학교에서 공부를 했다",
+                              strip_particles=False)._tokens
+        assert got == ["학교에서", "공부를", "했다"]
+
+    def test_single_char_words_kept(self):
+        # a word that IS a particle-like single char must not vanish
+        assert KoreanTokenizer("물 좀 주세요")._tokens == ["물", "좀",
+                                                           "주세요"]

@@ -24,6 +24,7 @@ def test_all_examples_compile():
         py_compile.compile(os.path.join(EXAMPLES, f), doraise=True)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name", ["ring_attention_long_context.py",
                                   "moe_expert_parallel.py"])
 def test_fast_examples_run(name):

@@ -76,6 +76,7 @@ class TestFlashBackward:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5)
 
+    @pytest.mark.slow
     def test_trains_in_transformer_block(self):
         """flash attention drops into the zoo transformer block and the LM
         still learns (attention='flash' path)."""

@@ -1,0 +1,171 @@
+"""Loss-function tail (MAPE, MSLE) + VAE reconstruction-distribution set
+(Exponential, Composite, LossFunctionWrapper) — closes the reference's
+ILossFunction surface (nd4j LossMAPE/LossMSLE) and
+nn/conf/layers/variational/ (ExponentialReconstructionDistribution.java,
+CompositeReconstructionDistribution.java, LossFunctionWrapper.java).
+Each new term is gradient-checked numerically, the reference's
+VaeGradientCheckTests / LossFunctionGradientCheck pattern."""
+import numpy as np
+import pytest
+
+jax = __import__("jax")
+jnp = jax.numpy
+
+from deeplearning4j_tpu.nn import losses
+from deeplearning4j_tpu.nn.conf.layers.variational import (
+    BernoulliReconstructionDistribution,
+    CompositeReconstructionDistribution,
+    ExponentialReconstructionDistribution,
+    GaussianReconstructionDistribution, LossFunctionWrapper,
+    VariationalAutoencoder, _dist_from_dict)
+
+
+def _numeric_grad_check(f, x0, n_probe=25, eps=1e-6, tol=1e-4, seed=0):
+    """Central-difference check of jax.grad(f) at flat vector x0."""
+    g = np.asarray(jax.grad(f)(jnp.asarray(x0)))
+    rs = np.random.default_rng(seed)
+    idx = rs.choice(x0.size, min(n_probe, x0.size), replace=False)
+    for i in idx:
+        v = x0.copy()
+        v[i] += eps
+        sp = float(f(jnp.asarray(v)))
+        v[i] -= 2 * eps
+        sm = float(f(jnp.asarray(v)))
+        num = (sp - sm) / (2 * eps)
+        denom = abs(g[i]) + abs(num)
+        assert denom == 0 or abs(g[i] - num) / denom < tol, (i, g[i], num)
+
+
+class TestLossTail:
+    def test_mape_value_and_grad(self):
+        r = np.random.default_rng(0)
+        y = r.random((6, 4)) + 0.5            # bounded away from zero
+        p = r.standard_normal((6, 4))
+        got = np.asarray(losses.mape(jnp.asarray(y), jnp.asarray(p)))
+        want = (100.0 * np.abs(p - y) / np.abs(y)).sum(1) / 4
+        np.testing.assert_allclose(got, want, rtol=1e-9)
+        _numeric_grad_check(
+            lambda v: jnp.mean(losses.mape(jnp.asarray(y),
+                                           v.reshape(6, 4))),
+            p.ravel().copy())
+
+    def test_msle_value_and_grad(self):
+        r = np.random.default_rng(1)
+        y = r.random((5, 3)) * 4
+        p = r.random((5, 3)) * 4
+        got = np.asarray(losses.msle(jnp.asarray(y), jnp.asarray(p)))
+        want = ((np.log1p(p) - np.log1p(y)) ** 2).sum(1) / 3
+        np.testing.assert_allclose(got, want, rtol=1e-9)
+        _numeric_grad_check(
+            lambda v: jnp.mean(losses.msle(jnp.asarray(y),
+                                           v.reshape(5, 3))),
+            p.ravel().copy())
+
+    def test_registry_exposes_new_losses(self):
+        assert losses.get("mape") is losses.mape
+        assert losses.get("MSLE") is losses.msle
+
+    def test_mask_zeroes_contributions(self):
+        y = jnp.ones((2, 3)) * 2.0
+        p = jnp.ones((2, 3)) * 3.0
+        m = jnp.asarray([[1.0, 1.0, 0.0], [1.0, 1.0, 1.0]])
+        full = np.asarray(losses.mape(y, p, "identity", None))
+        masked = np.asarray(losses.mape(y, p, "identity", m))
+        assert masked[0] == pytest.approx(full[0] * 2 / 3)
+        assert masked[1] == pytest.approx(full[1])
+
+
+def _vae(dist):
+    return VariationalAutoencoder(
+        n_in=8, n_out=3, encoder_layer_sizes=(10,),
+        decoder_layer_sizes=(10,), activation="tanh",
+        reconstruction_distribution=dist,
+    ).apply_global_defaults({"weight_init": "xavier"})
+
+
+def _flat_elbo(vae, x, seed=0):
+    params = vae.init_params(jax.random.PRNGKey(seed), jnp.float64)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    flat = np.concatenate([np.asarray(l).ravel() for l in leaves])
+    rng = jax.random.PRNGKey(3)
+
+    def unflatten(v):
+        out, off = [], 0
+        for l in leaves:
+            n = l.size
+            out.append(jnp.asarray(v[off:off + n]).reshape(l.shape))
+            off += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return params, flat, (lambda v: vae.pretrain_loss(unflatten(v), x,
+                                                      rng=rng))
+
+
+class TestReconstructionDistributionTail:
+    def test_exponential_elbo_gradcheck(self):
+        r = np.random.default_rng(2)
+        x = jnp.asarray(r.exponential(1.0, (12, 8)))
+        vae = _vae({"type": "exponential"})
+        _, flat, f = _flat_elbo(vae, x)
+        _numeric_grad_check(f, flat, n_probe=12)
+
+    def test_exponential_mean_is_inverse_rate(self):
+        d = ExponentialReconstructionDistribution()
+        gamma = jnp.asarray([[0.0, 1.0, -1.0]])
+        mean = np.asarray(d.sample_mean(gamma, 3))
+        np.testing.assert_allclose(mean, np.exp([[0.0, -1.0, 1.0]]),
+                                   rtol=1e-6)
+        # analytic check: -log p for λ=1 (γ=0) is x
+        x = jnp.asarray([[0.5, 2.0, 1.0]])
+        nlp = float(d.neg_log_prob(x, jnp.zeros((1, 3)))[0])
+        assert nlp == pytest.approx(3.5)
+
+    def test_composite_slices_and_sums(self):
+        """Composite(gaussian 5, bernoulli 3) == gaussian on x[:, :5] +
+        bernoulli on x[:, 5:] with the matching param slices."""
+        g = GaussianReconstructionDistribution()
+        b = BernoulliReconstructionDistribution()
+        comp = CompositeReconstructionDistribution([(5, g), (3, b)])
+        assert comp.total_params(8) == 5 * 2 + 3
+        r = np.random.default_rng(3)
+        x = jnp.asarray(r.random((6, 8)))
+        params = jnp.asarray(r.standard_normal((6, 13)))
+        got = np.asarray(comp.neg_log_prob(x, params))
+        want = (np.asarray(g.neg_log_prob(x[:, :5], params[:, :10]))
+                + np.asarray(b.neg_log_prob(x[:, 5:], params[:, 10:])))
+        np.testing.assert_allclose(got, want, rtol=1e-9)
+        mean = np.asarray(comp.sample_mean(params, 8))
+        assert mean.shape == (6, 8)
+        with pytest.raises(ValueError):
+            comp.total_params(9)   # components cover 8 features
+
+    def test_composite_elbo_gradcheck_and_serde(self):
+        dist = {"type": "composite", "components": [
+            [5, {"type": "gaussian", "activation": "identity"}],
+            [3, {"type": "bernoulli"}]]}
+        r = np.random.default_rng(4)
+        x = np.asarray(r.random((10, 8)))
+        x[:, 5:] = (x[:, 5:] > 0.5).astype(np.float64)
+        vae = _vae(dist)
+        _, flat, f = _flat_elbo(vae, jnp.asarray(x))
+        _numeric_grad_check(f, flat, n_probe=12)
+        # serde round-trip through the dict form
+        d2 = _dist_from_dict(vae._dist().to_dict())
+        assert isinstance(d2, CompositeReconstructionDistribution)
+        assert d2.total_params(8) == 13
+
+    def test_loss_wrapper_trains_plain_autoencoder(self):
+        vae = _vae({"type": "loss_wrapper", "loss": "mse",
+                    "activation": "sigmoid"})
+        r = np.random.default_rng(5)
+        x = jnp.asarray(r.random((12, 8)))
+        _, flat, f = _flat_elbo(vae, x)
+        _numeric_grad_check(f, flat, n_probe=12)
+        # distribution-object construction path also accepted + normalized
+        vae2 = _vae(LossFunctionWrapper("mse", "sigmoid"))
+        assert vae2.reconstruction_distribution["type"] == "loss_wrapper"
+        assert isinstance(vae2._dist(), LossFunctionWrapper)
+        # not a normalized density: log p(x) is undefined (reference throws)
+        params = vae2.init_params(jax.random.PRNGKey(0), jnp.float64)
+        with pytest.raises(ValueError):
+            vae2.reconstruction_probability(params, x, num_samples=2)

@@ -37,6 +37,16 @@ class TestExpertParallelDispatch:
         np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
                                    atol=1e-5)
 
+    def test_aux_loss_matches_dense_reference(self):
+        """The sharded aux loss pmean's f and P separately before forming
+        E*sum(f*P), so it equals the dense global-batch loss exactly —
+        pmean of per-shard losses would not (the product is nonlinear)."""
+        params, ps, x, mesh = _setup(7)
+        _, aux_ep = jax.jit(moe_mlp_sharded(mesh))(ps, x)
+        _, aux_dense = moe_mlp_dense(params, x)
+        np.testing.assert_allclose(float(aux_ep), float(aux_dense),
+                                   rtol=1e-6)
+
     def test_capacity_drops_to_residual_zero(self):
         """All-identical tokens route to one expert; capacity=1 keeps one
         token per source shard and zeroes the rest (Switch drop)."""
@@ -82,6 +92,7 @@ class TestExpertParallelDispatch:
 
 
 class TestMoETransformer:
+    @pytest.mark.slow
     def test_ep_moe_transformer_learns(self):
         from deeplearning4j_tpu.models.zoo.transformer import (
             embed_fn, init_moe_block, lm_loss, logits_fn, make_moe_block_fn)

@@ -79,6 +79,7 @@ class TestAllReduceMode:
 
 
 class TestLocalStepsMode:
+    @pytest.mark.slow
     def test_param_averaging_mode(self):
         net = make_net()
         pw = (ParallelWrapper.Builder(net).workers(8)

@@ -10,6 +10,7 @@ cannot corrupt gradients:
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.nn.conf.layers.convolution import SubsamplingLayer
 from deeplearning4j_tpu.nn.conf.layers.normalization import BatchNormalization
@@ -65,6 +66,7 @@ class TestFusedBNBackward:
 
 
 class TestMaxpoolGatherVJP:
+    @pytest.mark.slow
     def test_gather_equals_select_scatter(self):
         rng = np.random.default_rng(0)
         for kern, stride, mode, pad in [((2, 2), (2, 2), "truncate", (0, 0)),

@@ -82,6 +82,7 @@ def _char_data(B=16, T=16, V=11, seed=0):
 
 
 class TestPipelinedTransformer:
+    @pytest.mark.slow
     def test_pipeline_loss_matches_single_chip(self):
         """Pipelined forward loss == stacking the blocks sequentially."""
         V, D = 11, 32
@@ -102,6 +103,7 @@ class TestPipelinedTransformer:
         loss_seq = float(lm_loss(aux, h, jnp.asarray(y)))
         assert abs(loss_pipe - loss_seq) < 1e-5
 
+    @pytest.mark.slow
     def test_dp_pp_training_learns(self):
         """dp=2 x pp=4 mesh: the pipelined LM learns the shift task."""
         V, D = 11, 32
@@ -129,6 +131,7 @@ class TestPipelinedTransformer:
         w = pp.stacked["attn"]["wqkv"]          # [S, D, 3D]
         assert tuple(w.sharding.spec)[0] == "pipe"
 
+    @pytest.mark.slow
     def test_single_chip_reference_model_learns(self):
         lm = TransformerLM(11, d_model=32, n_heads=4, n_layers=2,
                            max_len=16, learning_rate=0.2, momentum=0.9)
@@ -141,6 +144,7 @@ class TestPipelinedTransformer:
         pred = np.asarray(jnp.argmax(lm.logits(x), -1))
         assert (pred == y).mean() > 0.8
 
+    @pytest.mark.slow
     def test_generate_continues_learned_pattern(self):
         """After learning the +1 shift task, greedy generate() continues
         the arithmetic sequence."""
@@ -161,3 +165,26 @@ class TestPipelinedTransformer:
                            use_cache=True) == sampled
         with pytest.raises(ValueError):
             lm.generate([1] * 10, max_new_tokens=10, use_cache=True)
+
+    def test_generate_batch_matches_cached_decode(self):
+        """generate_batch (one on-device prefill+decode scan program) is
+        token-identical, row by row, to the per-token KV-cache decode —
+        the same greedy outputs with one host round trip per call."""
+        lm = TransformerLM(11, d_model=32, n_heads=4, n_layers=2,
+                           max_len=16, learning_rate=0.2, momentum=0.9)
+        x, y = _char_data()
+        for _ in range(40):
+            lm.fit_batch(x, y)
+        prompts = np.array([[2, 3, 4], [0, 1, 2], [7, 8, 9]], np.int32)
+        out = lm.generate_batch(prompts, max_new_tokens=5)
+        assert out.shape == (3, 8)
+        for b in range(3):
+            ref = lm.generate(prompts[b], max_new_tokens=5, use_cache=True)
+            assert list(out[b]) == ref
+        # n_new=1 edge (decode scan has zero iterations)
+        one = lm.generate_batch(prompts, max_new_tokens=1)
+        assert one.shape == (3, 4)
+        assert [list(r[:4]) for r in out] == [list(r) for r in one]
+        with pytest.raises(ValueError):
+            lm.generate_batch(np.zeros((2, 10), np.int32),
+                              max_new_tokens=10)

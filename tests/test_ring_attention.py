@@ -39,6 +39,7 @@ class TestRingAttention:
         ring = ring_self_attention(q, k, v, mesh, axis="seq", causal=True)
         assert np.allclose(np.asarray(full), np.asarray(ring), atol=1e-5)
 
+    @pytest.mark.slow
     def test_causality_actually_holds(self):
         """Changing future keys must not change past outputs."""
         q, k, v = _qkv(T=16, seed=2)
@@ -52,6 +53,7 @@ class TestRingAttention:
         assert np.allclose(out1[:, :12], out2[:, :12], atol=1e-5)
         assert not np.allclose(out1[:, 12:], out2[:, 12:])
 
+    @pytest.mark.slow
     def test_key_mask(self):
         q, k, v = _qkv(T=16, seed=3)
         mesh = _seq_mesh(4)
@@ -65,6 +67,7 @@ class TestRingAttention:
         trunc = blockwise_attention(q, k[:, :10], v[:, :10])
         assert np.allclose(np.asarray(full), np.asarray(trunc), atol=1e-5)
 
+    @pytest.mark.slow
     def test_gradients_flow_through_ring(self):
         q, k, v = _qkv(T=8, seed=4)
         mesh = _seq_mesh(4)
@@ -96,6 +99,7 @@ class TestSelfAttentionLayer:
                 .set_input_type(InputType.recurrent(6))
                 .build())
 
+    @pytest.mark.slow
     def test_gradient_check(self):
         from deeplearning4j_tpu import MultiLayerNetwork
         from deeplearning4j_tpu.gradientcheck.gradient_check_util import \
@@ -123,6 +127,7 @@ class TestSelfAttentionLayer:
             net.fit(ds)
         assert net.score(ds) < s0
 
+    @pytest.mark.slow
     def test_sequence_parallel_layer_matches_local(self):
         from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
         layer = SelfAttentionLayer(n_in=8, n_out=8, n_heads=2,
@@ -145,6 +150,7 @@ class TestRingFlashPath:
     (interpreter on CPU, Mosaic on TPU) — the full long-context stack
     (sequence parallelism x flash attention)."""
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_full_attention(self, causal):
         q, k, v = _qkv(T=32, seed=3)
@@ -155,6 +161,7 @@ class TestRingFlashPath:
         assert np.allclose(np.asarray(full), np.asarray(ring), atol=1e-5), \
             np.abs(np.asarray(full) - np.asarray(ring)).max()
 
+    @pytest.mark.slow
     def test_eight_device_ring(self):
         q, k, v = _qkv(T=64, seed=4)
         mesh = _seq_mesh(8)
@@ -170,6 +177,7 @@ class TestRingFlashPath:
             ring_self_attention(q, k, v, mesh, axis="seq", use_flash=True,
                                 kv_mask=jnp.ones(q.shape[:2]))
 
+    @pytest.mark.slow
     def test_flash_path_differentiable(self):
         """use_flash trains: grads come from the einsum-ring recompute VJP
         and match the einsum path's grads."""

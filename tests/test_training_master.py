@@ -50,6 +50,7 @@ def test_training_master_trains_and_records_stats():
     assert tm.stats.phase_total("fit") > 0
 
 
+@pytest.mark.slow
 def test_training_master_export_approach_streams_from_disk(tmp_path):
     """Reference default RDDTrainingApproach.Export: source streamed once to
     batched files, splits read from disk — the whole dataset is never

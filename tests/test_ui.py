@@ -239,6 +239,7 @@ def test_activation_collection_and_new_pages():
         server.stop()
 
 
+@pytest.mark.slow
 def test_legacy_listeners_feed_modern_storage():
     """reference deeplearning4j-ui legacy listeners as StatsListener
     presets: histogram listener collects histograms, conv listener

@@ -67,6 +67,7 @@ class TestVAE:
             assert denom == 0 or abs(g[i] - num) / denom < 1e-4, \
                 (i, g[i], num)
 
+    @pytest.mark.slow
     def test_pretrain_reduces_elbo_and_recon_prob_orders(self):
         conf = (NeuralNetConfiguration.Builder().seed(5)
                 .updater("adam").learning_rate(5e-3).list()
@@ -148,6 +149,7 @@ class TestRBM:
         assert out.shape == (4, 5)
         assert (out >= 0).all() and (out <= 1).all()  # binary units
 
+    @pytest.mark.slow
     def test_stacked_pretrain_then_finetune(self):
         """DBN-style: RBM + RBM + softmax, greedy pretrain then backprop."""
         conf = (NeuralNetConfiguration.Builder().seed(7)

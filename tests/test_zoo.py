@@ -1,5 +1,6 @@
 """Model zoo: ResNet-50 topology/training smoke, char-RNN TBPTT training."""
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.models.zoo import char_rnn_conf, lenet_conf, resnet50_conf
@@ -7,6 +8,7 @@ from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
 
+@pytest.mark.slow
 def test_resnet50_full_param_count():
     conf = resnet50_conf(num_classes=1000, data_type="float32")
     net = ComputationGraph(conf).init()
@@ -16,6 +18,7 @@ def test_resnet50_full_param_count():
     assert 25.4e6 < n < 25.8e6, n
 
 
+@pytest.mark.slow
 def test_resnet_tiny_trains():
     conf = resnet50_conf(height=32, width=32, channels=3, num_classes=10,
                          data_type="float32", learning_rate=1e-3,
@@ -35,6 +38,7 @@ def test_resnet_tiny_trains():
     assert np.allclose(out.sum(axis=1), 1.0, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_char_rnn_tbptt_trains():
     vocab, T, B = 12, 20, 4
     conf = char_rnn_conf(vocab_size=vocab, hidden=16, layers=2,
@@ -62,6 +66,7 @@ def test_lenet_conf_shapes():
 class TestClassicCNNs:
     """AlexNet / VGG-16 zoo configs (reference-era model zoo members)."""
 
+    @pytest.mark.slow
     def test_alexnet_trains_small(self):
         from deeplearning4j_tpu.datasets.dataset import DataSet
         from deeplearning4j_tpu.models.zoo import alexnet_conf
@@ -78,6 +83,7 @@ class TestClassicCNNs:
         assert out.shape == (4, 4)
         assert np.allclose(out.sum(1), 1.0, atol=1e-3)
 
+    @pytest.mark.slow
     def test_vgg16_structure_and_forward(self):
         from deeplearning4j_tpu.models.zoo import vgg16_conf
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
