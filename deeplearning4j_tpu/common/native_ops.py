@@ -28,7 +28,7 @@ _lib_lock = threading.Lock()
 _build_attempted = False
 
 
-_ABI_VERSION = 6  # must match dl4j_abi_version() in dl4j_tpu_native.cpp
+_ABI_VERSION = 7  # must match dl4j_abi_version() in dl4j_tpu_native.cpp
 
 
 def _try_build(force=False):
@@ -117,6 +117,15 @@ def get_lib():
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+        lib.dl4j_bh_repulsion.restype = ctypes.c_double
+        lib.dl4j_bh_repulsion.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float,
+            ctypes.POINTER(ctypes.c_float)]
+        lib.dl4j_bh_attraction.restype = None
+        lib.dl4j_bh_attraction.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float)]
         _lib = lib
         return _lib
 
@@ -259,6 +268,43 @@ def glove_cooc(ids, offsets, window, symmetric):
     for p in (pi, pj, px):
         lib.dl4j_free(p)
     return i, j, x
+
+
+def bh_repulsion(y, theta=0.5):
+    """Barnes-Hut repulsive t-SNE forces (quadtree + theta traversal in
+    C++, threaded). y: [n, 2] float32. Returns (rep [n, 2], Z) or None
+    when the library is missing (caller falls back)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    y = np.ascontiguousarray(y, np.float32)
+    rep = np.empty_like(y)
+    z = lib.dl4j_bh_repulsion(
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        int(y.shape[0]), float(theta),
+        rep.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return rep, float(z)
+
+
+def bh_attraction(y, row_ptr, cols, vals):
+    """Sparse attractive t-SNE forces from a CSR neighbor matrix in C++.
+    Returns attr [n, 2] or None when the library is missing."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    y = np.ascontiguousarray(y, np.float32)
+    row_ptr = np.ascontiguousarray(row_ptr, np.int64)
+    cols = np.ascontiguousarray(cols, np.int32)
+    vals = np.ascontiguousarray(vals, np.float32)
+    attr = np.empty_like(y)
+    lib.dl4j_bh_attraction(
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        int(y.shape[0]),
+        row_ptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        attr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return attr
 
 
 class PrefetchCsvLoader:

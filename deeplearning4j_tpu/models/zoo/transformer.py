@@ -102,6 +102,94 @@ def make_block_fn(n_heads, attention="dense"):
     return block_fn
 
 
+def init_tp_block(rng, d_model, n_heads, d_ff, dtype=jnp.float32):
+    """Block params in the TENSOR-PARALLEL layout: attention projections
+    stored per-head ([H, D, 3*hd] / [H, hd, D]) so the head dim shards
+    cleanly over a "model" mesh axis (Megatron split), and the MLP hidden
+    dim shards on w1 columns / w2 rows. Numerics match `init_block`'s
+    layout exactly — only the storage axes differ."""
+    k = jax.random.split(rng, 4)
+    hd = d_model // n_heads
+    s_attn = 1.0 / math.sqrt(d_model)
+    s_ff = 1.0 / math.sqrt(d_ff)
+    return {
+        "ln1": {"g": jnp.ones(d_model, dtype),
+                "b": jnp.zeros(d_model, dtype)},
+        "attn": {
+            "wqkv": (jax.random.normal(k[0], (n_heads, d_model, 3 * hd)) *
+                     s_attn).astype(dtype),
+            "wo": (jax.random.normal(k[1], (n_heads, hd, d_model)) *
+                   s_attn).astype(dtype),
+        },
+        "ln2": {"g": jnp.ones(d_model, dtype),
+                "b": jnp.zeros(d_model, dtype)},
+        "mlp": {
+            "w1": (jax.random.normal(k[2], (d_model, d_ff)) *
+                   s_attn).astype(dtype),
+            "b1": jnp.zeros(d_ff, dtype),
+            "w2": (jax.random.normal(k[3], (d_ff, d_model)) *
+                   s_ff).astype(dtype),
+            "b2": jnp.zeros(d_model, dtype),
+        },
+    }
+
+
+def tp_block_specs(pipe_axis="pipe", model_axis="model"):
+    """PartitionSpec pytree for STACKED `init_tp_block` params (leading
+    stage axis over `pipe_axis`): attention head dim and MLP hidden dim
+    over `model_axis`, LN/biases replicated across it — the Megatron
+    sharding, expressed for `parallel.pipeline.gpipe(param_specs=...)`."""
+    from jax.sharding import PartitionSpec as P
+    return {
+        "ln1": {"g": P(pipe_axis), "b": P(pipe_axis)},
+        "attn": {"wqkv": P(pipe_axis, model_axis),
+                 "wo": P(pipe_axis, model_axis)},
+        "ln2": {"g": P(pipe_axis), "b": P(pipe_axis)},
+        "mlp": {"w1": P(pipe_axis, None, model_axis),
+                "b1": P(pipe_axis, model_axis),
+                "w2": P(pipe_axis, model_axis, None),
+                "b2": P(pipe_axis)},
+    }
+
+
+def make_tp_block_fn(n_heads_local, model_axis="model"):
+    """Tensor-parallel transformer block for use INSIDE shard_map over a
+    mesh with `model_axis`: each device computes its local head group and
+    local MLP hidden slice; one psum after the attention output projection
+    and one after the MLP down-projection reduce the partial sums — the
+    Megatron recipe (two collectives per block), composable with the GPipe
+    rotation because both run in the same shard_map body.
+
+    n_heads_local: heads PER DEVICE (global heads / model-axis size);
+    asserted against the local param shard so a mismatched mesh split
+    fails loudly at trace time instead of silently reading stale docs."""
+
+    def block_fn(p, x):
+        B, T, D = x.shape
+        assert p["attn"]["wqkv"].shape[0] == n_heads_local, \
+            (p["attn"]["wqkv"].shape, n_heads_local)
+        hd = p["attn"]["wqkv"].shape[2] // 3
+        h = _layer_norm(x, p["ln1"]["g"], p["ln1"]["b"])
+        # local heads: [B, T, Hl, 3*hd]
+        qkv = jnp.einsum("btd,hdk->bthk", h, p["attn"]["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        tr = lambda a: a.transpose(0, 2, 1, 3)          # [B, Hl, T, hd]
+        q, k, v = tr(q), tr(k), tr(v)
+        scores = (q @ k.transpose(0, 1, 3, 2)) / math.sqrt(hd)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        att = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(x.dtype)
+        out = (att @ v).transpose(0, 2, 1, 3)           # [B, T, Hl, hd]
+        o_part = jnp.einsum("bthk,hkd->btd", out, p["attn"]["wo"])
+        x = x + jax.lax.psum(o_part, model_axis)
+        h = _layer_norm(x, p["ln2"]["g"], p["ln2"]["b"])
+        m = jax.nn.gelu(h @ p["mlp"]["w1"] + p["mlp"]["b1"])  # local F/m
+        y_part = m @ p["mlp"]["w2"]
+        return x + jax.lax.psum(y_part, model_axis) + p["mlp"]["b2"]
+
+    return block_fn
+
+
 def make_moe_block_fn(n_heads, moe_apply):
     """Transformer block whose MLP is a mixture-of-experts: attention as in
     `make_block_fn`, the FFN replaced by `moe_apply(moe_params, tokens)`
@@ -357,6 +445,8 @@ class TransformerLM:
         prompts = jnp.asarray(np.asarray(prompts), jnp.int32)
         B, P = prompts.shape
         n_new = int(max_new_tokens)
+        if n_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {n_new}")
         max_len = self.aux["pos"].shape[0]
         if P + n_new > max_len:
             raise ValueError(

@@ -34,14 +34,19 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def make_pipeline_mesh(n_pipe, n_data=1, devices=None):
-    """(data, pipe) mesh; pipe is the fastest-varying axis so neighbouring
-    stages land on neighbouring devices (ppermute hops ride single ICI
-    links on a real torus)."""
+def make_pipeline_mesh(n_pipe, n_data=1, n_model=1, devices=None):
+    """(data, pipe) mesh — or the 3-axis (data, model, pipe) mesh when
+    n_model > 1 (dp x tp x pp in ONE program). pipe is the fastest-varying
+    axis so neighbouring stages land on neighbouring devices (ppermute
+    hops ride single ICI links on a real torus); model sits between so a
+    stage's tensor-parallel group is also ICI-adjacent."""
     devices = devices if devices is not None else jax.devices()
-    n = n_data * n_pipe
+    n = n_data * n_model * n_pipe
     if len(devices) < n:
         raise ValueError(f"need {n} devices, have {len(devices)}")
+    if n_model > 1:
+        arr = np.asarray(devices[:n]).reshape(n_data, n_model, n_pipe)
+        return Mesh(arr, ("data", "model", "pipe"))
     arr = np.asarray(devices[:n]).reshape(n_data, n_pipe)
     return Mesh(arr, ("data", "pipe"))
 
@@ -61,12 +66,20 @@ def _rotation(n):
     return [(i, (i + 1) % n) for i in range(n)]
 
 
-def gpipe(stage_fn, mesh, axis="pipe", data_axis=None):
+def gpipe(stage_fn, mesh, axis="pipe", data_axis=None, param_specs=None):
     """Build ``pipelined(stacked_params, xs) -> ys``.
 
     stage_fn(stage_params, x[B, ...]) -> y[B, ...] (uniform interface).
     xs: [M, B, ...] microbatched input; ys: same shape, equal to applying
     the S stages sequentially to every microbatch.
+
+    param_specs: optional PartitionSpec pytree for the stacked params
+    (leading stage axis must map to `axis`) — how tensor parallelism
+    composes: shard weight columns over a "model" mesh axis and have
+    stage_fn psum over it (e.g. `models.zoo.transformer.make_tp_block_fn`
+    + `tp_block_specs`); both the TP collectives and the pipe rotation
+    then live in the same shard_map body. Default: P(axis) per leaf
+    (pipe-sharded, model-replicated).
 
     Differentiable end-to-end; donate/jit at the caller.
     """
@@ -116,7 +129,8 @@ def gpipe(stage_fn, mesh, axis="pipe", data_axis=None):
         ospec = P()
 
     def pipelined(stacked_params, xs):
-        pspec = jax.tree.map(lambda _: pspec_leaf, stacked_params)
+        pspec = (param_specs if param_specs is not None
+                 else jax.tree.map(lambda _: pspec_leaf, stacked_params))
         fn = shard_map(spmd, mesh=mesh, in_specs=(pspec, xspec),
                        out_specs=ospec,
                        check_vma=False)
@@ -159,7 +173,8 @@ class PipelineParallel:
 
     def __init__(self, stage_fn, stage_params, mesh, *, loss_fn,
                  aux_params=None, pre_fn=None, n_micro, axis="pipe",
-                 data_axis=None, learning_rate=0.1, momentum=0.0):
+                 data_axis=None, learning_rate=0.1, momentum=0.0,
+                 param_specs=None):
         self.mesh = mesh
         self.axis = axis
         self.data_axis = data_axis
@@ -170,14 +185,21 @@ class PipelineParallel:
                              f"{axis}={self.S}")
         from .sharding import put_sharded, replicate
         stacked = stack_stage_params(stage_params)
-        sh = NamedSharding(mesh, P(axis))
         # put_sharded/replicate handle multi-host meshes (each process
         # contributes its addressable shards; plain device_put cannot)
-        self.stacked = jax.tree.map(
-            lambda a: put_sharded(a, sh, full_array=True), stacked)
+        if param_specs is not None:
+            self.stacked = jax.tree.map(
+                lambda a, sp: put_sharded(a, NamedSharding(mesh, sp),
+                                          full_array=True),
+                stacked, param_specs)
+        else:
+            sh = NamedSharding(mesh, P(axis))
+            self.stacked = jax.tree.map(
+                lambda a: put_sharded(a, sh, full_array=True), stacked)
         self.aux = replicate(aux_params if aux_params is not None else {},
                              mesh)
-        self._pipe = gpipe(stage_fn, mesh, axis=axis, data_axis=data_axis)
+        self._pipe = gpipe(stage_fn, mesh, axis=axis, data_axis=data_axis,
+                           param_specs=param_specs)
         self.pre_fn = pre_fn
         self.loss_fn = loss_fn
         self.lr = float(learning_rate)

@@ -1,15 +1,23 @@
 """t-SNE embedding for visualization.
 
 TPU-native equivalent of reference deeplearning4j-core plot/BarnesHutTsne.java
-+ plot/Tsne.java (1,276 LoC). Redesign rationale: the reference's Barnes-Hut
-quadtree exists to avoid an O(N^2) host loop; on TPU the dense [N,N]
-similarity and gradient kernels ARE the fast path (matmuls + fused
-elementwise on the MXU), so the whole gradient loop is one jitted
-`lax.fori_loop` — exact t-SNE, no tree approximation, same API (fit ->
-2-D/3-D coordinates).
++ plot/Tsne.java + clustering/sptree (1,276 LoC). Two paths:
 
-Standard recipe: perplexity binary search for conditional P, symmetrize,
-early exaggeration, momentum gradient descent.
+* dense (small/medium N): the [N,N] similarity and gradient kernels ARE
+  the TPU fast path (matmuls + fused elementwise on the MXU); the whole
+  gradient loop is one jitted `lax.fori_loop` — exact t-SNE, no tree.
+* barnes_hut (N up to 50k+): the reference's O(N log N) design, kept where
+  the reference keeps it — on the host. kNN candidate search and the
+  per-point perplexity bisection are VECTORIZED in JAX (every point
+  searched in parallel — the reference's computeGaussianPerplexity row
+  loop collapsed to a scan); the quadtree build + theta-criterion
+  repulsion and CSR sparse attraction run in the native C++ runtime
+  (`native/dl4j_tpu_native.cpp dl4j_bh_repulsion/dl4j_bh_attraction`,
+  threaded), with exact numpy fallbacks when the toolchain is missing.
+
+`method="auto"` picks dense below _DENSE_MAX points, barnes_hut above.
+Standard recipe either way: perplexity search for conditional P,
+symmetrize, early exaggeration, momentum + adaptive gains descent.
 """
 from __future__ import annotations
 
@@ -79,6 +87,110 @@ def _tsne_loop(P, y0, key, n_iter, momentum=0.8, lr=200.0,
     return y
 
 
+_DENSE_MAX = 4096      # auto: dense TPU kernel up to here, Barnes-Hut above
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _knn_chunk(xq, x, k):
+    """Squared distances + indices of the k+1 nearest points (self
+    included) for a chunk of queries — one MXU matmul per chunk."""
+    d2 = (jnp.sum(xq * xq, 1)[:, None] - 2.0 * xq @ x.T
+          + jnp.sum(x * x, 1)[None, :])
+    neg, idx = jax.lax.top_k(-d2, k + 1)
+    return -neg, idx
+
+
+@jax.jit
+def _beta_search_rows(d2, log_u):
+    """Vectorized perplexity bisection: all points' bandwidths at once
+    (the reference's computeGaussianPerplexity per-row loop, collapsed to
+    one 50-step scan over [N] betas). d2: [N, K] neighbor sq-distances."""
+    n = d2.shape[0]
+
+    def body(carry, _):
+        beta, lo, hi = carry
+        p = jnp.exp(-d2 * beta[:, None])
+        s = jnp.maximum(p.sum(1), 1e-12)
+        h = jnp.log(s) + beta * (d2 * p).sum(1) / s
+        too_high = h > log_u            # entropy too high -> raise beta
+        lo = jnp.where(too_high, beta, lo)
+        hi = jnp.where(too_high, hi, beta)
+        beta = jnp.where(
+            too_high,
+            jnp.where(jnp.isinf(hi), beta * 2.0, 0.5 * (beta + hi)),
+            jnp.where(lo > 0.0, 0.5 * (beta + lo), beta * 0.5))
+        return (beta, lo, hi), None
+
+    init = (jnp.ones(n, d2.dtype), jnp.zeros(n, d2.dtype),
+            jnp.full(n, jnp.inf, d2.dtype))
+    (beta, _, _), _ = jax.lax.scan(body, init, None, length=50)
+    p = jnp.exp(-d2 * beta[:, None])
+    return p / jnp.maximum(p.sum(1, keepdims=True), 1e-12)
+
+
+def _sparse_sym_p(x, perplexity, chunk=1024):
+    """kNN conditional P, symmetrized to CSR (row_ptr, cols, vals)."""
+    n = x.shape[0]
+    k = max(3, min(n - 1, int(3 * perplexity)))
+    xj = jnp.asarray(x, jnp.float32)
+    d2s, idxs = [], []
+    for s in range(0, n, chunk):
+        d2c, idxc = _knn_chunk(xj[s:s + chunk], xj, k)
+        d2s.append(np.asarray(d2c))
+        idxs.append(np.asarray(idxc))
+    d2 = np.concatenate(d2s)                        # [n, k+1] ascending
+    idx = np.concatenate(idxs)
+    # drop self (first occurrence of the query's own index per row)
+    rows_arange = np.arange(n)
+    self_pos = np.argmax(idx == rows_arange[:, None], 1)
+    keep = np.ones_like(idx, bool)
+    keep[rows_arange, self_pos] = False
+    d2 = d2[keep].reshape(n, k)
+    idx = idx[keep].reshape(n, k)
+    p = np.asarray(_beta_search_rows(jnp.asarray(d2, jnp.float32),
+                                     float(np.log(perplexity))))
+    # symmetrize: P_sym = (P + P^T) / (2n) over the union pattern
+    rows = np.repeat(rows_arange, k).astype(np.int64)
+    cols = idx.ravel().astype(np.int64)
+    keys = np.concatenate([rows * n + cols, cols * n + rows])
+    vals = np.concatenate([p.ravel(), p.ravel()]).astype(np.float64)
+    uk, inv = np.unique(keys, return_inverse=True)
+    sv = np.zeros(uk.shape[0])
+    np.add.at(sv, inv, vals)
+    sv /= (2.0 * n)
+    r, c = (uk // n).astype(np.int64), (uk % n).astype(np.int32)
+    row_ptr = np.searchsorted(r, np.arange(n + 1), side="left").astype(
+        np.int64)
+    return row_ptr, c, np.maximum(sv, 1e-12).astype(np.float32)
+
+
+def _np_attraction(y, row_ptr, cols, vals):
+    """Exact numpy fallback for dl4j_bh_attraction (COO vectorized)."""
+    n = y.shape[0]
+    rows = np.repeat(np.arange(n), np.diff(row_ptr))
+    d = y[rows] - y[cols]
+    q = 1.0 / (1.0 + (d * d).sum(1))
+    w = (vals * q)[:, None] * d
+    out = np.zeros_like(y)
+    np.add.at(out, rows, w)
+    return out
+
+
+def _np_repulsion(y, chunk=2048):
+    """Exact (theta=0) chunked fallback for dl4j_bh_repulsion."""
+    n = y.shape[0]
+    rep = np.zeros_like(y)
+    Z = 0.0
+    for s in range(0, n, chunk):
+        d = y[s:s + chunk, None, :] - y[None, :, :]
+        q = 1.0 / (1.0 + (d * d).sum(-1))
+        q[np.arange(s, min(s + chunk, n)) - s,
+          np.arange(s, min(s + chunk, n))] = 0.0
+        Z += q.sum()
+        rep[s:s + chunk] = ((q * q)[..., None] * d).sum(1)
+    return rep, max(Z, 1e-12)
+
+
 class Tsne:
     """reference API: plot/Tsne.java + BarnesHutTsne.Builder."""
 
@@ -95,7 +207,7 @@ class Tsne:
             self._kw["perplexity"] = float(v); return self
 
         def theta(self, v):
-            return self   # Barnes-Hut approximation knob: exact kernel here
+            self._kw["theta"] = float(v); return self
 
         def learning_rate(self, v):
             self._kw["learning_rate"] = float(v); return self
@@ -110,21 +222,34 @@ class Tsne:
         def seed(self, v):
             self._kw["seed"] = int(v); return self
 
+        def use_barnes_hut(self, v):
+            self._kw["method"] = "barnes_hut" if v else "dense"
+            return self
+
         def build(self):
             return Tsne(**self._kw)
 
     def __init__(self, n_components=2, perplexity=30.0, max_iter=500,
-                 learning_rate=200.0, seed=123):
+                 learning_rate=200.0, seed=123, theta=0.5, method="auto"):
         self.n_components = int(n_components)
         self.perplexity = float(perplexity)
         self.max_iter = int(max_iter)
         self.learning_rate = float(learning_rate)
         self.seed = int(seed)
+        self.theta = float(theta)
+        self.method = method
         self.embedding = None
 
     def fit(self, x):
         x = np.asarray(x, np.float64)
         n = x.shape[0]
+        method = self.method
+        if method == "auto":
+            # the quadtree is 2-D; 3-D embeddings stay on the exact path
+            method = ("dense" if n <= _DENSE_MAX or self.n_components != 2
+                      else "barnes_hut")
+        if method == "barnes_hut":
+            return self._fit_barnes_hut(x)
         perp = min(self.perplexity, (n - 1) / 3.0)
         P = jnp.asarray(_cond_probs(x, perp), jnp.float32)
         key = jax.random.PRNGKey(self.seed)
@@ -136,6 +261,44 @@ class Tsne:
         return self.embedding
 
     fit_transform = fit
+
+    def _fit_barnes_hut(self, x):
+        """O(N log N) path (reference BarnesHutTsne.gradient + SpTree):
+        sparse kNN attraction + quadtree repulsion, momentum + adaptive
+        gains (the reference's gains.muli / learning-rate schedule)."""
+        if self.n_components != 2:
+            raise ValueError("barnes_hut t-SNE is 2-D (quadtree), like "
+                             "the reference's BarnesHutTsne")
+        from ..common import native_ops
+        n = x.shape[0]
+        perp = min(self.perplexity, (n - 1) / 3.0)
+        row_ptr, cols, vals = _sparse_sym_p(x, perp)
+        rng = np.random.default_rng(self.seed)
+        y = (1e-2 * rng.standard_normal((n, 2))).astype(np.float32)
+        v = np.zeros_like(y)
+        gains = np.ones_like(y)
+        native = native_ops.available()
+        exagg_iters = min(100, self.max_iter // 4)
+        for it in range(self.max_iter):
+            ex = 12.0 if it < exagg_iters else 1.0
+            momentum = 0.5 if it < 250 else 0.8
+            attr = (native_ops.bh_attraction(y, row_ptr, cols, vals * ex)
+                    if native else None)
+            if attr is None:
+                attr = _np_attraction(y, row_ptr, cols, vals * ex)
+            rz = native_ops.bh_repulsion(y, self.theta) if native else None
+            if rz is None:
+                rz = _np_repulsion(y)
+            rep, Z = rz
+            g = 4.0 * (attr - rep / Z)
+            flips = np.sign(g) != np.sign(v)
+            gains = np.clip(np.where(flips, gains + 0.2, gains * 0.8),
+                            0.01, None)
+            v = momentum * v - self.learning_rate * gains * g
+            y = y + v
+            y -= y.mean(0)
+        self.embedding = np.asarray(y, np.float32)
+        return self.embedding
 
     def plot(self, x, labels=None, path=None):
         """Fit and dump coordinates (+labels) to a TSV like the reference's
@@ -150,4 +313,12 @@ class Tsne:
         return coords
 
 
-BarnesHutTsne = Tsne   # exact kernel; alias keeps the reference's class name
+class BarnesHutTsne(Tsne):
+    """reference: plot/BarnesHutTsne.java — always the O(N log N)
+    theta-approximate path (quadtree repulsion + sparse kNN attraction),
+    any N. Plain `Tsne` auto-selects between this and the exact dense
+    TPU kernel by size."""
+
+    def __init__(self, **kw):
+        kw.setdefault("method", "barnes_hut")
+        super().__init__(**kw)

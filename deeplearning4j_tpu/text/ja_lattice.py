@@ -76,8 +76,6 @@ _set("bos", "adj", 300)
 _set("bos", "particle", 1200)        # sentences rarely open with a particle
 _set("unk", "particle", 150)         # unknown noun-ish + particle is normal
 _set("unk", "aux", 400)
-for _p in _POS:
-    _CONN[_p]["unk"] = min(_CONN[_p]["unk"], _DEF)
 _set("unk", "eos", 500)
 _set("unk", "unk", 900)
 

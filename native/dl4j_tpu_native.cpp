@@ -28,7 +28,7 @@ extern "C" {
 
 // Bumped whenever an exported signature changes; the Python loader refuses
 // (and rebuilds) a library whose version doesn't match.
-int64_t dl4j_abi_version() { return 6; }
+int64_t dl4j_abi_version() { return 7; }
 
 // ---------------------------------------------------------------------------
 // IDX parsing (reference: datasets/mnist/MnistImageFile binary reader)
@@ -479,6 +479,179 @@ void dl4j_loader_destroy(void* handle) {
   for (auto& it : L->items)
     if (it.data) free(it.data);
   delete L;
+}
+
+// ---------------------------------------------------------------------------
+// Barnes-Hut t-SNE forces (reference: plot/BarnesHutTsne.java +
+// clustering/sptree/SpTree.java — the O(N log N) path the dense TPU kernel
+// in plot/tsne.py cannot scale to; quadtree build + theta-criterion
+// traversal stay on the host, exactly where the reference keeps them)
+// ---------------------------------------------------------------------------
+
+struct BHNode {
+  float cx, cy, hw;          // cell center + half-width
+  double comx, comy;         // center-of-mass accumulator (sum; normalized
+  int64_t count;             //  to the mean after the build pass)
+  int32_t child[4];          // quadrant children, -1 = none
+  int32_t point;             // resident point index for singleton leaves
+};
+
+struct BHTree {
+  std::vector<BHNode> nodes;
+  int32_t new_node(float cx, float cy, float hw) {
+    BHNode n;
+    n.cx = cx; n.cy = cy; n.hw = hw;
+    n.comx = 0; n.comy = 0; n.count = 0;
+    n.child[0] = n.child[1] = n.child[2] = n.child[3] = -1;
+    n.point = -1;
+    nodes.push_back(n);
+    return (int32_t)nodes.size() - 1;
+  }
+};
+
+static const int kBHMaxDepth = 48;
+
+static void bh_insert(BHTree& t, int32_t cur, const float* y, int32_t p,
+                      int depth);
+
+static void bh_place_child(BHTree& t, int32_t cur, const float* y,
+                           int32_t p, int depth) {
+  const float cx = t.nodes[cur].cx, cy = t.nodes[cur].cy;
+  const float hw = t.nodes[cur].hw;
+  const int q = (y[2 * p] >= cx ? 1 : 0) | (y[2 * p + 1] >= cy ? 2 : 0);
+  int32_t ch = t.nodes[cur].child[q];
+  if (ch < 0) {
+    const float hw2 = hw * 0.5f;
+    ch = t.new_node(cx + ((q & 1) ? hw2 : -hw2),
+                    cy + ((q & 2) ? hw2 : -hw2), hw2);
+    t.nodes[cur].child[q] = ch;   // re-index: new_node may reallocate
+  }
+  bh_insert(t, ch, y, p, depth + 1);
+}
+
+static void bh_insert(BHTree& t, int32_t cur, const float* y, int32_t p,
+                      int depth) {
+  t.nodes[cur].comx += y[2 * p];
+  t.nodes[cur].comy += y[2 * p + 1];
+  t.nodes[cur].count++;
+  if (t.nodes[cur].count == 1) {            // first point: singleton leaf
+    t.nodes[cur].point = p;
+    return;
+  }
+  if (depth >= kBHMaxDepth) return;  // duplicates: merge into COM only
+  const int32_t resident = t.nodes[cur].point;
+  if (resident >= 0) {               // split: push the resident down first
+    t.nodes[cur].point = -1;
+    bh_place_child(t, cur, y, resident, depth);
+  }
+  bh_place_child(t, cur, y, p, depth);
+}
+
+// Repulsive forces + partition function for one point via theta-criterion
+// traversal. Self-interaction is excluded at the resident leaf.
+static void bh_point_forces(const BHTree& t, const float* y, int32_t i,
+                            float theta2, float* fx, float* fy,
+                            double* z_out) {
+  const float px = y[2 * i], py = y[2 * i + 1];
+  double Z = 0.0, rx = 0.0, ry = 0.0;
+  int32_t stack[4 * kBHMaxDepth + 8];
+  int sp = 0;
+  stack[sp++] = 0;
+  while (sp) {
+    const BHNode& n = t.nodes[stack[--sp]];
+    if (n.count == 0) continue;
+    if (n.point == i && n.count == 1) continue;       // exact self leaf
+    const float dx = px - (float)n.comx, dy = py - (float)n.comy;
+    const float d2 = dx * dx + dy * dy;
+    const bool leaf = n.child[0] < 0 && n.child[1] < 0 &&
+                      n.child[2] < 0 && n.child[3] < 0;
+    const float size = 2.0f * n.hw;
+    if (leaf || size * size < theta2 * d2) {
+      double cnt = (double)n.count;
+      if (n.point == i) cnt -= 1.0;  // depth-capped leaf holding i
+      const double q = 1.0 / (1.0 + (double)d2);
+      Z += cnt * q;
+      const double qq = cnt * q * q;
+      rx += qq * dx;
+      ry += qq * dy;
+    } else {
+      for (int c = 0; c < 4; c++)
+        if (n.child[c] >= 0) stack[sp++] = n.child[c];
+    }
+  }
+  *fx = (float)rx;
+  *fy = (float)ry;
+  *z_out = Z;
+}
+
+// y: [n, 2] row-major embedding. Writes unnormalized repulsive forces to
+// rep [n, 2]; returns the partition function Z = sum_{i != j} q_ij (the
+// caller divides: F_rep_i = rep_i / Z). theta = Barnes-Hut accuracy knob
+// (0 = exact). Traversal is threaded; the tree is read-only by then.
+double dl4j_bh_repulsion(const float* y, int64_t n, float theta,
+                         float* rep) {
+  if (n <= 0) return 0.0;
+  float mnx = y[0], mxx = y[0], mny = y[1], mxy = y[1];
+  for (int64_t i = 1; i < n; i++) {
+    mnx = y[2 * i] < mnx ? y[2 * i] : mnx;
+    mxx = y[2 * i] > mxx ? y[2 * i] : mxx;
+    mny = y[2 * i + 1] < mny ? y[2 * i + 1] : mny;
+    mxy = y[2 * i + 1] > mxy ? y[2 * i + 1] : mxy;
+  }
+  const float cx = 0.5f * (mnx + mxx), cy = 0.5f * (mny + mxy);
+  float hw = 0.5f * ((mxx - mnx) > (mxy - mny) ? (mxx - mnx) : (mxy - mny));
+  hw = hw > 1e-5f ? hw * 1.0001f : 1e-5f;
+  BHTree t;
+  t.nodes.reserve((size_t)(2 * n + 16));
+  t.new_node(cx, cy, hw);
+  for (int64_t i = 0; i < n; i++) bh_insert(t, 0, y, (int32_t)i, 0);
+  for (auto& nd : t.nodes)
+    if (nd.count > 0) { nd.comx /= nd.count; nd.comy /= nd.count; }
+  const float theta2 = theta * theta;
+  unsigned hwc = std::thread::hardware_concurrency();
+  int nt = (int)(hwc ? (hwc < 8 ? hwc : 8) : 1);
+  if (n < 4096) nt = 1;
+  std::vector<double> zs((size_t)nt, 0.0);
+  auto worker = [&](int w) {
+    double z = 0.0;
+    for (int64_t i = w; i < n; i += nt) {
+      double zi;
+      bh_point_forces(t, y, (int32_t)i, theta2, &rep[2 * i],
+                      &rep[2 * i + 1], &zi);
+      z += zi;
+    }
+    zs[w] = z;
+  };
+  if (nt == 1) {
+    worker(0);
+  } else {
+    std::vector<std::thread> threads;
+    for (int w = 0; w < nt; w++) threads.emplace_back(worker, w);
+    for (auto& th : threads) th.join();
+  }
+  double Z = 0.0;
+  for (double z : zs) Z += z;
+  return Z > 1e-12 ? Z : 1e-12;
+}
+
+// Sparse attractive forces from the CSR neighbor matrix (row_ptr [n+1],
+// cols/vals [nnz]): attr_i = sum_j P_ij q_ij (y_i - y_j).
+void dl4j_bh_attraction(const float* y, int64_t n, const int64_t* row_ptr,
+                        const int32_t* cols, const float* vals,
+                        float* attr) {
+  for (int64_t i = 0; i < n; i++) {
+    double ax = 0.0, ay = 0.0;
+    const float px = y[2 * i], py = y[2 * i + 1];
+    for (int64_t k = row_ptr[i]; k < row_ptr[i + 1]; k++) {
+      const int32_t j = cols[k];
+      const float dx = px - y[2 * j], dy = py - y[2 * j + 1];
+      const double q = 1.0 / (1.0 + (double)(dx * dx + dy * dy));
+      ax += (double)vals[k] * q * dx;
+      ay += (double)vals[k] * q * dy;
+    }
+    attr[2 * i] = (float)ax;
+    attr[2 * i + 1] = (float)ay;
+  }
 }
 
 }  // extern "C"

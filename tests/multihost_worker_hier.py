@@ -1,0 +1,77 @@
+"""Worker for the HIERARCHICAL multi-host test (test_multihost.py).
+
+4 real processes x 2 virtual CPU devices each = 8 global devices, one
+3-axis mesh (data=2, model=2, pipe=2) laid out so the axes mix fabrics the
+way a real pod slice does: with jax.devices() ordered process-major, the
+reshape puts "pipe" INSIDE a process (the ICI role) while "data" and
+"model" SPAN process boundaries (the DCN role). One dp x tp x pp training
+step (Megatron TP blocks inside the GPipe rotation) then exercises
+psum/ppermute over both fabrics in a single jitted program — SURVEY.md
+§5.8's north star (ICI within the pod, DCN across).
+
+Usage: python tests/multihost_worker_hier.py <proc_id> <nproc> <coord>
+"""
+import os
+import sys
+
+proc_id, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from deeplearning4j_tpu.models.zoo.transformer import (  # noqa: E402
+    embed_fn, init_lm, init_tp_block, lm_loss, make_tp_block_fn,
+    tp_block_specs)
+from deeplearning4j_tpu.parallel import distributed  # noqa: E402
+from deeplearning4j_tpu.parallel.pipeline import (  # noqa: E402
+    PipelineParallel, make_pipeline_mesh)
+
+
+def main():
+    ok = distributed.initialize(coord, nproc, proc_id)
+    assert ok, "distributed.initialize returned False"
+    assert jax.process_count() == nproc and jax.device_count() == 8
+
+    # process-major device order -> (data=2, model=2, pipe=2): pipe pairs
+    # are intra-process (ICI), data/model boundaries are cross-process (DCN)
+    mesh = make_pipeline_mesh(n_pipe=2, n_data=2, n_model=2)
+    assert mesh.axis_names == ("data", "model", "pipe")
+    dev_grid = np.asarray(mesh.devices)
+    # pipe neighbours share a process; model neighbours do not
+    assert dev_grid[0, 0, 0].process_index == dev_grid[0, 0, 1].process_index
+    assert dev_grid[0, 0, 0].process_index != dev_grid[0, 1, 0].process_index
+
+    D, H, F = 16, 4, 32
+    rng = jax.random.PRNGKey(3)
+    blocks = [init_tp_block(jax.random.fold_in(rng, i), D, H, F)
+              for i in range(2)]
+    aux, _ = init_lm(11, d_model=D, n_heads=H, n_layers=1, max_len=8,
+                     seed=5)
+    pp = PipelineParallel(
+        make_tp_block_fn(H // 2, "model"), blocks, mesh, loss_fn=lm_loss,
+        aux_params=aux, pre_fn=embed_fn, n_micro=2, data_axis="data",
+        learning_rate=0.1, param_specs=tp_block_specs("pipe", "model"))
+
+    r = np.random.default_rng(0)
+    x = r.integers(0, 11, (8, 8)).astype(np.int32)
+    y = (x + 1) % 11
+    losses = [pp.fit_batch(x, y) for _ in range(3)]
+    assert all(np.isfinite(l) for l in losses)
+
+    # gather the full (replicated-view) stacked params for the checksum
+    total = 0.0
+    for leaf in jax.tree.leaves(pp.stacked):
+        total += float(jax.jit(lambda a: jax.numpy.sum(
+            a.astype(jax.numpy.float64)), out_shardings=None)(leaf))
+    print(f"RESULT {proc_id} sum={total:.10f} loss={losses[-1]:.10f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

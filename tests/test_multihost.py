@@ -55,6 +55,38 @@ def test_two_process_parallel_wrapper_allreduce():
     assert np.isfinite(results[0][0]) and np.isfinite(results[0][1])
 
 
+def test_hierarchical_three_axis_mesh_across_processes():
+    """4 processes x 2 virtual devices: one (data=2, model=2, pipe=2)
+    mesh whose pipe axis is intra-process (ICI role) while data/model span
+    processes (DCN role) — a dp x tp x pp step with Megatron TP blocks
+    inside the GPipe rotation, collectives riding both fabrics in one
+    program (VERDICT r3 item 9; SURVEY §5.8 north star)."""
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO
+    script = os.path.join(REPO, "tests", "multihost_worker_hier.py")
+    procs = [subprocess.Popen(
+        [sys.executable, script, str(i), "4", coord],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env) for i in range(4)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=280)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT"):
+                _, pid, s, l = line.split()
+                results[int(pid)] = (s, l)
+    assert set(results) == {0, 1, 2, 3}, f"missing results: {outs}"
+    assert len(set(results.values())) == 1       # bit-identical params
+    assert np.isfinite(float(results[0][0].split("=")[1]))
+
+
 def test_four_process_model_axis_and_training_master():
     """Scaled multi-host proof (VERDICT r2 item 9): 4 real processes, a
     mesh whose model axis spans process boundaries (tensor parallelism over
