@@ -88,6 +88,11 @@ def resnet50_conf(height=224, width=224, channels=3, num_classes=1000,
             .build())
 
 
-def resnet50(**kwargs):
+def resnet50(remat=False, **kwargs):
+    """remat=True: segment gradient checkpointing at the residual adds
+    (ComputationGraph(remat_segments=True)) — recompute each bottleneck's
+    conv→BN→ReLU interior in the backward instead of storing it; the
+    structural bytes/step lever for the HBM-bound step (PERF.md)."""
     from ...nn.graph import ComputationGraph
-    return ComputationGraph(resnet50_conf(**kwargs)).init()
+    return ComputationGraph(resnet50_conf(**kwargs),
+                            remat_segments=remat).init()

@@ -30,8 +30,20 @@ log = logging.getLogger(__name__)
 
 
 class ComputationGraph:
-    def __init__(self, conf: ComputationGraphConfiguration):
+    def __init__(self, conf: ComputationGraphConfiguration,
+                 remat_segments=False):
+        """remat_segments=True: gradient-checkpoint the graph in segments
+        bounded by element-wise (residual-add) vertices — the backward
+        recomputes each segment's conv→BN→ReLU chain from the segment
+        boundary instead of re-reading every intermediate activation from
+        HBM. Structural bytes/step lever for bandwidth-bound CNNs
+        (PERF.md r2 roofline: ResNet-50 is HBM-bound); trades ~1/3 more
+        forward FLOPs for activation traffic. Numerics are identical
+        (pinned by test). The reference has no equivalent (it stores all
+        activations; workspace reuse is its only memory lever —
+        WorkspaceMode in MultiLayerConfiguration.java)."""
         self.conf = conf
+        self._remat = bool(remat_segments)
         g = conf.global_conf
         dt = str(g.get("data_type", "float32"))
         self.compute_dtype = {"bfloat16": jnp.bfloat16,
@@ -97,6 +109,14 @@ class ComputationGraph:
         new_carries dict).
         """
         cdt = self.compute_dtype
+        # remat only wraps the TRAINING forward (what the backward stores);
+        # inference/inspection (feed_forward, UI activation capture) keeps
+        # the full per-vertex activation contract
+        if (self._remat and train and stop_at is None and carries is None
+                and not (fmasks and any(m is not None
+                                        for m in fmasks.values()))):
+            return self._apply_graph_remat(params, state, inputs,
+                                           train=train, rng=rng)
         acts = {}
         masks = {}
         for name in self.conf.network_inputs:
@@ -112,36 +132,146 @@ class ComputationGraph:
             in_acts = [acts[i] for i in spec.inputs]
             in_masks = [masks.get(i) for i in spec.inputs]
             lrng = jax.random.fold_in(rng, vi) if rng is not None else None
+            out, st, c = self._forward_vertex(
+                spec, params.get(name), in_acts, in_masks, train=train,
+                lrng=lrng, state_entry=state.get(name),
+                carry_entry=(carries or {}).get(name)
+                if carries is not None else None)
+            acts[name] = out
+            if st is not None:
+                new_state[name] = st
+            if c is not None:
+                new_carries[name] = c
             if spec.is_layer:
-                layer = spec.conf
-                x = in_acts[0]
-                if spec.preprocessor is not None:
-                    x = spec.preprocessor.pre_process(x)
-                p = jax.tree.map(
-                    lambda a: a.astype(cdt)
-                    if jnp.issubdtype(a.dtype, jnp.floating) else a,
-                    params[name])
-                m = in_masks[0]
-                if (isinstance(layer, BaseRecurrentLayer)
-                        and carries is not None):
-                    out, c = layer.forward_with_carry(
-                        p, x, carries[name], train=train, rng=lrng, mask=m)
-                    new_carries[name] = c
-                elif layer.has_state():
-                    out, st = layer.forward_with_state(
-                        p, x, state[name], train=train, rng=lrng, mask=m)
-                    new_state[name] = st
-                else:
-                    out = layer.forward(p, x, train=train, rng=lrng, mask=m)
-                acts[name] = out
-                masks[name] = m if _keeps_time_axis(layer) else None
+                masks[name] = (in_masks[0]
+                               if _keeps_time_axis(spec.conf) else None)
             else:
-                acts[name] = spec.conf.forward(in_acts, masks=in_masks,
-                                               train=train, rng=lrng)
                 masks[name] = spec.conf.output_mask(in_masks)
             if stop_at is not None and name == stop_at:
                 break
         return acts, new_state, masks, new_carries
+
+    def _cast_params(self, p):
+        cdt = self.compute_dtype
+        return jax.tree.map(
+            lambda a: a.astype(cdt)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+
+    def _forward_vertex(self, spec, p, in_acts, in_masks, *, train, lrng,
+                        state_entry=None, carry_entry=None):
+        """One vertex's forward — the SINGLE dispatch (preprocessor, param
+        cast, carry/state/stateless branches) shared by `_apply_graph` and
+        the remat segment body, so the two forward paths cannot drift.
+        Returns (out, new_state | None, new_carry | None)."""
+        if spec.is_layer:
+            layer = spec.conf
+            x = in_acts[0]
+            if spec.preprocessor is not None:
+                x = spec.preprocessor.pre_process(x)
+            p = self._cast_params(p)
+            m = in_masks[0]
+            if (isinstance(layer, BaseRecurrentLayer)
+                    and carry_entry is not None):
+                out, c = layer.forward_with_carry(
+                    p, x, carry_entry, train=train, rng=lrng, mask=m)
+                return out, None, c
+            if layer.has_state():
+                out, st = layer.forward_with_state(
+                    p, x, state_entry, train=train, rng=lrng, mask=m)
+                return out, st, None
+            return (layer.forward(p, x, train=train, rng=lrng, mask=m),
+                    None, None)
+        return (spec.conf.forward(in_acts, masks=in_masks, train=train,
+                                  rng=lrng), None, None)
+
+    def _remat_plan(self):
+        """Segment the topological order at element-wise (residual-add)
+        vertex boundaries. Returns (segment-id per vertex, n_segments)."""
+        if getattr(self, "_remat_plan_cache", None) is None:
+            from ..conf.graph_vertices import ElementWiseVertex
+            seg, s = {}, 0
+            for name in self.conf.topological_order:
+                seg[name] = s
+                spec = self.conf.vertices[name]
+                if (not spec.is_layer
+                        and isinstance(spec.conf, ElementWiseVertex)):
+                    s += 1
+            self._remat_plan_cache = (seg, s + 1)
+        return self._remat_plan_cache
+
+    def _apply_graph_remat(self, params, state, inputs, *, train, rng):
+        """`_apply_graph` with each residual segment under `jax.checkpoint`:
+        only segment-boundary activations become autodiff residuals; the
+        interior (conv outputs, BN normalized, ReLU) is recomputed during
+        the backward. Only reached for mask-free, carry-free graphs (the
+        CNN shape this lever targets)."""
+        cdt = self.compute_dtype
+        seg_of, n_seg = self._remat_plan()
+        order = self.conf.topological_order
+        segments = [[] for _ in range(n_seg)]
+        for name in order:
+            segments[seg_of[name]].append(name)
+        # activations needed beyond their own segment stay live; output
+        # heads' INPUTS too — _loss_fn recomputes each head on its
+        # pre-head activation to attach the loss
+        needed_later = set(self.conf.network_outputs)
+        for out in self.conf.network_outputs:
+            needed_later.update(self.conf.vertices[out].inputs)
+        for name in order:
+            for inp in self.conf.vertices[name].inputs:
+                if seg_of.get(inp, -1) != seg_of[name]:
+                    needed_later.add(inp)
+        vi_of = {name: i for i, name in enumerate(order)}
+        acts = {}
+        for name in self.conf.network_inputs:
+            x = inputs[name]
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(cdt)
+            acts[name] = x
+        new_state = dict(state)
+
+        for si, seg_names in enumerate(segments):
+            if not seg_names:
+                continue
+            ext_in = sorted({i for n in seg_names
+                             for i in self.conf.vertices[n].inputs
+                             if seg_of.get(i, -1) != si})
+            layer_names = tuple(n for n in seg_names
+                                if self.conf.vertices[n].is_layer)
+            stateful = tuple(n for n in layer_names
+                             if self.conf.vertices[n].conf.has_state())
+            out_names = tuple(n for n in seg_names if n in needed_later)
+
+            def seg_fn(p_sub, st_sub, in_list, _names=tuple(seg_names),
+                       _ext=tuple(ext_in), _outs=out_names):
+                local = dict(zip(_ext, in_list))
+                st_new = {}
+                for name in _names:
+                    spec = self.conf.vertices[name]
+                    in_acts = [local[i] for i in spec.inputs]
+                    lrng = (jax.random.fold_in(rng, vi_of[name])
+                            if rng is not None else None)
+                    # same vertex dispatch as the default path — shared
+                    # helper, so the two forwards cannot drift
+                    out, st, _ = self._forward_vertex(
+                        spec, p_sub.get(name), in_acts,
+                        [None] * len(in_acts), train=train, lrng=lrng,
+                        state_entry=st_sub.get(name))
+                    if st is not None:
+                        st_new[name] = st
+                    local[name] = out
+                return [local[o] for o in _outs], st_new
+
+            # the final segment (head + loss inputs) gains nothing from
+            # recompute — its residuals back the loss directly
+            call = jax.checkpoint(seg_fn) if si < n_seg - 1 else seg_fn
+            outs, st_new = call({n: params[n] for n in layer_names},
+                                {n: state[n] for n in stateful},
+                                [acts[i] for i in ext_in])
+            acts.update(zip(out_names, outs))
+            new_state.update(st_new)
+        masks = {name: None for name in acts}
+        return acts, new_state, masks, None
 
     def _canon_inputs(self, features):
         if isinstance(features, dict):
@@ -184,10 +314,7 @@ class ComputationGraph:
             x = acts[spec.inputs[0]]
             if spec.preprocessor is not None:
                 x = spec.preprocessor.pre_process(x)
-            p = jax.tree.map(
-                lambda a: a.astype(self.compute_dtype)
-                if jnp.issubdtype(a.dtype, jnp.floating) else a,
-                params[out_name])
+            p = self._cast_params(params[out_name])
             lrng = (jax.random.fold_in(rng, order[out_name])
                     if rng is not None else None)
             lmask = None

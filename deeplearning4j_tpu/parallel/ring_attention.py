@@ -72,9 +72,12 @@ def ring_attention_kernel(q, k, v, kv_mask, axis_name, causal=False,
     o0 = jnp.zeros((B, H, Tq, D), acc_dt)
     m0 = jnp.full((B, H, Tq), NEG_INF, acc_dt)
     l0 = jnp.zeros((B, H, Tq), acc_dt)
-    if hasattr(lax, "pvary"):
-        # constants start replicated under shard_map; the loop carry becomes
-        # axis-varying, so mark the initial accumulators varying too
+    # constants start replicated under shard_map; the loop carry becomes
+    # axis-varying, so mark the initial accumulators varying too.
+    # pcast replaced pvary (deprecated) — support both jax generations.
+    if hasattr(lax, "pcast"):
+        o0, m0, l0 = lax.pcast((o0, m0, l0), axis_name, to="varying")
+    elif hasattr(lax, "pvary"):
         o0, m0, l0 = lax.pvary((o0, m0, l0), (axis_name,))
     perm = [(j, (j + 1) % n) for j in range(n)]
 

@@ -279,13 +279,14 @@ class Tsne:
         gains = np.ones_like(y)
         native = native_ops.available()
         exagg_iters = min(100, self.max_iter // 4)
+        vals_ex = vals * 12.0     # early-exaggeration array, built ONCE
         for it in range(self.max_iter):
-            ex = 12.0 if it < exagg_iters else 1.0
+            v_it = vals_ex if it < exagg_iters else vals
             momentum = 0.5 if it < 250 else 0.8
-            attr = (native_ops.bh_attraction(y, row_ptr, cols, vals * ex)
+            attr = (native_ops.bh_attraction(y, row_ptr, cols, v_it)
                     if native else None)
             if attr is None:
-                attr = _np_attraction(y, row_ptr, cols, vals * ex)
+                attr = _np_attraction(y, row_ptr, cols, v_it)
             rz = native_ops.bh_repulsion(y, self.theta) if native else None
             if rz is None:
                 rz = _np_repulsion(y)

@@ -129,9 +129,23 @@ class PosAnnotator(Annotator):
               ("ness", "NN"), ("ment", "NN"), ("ous", "JJ"), ("ful", "JJ"),
               ("able", "JJ"), ("ible", "JJ"), ("al", "JJ"), ("s", "NNS"))
     _CLOSED = {"the": "DT", "a": "DT", "an": "DT", "is": "VBZ",
-               "are": "VBP", "was": "VBD", "be": "VB", "and": "CC",
-               "or": "CC", "of": "IN", "in": "IN", "on": "IN", "to": "TO",
-               "it": "PRP", "he": "PRP", "she": "PRP", "they": "PRP"}
+               "are": "VBP", "was": "VBD", "were": "VBD", "be": "VB",
+               "been": "VBN", "and": "CC", "or": "CC", "but": "CC",
+               "of": "IN", "in": "IN", "on": "IN", "at": "IN",
+               "with": "IN", "from": "IN", "by": "IN", "for": "IN",
+               "to": "TO", "it": "PRP", "he": "PRP", "she": "PRP",
+               "they": "PRP", "we": "PRP", "i": "PRP", "you": "PRP",
+               "his": "PRP$", "her": "PRP$", "its": "PRP$",
+               "their": "PRP$", "my": "PRP$", "have": "VBP",
+               "has": "VBZ", "had": "VBD", "do": "VBP", "does": "VBZ",
+               "did": "VBD", "can": "MD", "could": "MD", "will": "MD",
+               "would": "MD", "may": "MD", "should": "MD", "must": "MD",
+               "not": "RB", "very": "RB", "sat": "VBD", "ran": "VBD",
+               "saw": "VBD", "went": "VBD", "made": "VBD", "said": "VBD",
+               "chased": "VBD", "ate": "VBD", "big": "JJ", "small": "JJ",
+               "quick": "JJ", "old": "JJ", "new": "JJ", "good": "JJ",
+               "happy": "JJ", "that": "IN", "this": "DT", "these": "DT",
+               "those": "DT"}
 
     def process(self, doc):
         for t in doc.select("token"):

@@ -108,6 +108,7 @@ class TestTsne:
         assert (np.abs(rep_a - rep_e).max()
                 / max(np.abs(rep_e).max(), 1e-9)) < 0.05
 
+    @pytest.mark.slow
     def test_barnes_hut_clusters_stay_separated(self):
         from deeplearning4j_tpu.plot.tsne import BarnesHutTsne
         x, y = _blobs(n_per=40)

@@ -179,6 +179,7 @@ class TestRecordReaders:
 
 
 class TestMultiInputPipeline:
+    @pytest.mark.slow
     def test_csv_multi_reader_async_feeds_computation_graph(self, tmp_path):
         """Round-1/2 mandate: CSV-backed RecordReaderMultiDataSetIterator
         (2 inputs, 2 outputs incl. one-hot) wrapped in

@@ -139,6 +139,7 @@ class TestReconstructionDistributionTail:
         with pytest.raises(ValueError):
             comp.total_params(9)   # components cover 8 features
 
+    @pytest.mark.slow
     def test_composite_elbo_gradcheck_and_serde(self):
         dist = {"type": "composite", "components": [
             [5, {"type": "gaussian", "activation": "identity"}],
@@ -154,6 +155,7 @@ class TestReconstructionDistributionTail:
         assert isinstance(d2, CompositeReconstructionDistribution)
         assert d2.total_params(8) == 13
 
+    @pytest.mark.slow
     def test_loss_wrapper_trains_plain_autoencoder(self):
         vae = _vae({"type": "loss_wrapper", "loss": "mse",
                     "activation": "sigmoid"})

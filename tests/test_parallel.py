@@ -161,6 +161,7 @@ class TestZeroShardedUpdaterState:
                 .build())
         return MultiLayerNetwork(conf).init()
 
+    @pytest.mark.slow
     def test_matches_replicated(self):
         x, y = blob_data(n=64)
         ds = DataSet(x, y)

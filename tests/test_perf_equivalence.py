@@ -45,6 +45,7 @@ class TestFusedBNBackward:
             for a, b in zip(jax.tree.leaves(nsf), jax.tree.leaves(nsa)):
                 assert float(jnp.max(jnp.abs(a - b))) < 1e-9
 
+    @pytest.mark.slow
     def test_fused_numeric_gradient(self):
         rng = np.random.default_rng(1)
         x = jnp.asarray(rng.standard_normal((4, 3, 3, 2)))

@@ -1,0 +1,106 @@
+"""Segment gradient checkpointing (ComputationGraph remat_segments) —
+the structural bytes/step lever for HBM-bound CNN training (PERF.md r4).
+Numerics must be IDENTICAL to the default path: remat changes what the
+backward stores, never what it computes."""
+import numpy as np
+import pytest
+
+jax = __import__("jax")
+jnp = jax.numpy
+
+from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.graph_vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                               BatchNormalization,
+                                               ConvolutionLayer,
+                                               GlobalPoolingLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def _residual_conf(seed=7):
+    """Two residual blocks: conv->BN->relu chains + adds (the ResNet
+    shape at toy scale)."""
+    gb = (NeuralNetConfiguration.Builder().seed(seed).updater("sgd")
+          .learning_rate(0.1).weight_init("relu").graph_builder()
+          .add_inputs("input"))
+    gb.add_layer("c0", ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                        convolution_mode="same"), "input")
+    x = "c0"
+    for b in range(2):
+        gb.add_layer(f"b{b}_c1", ConvolutionLayer(
+            n_out=8, kernel_size=(3, 3), convolution_mode="same"), x)
+        gb.add_layer(f"b{b}_bn", BatchNormalization(), f"b{b}_c1")
+        gb.add_layer(f"b{b}_r", ActivationLayer(activation="relu"),
+                     f"b{b}_bn")
+        gb.add_layer(f"b{b}_c2", ConvolutionLayer(
+            n_out=8, kernel_size=(3, 3), convolution_mode="same"),
+            f"b{b}_r")
+        gb.add_vertex(f"b{b}_add", ElementWiseVertex(op="add"),
+                      f"b{b}_c2", x)
+        gb.add_layer(f"b{b}_out", ActivationLayer(activation="relu"),
+                     f"b{b}_add")
+        x = f"b{b}_out"
+    gb.add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), x)
+    gb.add_layer("fc", OutputLayer(n_out=3, activation="softmax",
+                                   loss_function="mcxent"), "pool")
+    return (gb.set_outputs("fc")
+            .set_input_types(InputType.convolutional(8, 8, 2)).build())
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((8, 8, 8, 2)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    return x, y
+
+
+class TestRematSegments:
+    def test_plan_segments_at_adds(self):
+        net = ComputationGraph(_residual_conf(), remat_segments=True).init()
+        seg_of, n_seg = net._remat_plan()
+        assert n_seg == 3                       # two adds -> three segments
+        assert seg_of["b0_c1"] == 0
+        assert seg_of["b0_out"] == 1            # first vertex after add 0
+        assert seg_of["fc"] == 2
+
+    def test_training_identical_to_default(self):
+        """Same seed, same data: per-step scores and final params match
+        the non-remat path bit-for-bit-ish (fp tolerance)."""
+        x, y = _data()
+        nets = [ComputationGraph(_residual_conf(), remat_segments=r).init()
+                for r in (False, True)]
+        scores = [[], []]
+        for i, net in enumerate(nets):
+            for _ in range(4):
+                net.fit(DataSet(x, y))
+                scores[i].append(float(net._score))
+        np.testing.assert_allclose(scores[0], scores[1], rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(nets[0]._params),
+                        jax.tree.leaves(nets[1]._params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+
+    def test_bn_running_stats_still_update(self):
+        x, y = _data()
+        net = ComputationGraph(_residual_conf(), remat_segments=True).init()
+        before = np.asarray(net._model_state["b0_bn"]["mean"]).copy()
+        net.fit(DataSet(x, y))
+        after = np.asarray(net._model_state["b0_bn"]["mean"])
+        assert not np.allclose(before, after)
+
+    def test_inference_output_matches(self):
+        x, _ = _data()
+        n0 = ComputationGraph(_residual_conf(), remat_segments=False).init()
+        n1 = ComputationGraph(_residual_conf(), remat_segments=True).init()
+        np.testing.assert_allclose(np.asarray(n0.output(x)),
+                                   np.asarray(n1.output(x)), atol=1e-6)
+
+    def test_resnet50_factory_flag(self):
+        from deeplearning4j_tpu.models.zoo.resnet import resnet50_conf
+        conf = resnet50_conf(height=32, width=32, num_classes=4,
+                             data_type="float32")
+        net = ComputationGraph(conf, remat_segments=True)
+        _, n_seg = net._remat_plan()
+        assert n_seg == 17                      # 16 bottleneck adds + head

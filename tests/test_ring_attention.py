@@ -32,6 +32,7 @@ class TestRingAttention:
         assert np.allclose(np.asarray(full), np.asarray(ring), atol=1e-5), \
             np.abs(np.asarray(full) - np.asarray(ring)).max()
 
+    @pytest.mark.slow
     def test_causal_matches(self):
         q, k, v = _qkv(T=24, seed=1)
         mesh = _seq_mesh(4)

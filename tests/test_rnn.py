@@ -66,6 +66,7 @@ class TestLSTMGradients:
         net = MultiLayerNetwork(rnn_conf(SimpleRnn(n_out=4))).init()
         assert check_gradients(net, x, y, max_rel_error=1e-4, subset=40)
 
+    @pytest.mark.slow
     def test_gradcheck_bidirectional(self):
         x, y = seq_data()
         net = MultiLayerNetwork(

@@ -105,6 +105,70 @@ class TestStreamingPipelines:
         with pytest.raises(ImportError, match="kafka-python"):
             KafkaBroker()
 
+    def test_kafka_broker_protocol_contract(self, monkeypatch):
+        """Contract test against a kafka-python API stub (the reference
+        tests against EmbeddedKafkaCluster — dl4j-streaming
+        src/test/.../embedded/; no Kafka client ships in this image, so
+        the stub pins every interaction KafkaBroker makes with the
+        client API: producer construction args, async send(topic, bytes),
+        flush-on-close, consumer construction with earliest offset, and
+        the pump thread delivering msg.value)."""
+        import sys
+        import time
+        import types
+
+        sent, flushed, closed = [], [], []
+
+        class FakeProducer:
+            def __init__(self, bootstrap_servers=None):
+                sent.append(("init", bootstrap_servers))
+
+            def send(self, topic, payload):
+                sent.append((topic, payload))
+
+            def flush(self):
+                flushed.append(True)
+
+            def close(self):
+                closed.append(True)
+
+        class FakeMsg:
+            def __init__(self, value):
+                self.value = value
+
+        class FakeConsumer:
+            created = []
+
+            def __init__(self, topic, bootstrap_servers=None,
+                         auto_offset_reset=None):
+                FakeConsumer.created.append(
+                    (topic, bootstrap_servers, auto_offset_reset))
+                self._msgs = [FakeMsg(b"m1"), FakeMsg(b"m2")]
+
+            def __iter__(self):
+                return iter(self._msgs)
+
+        fake = types.ModuleType("kafka")
+        fake.KafkaProducer = FakeProducer
+        fake.KafkaConsumer = FakeConsumer
+        monkeypatch.setitem(sys.modules, "kafka", fake)
+
+        from deeplearning4j_tpu.streaming import KafkaBroker
+        b = KafkaBroker(bootstrap_servers="broker:9092")
+        assert sent == [("init", "broker:9092")]
+        b.publish("ndarray-topic", b"payload")
+        assert sent[-1] == ("ndarray-topic", b"payload")
+        assert not flushed            # publish is async (batched)
+        b.flush()
+        assert flushed == [True]
+        sub = b.subscribe("ndarray-topic")
+        assert FakeConsumer.created == [
+            ("ndarray-topic", "broker:9092", "earliest")]
+        got = {sub.get(timeout=2), sub.get(timeout=2)}
+        assert got == {b"m1", b"m2"}
+        b.close()
+        assert closed == [True] and len(flushed) == 2  # flush-on-close
+
 
 class TestNTPTimeSource:
     def _fake_ntp_server(self, offset_s):

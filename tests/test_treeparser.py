@@ -1,0 +1,110 @@
+"""Sentence -> parse-tree pipeline (reference deeplearning4j-nlp-uima
+text/corpora/treeparser/: TreeParser, BinarizeTreeTransformer,
+CollapseUnaries, HeadWordFinder, TreeVectorizer, TreeIterator)."""
+import pytest
+
+from deeplearning4j_tpu.text.sentence_iterator import \
+    LabelAwareListSentenceIterator
+from deeplearning4j_tpu.text.treeparser import (BinarizeTreeTransformer,
+                                                CollapseUnaries,
+                                                HeadWordFinder, Tree,
+                                                TreeIterator, TreeParser,
+                                                TreeVectorizer)
+
+
+class TestTreeParser:
+    def test_one_tree_per_sentence_with_spans(self):
+        trees = TreeParser().get_trees("The quick cat sat on the mat. "
+                                       "He was happy.")
+        assert len(trees) == 2
+        assert all(t.label == "S" for t in trees)
+        # leaves reproduce the sentence tokens in order, spans increase
+        words = trees[0].yield_words()
+        assert words[0] == "The" and "cat" in words
+        leaves = trees[0].leaves()
+        assert all(leaves[i].begin < leaves[i + 1].begin
+                   for i in range(len(leaves) - 1))
+
+    def test_chunk_structure(self):
+        """DT JJ NN sequences group into NPs; verbs into a VP; IN+NP
+        into a PP — the shallow-parse contract."""
+        (tree,) = TreeParser().get_trees("The big dog chased a small cat")
+        labels = [c.label for c in tree.children]
+        assert labels == ["NP", "VP", "NP"]
+        assert tree.children[0].yield_words() == ["The", "big", "dog"]
+        assert tree.children[1].children[0].label == "VBD"
+
+    def test_pp_attachment(self):
+        (tree,) = TreeParser().get_trees("He sat on the mat")
+        pp = [c for c in tree.children if c.label == "PP"]
+        assert len(pp) == 1
+        assert pp[0].children[0].label == "IN"
+        assert pp[0].children[1].label == "NP"
+
+    def test_trees_with_labels_attach_tags(self):
+        trees = TreeParser().get_trees_with_labels(
+            "The cat sat.", ["pos", "neg"])
+        for node in trees[0]:
+            assert node.tags == ["POS", "NEG"]
+
+
+class TestTransformers:
+    def _nary(self):
+        kids = [Tree("NN", value=w, begin=i, end=i + 1)
+                for i, w in enumerate("a b c d".split())]
+        return Tree("NP", kids, begin=0, end=4)
+
+    def test_binarize_caps_fanout_and_preserves_yield(self):
+        t = BinarizeTreeTransformer().transform(self._nary())
+        assert t.yield_words() == ["a", "b", "c", "d"]
+        for node in t:
+            assert len(node.children) <= 2
+        assert t.children[0].label == "@NP"
+
+    def test_collapse_unaries(self):
+        inner = Tree("NP", [Tree("NN", value="cat", begin=0, end=3)])
+        chain = Tree("S", [Tree("X", [inner])])
+        out = CollapseUnaries().transform(chain)
+        assert out.label == "S"
+        assert out.children[0].label == "NN"
+        # original untouched (clone semantics)
+        assert chain.children[0].label == "X"
+
+    def test_head_word_finder(self):
+        (tree,) = TreeParser().get_trees("The big dog chased a small cat")
+        assert HeadWordFinder().find_head(tree).value == "chased"
+        np = tree.children[0]
+        assert HeadWordFinder().find_head(np).value == "dog"
+
+    def test_head_pp_modes(self):
+        (tree,) = TreeParser().get_trees("He sat on the mat")
+        pp = [c for c in tree.children if c.label == "PP"][0]
+        assert HeadWordFinder().find_head(pp).value == "on"
+        assert HeadWordFinder(include_pp_head=True).find_head(
+            pp).value == "mat"
+
+
+class TestVectorizerAndIterator:
+    def test_vectorizer_binarizes_and_labels(self):
+        trees = TreeVectorizer().get_trees_with_labels(
+            "The big dog chased a small cat in the garden", label="pos")
+        t = trees[0]
+        assert t.gold_label == "pos"
+        for node in t:
+            assert len(node.children) <= 2
+        assert "POS" in t.tags
+
+    def test_tree_iterator_batches_with_labels(self):
+        it = LabelAwareListSentenceIterator(
+            ["The cat sat", "The dog ran", "He was happy"],
+            ["a", "b", "c"])
+        ti = TreeIterator(it, labels=["a", "b", "c"], batch_size=2)
+        batch = ti.next()
+        assert len(batch) >= 2
+        assert batch[0].gold_label == "a"
+        ti.reset()
+        assert ti.has_next()
+        total = []
+        while ti.has_next():
+            total.extend(ti.next())
+        assert len(total) == 3

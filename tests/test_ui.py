@@ -188,6 +188,7 @@ def test_listener_events_push():
     assert events == ["static", "update"]
 
 
+@pytest.mark.slow
 def test_activation_collection_and_new_pages():
     """Flow / conv-activation / system pages + activation capture
     (reference FlowListenerModule, ConvolutionalListenerModule,
