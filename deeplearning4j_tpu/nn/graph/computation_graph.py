@@ -100,7 +100,7 @@ class ComputationGraph:
     # Forward — reference: per-vertex doForward in topological order
     # ------------------------------------------------------------------
     def _apply_graph(self, params, state, inputs, *, train, rng, fmasks=None,
-                     stop_at=None, carries=None):
+                     stop_at=None, carries=None, allow_remat=False):
         """Pure forward over the DAG.
 
         inputs: dict input-name -> array. fmasks: dict input-name -> mask.
@@ -109,10 +109,12 @@ class ComputationGraph:
         new_carries dict).
         """
         cdt = self.compute_dtype
-        # remat only wraps the TRAINING forward (what the backward stores);
-        # inference/inspection (feed_forward, UI activation capture) keeps
-        # the full per-vertex activation contract
-        if (self._remat and train and stop_at is None and carries is None
+        # remat only wraps the TRAINING-STEP forward (allow_remat is set
+        # by _loss_fn alone — what the backward stores); inference AND
+        # inspection (feed_forward/UI activation capture, any train flag)
+        # keep the full per-vertex activation contract
+        if (self._remat and allow_remat and train and stop_at is None
+                and carries is None
                 and not (fmasks and any(m is not None
                                         for m in fmasks.values()))):
             return self._apply_graph_remat(params, state, inputs,
@@ -302,7 +304,7 @@ class ComputationGraph:
         """features: dict name->arr; labels: list aligned with network_outputs."""
         acts, new_state, masks, new_carries = self._apply_graph(
             params, state, features, train=train, rng=rng, fmasks=fmasks,
-            carries=carries)
+            carries=carries, allow_remat=True)
         total = 0.0
         order = {n: i for i, n in enumerate(self.conf.topological_order)}
         for oi, out_name in enumerate(self.conf.network_outputs):

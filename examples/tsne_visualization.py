@@ -1,0 +1,36 @@
+"""t-SNE at two scales: the exact dense kernel (small N, one jitted
+fori_loop on the accelerator) and Barnes-Hut (large N — C++ quadtree
+repulsion + sparse kNN attraction; the kNN search and every point's
+perplexity bisection run vectorized in JAX).
+
+reference: plot/Tsne.java + plot/BarnesHutTsne.java + clustering/sptree.
+"""
+import _common  # noqa: F401
+
+import numpy as np
+
+from deeplearning4j_tpu.plot import Tsne
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne
+
+rng = np.random.default_rng(0)
+centers = rng.standard_normal((5, 16)) * 8.0
+labels = np.repeat(np.arange(5), 400)
+x = (centers[labels] + rng.standard_normal((2000, 16))).astype(np.float32)
+
+# auto: dense exact kernel below ~4k points, Barnes-Hut above
+emb = (Tsne.Builder().set_max_iter(250).perplexity(25).theta(0.5)
+       .seed(3).build().fit(x))
+
+# force the Barnes-Hut path (any N, 2-D)
+emb_bh = BarnesHutTsne(perplexity=25, max_iter=250, seed=3).fit(x)
+
+for name, e in (("auto", emb), ("barnes_hut", emb_bh)):
+    cents = np.stack([e[labels == i].mean(0) for i in range(5)])
+    intra = np.mean([np.linalg.norm(e[labels == i] - cents[i], axis=1).mean()
+                     for i in range(5)])
+    inter = np.mean([np.linalg.norm(cents[i] - cents[j])
+                     for i in range(5) for j in range(i + 1, 5)])
+    print(f"{name}: embedding {e.shape}, cluster separation "
+          f"inter/intra = {inter / intra:.2f} (separated: "
+          f"{bool(inter / intra > 2)})")
+print(True)

@@ -86,6 +86,50 @@ class TestLatticeSegmentation:
         assert out == ["水", "を", "飲みたい", "です"]
 
 
+class TestKoreanMorphology:
+    """Eojeol decomposition (reference deeplearning4j-nlp-korean vendored
+    KoreanText analyzer; closed-class + jamo-aware rules here)."""
+
+    def test_stem_josa_eomi_stream(self):
+        from deeplearning4j_tpu.text.ko_morph import KoreanMorphTokenizer
+        got = KoreanMorphTokenizer("학교에서 공부를 했다")._tokens
+        assert got == ["학교", "에서", "공부", "를", "하", "였다"]
+
+    def test_batchim_agreement_selects_particle(self):
+        from deeplearning4j_tpu.text.ko_morph import split_josa
+        # 은/는, 이/가, 을/를 alternate on the final consonant
+        assert split_josa("책은") == ("책", "은")
+        assert split_josa("저는") == ("저", "는")
+        assert split_josa("책이") == ("책", "이")
+        assert split_josa("친구가") == ("친구", "가")
+        # (으)로: 로 after vowel OR ㄹ-final (서울로), 으로 otherwise
+        assert split_josa("서울로") == ("서울", "로")
+        assert split_josa("집으로") == ("집", "으로")
+
+    def test_ha_and_bieup_contractions(self):
+        from deeplearning4j_tpu.text.ko_morph import split_eomi
+        assert split_eomi("했다") == ("하", "였다")
+        assert split_eomi("갑니다") == ("가", "ㅂ니다")      # 가 + ㅂ니다
+        assert split_eomi("마십니다") == ("마시", "ㅂ니다")
+        # regular polite after consonant stem stays table-matched — the
+        # 습 syllable also ends in ㅂ, so this pins the tie-break (먹+습니다,
+        # never the bogus 먹스+ㅂ니다)
+        assert split_eomi("읽었습니다") == ("읽", "었습니다")
+        assert split_eomi("먹습니다") == ("먹", "습니다")
+        assert split_eomi("좋습니다") == ("좋", "습니다")
+
+    def test_stems_only_mode_and_factory(self):
+        from deeplearning4j_tpu.text.ko_morph import \
+            KoreanMorphTokenizerFactory
+        f = KoreanMorphTokenizerFactory(emit_affixes=False)
+        t = f.create("학교에서 공부를 했다")
+        assert t.get_tokens() == ["학교", "공부", "하"]
+
+    def test_bare_nouns_pass_through(self):
+        from deeplearning4j_tpu.text.ko_morph import KoreanMorphTokenizer
+        assert KoreanMorphTokenizer("서울 김치")._tokens == ["서울", "김치"]
+
+
 class TestKoreanParticles:
     def test_strips_common_particles(self):
         got = KoreanTokenizer("학교에서 공부를 했다")._tokens
