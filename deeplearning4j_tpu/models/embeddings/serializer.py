@@ -2,11 +2,15 @@
 
 TPU-native equivalent of reference
 models/embeddings/loader/WordVectorSerializer.java:88: read/write the Google
-word2vec text and binary formats, plus a zip container (vocab json + vectors
-npz) standing in for the reference's DL4J zip formats.
+word2vec text and binary formats (plain or gzip — the reference's
+loadTxtVectors sniffs the GZIP magic the same way), ParagraphVectors
+persistence with the label space preserved (writeParagraphVectors /
+readParagraphVectors), GloVe text export, plus a zip container (vocab json
++ vectors npz) standing in for the reference's DL4J zip formats.
 """
 from __future__ import annotations
 
+import gzip
 import io
 import json
 import struct
@@ -18,14 +22,30 @@ from ..word2vec.vocab import VocabCache, build_huffman
 from .lookup_table import InMemoryLookupTable
 
 
+def _open_text(path, mode):
+    """Text open with transparent gzip by extension on write and by magic
+    on read (reference: WordVectorSerializer's GZIP sniffing)."""
+    path = str(path)
+    if "r" in mode:
+        with open(path, "rb") as fh:
+            magic = fh.read(2)
+        if magic == b"\x1f\x8b":
+            return gzip.open(path, "rt", encoding="utf-8")
+        return open(path, "r", encoding="utf-8")
+    if path.endswith(".gz"):
+        return gzip.open(path, "wt", encoding="utf-8")
+    return open(path, "w", encoding="utf-8")
+
+
 # ---------------------------------------------------------------------------
 # Google word2vec text format: "V D\nword v1 v2 ...\n"
 # ---------------------------------------------------------------------------
 
 def write_word2vec_text(model, path):
-    """reference: WordVectorSerializer.writeWordVectors (text)."""
+    """reference: WordVectorSerializer.writeWordVectors (text; .gz path
+    compresses, the reference's GZIP variant)."""
     vocab, lookup = model.vocab, model.lookup
-    with open(path, "w", encoding="utf-8") as fh:
+    with _open_text(path, "w") as fh:
         fh.write(f"{len(vocab)} {lookup.vector_length}\n")
         for vw in vocab.vocab_words():
             vec = " ".join(f"{x:.6f}" for x in lookup.syn0[vw.index])
@@ -36,8 +56,9 @@ writeWordVectors = write_word2vec_text
 
 
 def read_word2vec_text(path):
-    """reference: WordVectorSerializer.loadTxtVectors."""
-    with open(path, "r", encoding="utf-8") as fh:
+    """reference: WordVectorSerializer.loadTxtVectors (gzip auto-detected
+    by magic)."""
+    with _open_text(path, "r") as fh:
         header = fh.readline().split()
         V, D = int(header[0]), int(header[1])
         vocab = VocabCache()
@@ -149,10 +170,65 @@ def read_full_model(path):
             lookup.syn1 = weights["syn1"]
         if "syn1neg" in weights:
             lookup.syn1neg = weights["syn1neg"]
+        if lookup.negative > 0:
+            # weights were assigned directly (no reset_weights), so the
+            # unigram sampling table must be built here or training-style
+            # code (infer_vector) dereferences neg_table=None
+            lookup._build_neg_table()
     return _as_static_model(vocab, lookup)
 
 
 loadFullModel = read_full_model
+
+
+# ---------------------------------------------------------------------------
+# ParagraphVectors persistence (labels are pseudo-words in the same
+# vocab/lookup; the label LIST must round-trip so inference + nearest-label
+# queries work after load)
+# ---------------------------------------------------------------------------
+
+def write_paragraph_vectors(pv, path):
+    """reference: WordVectorSerializer.writeParagraphVectors — the full
+    zip plus labels.json recording which vocab entries are labels."""
+    write_full_model(pv, path)
+    labels = list(pv.labels_source.get_labels())
+    with zipfile.ZipFile(path, "a", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("labels.json", json.dumps(labels))
+
+
+writeParagraphVectors = write_paragraph_vectors
+
+
+def read_paragraph_vectors(path):
+    """reference: WordVectorSerializer.readParagraphVectors — restores
+    the training hyperparameters (use_hs/negative) from config.json so a
+    negative-sampling model infers with the negative path, not a crashed
+    HS default."""
+    from ...text.sentence_iterator import LabelsSource
+    from ..paragraphvectors.paragraph_vectors import ParagraphVectors
+    base = read_full_model(path)
+    with zipfile.ZipFile(path, "r") as zf:
+        labels = json.loads(zf.read("labels.json"))
+        cfg = json.loads(zf.read("config.json"))
+    b = (ParagraphVectors.Builder()
+         .layer_size(base.lookup.vector_length))
+    if int(cfg.get("negative", 0)) > 0:
+        b = b.negative_sample(int(cfg["negative"]))
+    pv = b.build()
+    pv.use_hs = bool(cfg.get("useHs", True))
+    pv.vocab = base.vocab
+    pv.lookup = base.lookup
+    pv.labels_source = LabelsSource(labels=labels)
+    return pv
+
+
+readParagraphVectors = read_paragraph_vectors
+
+
+def write_glove_text(glove, path):
+    """reference: WordVectorSerializer.writeWordVectors(Glove) — the same
+    text dialect over the summed W + Wc table."""
+    write_word2vec_text(glove, path)
 
 
 def _as_static_model(vocab, lookup):

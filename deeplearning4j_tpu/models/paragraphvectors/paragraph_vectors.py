@@ -117,6 +117,12 @@ class ParagraphVectors(SequenceVectors):
         if documents is None:
             documents = self._docs_from_iterator()
         self._docs = list(documents)
+        # record THIS fit's label space (dedup'd via the public API) so
+        # it serializes — refits replace, never leave a stale list
+        # (reference: labelsSource is always populated)
+        self.labels_source._labels = []
+        for label, _ in self._docs:
+            self.labels_source.store_label(label)
 
         # vocab over words AND labels (labels are count-1 pseudo-words)
         seqs = [toks for _, toks in self._docs]

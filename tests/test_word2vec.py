@@ -186,6 +186,79 @@ class TestSerializer:
         assert m2.vocab.word_frequency("cat") == m.vocab.word_frequency("cat")
         assert m2.lookup.syn1 is not None  # HS weights preserved
 
+    def test_gzip_text_round_trip(self, tmp_path):
+        """.gz write compresses; read sniffs the GZIP magic (reference
+        loadTxtVectors behavior) — same vectors either way."""
+        import gzip
+        m = self._model()
+        p = str(tmp_path / "vec.txt.gz")
+        WVS.write_word2vec_text(m, p)
+        with open(p, "rb") as fh:
+            assert fh.read(2) == b"\x1f\x8b"     # really gzip on disk
+        m2 = WVS.read_word2vec_text(p)
+        assert np.allclose(m2.get_word_vector("cat"),
+                           m.get_word_vector("cat"), atol=1e-5)
+
+    def test_paragraph_vectors_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.models.paragraphvectors.paragraph_vectors \
+            import ParagraphVectors
+        docs = [("DOC_A", ["cat", "dog", "fur", "pet"] * 5),
+                ("DOC_B", ["car", "wheel", "road", "drive"] * 5)]
+        pv = (ParagraphVectors.Builder().layer_size(16).window_size(3)
+              .seed(3).epochs(5).build())
+        pv.fit(docs)
+        p = str(tmp_path / "pv.zip")
+        WVS.write_paragraph_vectors(pv, p)
+        pv2 = WVS.read_paragraph_vectors(p)
+        # label vectors AND the label list survive
+        assert pv2.labels_source._labels == ["DOC_A", "DOC_B"]
+        for lab in ("DOC_A", "DOC_B"):
+            assert np.allclose(pv2.get_word_vector(lab),
+                               pv.get_word_vector(lab))
+        # inference works on the restored model
+        v = pv2.infer_vector(["cat", "dog"])
+        assert v.shape == (16,)
+
+    def test_paragraph_vectors_negative_sampling_round_trip(self, tmp_path):
+        """A negative-sampling PV restores with use_hs=False and a rebuilt
+        unigram table — infer_vector must run the negative path, not
+        crash on the HS default (syn1 is None for these models)."""
+        from deeplearning4j_tpu.models.paragraphvectors.paragraph_vectors \
+            import ParagraphVectors
+        docs = [("D_A", ["cat", "dog", "fur", "pet"] * 5),
+                ("D_B", ["car", "wheel", "road", "drive"] * 5),
+                ("D_A", ["cat", "pet", "fur", "dog"] * 5)]   # dup label
+        pv = (ParagraphVectors.Builder().layer_size(12).window_size(3)
+              .seed(4).epochs(4).negative_sample(5).build())
+        pv.fit(docs)
+        assert pv.labels_source.get_labels() == ["D_A", "D_B"]  # dedup'd
+        p = str(tmp_path / "pv_neg.zip")
+        WVS.write_paragraph_vectors(pv, p)
+        pv2 = WVS.read_paragraph_vectors(p)
+        assert pv2.use_hs is False and pv2.negative == 5
+        assert pv2.lookup.neg_table is not None
+        v = pv2.infer_vector(["cat", "dog"])
+        assert v.shape == (12,) and np.isfinite(v).all()
+
+    def test_refit_replaces_label_space(self):
+        from deeplearning4j_tpu.models.paragraphvectors.paragraph_vectors \
+            import ParagraphVectors
+        pv = (ParagraphVectors.Builder().layer_size(8).window_size(2)
+              .seed(1).epochs(2).build())
+        pv.fit([("X", ["a", "b", "c", "d"] * 4)])
+        pv.fit([("Y", ["e", "f", "g", "h"] * 4)])
+        assert pv.labels_source.get_labels() == ["Y"]   # no stale X
+
+    def test_glove_text_export(self, tmp_path):
+        g = (Glove.Builder().layer_size(12).window_size(3).seed(7)
+             .learning_rate(0.1).epochs(5).build())
+        g.fit(_toy_corpus(30))
+        p = str(tmp_path / "glove.txt")
+        WVS.write_glove_text(g, p)
+        m2 = WVS.read_word2vec_text(p)
+        assert np.allclose(m2.get_word_vector("cat"),
+                           g.get_word_vector("cat"), atol=1e-5)
+
 
 class TestTextPipeline:
     def test_default_tokenizer_and_preprocessor(self):
