@@ -51,24 +51,30 @@ def init_block(rng, d_model, n_heads, d_ff, dtype=jnp.float32):
     }
 
 
-def causal_attention(x, wqkv, wo, n_heads):
-    """[B, T, D] causal MHA; one fused qkv matmul, one output matmul."""
+def causal_attention(x, wqkv, wo, n_heads, return_kv=False):
+    """[B, T, D] causal MHA; one fused qkv matmul, one output matmul.
+    return_kv=True also yields the [B, T, H, hd] k/v panels — the ONE
+    source of the attention math that `generate_batch`'s parallel prefill
+    reuses to fill the KV cache (so prefill can never drift from the
+    training/forward block numerics)."""
     B, T, D = x.shape
     H = n_heads
     hd = D // H
     qkv = x @ wqkv                                     # [B, T, 3D]
     q, k, v = jnp.split(qkv, 3, axis=-1)
+    panels = lambda a: a.reshape(B, T, H, hd)
+    heads = lambda a: panels(a).transpose(0, 2, 1, 3)  # [B, H, T, hd]
 
-    def heads(a):
-        return a.reshape(B, T, H, hd).transpose(0, 2, 1, 3)  # [B, H, T, hd]
-
-    q, k, v = heads(q), heads(k), heads(v)
-    scores = (q @ k.transpose(0, 1, 3, 2)) / math.sqrt(hd)   # [B, H, T, T]
+    qh, kh, vh = heads(q), heads(k), heads(v)
+    scores = (qh @ kh.transpose(0, 1, 3, 2)) / math.sqrt(hd)  # [B,H,T,T]
     mask = jnp.tril(jnp.ones((T, T), bool))
     scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     att = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(x.dtype)
-    out = (att @ v).transpose(0, 2, 1, 3).reshape(B, T, D)
-    return out @ wo
+    out = (att @ vh).transpose(0, 2, 1, 3).reshape(B, T, D)
+    out = out @ wo
+    if return_kv:
+        return out, panels(k), panels(v)
+    return out
 
 
 def flash_causal_attention(x, wqkv, wo, n_heads):
@@ -428,7 +434,9 @@ class TransformerLM:
 
     def generate_batch(self, prompts, max_new_tokens):
         """Batched greedy KV-cache decode, entire generation in ONE jitted
-        program (`lax.scan` over prefill columns, then over new tokens).
+        program: a PARALLEL prefill (one causal forward over the whole
+        prompt fills every layer's cache — MXU-shaped, not P sequential
+        steps) followed by a `lax.scan` over the new tokens.
 
         Contrast `generate(use_cache=True)`: that path round-trips
         host<->device per token to pick the next token in numpy — on a
@@ -470,22 +478,31 @@ class TransformerLM:
                 # numpy pick()
                 return logits_fn(aux, x).astype(jnp.float32), new_cache
 
+            def prefill_block(p, h):
+                """make_block_fn's forward, via the SHARED attention core
+                (return_kv=True), whole prompt in parallel."""
+                hn = _layer_norm(h, p["ln1"]["g"], p["ln1"]["b"])
+                att, kp, vp = causal_attention(
+                    hn, p["attn"]["wqkv"], p["attn"]["wo"], n_heads,
+                    return_kv=True)
+                h = h + att
+                hn = _layer_norm(h, p["ln2"]["g"], p["ln2"]["b"])
+                m = jax.nn.gelu(hn @ p["mlp"]["w1"] + p["mlp"]["b1"])
+                h = h + m @ p["mlp"]["w2"] + p["mlp"]["b2"]
+                return h, kp, vp
+
             def gen(aux, blocks, prompts):
-                cache = init_kv_cache(len(blocks), B, max_len,
-                                      aux["tok"].shape[1], n_heads,
-                                      aux["tok"].dtype)
-
-                def pre_body(carry, tok_col):
-                    cache, pos, _ = carry
-                    logit, cache = step_token(aux, blocks, cache, pos,
-                                              tok_col)
-                    return (cache, pos + 1, logit), None
-
-                zero_logit = jnp.zeros(
-                    (B, aux["head"].shape[1]), jnp.float32)
-                (cache, pos, logit), _ = jax.lax.scan(
-                    pre_body, (cache, jnp.asarray(0, jnp.int32),
-                               zero_logit), prompts.T)
+                # parallel prefill: one causal pass fills the caches
+                h = embed_fn(aux, prompts)                 # [B, P, D]
+                cache = []
+                for p in blocks:
+                    h, kp, vp = prefill_block(p, h)
+                    z = jnp.zeros((B, max_len, n_heads,
+                                   kp.shape[-1]), kp.dtype)
+                    cache.append({"k": z.at[:, :P].set(kp),
+                                  "v": z.at[:, :P].set(vp)})
+                logit = logits_fn(aux, h[:, -1]).astype(jnp.float32)
+                pos = jnp.asarray(P, jnp.int32)
 
                 def dec_body(carry, _):
                     cache, pos, logit = carry
