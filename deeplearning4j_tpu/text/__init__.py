@@ -13,6 +13,9 @@ from .document_iterator import (AsyncLabelAwareIterator,
                                 LabelAwareDocumentIterator, LabelledDocument,
                                 SimpleLabelAwareIterator)
 from .inverted_index import InMemoryInvertedIndex
+from .ja_lattice import (JapaneseLatticeTokenizer,
+                         JapaneseLatticeTokenizerFactory)
+from .ko_morph import KoreanMorphTokenizer, KoreanMorphTokenizerFactory
 from .sentence_iterator import (BasicLineIterator, CollectionSentenceIterator,
                                 FileSentenceIterator, LabelAwareIterator,
                                 LabelAwareListSentenceIterator, LabelsSource,
@@ -32,7 +35,9 @@ __all__ = [
     "DefaultTokenizerFactory", "DocumentIterator", "EndingPreProcessor",
     "FileDocumentIterator", "FileLabelAwareIterator",
     "FileSentenceIterator", "FilenamesLabelAwareIterator",
-    "InMemoryInvertedIndex", "JapaneseTokenizerFactory",
+    "InMemoryInvertedIndex", "JapaneseLatticeTokenizer",
+    "JapaneseLatticeTokenizerFactory", "JapaneseTokenizerFactory",
+    "KoreanMorphTokenizer", "KoreanMorphTokenizerFactory",
     "KoreanTokenizerFactory", "LabelAwareDocumentIterator",
     "LabelAwareIterator", "LabelAwareListSentenceIterator",
     "LabelledDocument", "LabelsSource", "LowCasePreProcessor",
