@@ -295,16 +295,20 @@ def _divisor_block(T, requested):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dq_ref,
-                   dq_acc_ref, *, scale, causal, block_q, block_k):
+def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, delta_ref, do_ref,
+                   lse_ref, dq_ref, dq_acc_ref, *, scale, causal, block_q,
+                   block_k):
     ik = pl.program_id(2)
 
     @pl.when(ik == 0)
     def _init():
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
 
-    q_start = pl.program_id(1) * block_q
-    k_start = ik * block_k
+    # global offsets from SMEM: 0 on the single-device path; the ring
+    # backward prefetches each hop's chunk positions (causality across
+    # devices)
+    q_start = pl.program_id(1) * block_q + qo_ref[0]
+    k_start = ik * block_k + ko_ref[0]
 
     def compute():
         s = jax.lax.dot_general(
@@ -337,9 +341,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dq_ref,
         dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dk_ref,
-                    dv_ref, dk_acc_ref, dv_acc_ref, *, scale, causal,
-                    block_q, block_k):
+def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, delta_ref, do_ref,
+                    lse_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
+                    scale, causal, block_q, block_k):
     iq = pl.program_id(2)
 
     @pl.when(iq == 0)
@@ -347,8 +351,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dk_ref,
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
-    k_start = pl.program_id(1) * block_k
-    q_start = iq * block_q
+    k_start = pl.program_id(1) * block_k + ko_ref[0]
+    q_start = iq * block_q + qo_ref[0]
 
     def compute():
         s = jax.lax.dot_general(
@@ -407,47 +411,106 @@ def _flash_bwd_bthd(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         raise NotImplementedError("pallas TPU backend unavailable")
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     -1, keepdims=True)                    # [BH, T, 1]
+    zero = jnp.zeros((1,), jnp.int32)
+    dq = _flash_bwd_dq_pass(q, k, v, delta, do, lse, zero, zero, causal,
+                            scale, bq, bk, interpret)
+    dk, dv = _flash_bwd_dkv_pass(q, k, v, delta, do, lse, zero, zero,
+                                 causal, scale, bq, bk, interpret)
+    return dq, dk, dv
+
+
+def _flash_bwd_dq_pass(q, k, v, delta, do, lse, q_off, k_off, causal,
+                       scale, bq, bk, interpret, out_dtype=None):
+    """dQ grid pass (kv innermost). q [BH, Tq, d]; k/v [BH, Tk, d];
+    q_off/k_off: int32 [1] global chunk offsets (SMEM) — zero on the
+    single-device path, hop positions in the ring backward. out_dtype:
+    gradient dtype (default q.dtype; the ring backward requests f32 so
+    per-hop partials are rounded ONCE at the end, not once per hop)."""
+    BH, Tq, d = q.shape
+    Tk = k.shape[1]
     kw = {"memory_space": _VMEM} if _VMEM is not None else {}
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     extra = {}
     if not interpret:
         extra["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
-
-    # --- pass 1: dQ (grid kv-innermost) ---
     qb_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **kw)
     kvb_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), **kw)
     lse_q_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), **kw)
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk),
-        grid=(BH, T // bq, T // bk),
-        in_specs=[qb_spec, kvb_spec, kvb_spec, lse_q_spec, qb_spec,
-                  lse_q_spec],
+        grid=(BH, Tq // bq, Tk // bk),
+        in_specs=[smem, smem, qb_spec, kvb_spec, kvb_spec, lse_q_spec,
+                  qb_spec, lse_q_spec],
         out_specs=qb_spec,
-        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, d), out_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
         **extra,
-    )(q, k, v, delta, do, lse)
+    )(q_off, k_off, q, k, v, delta, do, lse)
 
-    # --- pass 2: dK + dV (grid q-innermost) ---
+
+def _flash_bwd_dkv_pass(q, k, v, delta, do, lse, q_off, k_off, causal,
+                        scale, bq, bk, interpret, out_dtype=None):
+    """dK/dV grid pass (q innermost); same offset/out_dtype contract as
+    the dQ pass."""
+    BH, Tq, d = q.shape
+    Tk = k.shape[1]
+    kw = {"memory_space": _VMEM} if _VMEM is not None else {}
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    extra = {}
+    if not interpret:
+        extra["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
     q_in_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0), **kw)
     kv_out_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0), **kw)
     lse_in_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0), **kw)
-    dk, dv = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk),
-        grid=(BH, T // bk, T // bq),
-        in_specs=[q_in_spec, kv_out_spec, kv_out_spec, lse_in_spec,
-                  q_in_spec, lse_in_spec],
+        grid=(BH, Tk // bk, Tq // bq),
+        in_specs=[smem, smem, q_in_spec, kv_out_spec, kv_out_spec,
+                  lse_in_spec, q_in_spec, lse_in_spec],
         out_specs=[kv_out_spec, kv_out_spec],
-        out_shape=[jax.ShapeDtypeStruct((BH, T, d), k.dtype),
-                   jax.ShapeDtypeStruct((BH, T, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tk, d), out_dtype or k.dtype),
+                   jax.ShapeDtypeStruct((BH, Tk, d), out_dtype or v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
         **extra,
-    )(q, k, v, delta, do, lse)
+    )(q_off, k_off, q, k, v, delta, do, lse)
+
+
+def flash_attention_bwd_partial(q, k, v, delta, do, lse, q_off, k_off,
+                                causal=True, scale=None, block_q=512,
+                                block_k=512, interpret=None):
+    """One ring hop's backward contributions: (dq_partial, dk_partial,
+    dv_partial) for the (q chunk at q_off) x (kv chunk at k_off) pair —
+    both fused grid passes with global-offset causal masking. The ring
+    backward accumulates dq locally and rotates dk/dv partials home.
+    Shapes: q/do [BH, Tq, d]; k/v [BH, Tk, d]; delta/lse [BH, Tq, 1]
+    f32 (delta = rowsum(dO ∘ O))."""
+    BH, Tq, d = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if pltpu is None:
+        raise NotImplementedError("pallas TPU backend unavailable")
+    bq = _divisor_block(Tq, block_q)
+    bk = _divisor_block(Tk, block_k)
+    qo = jnp.asarray(q_off, jnp.int32).reshape(1)
+    ko = jnp.asarray(k_off, jnp.int32).reshape(1)
+    # f32 partials: the ring accumulates across hops — round once at the
+    # end, not per hop (matters for bf16 inputs)
+    dq = _flash_bwd_dq_pass(q, k, v, delta, do, lse, qo, ko, causal,
+                            scale, bq, bk, interpret,
+                            out_dtype=jnp.float32)
+    dk, dv = _flash_bwd_dkv_pass(q, k, v, delta, do, lse, qo, ko, causal,
+                                 scale, bq, bk, interpret,
+                                 out_dtype=jnp.float32)
     return dq, dk, dv
 
 

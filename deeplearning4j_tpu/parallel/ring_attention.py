@@ -26,6 +26,17 @@ from jax import lax
 NEG_INF = -1e30
 
 
+def _mark_varying(tree, axis_name):
+    """Mark replicated constants as axis-varying under shard_map (loop
+    carries become varying). pcast replaced pvary (deprecated) — support
+    both jax generations; no-op on versions with neither."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(tree, axis_name, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(tree, (axis_name,))
+    return tree  # pragma: no cover
+
+
 def _attend_block(q, k, v, bias):
     """Scores for one (Q-chunk, K-block) pair.
     q [B,Tq,H,D]; k,v [B,Tk,H,D]; bias [Tq,Tk] additive (0 or NEG_INF).
@@ -46,7 +57,7 @@ def _flash_fold(o, m, l, s, v):
 
 
 def ring_attention_kernel(q, k, v, kv_mask, axis_name, causal=False,
-                          scale=None, use_flash=False):
+                          scale=None, use_flash=False, return_lse=False):
     """Per-device ring attention body (run under shard_map).
 
     q,k,v: [B, T_local, H, D] — this device's sequence chunk.
@@ -72,13 +83,7 @@ def ring_attention_kernel(q, k, v, kv_mask, axis_name, causal=False,
     o0 = jnp.zeros((B, H, Tq, D), acc_dt)
     m0 = jnp.full((B, H, Tq), NEG_INF, acc_dt)
     l0 = jnp.zeros((B, H, Tq), acc_dt)
-    # constants start replicated under shard_map; the loop carry becomes
-    # axis-varying, so mark the initial accumulators varying too.
-    # pcast replaced pvary (deprecated) — support both jax generations.
-    if hasattr(lax, "pcast"):
-        o0, m0, l0 = lax.pcast((o0, m0, l0), axis_name, to="varying")
-    elif hasattr(lax, "pvary"):
-        o0, m0, l0 = lax.pvary((o0, m0, l0), (axis_name,))
+    o0, m0, l0 = _mark_varying((o0, m0, l0), axis_name)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     qpos = my * Tq + jnp.arange(Tq)                    # global q positions
@@ -121,7 +126,70 @@ def ring_attention_kernel(q, k, v, kv_mask, axis_name, causal=False,
 
     o, m, l, _, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v, kv_mask))
     out = o / jnp.maximum(l, 1e-30)[..., None]         # [B,H,Tq,D]
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Tq,H,D]
+    out_t = jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Tq,H,D]
+    if return_lse:
+        # GLOBAL per-row logsumexp (all hops folded) — the only extra
+        # residual the fused ring backward needs
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))       # [B,H,Tq]
+        return out_t, jnp.transpose(lse, (0, 2, 1)).astype(jnp.float32)
+    return out_t
+
+
+def ring_attention_bwd_kernel(q, k, v, o, lse, do, axis_name, causal=False,
+                              scale=None):
+    """Per-device FUSED ring backward (run under shard_map): the reverse
+    of the forward rotation, every hop's contribution computed by the
+    Pallas backward grid passes (`flash_attention_bwd_partial`).
+
+    Per hop, the device holds its own (q, o, lse, do, delta) and the
+    visiting (k, v) block: the dQ contribution accumulates locally; the
+    dK/dV partials accumulate into buffers that ROTATE WITH the block, so
+    after n hops each block's gradient arrives back at its home device
+    with every device's contribution folded in — same communication
+    volume as the forward (one extra 2x payload for the traveling
+    gradients). The global lse makes each hop's probabilities exact
+    (p = exp(s − lse_global)), so no cross-hop softmax refold is needed
+    in the backward at all.
+
+    q,k,v,o,do: [B, Tq, H, D] local chunks; lse: [B, Tq, H] f32 (from
+    the forward's return_lse). Returns (dq, dk, dv) local chunks."""
+    from ..ops.flash_attention import flash_attention_bwd_partial
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    flat = lambda a: a.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    qf, kf, vf, of, dof = flat(q), flat(k), flat(v), flat(o), flat(do)
+    lse_f = lse.transpose(0, 2, 1).reshape(B * H, Tq, 1)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    -1, keepdims=True)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    z = jnp.zeros((B * H, Tq, D), jnp.float32)
+    dq0, zk, zv = _mark_varying((z, z, z), axis_name)
+
+    def body(i, carry):
+        dq, dk_rot, dv_rot, k_blk, v_blk = carry
+        src = (my - i) % n
+        dq_p, dk_p, dv_p = flash_attention_bwd_partial(
+            qf, k_blk, v_blk, delta, dof, lse_f, my * Tq, src * Tq,
+            causal=causal, scale=scale)
+        dq = dq + dq_p.astype(jnp.float32)
+        dk_rot = dk_rot + dk_p.astype(jnp.float32)
+        dv_rot = dv_rot + dv_p.astype(jnp.float32)
+        # gradients travel WITH their block: one more hop each iteration
+        # brings them home after the loop
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk_rot = lax.ppermute(dk_rot, axis_name, perm)
+        dv_rot = lax.ppermute(dv_rot, axis_name, perm)
+        return dq, dk_rot, dv_rot, k_blk, v_blk
+
+    dq, dk, dv, _, _ = lax.fori_loop(0, n, body, (dq0, zk, zv, kf, vf))
+    unflat = lambda a, dt: a.reshape(B, H, Tq, D).transpose(
+        0, 2, 1, 3).astype(dt)
+    return unflat(dq, q.dtype), unflat(dk, k.dtype), unflat(dv, v.dtype)
 
 
 def blockwise_attention(q, k, v, kv_mask=None, causal=False, scale=None):
@@ -162,36 +230,50 @@ def ring_self_attention(q, k, v, mesh, axis="seq", causal=False,
     spec = P(None, axis, None, None)
     mspec = P(None, axis)
 
-    def build(flash):
+    def build(flash, return_lse=False):
         extra = {}
         if flash:
             # pallas_call outputs carry no vma annotation; disable the
             # check for the kernel path (the einsum path keeps it)
             extra["check_vma"] = False
+        lse_spec = P(None, axis, None)
         return shard_map(
             functools.partial(ring_attention_kernel, axis_name=axis,
-                              causal=causal, use_flash=flash),
-            mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
+                              causal=causal, use_flash=flash,
+                              return_lse=return_lse),
+            mesh=mesh, in_specs=(spec, spec, spec, mspec),
+            out_specs=(spec, lse_spec) if return_lse else spec,
             **extra)
 
     if not use_flash:
         return build(False)(q, k, v, kv_mask)
 
-    # The Pallas partial kernel has no VJP; differentiate the flash path
-    # by recomputing the backward through the (identical-math) einsum
-    # ring — forward stays on the kernel, training still works.
+    # Fused ring backward: the forward additionally saves the global
+    # per-row logsumexp; the backward is its own reverse ring with the
+    # Pallas dQ/dK+dV grid passes per hop and dK/dV partials rotating
+    # home with their blocks (`ring_attention_bwd_kernel`) — long-context
+    # TRAINING keeps the flash memory/compute profile across devices
+    # (the r3 design recomputed the backward through the einsum ring,
+    # materializing per-hop [T/n, T/n] score panels).
     @jax.custom_vjp
     def rsa(q, k, v):
+        # primal (inference / no grad): skip the lse output entirely
         return build(True)(q, k, v, kv_mask)
 
     def rsa_fwd(q, k, v):
-        return rsa(q, k, v), (q, k, v)
+        out, lse = build(True, return_lse=True)(q, k, v, kv_mask)
+        return out, (q, k, v, out, lse)
 
     def rsa_bwd(res, g):
-        q, k, v = res
-        _, vjp = jax.vjp(lambda q, k, v: build(False)(q, k, v, kv_mask),
-                         q, k, v)
-        return vjp(g)
+        q, k, v, out, lse = res
+        lse_spec = P(None, axis, None)
+        bwd = shard_map(
+            functools.partial(ring_attention_bwd_kernel, axis_name=axis,
+                              causal=causal),
+            mesh=mesh,
+            in_specs=(spec, spec, spec, spec, lse_spec, spec),
+            out_specs=(spec, spec, spec), check_vma=False)
+        return bwd(q, k, v, out, lse, g)
 
     rsa.defvjp(rsa_fwd, rsa_bwd)
     return rsa(q, k, v)
