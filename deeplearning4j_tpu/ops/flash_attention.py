@@ -419,6 +419,19 @@ def _flash_bwd_bthd(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     return dq, dk, dv
 
 
+def _pallas_env(interpret):
+    """Shared pallas_call scaffolding: (VMEM block-spec kwargs, SMEM spec,
+    compiler-params extras). One definition so every grid pass compiles
+    with identical memory-space and dimension-semantics settings."""
+    kw = {"memory_space": _VMEM} if _VMEM is not None else {}
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    extra = {}
+    if not interpret:
+        extra["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return kw, smem, extra
+
+
 def _flash_bwd_dq_pass(q, k, v, delta, do, lse, q_off, k_off, causal,
                        scale, bq, bk, interpret, out_dtype=None):
     """dQ grid pass (kv innermost). q [BH, Tq, d]; k/v [BH, Tk, d];
@@ -428,12 +441,7 @@ def _flash_bwd_dq_pass(q, k, v, delta, do, lse, q_off, k_off, causal,
     per-hop partials are rounded ONCE at the end, not once per hop)."""
     BH, Tq, d = q.shape
     Tk = k.shape[1]
-    kw = {"memory_space": _VMEM} if _VMEM is not None else {}
-    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
-    extra = {}
-    if not interpret:
-        extra["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    kw, smem, extra = _pallas_env(interpret)
     qb_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **kw)
     kvb_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), **kw)
     lse_q_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), **kw)
@@ -457,12 +465,7 @@ def _flash_bwd_dkv_pass(q, k, v, delta, do, lse, q_off, k_off, causal,
     the dQ pass."""
     BH, Tq, d = q.shape
     Tk = k.shape[1]
-    kw = {"memory_space": _VMEM} if _VMEM is not None else {}
-    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
-    extra = {}
-    if not interpret:
-        extra["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    kw, smem, extra = _pallas_env(interpret)
     q_in_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0), **kw)
     kv_out_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0), **kw)
     lse_in_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0), **kw)

@@ -175,9 +175,13 @@ def ring_attention_bwd_kernel(q, k, v, o, lse, do, axis_name, causal=False,
         dq_p, dk_p, dv_p = flash_attention_bwd_partial(
             qf, k_blk, v_blk, delta, dof, lse_f, my * Tq, src * Tq,
             causal=causal, scale=scale)
-        dq = dq + dq_p.astype(jnp.float32)
-        dk_rot = dk_rot + dk_p.astype(jnp.float32)
-        dv_rot = dv_rot + dv_p.astype(jnp.float32)
+        # partials arrive f32 by flash_attention_bwd_partial's out_dtype
+        # contract — bf16 inputs are rounded ONCE after the ring, never
+        # per hop (the accumulators below stay f32 end to end)
+        assert dq_p.dtype == dk_p.dtype == dv_p.dtype == jnp.float32
+        dq = dq + dq_p
+        dk_rot = dk_rot + dk_p
+        dv_rot = dv_rot + dv_p
         # gradients travel WITH their block: one more hop each iteration
         # brings them home after the loop
         k_blk = lax.ppermute(k_blk, axis_name, perm)

@@ -26,3 +26,14 @@ flash = ring_self_attention(q, q, q, mesh, axis="seq", causal=True,
 full = blockwise_attention(q, q, q, causal=True)
 print("ring == full:", bool(jnp.allclose(ring, full, atol=1e-4)),
       " ring+flash == full:", bool(jnp.allclose(flash, full, atol=1e-4)))
+
+# TRAINING on the flash path: gradients come from the fused ring backward
+# (a reverse ring over the Pallas dQ/dK+dV passes — no score panel is
+# ever materialized, forward or backward)
+g_flash = jax.grad(lambda q: jnp.mean(ring_self_attention(
+    q, q, q, mesh, axis="seq", causal=True, use_flash=True) ** 2))(q)
+g_full = jax.grad(lambda q: jnp.mean(blockwise_attention(
+    q, q, q, causal=True) ** 2))(q)
+grads_match = bool(jnp.allclose(g_flash, g_full, atol=1e-4))
+print("fused ring backward grads == single-device grads:", grads_match)
+print(grads_match)

@@ -94,6 +94,7 @@ class TestGraphBuilding:
 
 
 class TestGraphGradients:
+    @pytest.mark.slow
     def test_gradcheck_merge_elementwise(self):
         x, y = _xy()
         for vertex in (MergeVertex(), ElementWiseVertex(op="add"),

@@ -81,6 +81,7 @@ class TestFlashBackward:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5)
 
+    @pytest.mark.slow
     def test_backward_block_size_oblivious(self):
         """Backward accumulation is associative over (bq, bk) tilings —
         any divisor blocks give the same gradients."""
@@ -98,6 +99,7 @@ class TestFlashBackward:
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                            atol=2e-5)
 
+    @pytest.mark.slow
     def test_backward_non_divisible_seq(self):
         q, k, v = _qkv(9, t=96)
 

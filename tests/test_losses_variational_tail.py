@@ -102,6 +102,7 @@ def _flat_elbo(vae, x, seed=0):
 
 
 class TestReconstructionDistributionTail:
+    @pytest.mark.slow
     def test_exponential_elbo_gradcheck(self):
         r = np.random.default_rng(2)
         x = jnp.asarray(r.exponential(1.0, (12, 8)))
