@@ -1,10 +1,11 @@
-"""Classic CNN zoo configs: AlexNet, VGG-16.
+"""Classic CNN zoo configs: AlexNet, VGG-16, GoogLeNet.
 
 TPU-native equivalents of the model-zoo members of the reference era
-(dl4j model zoo AlexNet.java / VGG16.java configurations, built on the
-same layer stack the reference's examples wire by hand): sequential
-MultiLayerConfigurations in NHWC/bf16, ready for `fit()` on one chip or a
-mesh via ParallelWrapper.
+(dl4j model zoo AlexNet.java / VGG16.java / GoogLeNet.java shapes, built
+on the same layer stack the reference's examples wire by hand): AlexNet
+and VGG-16 as sequential MultiLayerConfigurations, GoogLeNet as a
+multi-branch ComputationGraph (nine Inception modules) — all NHWC/bf16,
+ready for `fit()` on one chip or a mesh via ParallelWrapper.
 """
 from __future__ import annotations
 
@@ -97,3 +98,84 @@ def alexnet(**kwargs):
 def vgg16(**kwargs):
     from ...nn.multilayer import MultiLayerNetwork
     return MultiLayerNetwork(vgg16_conf(**kwargs)).init()
+
+
+def googlenet_conf(height=224, width=224, channels=3, num_classes=1000,
+                   seed=123, learning_rate=0.01, data_type="bfloat16"):
+    """GoogLeNet / Inception-v1 (2014): nine Inception modules — each a
+    four-branch DAG (1x1 / 1x1->3x3 / 1x1->5x5 / maxpool->1x1) joined by
+    a MergeVertex on the channel axis — the era's classic multi-branch
+    ComputationGraph (reference model-zoo GoogLeNet.java shape; auxiliary
+    classifier heads omitted — they exist to aid 2014-era optimizers).
+    NHWC/bf16; every branch is an MXU-shaped conv."""
+    from ...nn.conf.graph_vertices import MergeVertex
+    from ...nn.conf.layers import GlobalPoolingLayer
+
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed).updater("nesterovs").momentum(0.9)
+         .learning_rate(learning_rate).weight_init("relu")
+         .data_type(data_type))
+    gb = b.graph_builder().add_inputs("input")
+
+    def conv(name, inp, n_out, k, stride=1):
+        gb.add_layer(name, ConvolutionLayer(
+            n_out=n_out, kernel_size=(k, k), stride=(stride, stride),
+            convolution_mode="same", activation="relu"), inp)
+        return name
+
+    def inception(name, inp, c1, c3r, c3, c5r, c5, cp):
+        """One four-branch module; returns the merge vertex name."""
+        b1 = conv(f"{name}_1x1", inp, c1, 1)
+        b3 = conv(f"{name}_3x3", conv(f"{name}_3x3r", inp, c3r, 1), c3, 3)
+        b5 = conv(f"{name}_5x5", conv(f"{name}_5x5r", inp, c5r, 1), c5, 5)
+        gb.add_layer(f"{name}_pool", SubsamplingLayer(
+            pooling_type="max", kernel_size=(3, 3), stride=(1, 1),
+            convolution_mode="same"), inp)
+        bp = conv(f"{name}_poolproj", f"{name}_pool", cp, 1)
+        gb.add_vertex(f"{name}_out", MergeVertex(), b1, b3, b5, bp)
+        return f"{name}_out"
+
+    x = conv("stem1", "input", 64, 7, stride=2)
+    gb.add_layer("stem1_pool", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+        convolution_mode="same"), x)
+    gb.add_layer("stem1_lrn", LocalResponseNormalization(), "stem1_pool")
+    x = conv("stem3", conv("stem2", "stem1_lrn", 64, 1), 192, 3)
+    gb.add_layer("stem3_lrn", LocalResponseNormalization(), x)
+    gb.add_layer("stem3_pool", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+        convolution_mode="same"), "stem3_lrn")
+    x = "stem3_pool"
+
+    # (c1, c3r, c3, c5r, c5, pool-proj) per module — the v1 paper table
+    plan = [("3a", 64, 96, 128, 16, 32, 32), ("3b", 128, 128, 192, 32, 96, 64),
+            ("pool", ),
+            ("4a", 192, 96, 208, 16, 48, 64), ("4b", 160, 112, 224, 24, 64, 64),
+            ("4c", 128, 128, 256, 24, 64, 64), ("4d", 112, 144, 288, 32, 64, 64),
+            ("4e", 256, 160, 320, 32, 128, 128),
+            ("pool2", ),
+            ("5a", 256, 160, 320, 32, 128, 128),
+            ("5b", 384, 192, 384, 48, 128, 128)]
+    for spec in plan:
+        if len(spec) == 1:
+            gb.add_layer(f"incep_{spec[0]}", SubsamplingLayer(
+                pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+                convolution_mode="same"), x)
+            x = f"incep_{spec[0]}"
+        else:
+            x = inception(f"incep_{spec[0]}", x, *spec[1:])
+
+    gb.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+    # DL4J dropout semantics: the value is the RETAIN probability
+    # (Dropout.java DropOutInverted) — the paper's "40% dropout" = 0.6
+    gb.add_layer("fc", OutputLayer(n_out=num_classes, activation="softmax",
+                                   loss_function="mcxent",
+                                   dropout=0.6), "avgpool")
+    return (gb.set_outputs("fc")
+            .set_input_types(InputType.convolutional(height, width,
+                                                     channels)).build())
+
+
+def googlenet(**kwargs):
+    from ...nn.graph import ComputationGraph
+    return ComputationGraph(googlenet_conf(**kwargs)).init()

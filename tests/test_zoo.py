@@ -84,6 +84,30 @@ class TestClassicCNNs:
         assert np.allclose(out.sum(1), 1.0, atol=1e-3)
 
     @pytest.mark.slow
+    def test_googlenet_inception_modules_train(self):
+        """Inception-v1: nine 4-branch modules merged on the channel axis
+        (the era's classic multi-branch ComputationGraph)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.models.zoo import googlenet, googlenet_conf
+        conf = googlenet_conf(height=64, width=64, num_classes=4,
+                              data_type="float32")
+        # nine inception merge vertices in the DAG
+        merges = [n for n in conf.vertices if n.endswith("_out")
+                  and not conf.vertices[n].is_layer]
+        assert len(merges) == 9
+        net = googlenet(height=64, width=64, num_classes=4,
+                        data_type="float32", learning_rate=0.005)
+        rng = np.random.default_rng(0)
+        x = rng.random((4, 64, 64, 3)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 4)]
+        for _ in range(2):
+            net.fit(DataSet(x, y))
+        assert np.isfinite(float(net._score))
+        out = np.asarray(net.output(x)[0])
+        assert out.shape == (4, 4)
+        assert np.allclose(out.sum(1), 1.0, atol=1e-3)
+
+    @pytest.mark.slow
     def test_vgg16_structure_and_forward(self):
         from deeplearning4j_tpu.models.zoo import vgg16_conf
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
