@@ -1,0 +1,82 @@
+"""On-chip perf sweep for the round-4 levers (run when the TPU is up).
+
+Interleaved A/B measurements that bench.py's fixed budget doesn't cover:
+
+  1. TRAINING tok/s: flash (fused Pallas backward) vs dense attention in
+     the zoo TransformerLM at T = 2048 / 4096 / 8192 — the r3 record
+     showed flash at 0.86x/0.71x of dense with the einsum-recompute VJP
+     and dense failing outright at 8192; this measures what the fused
+     backward changed.
+  2. Ring+flash training step at T=8192 over a 1-axis mesh (single chip:
+     ring of 1 — kernel path sanity under grad).
+
+Prints one JSON line per measurement (records are self-contained; safe
+under any timeout). Usage: python perf_sweep.py [--budget SECONDS]
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main(budget_s=900.0):
+    t0 = time.perf_counter()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+
+    platform = jax.devices()[0].platform
+    print(json.dumps({"sweep": "start", "platform": platform}), flush=True)
+
+    B, D_MODEL, HEADS, LAYERS = 4, 512, 8, 4
+    rng = np.random.default_rng(0)
+
+    def train_tok_s(attention, T, steps=10):
+        lm = TransformerLM(512, d_model=D_MODEL, n_heads=HEADS,
+                           n_layers=LAYERS, max_len=T,
+                           dtype=jnp.bfloat16, attention=attention)
+        x = rng.integers(0, 512, (B, T)).astype(np.int32)
+        y = (x + 1) % 512
+        lm.fit_batch(x, y)            # compile
+        lm.fit_batch(x, y)            # warm
+        best = 0.0
+        for _ in range(2):            # best-of-2 segments
+            t = time.perf_counter()
+            for _ in range(steps):
+                lm.fit_batch(x, y)
+            dt = time.perf_counter() - t
+            best = max(best, B * T * steps / dt)
+        return best
+
+    for T in (2048, 4096, 8192):
+        if time.perf_counter() - t0 > budget_s - 120:
+            print(json.dumps({"skipped": f"T={T}", "reason": "budget"}),
+                  flush=True)
+            continue
+        rec = {"metric": f"transformer train tokens/sec T={T}",
+               "config": f"B={B} d={D_MODEL} H={HEADS} L={LAYERS} bf16"}
+        try:
+            rec["flash"] = round(train_tok_s("flash", T), 0)
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            rec["flash_error"] = str(e)[:200]
+        try:
+            rec["dense"] = round(train_tok_s("dense", T), 0)
+        except Exception as e:  # noqa: BLE001 — dense dies at long T
+            rec["dense_error"] = str(e)[:200]
+        if "flash" in rec and "dense" in rec:
+            rec["flash_vs_dense"] = round(rec["flash"] / rec["dense"], 3)
+        print(json.dumps(rec), flush=True)
+
+    print(json.dumps({"sweep": "done",
+                      "wall_s": round(time.perf_counter() - t0, 1)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    budget = 900.0
+    if "--budget" in sys.argv:
+        budget = float(sys.argv[sys.argv.index("--budget") + 1])
+    main(budget)
