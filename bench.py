@@ -491,7 +491,10 @@ def main():
     emit()
 
     # --- invariant 2: backend acquisition cannot raise or hang here ---
-    probe_budget = float(os.environ.get("BENCH_PROBE_BUDGET_S", "300"))
+    # 200 s = two generous 90 s init attempts + backoff: a healthy chip
+    # answers the first (~20-40 s); a wedged tunnel (the r4 failure mode,
+    # hangs forever) shouldn't eat budget the CPU-fallback configs need
+    probe_budget = float(os.environ.get("BENCH_PROBE_BUDGET_S", "200"))
     platform, tpu_err = _probe_backend(
         deadline=min(deadline - 120, t_start + probe_budget))
     env_overlay, small = {}, False
