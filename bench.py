@@ -63,9 +63,33 @@ BASELINE_W2V_PAIRS_PER_SEC = 500000.0        # native hogwild AggregateSkipGram 
 BASELINE_DECODE_TOKENS_PER_SEC = 1000.0      # rnnTimeStep-era streaming stand-in
 
 # ResNet-50 batch-128 training step: 2.86 TFLOP by XLA cost analysis
-# (PERF.md); v5e bf16 peak ~197 TFLOP/s. Used for the primary's "mfu" field.
+# (PERF.md). Used for the primary's "mfu" field, divided by the peak of
+# whatever device is actually attached (r4 advisor finding: dividing by a
+# hard-coded v5e peak makes the mfu field meaningless on v4/v6e/CPU).
 RESNET50_FLOPS_PER_IMAGE = 2.86e12 / 128
-TPU_V5E_PEAK_FLOPS = 197e12
+
+# substring of jax device_kind (lowercased) -> peak bf16 FLOP/s; first match
+# wins, so more specific generations come first
+TPU_PEAK_BF16_FLOPS = (
+    ("v6e", 918e12),
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+)
+
+
+def _peak_flops():
+    """Peak bf16 FLOP/s of the attached device, or None when unknown (CPU
+    fallback, unrecognised TPU generation) — callers omit mfu then."""
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in TPU_PEAK_BF16_FLOPS:
+        if key in kind:
+            return peak
+    return None
 
 
 def _bench_net(net, x, y, warmup=2, iters=10, reps=2):
@@ -125,13 +149,23 @@ def _bench_resnet50_arm(rng, small, remat):
     return ips, batch
 
 
+def _maybe_add_mfu(rec, ips):
+    """Attach "mfu" when the attached device's peak is known — the ONE
+    place the peak table is consulted, so the primary and the remat A/B
+    can never drift apart on the formula."""
+    peak = _peak_flops()
+    if peak:
+        rec["mfu"] = round(ips * RESNET50_FLOPS_PER_IMAGE / peak, 4)
+    return rec
+
+
 def bench_resnet50(rng, small=False):
     ips, batch = _bench_resnet50_arm(rng, small, remat=False)
-    return {"value": round(ips, 1), "unit": "images/sec",
-            "config": f"batch {batch}, 224x224, bf16",
-            "mfu": round(ips * RESNET50_FLOPS_PER_IMAGE
-                         / TPU_V5E_PEAK_FLOPS, 4),
-            "vs_baseline": round(ips / BASELINE_RESNET50_IMAGES_PER_SEC, 3)}
+    return _maybe_add_mfu(
+        {"value": round(ips, 1), "unit": "images/sec",
+         "config": f"batch {batch}, 224x224, bf16",
+         "vs_baseline": round(ips / BASELINE_RESNET50_IMAGES_PER_SEC, 3)},
+        ips)
 
 
 def bench_resnet50_remat(rng, small=False):
@@ -142,12 +176,12 @@ def bench_resnet50_remat(rng, small=False):
     roofline says the step is bandwidth-bound. Compare `value` against
     the primary record's."""
     ips, batch = _bench_resnet50_arm(rng, small, remat=True)
-    return {"value": round(ips, 1), "unit": "images/sec",
-            "config": f"remat-segments, batch {batch}, 224x224, bf16 "
-                      f"(A/B vs primary)",
-            "mfu": round(ips * RESNET50_FLOPS_PER_IMAGE
-                         / TPU_V5E_PEAK_FLOPS, 4),
-            "vs_baseline": round(ips / BASELINE_RESNET50_IMAGES_PER_SEC, 3)}
+    return _maybe_add_mfu(
+        {"value": round(ips, 1), "unit": "images/sec",
+         "config": f"remat-segments, batch {batch}, 224x224, bf16 "
+                   f"(A/B vs primary)",
+         "vs_baseline": round(ips / BASELINE_RESNET50_IMAGES_PER_SEC, 3)},
+        ips)
 
 
 def bench_resnet50_pipeline(rng, small=False):
@@ -548,6 +582,77 @@ def main():
             name, timeout=min(remaining, est_s * 2.5),
             env_overlay=env_overlay, small=small)
         emit()
+
+    # --- second TPU probe window (r5, VERDICT r4 item 1) ---
+    # A flaky tunnel sometimes comes back minutes later; after a CPU
+    # fallback the budget left over from the cheap small-shape configs is
+    # otherwise wasted. Re-probe once, and if the chip answers, replace
+    # the primary + as many secondaries as fit with REAL on-chip numbers
+    # (full shapes). A still-wedged tunnel costs only the re-probe, which
+    # nothing else needed. Disable with BENCH_SECOND_PROBE=0.
+    if (tpu_err is not None
+            and os.environ.get("BENCH_SECOND_PROBE", "1") != "0"
+            and deadline - time.perf_counter() > 180):
+        plat2, err2 = _probe_backend(
+            deadline=min(deadline - 120,
+                         time.perf_counter() + probe_budget))
+        record["second_probe"] = (
+            "accelerator up" if err2 is None else err2)
+        emit()
+        if err2 is None:
+            remaining = deadline - time.perf_counter()
+            if remaining > 60:
+                res = _run_config_subprocess(
+                    "resnet50", timeout=min(remaining, 300))
+                if "value" in res:
+                    # flip the headline ONLY now that an on-chip number
+                    # exists — a failed re-run must not relabel the CPU
+                    # batch-4 measurement as an on-chip batch-128 one
+                    record["value"] = res["value"]
+                    record["vs_baseline"] = res["vs_baseline"]
+                    if "mfu" in res:
+                        record["mfu"] = res["mfu"]
+                    else:
+                        record.pop("mfu", None)
+                    record["platform"] = plat2
+                    record["tpu_init_error"] = (
+                        f"first window: {tpu_err} "
+                        f"(recovered in second window)")
+                    record["metric"] = (f"ResNet-50 train images/sec "
+                                        f"(batch 128, 224x224, bf16, "
+                                        f"{plat2})")
+                    record["status"] = ("primary re-measured on-chip in "
+                                        "second probe window")
+                else:
+                    record["second_probe"] = (
+                        f"accelerator up but primary re-run failed: "
+                        f"{res.get('error', res)!s:.200}")
+            emit()
+            # on-chip re-runs in measurement-backlog priority order: the
+            # round-mandated A/B and the never-measured-on-chip configs
+            # before ones whose CPU number already beats baseline; derived
+            # from SECONDARY_CONFIGS so a renamed/added config can't drift
+            # out of the second window silently
+            backlog_first = ("resnet50_remat", "flash_attention_8k",
+                             "char_rnn_lstm", "decode_tokens_sec",
+                             "resnet50_fit_pipeline")
+            rerun_order = ([n for n in backlog_first
+                            if n in SECONDARY_CONFIGS]
+                           + [n for n in SECONDARY_CONFIGS
+                              if n not in backlog_first])
+            for name in rerun_order:
+                est_s = SECONDARY_CONFIGS[name][1]
+                remaining = deadline - time.perf_counter()
+                if remaining < est_s:
+                    continue   # keep the flagged CPU number already there
+                res = _run_config_subprocess(
+                    name, timeout=min(remaining, est_s * 2.5))
+                if "value" in res or "skipped" in res:
+                    # per-entry platform tag: the top-level "platform" may
+                    # still say cpu if the primary re-run failed
+                    res["platform"] = plat2
+                    record["secondary"][name] = res
+                emit()
 
 
 def run_single_config(name, small=False):

@@ -13,11 +13,17 @@ GeLU MLP + residual — all matmuls MXU-shaped ([B*T, D] x [D, *]).
 """
 from __future__ import annotations
 
+import collections
 import math
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# generate_batch compiles one program per (B, P, n_new); bound the cache
+# so unbounded shape variety in a serving workload cannot leak compiled
+# executables and their device buffers
+GEN_JIT_CACHE_SIZE = 8
 
 
 def _layer_norm(x, g, b, eps=1e-5):
@@ -462,9 +468,11 @@ class TransformerLM:
                 f"{max_len} (the KV cache has no sliding window)")
         cache = getattr(self, "_jit_gen_cache", None)
         if cache is None:
-            cache = self._jit_gen_cache = {}
+            cache = self._jit_gen_cache = collections.OrderedDict()
         key = (B, P, n_new)
-        if key not in cache:
+        if key in cache:
+            cache.move_to_end(key)          # LRU touch
+        else:
             block_decode = make_decode_block_fn(self.n_heads)
             n_heads = self.n_heads
 
@@ -517,8 +525,13 @@ class TransformerLM:
                 return jnp.concatenate(
                     [toks, last[None, :]], 0).T            # [B, n_new]
 
-            # keyed cache: alternating (B, P, n_new) shapes (e.g. a
-            # serving batcher flipping batch sizes) must not re-trace
+            # keyed LRU: alternating (B, P, n_new) shapes (e.g. a serving
+            # batcher flipping batch sizes) must not re-trace, but a
+            # workload with unbounded shape variety must not accumulate
+            # compiled programs + device buffers without bound either —
+            # bucket prompt lengths upstream to stay under the cap
             cache[key] = jax.jit(gen)
+            while len(cache) > GEN_JIT_CACHE_SIZE:
+                cache.popitem(last=False)
         new = cache[key](self.aux, self.blocks, prompts)
         return np.concatenate([np.asarray(prompts), np.asarray(new)], 1)

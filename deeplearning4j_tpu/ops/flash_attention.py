@@ -613,9 +613,12 @@ def _bwd(causal, scale, block_q, block_k, interpret, res, g):
     interp = (jax.default_backend() != "tpu" if interpret is None
               else interpret)
     to_bhtd = lambda a: a.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    # backward blocks: half the forward's (three f32 [bq,bk] panels live)
-    bwd_bq = max(block_q // 2, 256)
-    bwd_bk = max(block_k // 2, 256)
+    # backward blocks: half the forward's (three f32 [bq,bk] panels live),
+    # floored at 256 but never above the caller's forward block — a caller
+    # that shrank blocks below 256 did so for VMEM headroom, and the
+    # backward must not silently exceed that
+    bwd_bq = min(block_q, max(block_q // 2, 256))
+    bwd_bk = min(block_k, max(block_k // 2, 256))
     dq, dk, dv = _flash_bwd_bthd(
         to_bhtd(q), to_bhtd(k), to_bhtd(v), to_bhtd(o), lse, to_bhtd(g),
         causal, sc, bwd_bq, bwd_bk, interp)

@@ -140,9 +140,13 @@ def _sparse_sym_p(x, perplexity, chunk=1024):
         idxs.append(np.asarray(idxc))
     d2 = np.concatenate(d2s)                        # [n, k+1] ascending
     idx = np.concatenate(idxs)
-    # drop self (first occurrence of the query's own index per row)
+    # drop self (first occurrence of the query's own index per row); with
+    # >k exact duplicates the self index can be tied out of the top-(k+1),
+    # making argmax return 0 — drop the farthest column for those rows
+    # instead of silently discarding the true nearest neighbor
     rows_arange = np.arange(n)
-    self_pos = np.argmax(idx == rows_arange[:, None], 1)
+    is_self = idx == rows_arange[:, None]
+    self_pos = np.where(is_self.any(1), np.argmax(is_self, 1), idx.shape[1] - 1)
     keep = np.ones_like(idx, bool)
     keep[rows_arange, self_pos] = False
     d2 = d2[keep].reshape(n, k)

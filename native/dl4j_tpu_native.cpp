@@ -548,35 +548,50 @@ static void bh_insert(BHTree& t, int32_t cur, const float* y, int32_t p,
 }
 
 // Repulsive forces + partition function for one point via theta-criterion
-// traversal. Self-interaction is excluded at the resident leaf.
+// traversal. Self-interaction exclusion: a `has_i` bit is carried down the
+// stack — the root contains i, and exactly one child per expanded node
+// (picked with the SAME `>=` quadrant comparisons bh_place_child used to
+// insert i) inherits it. Wherever the traversal terminates on a cell with
+// has_i set, i's own q~1 term is subtracted — this is exact for the
+// resident-leaf case, the depth-capped merged-duplicate case (where
+// reconstructed cell bounds would be below fp32 resolution and useless),
+// and even a theta-summarized cell containing i.
 static void bh_point_forces(const BHTree& t, const float* y, int32_t i,
                             float theta2, float* fx, float* fy,
                             double* z_out) {
   const float px = y[2 * i], py = y[2 * i + 1];
   double Z = 0.0, rx = 0.0, ry = 0.0;
   int32_t stack[4 * kBHMaxDepth + 8];
+  bool cstack[4 * kBHMaxDepth + 8];
   int sp = 0;
-  stack[sp++] = 0;
+  stack[sp] = 0;
+  cstack[sp++] = true;
   while (sp) {
-    const BHNode& n = t.nodes[stack[--sp]];
+    --sp;
+    const BHNode& n = t.nodes[stack[sp]];
+    const bool has_i = cstack[sp];
     if (n.count == 0) continue;
-    if (n.point == i && n.count == 1) continue;       // exact self leaf
     const float dx = px - (float)n.comx, dy = py - (float)n.comy;
     const float d2 = dx * dx + dy * dy;
     const bool leaf = n.child[0] < 0 && n.child[1] < 0 &&
                       n.child[2] < 0 && n.child[3] < 0;
     const float size = 2.0f * n.hw;
     if (leaf || size * size < theta2 * d2) {
-      double cnt = (double)n.count;
-      if (n.point == i) cnt -= 1.0;  // depth-capped leaf holding i
+      double cnt = (double)n.count - (has_i ? 1.0 : 0.0);
+      if (cnt <= 0.0) continue;                       // pure self cell
       const double q = 1.0 / (1.0 + (double)d2);
       Z += cnt * q;
       const double qq = cnt * q * q;
       rx += qq * dx;
       ry += qq * dy;
     } else {
+      // i's quadrant under this node, by insertion's own comparisons
+      const int qi = (px >= n.cx ? 1 : 0) | (py >= n.cy ? 2 : 0);
       for (int c = 0; c < 4; c++)
-        if (n.child[c] >= 0) stack[sp++] = n.child[c];
+        if (n.child[c] >= 0) {
+          stack[sp] = n.child[c];
+          cstack[sp++] = has_i && c == qi;
+        }
     }
   }
   *fx = (float)rx;

@@ -188,3 +188,20 @@ class TestPipelinedTransformer:
         with pytest.raises(ValueError):
             lm.generate_batch(np.zeros((2, 10), np.int32),
                               max_new_tokens=10)
+
+    def test_generate_batch_jit_cache_is_bounded_lru(self):
+        """A serving workload with varied (B, P, n_new) shapes must not
+        accumulate compiled programs without bound; re-use must not
+        re-trace (the hot key stays resident under eviction pressure)."""
+        from deeplearning4j_tpu.models.zoo import transformer as tr
+        lm = TransformerLM(11, d_model=16, n_heads=2, n_layers=1,
+                           max_len=32)
+        hot = np.zeros((1, 2), np.int32)
+        lm.generate_batch(hot, max_new_tokens=1)
+        hot_fn = lm._jit_gen_cache[(1, 2, 1)]
+        for p in range(3, 3 + tr.GEN_JIT_CACHE_SIZE + 4):
+            lm.generate_batch(np.zeros((1, p), np.int32),
+                              max_new_tokens=1)
+            lm.generate_batch(hot, max_new_tokens=1)   # LRU touch
+        assert len(lm._jit_gen_cache) <= tr.GEN_JIT_CACHE_SIZE
+        assert lm._jit_gen_cache[(1, 2, 1)] is hot_fn

@@ -1,0 +1,94 @@
+"""Background TPU tunnel watcher.
+
+The axon relay (127.0.0.1:8103) is the only path to the chip and can be
+down/wedged for hours (see BENCH_r02..r04 history). This loop does a
+zero-risk TCP check first; only when the port accepts does it spend a
+real jax-init probe (subprocess, generous timeout — killing a chip job
+can wedge the relay, so we only probe when the TCP layer looks alive).
+
+Appends one JSON line per probe to /tmp/tpu_probe.log and, when the chip
+answers, writes /tmp/tpu_up.json with the device kind so the main agent
+can pivot to on-chip measurement.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+LOG = "/tmp/tpu_probe.log"
+UP = "/tmp/tpu_up.json"
+PORT = int(os.environ.get("TPU_WATCH_PORT", "8103"))
+INTERVAL = int(os.environ.get("TPU_WATCH_INTERVAL_S", "300"))
+JAX_PROBE_TIMEOUT = int(os.environ.get("TPU_WATCH_PROBE_TIMEOUT_S", "300"))
+
+PROBE_CODE = """
+import jax, json
+ds = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print(json.dumps({"platform": ds[0].platform, "kind": ds[0].device_kind,
+                  "n": len(ds), "ok": float(y[0, 0]) == 256.0}))
+"""
+
+
+def log(rec):
+    rec["t"] = time.strftime("%H:%M:%S")
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def tcp_open():
+    s = socket.socket()
+    s.settimeout(3)
+    try:
+        s.connect(("127.0.0.1", PORT))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def stale_up():
+    """Remove the up-marker: a later-wedged tunnel must not leave a
+    permanently fresh-looking 'chip is up' signal for the consumer."""
+    try:
+        os.remove(UP)
+    except OSError:
+        pass
+
+
+def main():
+    while True:
+        if not tcp_open():
+            log({"status": "no-relay"})
+            stale_up()
+        else:
+            try:
+                p = subprocess.run(
+                    [sys.executable, "-c", PROBE_CODE],
+                    capture_output=True, text=True, timeout=JAX_PROBE_TIMEOUT,
+                )
+                if p.returncode == 0 and p.stdout.strip():
+                    info = json.loads(p.stdout.strip().splitlines()[-1])
+                    info["probed_at"] = time.time()
+                    log({"status": "tpu-up", **info})
+                    with open(UP, "w") as f:
+                        json.dump(info, f)
+                else:
+                    log({"status": "probe-failed", "rc": p.returncode,
+                         "err": p.stderr[-400:]})
+                    stale_up()
+            except subprocess.TimeoutExpired:
+                log({"status": "probe-timeout"})
+                stale_up()
+            except Exception as e:  # keep the watcher alive no matter what
+                log({"status": "watcher-error", "err": repr(e)})
+        time.sleep(INTERVAL)
+
+
+if __name__ == "__main__":
+    main()
