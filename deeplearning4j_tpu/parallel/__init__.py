@@ -10,6 +10,7 @@ from .moe import (init_moe, make_expert_mesh, moe_mlp_dense,
 from .pipeline import PipelineParallel, gpipe, make_pipeline_mesh
 from .parameter_server import (GradientsAccumulator,
                                ParameterServerParallelWrapper)
+from .ps_transport import PSClient, PSServer, ps_worker_fit
 from .time_source import (NTPTimeSource, SystemClockTimeSource,
                           TimeSource)
 from .training_hook import ParameterServerTrainingHook, TrainingHook
@@ -25,6 +26,7 @@ __all__ = ["EarlyStoppingParallelTrainer",
            "MasterDataSetLossCalculator", "NTPTimeSource", "ParallelWrapper",
            "ParameterAveragingTrainingMaster",
            "ParameterServerParallelWrapper", "ParameterServerTrainingHook",
+           "PSClient", "PSServer", "ps_worker_fit",
            "SparkEarlyStoppingTrainer", "TpuComputationGraph",
            "SystemClockTimeSource", "TimeSource",
            "TpuEarlyStoppingTrainer", "TrainingHook",

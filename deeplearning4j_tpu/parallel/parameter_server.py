@@ -22,8 +22,11 @@ this module implements:
     in `stale_dropped`), mirroring soft-sync PS semantics. The queue size
     bounds in-flight gradients the way the Aeron client's buffer did.
 
-Multi-host DCN transport can replace the in-process queue without changing
-this API.
+Cross-process: `ps_transport.PSServer`/`PSClient` put a real TCP boundary
+under the same two operations (pull snapshot / push gradients) with this
+accumulator unchanged as the server core — see that module for the wire
+protocol and `tests/test_ps_transport.py` for the 2-process convergence
+proof.
 """
 from __future__ import annotations
 
@@ -87,15 +90,18 @@ class GradientsAccumulator:
         """The PS 'push' operation: enqueue gradients (plus the layer state
         the worker's forward produced, e.g. BN running stats) computed
         against snapshot `version`. Blocks when the inbox is full (bounded
-        in-flight). Raises if the accumulator died."""
+        in-flight). Raises if the accumulator died. Returns True when the
+        gradient was enqueued, False when the accumulator had already been
+        stopped and the push was discarded — transports must NOT ack a
+        False push as accepted."""
         while True:
             if self._error is not None:
                 raise self._error
             if self._stop.is_set():
-                return
+                return False
             try:
                 self._q.put((grads, score, version, model_state), timeout=0.1)
-                return
+                return True
             except queue.Full:
                 continue
 

@@ -1,0 +1,350 @@
+"""Cross-process transport for the asynchronous parameter server.
+
+The reference's PS is inherently cross-process: ParameterServerParallelWrapper
+launches an Aeron MediaDriver and workers push gradients / pull parameters
+through a ParameterServerClient over UDP (reference
+ParameterServerParallelWrapper.java:159-160, ParameterServerTrainer.java).
+The in-process accumulator (`parameter_server.GradientsAccumulator`) carries
+the staleness semantics; this module puts a REAL process/network boundary
+under the same two operations:
+
+  * `PSServer` — owns the master network and a GradientsAccumulator; serves
+    PULL (latest version-tagged snapshot) and PUSH (enqueue gradients) over a
+    length-prefixed TCP protocol. The ack for PUSH is sent only after the
+    gradient is enqueued, so the accumulator's bounded inbox exerts
+    backpressure straight through TCP — the role the Aeron client's bounded
+    buffer played.
+  * `PSClient` — numpy-only worker-side client (no jax import), one
+    connection per worker.
+  * `ps_worker_fit` — the worker loop: pull snapshot -> jitted grad_fn ->
+    push gradients, the exact loop the in-process wrapper's worker threads
+    run, against a remote master.
+
+Redesign note (why TCP and not Aeron/UDP): inside a pod, synchronous
+training rides ICI collectives (`parallel_wrapper.py`) — the PS transport
+only ever crosses the DCN/host boundary, where a stream socket's ordering
+and backpressure match the accumulator's queue semantics exactly.
+
+Wire format (little-endian): each message is `u32 length | u8 op | payload`.
+Array payloads pack a leaf list as `u32 n | per leaf: u8 dtype-len,
+dtype-str, u8 ndim, u64 dims..., u64 nbytes, raw bytes` — both ends hold the
+same model, so pytree structure never crosses the wire, only leaves.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+OP_PULL = 1
+OP_PUSH = 2
+OP_STATS = 3
+OP_DONE = 4
+
+_ACK = b"\x01"
+_NACK = b"\x00"
+
+
+class ProtocolError(ConnectionError):
+    """Malformed/unexpected wire message, or a push the server refused
+    (accumulator already stopped). Raised eagerly — a desynced stream must
+    fail loudly, never be parsed as the wrong message type."""
+
+
+# -- leaf (de)serialization -------------------------------------------------
+
+def pack_leaves(leaves):
+    out = [struct.pack("<I", len(leaves))]
+    for leaf in leaves:
+        # NOT ascontiguousarray: it promotes 0-d scalars to 1-d, and
+        # tobytes() below already emits C-order for any layout
+        a = np.asarray(leaf)
+        dt = a.dtype.str.encode()
+        out.append(struct.pack("<B", len(dt)))
+        out.append(dt)
+        out.append(struct.pack("<B", a.ndim))
+        out.append(struct.pack(f"<{a.ndim}Q", *a.shape) if a.ndim else b"")
+        out.append(struct.pack("<Q", a.nbytes))
+        out.append(a.tobytes())
+    return b"".join(out)
+
+
+def unpack_leaves(buf, off=0):
+    """Returns (leaves, next_offset)."""
+    (n,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    leaves = []
+    for _ in range(n):
+        (dtl,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        dt = buf[off:off + dtl].decode()
+        off += dtl
+        (ndim,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        shape = struct.unpack_from(f"<{ndim}Q", buf, off) if ndim else ()
+        off += 8 * ndim
+        (nbytes,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        count = int(np.prod(shape)) if ndim else 1   # 0-d scalar = 1 elem
+        leaves.append(np.frombuffer(buf, np.dtype(dt), count=count,
+                                    offset=off).reshape(shape).copy()
+                      if nbytes else np.empty(shape, np.dtype(dt)))
+        off += nbytes
+    return leaves, off
+
+
+# -- framed socket I/O ------------------------------------------------------
+
+def _send_msg(sock, op, payload=b""):
+    sock.sendall(struct.pack("<IB", 1 + len(payload), op) + payload)
+
+
+def _recv_exact(sock, n):
+    chunks = []
+    while n:
+        c = sock.recv(min(n, 1 << 20))
+        if not c:
+            raise ConnectionError("peer closed")
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock):
+    (length,) = struct.unpack("<I", _recv_exact(sock, 4))
+    body = _recv_exact(sock, length)
+    return body[0], body[1:]
+
+
+# -- server -----------------------------------------------------------------
+
+class PSServer:
+    """Socket front end over a GradientsAccumulator owning `net`.
+
+    `n_workers`: the server stops (drains the accumulator, closes the
+    listener) after this many DONE messages — the shutdown handshake the
+    reference runs through ParallelWrapper.close(). `wait()` blocks until
+    then and returns the accumulator stats."""
+
+    def __init__(self, net, host="127.0.0.1", port=0, queue_size=8,
+                 max_staleness=None, n_workers=1):
+        from .parameter_server import GradientsAccumulator
+        import jax
+
+        self.net = net
+        self._jax = jax
+        self._treedef = jax.tree_util.tree_structure(net._params)
+        self._acc = GradientsAccumulator(net, queue_size, max_staleness)
+        self._n_workers = int(n_workers)
+        self._done = 0
+        self._done_evt = threading.Event()
+        self._lock = threading.Lock()
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._threads = []
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        self.stats = None
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:           # listener closed during shutdown
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn):
+        jax = self._jax
+        try:
+            with conn:
+                while True:
+                    try:
+                        op, payload = _recv_msg(conn)
+                    except ConnectionError:
+                        return
+                    if op == OP_PULL:
+                        params, mstate, version = self._acc.snapshot_params()
+                        body = [struct.pack("<Q", version),
+                                pack_leaves(jax.tree_util.tree_leaves(
+                                    params))]
+                        if mstate is not None:
+                            body.append(b"\x01")
+                            body.append(pack_leaves(
+                                jax.tree_util.tree_leaves(mstate)))
+                        else:
+                            body.append(b"\x00")
+                        _send_msg(conn, OP_PULL, b"".join(body))
+                    elif op == OP_PUSH:
+                        (version,) = struct.unpack_from("<Q", payload, 0)
+                        (score,) = struct.unpack_from("<d", payload, 8)
+                        leaves, off = unpack_leaves(payload, 16)
+                        grads = jax.tree_util.tree_unflatten(self._treedef,
+                                                             leaves)
+                        mstate = None
+                        if payload[off] == 1:
+                            sleaves, _ = unpack_leaves(payload, off + 1)
+                            sdef = jax.tree_util.tree_structure(
+                                self.net._model_state)
+                            mstate = jax.tree_util.tree_unflatten(sdef,
+                                                                  sleaves)
+                        # blocks while the inbox is full -> the TCP ack
+                        # below is the backpressure signal; a push the
+                        # stopped accumulator discarded is NACKed so the
+                        # worker fails instead of training into a void
+                        accepted = self._acc.push_gradients(
+                            grads, score, version, mstate)
+                        _send_msg(conn, OP_PUSH,
+                                  _ACK if accepted else _NACK)
+                    elif op == OP_STATS:
+                        _send_msg(conn, OP_STATS,
+                                  json.dumps(self._acc.stats()).encode())
+                    elif op == OP_DONE:
+                        _send_msg(conn, OP_DONE, _ACK)
+                        with self._lock:
+                            self._done += 1
+                            if self._done >= self._n_workers:
+                                self._done_evt.set()
+                        return
+        except Exception:  # noqa: BLE001 — one bad client never kills serve
+            log.exception("ps connection handler failed")
+
+    def wait(self, timeout=None):
+        """Block until every worker sent DONE, then drain + stop. Returns
+        the accumulator stats dict."""
+        if not self._done_evt.wait(timeout):
+            raise TimeoutError(
+                f"only {self._done}/{self._n_workers} workers finished")
+        self.stop()
+        return self.stats
+
+    def stop(self):
+        self._acc.shutdown()
+        self.stats = self._acc.stats()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# -- client -----------------------------------------------------------------
+
+class PSClient:
+    """Worker-side connection. numpy-only: pull/push move leaf lists; the
+    caller owns pytree structure (both ends built the same model)."""
+
+    def __init__(self, host, port, timeout=120.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+
+    @staticmethod
+    def _expect(op, want, what):
+        # explicit raise, not assert: protocol checks must survive
+        # python -O in a deployed worker
+        if op != want:
+            raise ProtocolError(f"expected {what} reply (op {want}), "
+                                f"got op {op}")
+
+    def pull(self):
+        """-> (param_leaves, state_leaves_or_None, version)"""
+        _send_msg(self._sock, OP_PULL)
+        op, payload = _recv_msg(self._sock)
+        self._expect(op, OP_PULL, "PULL")
+        (version,) = struct.unpack_from("<Q", payload, 0)
+        leaves, off = unpack_leaves(payload, 8)
+        state = None
+        if payload[off] == 1:
+            state, _ = unpack_leaves(payload, off + 1)
+        return leaves, state, version
+
+    def push(self, grad_leaves, score, version, state_leaves=None):
+        body = [struct.pack("<Q", version), struct.pack("<d", float(score)),
+                pack_leaves(grad_leaves)]
+        if state_leaves is not None:
+            body.append(b"\x01")
+            body.append(pack_leaves(state_leaves))
+        else:
+            body.append(b"\x00")
+        _send_msg(self._sock, OP_PUSH, b"".join(body))
+        op, ack = _recv_msg(self._sock)
+        self._expect(op, OP_PUSH, "PUSH")
+        if ack != _ACK:
+            raise ProtocolError("server refused the push (accumulator "
+                                "stopped) — gradient was discarded")
+
+    def stats(self):
+        _send_msg(self._sock, OP_STATS)
+        op, payload = _recv_msg(self._sock)
+        self._expect(op, OP_STATS, "STATS")
+        return json.loads(payload.decode())
+
+    def done(self):
+        _send_msg(self._sock, OP_DONE)
+        op, ack = _recv_msg(self._sock)
+        self._expect(op, OP_DONE, "DONE")
+        if ack != _ACK:
+            raise ProtocolError("DONE not acknowledged")
+        self._sock.close()
+
+
+# -- worker loop ------------------------------------------------------------
+
+def ps_worker_fit(net, host, port, data, num_epochs=1, seed=0):
+    """The PS worker loop against a REMOTE master: pull snapshot, compute
+    gradients with the jitted grad fn, push — identical math to the
+    in-process `ParameterServerParallelWrapper` worker threads (the 2-process
+    convergence test pins that). `net` provides architecture + jit cache
+    only; its own parameters are never read."""
+    import jax
+    import jax.numpy as jnp
+
+    from .parameter_server import _jitted_ps_fns
+
+    net._ensure_init()
+    grad_fn = _jitted_ps_fns(net)[0]
+    treedef = jax.tree_util.tree_structure(net._params)
+    sdef = (jax.tree_util.tree_structure(net._model_state)
+            if net._model_state is not None else None)
+    client = PSClient(host, port)
+    rng = jax.random.PRNGKey(seed)
+    step = 0
+    for _ in range(num_epochs):
+        data.reset()
+        while data.has_next():
+            ds = data.next_batch()
+            pleaves, sleaves, version = client.pull()
+            params = jax.tree_util.tree_unflatten(treedef, pleaves)
+            state = (jax.tree_util.tree_unflatten(sdef, sleaves)
+                     if sleaves is not None else net._model_state)
+            batch = {
+                "features": jnp.asarray(ds.features),
+                "labels": jnp.asarray(ds.labels),
+                "fmask": (jnp.asarray(ds.features_mask)
+                          if ds.features_mask is not None else None),
+                "lmask": (jnp.asarray(ds.labels_mask)
+                          if ds.labels_mask is not None else None),
+                "rng": jax.random.fold_in(rng, step),
+            }
+            grads, score, new_state, _ = grad_fn(params, state, batch)
+            client.push(
+                [np.asarray(l) for l in jax.tree_util.tree_leaves(grads)],
+                float(score), version,
+                ([np.asarray(l) for l in
+                  jax.tree_util.tree_leaves(new_state)]
+                 if new_state is not None and sdef is not None else None))
+            step += 1
+    stats = client.stats()
+    client.done()
+    return stats

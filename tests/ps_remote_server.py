@@ -1,0 +1,63 @@
+"""Parameter-server master process for test_ps_transport.py.
+
+Owns the master network + GradientsAccumulator behind a PSServer socket,
+waits for every worker's DONE, then prints the final score and accumulator
+stats. Usage: python tests/ps_remote_server.py <port_file> <n_workers>
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,  # noqa: E402
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet  # noqa: E402
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,  # noqa: E402
+                                               OutputLayer)
+from deeplearning4j_tpu.parallel.ps_transport import PSServer  # noqa: E402
+
+
+def build_net(seed=7):
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, DenseLayer(n_out=16, activation="relu"))
+            .layer(1, OutputLayer(n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def build_data(n=256, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.random((n, 5)).astype(np.float32)
+    w = r.random((5, 3))
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return DataSet(x, y)
+
+
+def main():
+    # argv parsed here, not at module scope: the worker script and the
+    # pytest process both import build_net/build_data from this module
+    port_file, n_workers = sys.argv[1], int(sys.argv[2])
+    net = build_net()
+    ds = build_data()
+    s0 = float(net.score(ds))
+    srv = PSServer(net, queue_size=4, n_workers=n_workers)
+    with open(port_file, "w") as f:
+        f.write(str(srv.port))
+    stats = srv.wait(timeout=240)
+    print("RESULT", f"s0={s0}", f"score={float(net.score(ds))}",
+          f"applied={stats['applied']}",
+          f"stale_dropped={stats['stale_dropped']}",
+          f"max_staleness={stats['max_staleness_seen']}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

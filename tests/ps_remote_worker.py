@@ -1,0 +1,38 @@
+"""Parameter-server worker process for test_ps_transport.py.
+
+Builds the SAME architecture as the master (its own params are never used),
+shards the dataset by worker id, and runs the pull->grad->push loop against
+the remote master. Usage:
+python tests/ps_remote_worker.py <worker_id> <n_workers> <port>
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from deeplearning4j_tpu.datasets.iterators import \
+    ListDataSetIterator  # noqa: E402
+from deeplearning4j_tpu.parallel.ps_transport import \
+    ps_worker_fit  # noqa: E402
+from ps_remote_server import build_data, build_net  # noqa: E402
+
+
+def main():
+    worker_id, n_workers, port = (int(sys.argv[1]), int(sys.argv[2]),
+                                  int(sys.argv[3]))
+    net = build_net()
+    batches = list(build_data().batch_by(32))
+    shard = batches[worker_id::n_workers]
+    stats = ps_worker_fit(net, "127.0.0.1", port,
+                          ListDataSetIterator(shard), num_epochs=3,
+                          seed=worker_id)
+    print("WORKER", worker_id, "pushed", len(shard) * 3,
+          "applied_seen", stats["applied"], flush=True)
+
+
+if __name__ == "__main__":
+    main()

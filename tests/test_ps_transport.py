@@ -1,0 +1,105 @@
+"""Cross-process asynchronous parameter server (VERDICT r4 item 5).
+
+The reference PS is inherently cross-process — ParameterServerParallelWrapper
+launches an Aeron MediaDriver and workers talk to it over UDP
+(ParameterServerParallelWrapper.java:159-160). These tests put a REAL
+process/network boundary under the same semantics: one master process owning
+the accumulator, two worker processes pushing gradients over TCP, and the
+convergence compared against the in-process PS on identical data.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.multiprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + os.path.join(REPO, "tests")
+    return env
+
+
+def test_two_process_ps_converges_like_in_process(tmp_path):
+    port_file = str(tmp_path / "port")
+    env = _clean_env()
+    server = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "ps_remote_server.py"),
+         port_file, "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+    try:
+        for _ in range(600):                      # wait for the bound port
+            if os.path.exists(port_file) and open(port_file).read().strip():
+                break
+            if server.poll() is not None:
+                raise AssertionError(
+                    f"server died early:\n{server.stdout.read()}")
+            time.sleep(0.1)
+        port = open(port_file).read().strip()
+        workers = [subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "tests", "ps_remote_worker.py"),
+             str(i), "2", port],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for i in range(2)]
+        wouts = [p.communicate(timeout=240)[0] for p in workers]
+        for i, (p, out) in enumerate(zip(workers, wouts)):
+            assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        sout, _ = server.communicate(timeout=120)
+        assert server.returncode == 0, f"server failed:\n{sout}"
+    finally:
+        if server.poll() is None:
+            server.kill()
+    result = next(l for l in sout.splitlines() if l.startswith("RESULT"))
+    fields = dict(kv.split("=") for kv in result.split()[1:])
+    s0, score = float(fields["s0"]), float(fields["score"])
+    # every push from both workers was applied or counted as dropped:
+    # 8 batches x 3 epochs = 24 total
+    assert int(fields["applied"]) + int(fields["stale_dropped"]) == 24
+    assert np.isfinite(score) and score < s0
+
+    # convergence ~ the in-process PS on the SAME data/arch/hyperparams
+    # (the network boundary must not change the training semantics)
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.parallel import ParameterServerParallelWrapper
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    try:
+        from ps_remote_server import build_data, build_net
+    finally:
+        sys.path.pop(0)
+    net = build_net()
+    ds = build_data()
+    psw = (ParameterServerParallelWrapper.Builder(net)
+           .workers(2).queue_size(4).build())
+    psw.fit(ListDataSetIterator(list(ds.batch_by(32))), num_epochs=3)
+    in_proc = float(net.score(ds))
+    assert score < s0 - 0.5 * (s0 - in_proc), (
+        f"remote PS converged too little: remote {score}, "
+        f"in-process {in_proc}, start {s0}")
+
+
+def test_ps_leaf_serialization_round_trip():
+    """Wire format: every dtype/shape the params and BN state use survives
+    pack->unpack bit-exactly, including 0-d scalars and empty arrays."""
+    from deeplearning4j_tpu.parallel.ps_transport import (pack_leaves,
+                                                          unpack_leaves)
+    rng = np.random.default_rng(0)
+    leaves = [rng.standard_normal((4, 7)).astype(np.float32),
+              np.float32(3.25).reshape(()),
+              rng.integers(0, 9, (3,), dtype=np.int64),
+              np.empty((0, 5), np.float32),
+              rng.standard_normal((2, 3, 4)).astype(np.float64)]
+    buf = pack_leaves(leaves) + b"trailing"
+    out, off = unpack_leaves(buf)
+    assert off == len(buf) - len(b"trailing")
+    assert len(out) == len(leaves)
+    for a, b in zip(leaves, out):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
