@@ -43,6 +43,23 @@ from ..datasets.iterators import ListDataSetIterator
 log = logging.getLogger(__name__)
 
 
+def ps_batch(ds, rng):
+    """The batch dict the jitted grad fn consumes — the ONE definition of
+    the PS batch contract (in-process workers, TrainingHook workers and the
+    remote `ps_transport.ps_worker_fit` loop must stay byte-identical in
+    what they feed grad_fn, or their gradients silently diverge)."""
+    import jax.numpy as jnp
+    return {
+        "features": jnp.asarray(ds.features),
+        "labels": jnp.asarray(ds.labels),
+        "fmask": (jnp.asarray(ds.features_mask)
+                  if ds.features_mask is not None else None),
+        "lmask": (jnp.asarray(ds.labels_mask)
+                  if ds.labels_mask is not None else None),
+        "rng": rng,
+    }
+
+
 def _jitted_ps_fns(net):
     """(grad_fn, apply_fn) jitted once per network — cached on the model so
     repeated fit() calls (and new accumulators) reuse the compiled XLA
@@ -225,17 +242,7 @@ class ParameterServerParallelWrapper:
                     try:
                         for j, ds in enumerate(batches):
                             params, state, version = acc.snapshot_params()
-                            batch = {
-                                "features": jnp.asarray(ds.features),
-                                "labels": jnp.asarray(ds.labels),
-                                "fmask": (jnp.asarray(ds.features_mask)
-                                          if ds.features_mask is not None
-                                          else None),
-                                "lmask": (jnp.asarray(ds.labels_mask)
-                                          if ds.labels_mask is not None
-                                          else None),
-                                "rng": jax.random.fold_in(wrng, j),
-                            }
+                            batch = ps_batch(ds, jax.random.fold_in(wrng, j))
                             grads, score, new_state, _ = grad_fn(params,
                                                                  state, batch)
                             acc.push_gradients(grads, score, version,

@@ -246,8 +246,13 @@ class PSClient:
     """Worker-side connection. numpy-only: pull/push move leaf lists; the
     caller owns pytree structure (both ends built the same model)."""
 
-    def __init__(self, host, port, timeout=120.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+    def __init__(self, host, port, connect_timeout=120.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
+        # operations run UNBOUNDED: a PUSH ack legitimately blocks while
+        # the server inbox is full (that block IS the backpressure
+        # contract) — an op timeout here would kill healthy workers
+        self._sock.settimeout(None)
 
     @staticmethod
     def _expect(op, want, what):
@@ -308,9 +313,8 @@ def ps_worker_fit(net, host, port, data, num_epochs=1, seed=0):
     convergence test pins that). `net` provides architecture + jit cache
     only; its own parameters are never read."""
     import jax
-    import jax.numpy as jnp
 
-    from .parameter_server import _jitted_ps_fns
+    from .parameter_server import _jitted_ps_fns, ps_batch
 
     net._ensure_init()
     grad_fn = _jitted_ps_fns(net)[0]
@@ -328,15 +332,7 @@ def ps_worker_fit(net, host, port, data, num_epochs=1, seed=0):
             params = jax.tree_util.tree_unflatten(treedef, pleaves)
             state = (jax.tree_util.tree_unflatten(sdef, sleaves)
                      if sleaves is not None else net._model_state)
-            batch = {
-                "features": jnp.asarray(ds.features),
-                "labels": jnp.asarray(ds.labels),
-                "fmask": (jnp.asarray(ds.features_mask)
-                          if ds.features_mask is not None else None),
-                "lmask": (jnp.asarray(ds.labels_mask)
-                          if ds.labels_mask is not None else None),
-                "rng": jax.random.fold_in(rng, step),
-            }
+            batch = ps_batch(ds, jax.random.fold_in(rng, step))
             grads, score, new_state, _ = grad_fn(params, state, batch)
             client.push(
                 [np.asarray(l) for l in jax.tree_util.tree_leaves(grads)],

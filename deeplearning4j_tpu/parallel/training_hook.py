@@ -21,7 +21,8 @@ import threading
 import jax
 import jax.numpy as jnp
 
-from .parameter_server import GradientsAccumulator, _jitted_ps_fns
+from .parameter_server import (GradientsAccumulator, _jitted_ps_fns,
+                               ps_batch)
 
 
 class TrainingHook:
@@ -87,15 +88,7 @@ class ParameterServerTrainingHook(TrainingHook):
                 for j, ds in enumerate(shard):
                     self.pre_update(ds, net)
                     params, state, version = acc.snapshot_params()
-                    batch = {
-                        "features": jnp.asarray(ds.features),
-                        "labels": jnp.asarray(ds.labels),
-                        "fmask": (jnp.asarray(ds.features_mask)
-                                  if ds.features_mask is not None else None),
-                        "lmask": (jnp.asarray(ds.labels_mask)
-                                  if ds.labels_mask is not None else None),
-                        "rng": jax.random.fold_in(wrng, j),
-                    }
+                    batch = ps_batch(ds, jax.random.fold_in(wrng, j))
                     grads, score, new_state, _ = grad_fn(params, state, batch)
                     acc.push_gradients(grads, score, version, new_state)
                     self.post_update(ds, net)

@@ -13,8 +13,11 @@ from .document_iterator import (AsyncLabelAwareIterator,
                                 LabelAwareDocumentIterator, LabelledDocument,
                                 SimpleLabelAwareIterator)
 from .inverted_index import InMemoryInvertedIndex
+from .ja_dictionary import (MecabDictionary, compile_dictionary,
+                            parse_user_dictionary)
 from .ja_lattice import (JapaneseLatticeTokenizer,
                          JapaneseLatticeTokenizerFactory)
+from .ko_dictionary import KoreanDictionary, load_dictionary
 from .ko_morph import KoreanMorphTokenizer, KoreanMorphTokenizerFactory
 from .sentence_iterator import (BasicLineIterator, CollectionSentenceIterator,
                                 FileSentenceIterator, LabelAwareIterator,
@@ -37,7 +40,9 @@ __all__ = [
     "FileSentenceIterator", "FilenamesLabelAwareIterator",
     "InMemoryInvertedIndex", "JapaneseLatticeTokenizer",
     "JapaneseLatticeTokenizerFactory", "JapaneseTokenizerFactory",
-    "KoreanMorphTokenizer", "KoreanMorphTokenizerFactory",
+    "KoreanDictionary", "KoreanMorphTokenizer", "KoreanMorphTokenizerFactory",
+    "MecabDictionary", "compile_dictionary", "load_dictionary",
+    "parse_user_dictionary",
     "KoreanTokenizerFactory", "LabelAwareDocumentIterator",
     "LabelAwareIterator", "LabelAwareListSentenceIterator",
     "LabelledDocument", "LabelsSource", "LowCasePreProcessor",

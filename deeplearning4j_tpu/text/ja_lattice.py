@@ -205,32 +205,69 @@ def viterbi_segment(text):
 _SPLIT = re.compile(r"[\s。、．，！？!?,.「」『』（）()\[\]:;：；…・〜~]+")
 
 
+# mecab pos1 -> the coarse tag set the builtin lattice uses, so pos_tags
+# stays one vocabulary whichever dictionary backs the lattice
+_MECAB_POS = {"名詞": "noun", "代名詞": "pron", "動詞": "verb",
+              "形容詞": "adj", "副詞": "adv", "助詞": "particle",
+              "助動詞": "aux", "接続詞": "conj", "連体詞": "adnominal",
+              "感動詞": "interjection", "記号": "symbol",
+              "接頭詞": "prefix", "フィラー": "filler", "未知語": "unk"}
+
+
 class JapaneseLatticeTokenizer(Tokenizer):
     """Morphological tokenizer: trie + Viterbi over the committed lexicon
     (reference: JapaneseTokenizer.java backed by Kuromoji's
-    ViterbiSearcher). Punctuation splits chunks; each chunk is segmented
-    by least-cost lattice path."""
+    ViterbiSearcher), or over a compiled mecab-format dictionary when
+    `dictionary` is given (`ja_dictionary.compile_dictionary` — the
+    Kuromoji DictionaryCompiler/UserDictionary ingestion path).
+    Punctuation splits chunks; each chunk is segmented by least-cost
+    lattice path. User-dictionary multi-segment entries are expanded into
+    their segments (関西国際空港 -> 関西|国際|空港), the
+    UserDictionary.java match shape."""
 
-    def __init__(self, text, with_pos=False):
+    def __init__(self, text, with_pos=False, dictionary=None):
         tokens = []
         self.pos_tags = []
         for chunk in _SPLIT.split(text):
             if not chunk:
                 continue
-            for surface, pos in viterbi_segment(chunk):
-                tokens.append(surface)
-                self.pos_tags.append(pos)
+            if dictionary is None:
+                for surface, pos in viterbi_segment(chunk):
+                    tokens.append(surface)
+                    self.pos_tags.append(pos)
+            else:
+                from .ja_dictionary import viterbi_segment_dict
+                for surface, feats, segs in viterbi_segment_dict(
+                        chunk, dictionary):
+                    pos = _MECAB_POS.get(feats[0] if feats else "",
+                                         feats[0] if feats else "unk")
+                    for seg in (segs or (surface,)):
+                        tokens.append(seg)
+                        self.pos_tags.append(pos)
         super().__init__(tokens)
 
 
 class JapaneseLatticeTokenizerFactory(TokenizerFactory):
     """TokenizerFactory SPI over the lattice tokenizer — drop-in where
-    `JapaneseTokenizerFactory` (script-transition baseline) was used."""
+    `JapaneseTokenizerFactory` (script-transition baseline) was used.
 
-    def __init__(self):
+    `dict_path`: mecab-format dictionary directory (token CSVs +
+    matrix.def [+ char.def, unk.def]) or a single token CSV file;
+    `user_dict_path`: Kuromoji-format user dictionary. Compiled once here,
+    shared by every tokenizer the factory creates."""
+
+    def __init__(self, dict_path=None, user_dict_path=None):
         self._pre = None
+        self.dictionary = None
+        if dict_path is not None:
+            from .ja_dictionary import compile_dictionary
+            self.dictionary = compile_dictionary(
+                dict_path, user_dict_path=user_dict_path)
+        elif user_dict_path is not None:
+            raise ValueError("user_dict_path requires dict_path (user "
+                             "entries extend a base dictionary)")
 
     def create(self, text):
-        t = JapaneseLatticeTokenizer(text)
+        t = JapaneseLatticeTokenizer(text, dictionary=self.dictionary)
         t._pre = self._pre
         return t

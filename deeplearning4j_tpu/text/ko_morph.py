@@ -140,15 +140,34 @@ class KoreanMorphTokenizer(Tokenizer):
     KoreanTokenizer.java backed by the vendored KoreanText analyzer;
     closed-class decomposition here). emit_affixes=False drops the
     particles/endings (bag-of-stems mode, what embedding vocabularies
-    want)."""
+    want).
 
-    def __init__(self, text, emit_affixes=True):
+    `dictionary` (a `ko_dictionary.KoreanDictionary`) is the open-class
+    lexicon the analyzer consults: a known noun is never decomposed by the
+    eomi heuristic (바다 stays 바다, not 바+다), and a known noun found
+    under a josa confirms the particle split without further stripping —
+    the role the vendored wordlist resources play."""
+
+    def __init__(self, text, emit_affixes=True, dictionary=None):
         tokens = []
         for eojeol in re.split(r"[\s\W]+", text, flags=re.UNICODE):
             if not eojeol:
                 continue
+            if dictionary is not None and eojeol in dictionary.nouns:
+                tokens.append(eojeol)
+                continue
             stem, josa = split_josa(eojeol)
-            stem2, eomi = split_eomi(stem)
+            if dictionary is not None and stem in dictionary.nouns:
+                stem2, eomi = stem, None
+            else:
+                stem2, eomi = split_eomi(stem)
+                if (dictionary is not None and eomi is not None
+                        and stem2 not in dictionary.verbs
+                        and stem in dictionary.verbs):
+                    # the un-split form is a known stem but the split
+                    # result is not: trust the dictionary over the
+                    # heuristic (stem-in-nouns was handled above)
+                    stem2, eomi = stem, None
             tokens.append(stem2)
             if emit_affixes:
                 if eomi:
@@ -159,11 +178,20 @@ class KoreanMorphTokenizer(Tokenizer):
 
 
 class KoreanMorphTokenizerFactory(TokenizerFactory):
-    def __init__(self, emit_affixes=True):
+    """`dict_path`: KoreanText-layout wordlist directory (see
+    `ko_dictionary.load_dictionary`), loaded once and shared by every
+    tokenizer the factory creates."""
+
+    def __init__(self, emit_affixes=True, dict_path=None, dictionary=None):
         self._pre = None
         self.emit_affixes = emit_affixes
+        if dict_path is not None:
+            from .ko_dictionary import load_dictionary
+            dictionary = load_dictionary(dict_path)
+        self.dictionary = dictionary
 
     def create(self, text):
-        t = KoreanMorphTokenizer(text, self.emit_affixes)
+        t = KoreanMorphTokenizer(text, self.emit_affixes,
+                                 dictionary=self.dictionary)
         t._pre = self._pre
         return t

@@ -1,0 +1,188 @@
+"""CJK dictionary ingestion (VERDICT r4 item 6): mecab-format dictionary
+compile for the Japanese lattice (reference: Kuromoji
+ipadic/compile/DictionaryCompiler.java + dict/UserDictionary.java +
+util/DictionaryEntryLineParser.java) and KoreanText-layout wordlist loading
+for the Korean analyzer (reference: deeplearning4j-nlp-korean). The
+committed fixtures under tests/fixtures/{ja_dict,ko_dict} are format-exact:
+IPADIC 13-field token CSVs, full matrix.def, char.def/unk.def, and a
+Kuromoji user dictionary."""
+import os
+import shutil
+
+import pytest
+
+from deeplearning4j_tpu.text.ja_dictionary import (compile_dictionary,
+                                                   MecabDictionary,
+                                                   parse_entry_line,
+                                                   parse_user_dictionary,
+                                                   viterbi_segment_dict)
+from deeplearning4j_tpu.text.ja_lattice import (
+    JapaneseLatticeTokenizer, JapaneseLatticeTokenizerFactory)
+from deeplearning4j_tpu.text.ko_dictionary import load_dictionary
+from deeplearning4j_tpu.text.ko_morph import (KoreanMorphTokenizer,
+                                              KoreanMorphTokenizerFactory)
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures")
+JA = os.path.join(FIX, "ja_dict")
+KO = os.path.join(FIX, "ko_dict")
+
+
+class TestEntryLineParser:
+    def test_plain_and_quoted_fields(self):
+        assert parse_entry_line("a,b,c") == ["a", "b", "c"]
+        # a quoted field keeps its commas (DictionaryEntryLineParser)
+        assert parse_entry_line('"3,4-x",1,2') == ["3,4-x", "1", "2"]
+        # "" inside a quoted field is a literal quote
+        assert parse_entry_line('"say ""hi""",9') == ['say "hi"', "9"]
+
+    def test_unmatched_quote_raises(self):
+        with pytest.raises(ValueError):
+            parse_entry_line('"unterminated,1,2')
+
+
+class TestMecabCompile:
+    def test_compile_reads_all_components(self):
+        dic = compile_dictionary(JA)
+        surfaces = {e[0] for e in dic.entries}
+        assert {"東京", "東京都", "に", "住む",
+                "3,4-ジヒドロキシ安息香酸"} <= surfaces
+        # matrix.def header sizes honored
+        assert dic.conn.forward_size == dic.conn.backward_size == 7
+        assert dic.conn.cost(1, 2) == 0          # noun -> particle
+        assert dic.conn.cost(2, 1) == 100        # particle -> noun
+        # char.def categories + ranges
+        assert dic.char_defs.categories["KATAKANA"] == (1, 1, 0)
+        assert dic.char_defs.lookup("ラ") == "KATAKANA"
+        assert dic.char_defs.lookup("住") == "KANJI"
+        # unk.def templates keyed by category
+        assert "KATAKANA" in dic.unk_entries
+
+    def test_lattice_prefers_low_cost_path(self):
+        dic = compile_dictionary(JA)
+        out = [s for s, _, _ in viterbi_segment_dict("東京都に住む", dic)]
+        # 東京都 (5500) beats 東京+都 (3000 + conn 800 + 4000)
+        assert out == ["東京都", "に", "住む"]
+
+    def test_matrix_def_drives_segmentation(self, tmp_path):
+        """Same CSVs, one matrix.def line changed: the noun->noun-suffix
+        join becomes strongly negative and the SPLIT path must win — the
+        connection matrix is really consulted, format-exactly."""
+        d = tmp_path / "dict"
+        shutil.copytree(JA, d)
+        lines = (d / "matrix.def").read_text().splitlines()
+        patched = ["4 1 -9000" if l == "4 1 800" else l for l in lines]
+        assert patched != lines
+        (d / "matrix.def").write_text("\n".join(patched) + "\n")
+        dic = compile_dictionary(str(d))
+        out = [s for s, _, _ in viterbi_segment_dict("東京都に住む", dic)]
+        assert out == ["東京", "都", "に", "住む"]
+
+    def test_quoted_surface_matches_in_lattice(self):
+        dic = compile_dictionary(JA)
+        out = viterbi_segment_dict("3,4-ジヒドロキシ安息香酸です", dic)
+        assert [s for s, _, _ in out] == ["3,4-ジヒドロキシ安息香酸",
+                                          "です"]
+
+    def test_unknown_words_char_def_semantics(self):
+        dic = compile_dictionary(JA)
+        # katakana: group=1 -> whole run as one unknown noun
+        out = viterbi_segment_dict("コンピュータに住む", dic)
+        assert [s for s, _, _ in out] == ["コンピュータ", "に", "住む"]
+        assert out[0][1][0] == "名詞"            # unk.def KATAKANA features
+        # numeric grouping
+        out2 = viterbi_segment_dict("2026に住む", dic)
+        assert [s for s, _, _ in out2] == ["2026", "に", "住む"]
+
+    def test_compiled_artifact_round_trip(self, tmp_path):
+        dic = compile_dictionary(JA, user_dict_path=os.path.join(
+            JA, "userdict.txt"))
+        p = str(tmp_path / "compiled.json")
+        dic.save_compiled(p)
+        dic2 = MecabDictionary.load_compiled(p)
+        for text in ("東京都に住む", "関西国際空港に行った",
+                     "コンピュータです"):
+            a = viterbi_segment_dict(text, dic)
+            b = viterbi_segment_dict(text, dic2)
+            assert a == b
+
+
+class TestUserDictionary:
+    def test_user_entry_expands_to_segments(self):
+        """関西国際空港 matches as ONE lattice entry but is reported as its
+        three segments — UserDictionary.java's match shape."""
+        fac = JapaneseLatticeTokenizerFactory(
+            dict_path=JA, user_dict_path=os.path.join(JA, "userdict.txt"))
+        toks = fac.create("関西国際空港に行った")
+        assert toks.get_tokens() == ["関西", "国際", "空港", "に", "行った"]
+        assert toks.pos_tags[:3] == ["カスタム名詞"] * 3
+
+    def test_without_user_dict_base_segmentation_differs(self):
+        fac = JapaneseLatticeTokenizerFactory(dict_path=JA)
+        toks = fac.create("関西国際空港に行った")
+        # base dictionary: 関西 + 国際 + 空港 as separate lexical entries
+        # with noun->noun connection costs (not the single user entry)
+        assert toks.get_tokens()[:3] == ["関西", "国際", "空港"]
+        assert toks.pos_tags[0] == "noun"        # not カスタム名詞
+
+    def test_segment_concatenation_validated(self):
+        with pytest.raises(ValueError):
+            parse_user_dictionary("東京都,東京 京都,トウキョウ キョウト,"
+                                  "カスタム名詞")
+
+    def test_user_dict_requires_base_dict(self):
+        with pytest.raises(ValueError):
+            JapaneseLatticeTokenizerFactory(
+                user_dict_path=os.path.join(JA, "userdict.txt"))
+
+
+class TestDictPathChangesSegmentation:
+    def test_builtin_vs_fixture_dictionary(self):
+        """The VERDICT acceptance: JapaneseTokenizer(dict_path=...) loads a
+        mecab-format CSV and segmentation changes accordingly."""
+        text = "東京都に住む"
+        builtin = JapaneseLatticeTokenizer(text).get_tokens()
+        withdict = JapaneseLatticeTokenizer(
+            text, dictionary=compile_dictionary(JA)).get_tokens()
+        # both segment, but the fixture's single 東京都 entry wins there
+        assert withdict == ["東京都", "に", "住む"]
+        assert builtin != withdict
+
+
+class TestKoreanDictionary:
+    def test_load_layout_and_stems(self):
+        dic = load_dictionary(KO)
+        assert "바다" in dic.nouns and "서울" in dic.nouns
+        # verb.txt dictionary forms are stemmed (먹다 -> 먹)
+        assert "먹" in dic.verbs and "가" in dic.verbs
+        assert "바다" in dic.words("noun")
+
+    def test_known_noun_suppresses_eomi_split(self):
+        """바다 ends in 다, which the heuristic strips as a verb ending;
+        the dictionary must keep the noun whole — including under a
+        particle (바다는 -> 바다|는)."""
+        assert KoreanMorphTokenizer("바다").get_tokens() == ["바", "다"]
+        dic = load_dictionary(KO)
+        assert KoreanMorphTokenizer(
+            "바다", dictionary=dic).get_tokens() == ["바다"]
+        assert KoreanMorphTokenizer(
+            "바다는 넓다", dictionary=dic).get_tokens() == \
+            ["바다", "는", "넓", "다"]
+
+    def test_factory_dict_path(self):
+        fac = KoreanMorphTokenizerFactory(dict_path=KO)
+        assert fac.create("바다는").get_tokens() == ["바다", "는"]
+
+    def test_runtime_word_addition(self):
+        """addNounsToDictionary parity: user words extend a category at
+        runtime and immediately affect tokenization."""
+        dic = load_dictionary(KO)
+        # 도자기 ends in the nominalizer 기, which the heuristic strips
+        assert KoreanMorphTokenizer(
+            "도자기", dictionary=dic).get_tokens() == ["도자", "기"]
+        dic.add_words("noun", ["도자기"])
+        assert KoreanMorphTokenizer(
+            "도자기", dictionary=dic).get_tokens() == ["도자기"]
+
+    def test_missing_dir_raises(self, tmp_path):
+        with pytest.raises(ValueError):
+            load_dictionary(str(tmp_path))
