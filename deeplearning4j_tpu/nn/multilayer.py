@@ -63,6 +63,8 @@ class MultiLayerNetwork:
         self._jit_forward = {}
         self._rnn_state = None       # per-layer carried state for rnnTimeStep
         self._loop = None            # device-resident {iteration, rng}
+        self._act_stats_cfg = None   # (max_channels, max_size) when stats on
+        self._last_activation_stats = None
 
     # ------------------------------------------------------------------
     # Init — reference MultiLayerNetwork.init():398-465
@@ -129,6 +131,9 @@ class MultiLayerNetwork:
 
     def _output_layer_input(self, params, state, x, *, train, rng, fmask=None,
                             carries=None):
+        """(h, state', carries', acts): the output layer's input after the
+        last preprocessor, plus the full interior activation list (the ONE
+        forward shared by loss, inference and rnnTimeStep paths)."""
         acts, new_state, new_carries = self._apply_layers(
             params, state, x, train=train, rng=rng, fmask=fmask,
             upto=len(self.layers) - 1, carries=carries)
@@ -136,11 +141,32 @@ class MultiLayerNetwork:
         i = len(self.layers) - 1
         if i in self.conf.preprocessors:
             h = self.conf.preprocessors[i].pre_process(h)
-        return h, new_state, new_carries
+        return h, new_state, new_carries, acts
+
+    def _act_summaries(self, acts):
+        """ON-DEVICE per-layer activation summaries for the stats pipeline
+        (reference BaseStatsListener.java:273-420 captures activations from
+        the live training forward; here the fused step emits compact
+        summaries instead of shipping full activations over the tunnel):
+        f32 mean/stdev/mean-magnitude per layer, plus a downsampled
+        first-example channel grid for 4-D (NHWC conv) outputs — the
+        ConvolutionalIterationListener image source."""
+        max_ch, max_size = self._act_stats_cfg
+        out = []
+        for a in acts:
+            a32 = a.astype(jnp.float32)
+            s = {"mean": jnp.mean(a32), "stdev": jnp.std(a32),
+                 "meanMagnitude": jnp.mean(jnp.abs(a32))}
+            if a32.ndim == 4:
+                g = a32[0]
+                step = max(1, max(g.shape[0], g.shape[1]) // max_size)
+                s["grid"] = g[::step, ::step, :max_ch]
+            out.append(s)
+        return out
 
     def _loss_fn(self, params, state, features, labels, fmask, lmask, rng,
-                 train, carries=None):
-        h, new_state, new_carries = self._output_layer_input(
+                 train, carries=None, collect_acts=False):
+        h, new_state, new_carries, acts = self._output_layer_input(
             params, state, features, train=train, rng=rng, fmask=fmask,
             carries=carries)
         out_layer = self.layers[-1]
@@ -158,23 +184,30 @@ class MultiLayerNetwork:
         for layer, p in zip(self.layers, params):
             reg = reg + layer.reg_score(p)
         score = score + reg
+        if collect_acts:
+            # aux grows a third slot ONLY on the stats-collecting step
+            # variant — every default-path caller keeps the 2-tuple aux
+            return score, (new_state, new_carries,
+                           self._act_summaries(acts))
         return score, (new_state, new_carries)
 
     # ------------------------------------------------------------------
     # The fused train step (jitted, donated)
     # ------------------------------------------------------------------
-    def make_grad_fn(self):
-        """(params, state, batch) -> (grads, score, new_state, new_carries).
-        The gradient half of the step — what an async parameter-server worker
-        computes on a (possibly stale) parameter snapshot (reference
-        ParameterServerParallelWrapper.java worker push path)."""
+    def make_grad_fn(self, collect_acts=False):
+        """(params, state, batch) -> (grads, score, new_state, new_carries
+        [, act_summaries]). The gradient half of the step — what an async
+        parameter-server worker computes on a (possibly stale) parameter
+        snapshot (reference ParameterServerParallelWrapper.java worker push
+        path). collect_acts=True appends the on-device activation
+        summaries of the training forward (BaseStatsListener role)."""
         def grad_fn(params, state, batch):
-            (score, (new_state, new_carries)), grads = jax.value_and_grad(
+            (score, aux), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(
                     params, state, batch["features"], batch["labels"],
                     batch.get("fmask"), batch.get("lmask"), batch["rng"],
-                    True, batch.get("carries"))
-            return grads, score, new_state, new_carries
+                    True, batch.get("carries"), collect_acts)
+            return (grads, score) + tuple(aux)
         return grad_fn
 
     def make_apply_fn(self):
@@ -219,25 +252,29 @@ class MultiLayerNetwork:
 
         return apply_updates
 
-    def make_raw_step(self):
+    def make_raw_step(self, collect_acts=False):
         """The un-jitted training step over a batch dict — the compilation
         unit shared by the single-chip path, ParallelWrapper's sharded paths,
         and TrainingMaster. batch keys: features, labels, fmask, lmask,
-        iteration, rng, carries (optional)."""
-        grad_fn = self.make_grad_fn()
+        iteration, rng, carries (optional). collect_acts=True appends the
+        on-device activation summaries to the return tuple (the fast path's
+        tuple shape — and compiled program — is untouched when False)."""
+        grad_fn = self.make_grad_fn(collect_acts)
         apply_updates = self.make_apply_fn()
 
         def step(params, ustate, state, batch):
-            grads, score, new_state, new_carries = grad_fn(params, state,
-                                                           batch)
+            grads, score, new_state, new_carries, *acts = grad_fn(
+                params, state, batch)
             new_params, new_ustate = apply_updates(params, ustate, grads,
                                                    batch["iteration"])
-            return new_params, new_ustate, new_state, score, new_carries
+            return ((new_params, new_ustate, new_state, score, new_carries)
+                    + tuple(acts))
 
         return step
 
     def _make_step(self):
-        raw = self.make_raw_step()
+        collect_acts = self._act_stats_cfg is not None
+        raw = self.make_raw_step(collect_acts)
 
         def step(params, ustate, state, loop, features, labels, fmask,
                  lmask, carries=None):
@@ -250,11 +287,31 @@ class MultiLayerNetwork:
             batch = {"features": features, "labels": labels, "fmask": fmask,
                      "lmask": lmask, "iteration": loop["iteration"],
                      "rng": rng, "carries": carries}
-            p, u, s, score, car = raw(params, ustate, state, batch)
+            p, u, s, score, car, *acts = raw(params, ustate, state, batch)
             new_loop = {"iteration": loop["iteration"] + 1.0, "rng": next_rng}
-            return p, u, s, score, car, new_loop
+            return (p, u, s, score, car, new_loop) + tuple(acts)
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def collect_activation_stats(self, enabled=True, max_channels=8,
+                                 max_size=48):
+        """Make the fused train step ALSO emit per-layer activation
+        summaries of the REAL training batch (reference
+        BaseStatsListener.java:273-420 / ConvolutionalIterationListener —
+        activations come from the live forward pass, no extra probe
+        forward). Costs one recompile on toggle plus a few scalars (and
+        small conv grids) of device->host traffic per step; the disabled
+        path compiles the exact same program as before."""
+        cfg = (int(max_channels), int(max_size)) if enabled else None
+        if cfg != self._act_stats_cfg:
+            self._act_stats_cfg = cfg
+            self._jit_step = None              # recompile with/without aux
+            # bump the generation so wrappers caching their own compiled
+            # step (ParallelWrapper) rebuild too
+            self._act_stats_gen = getattr(self, "_act_stats_gen", 0) + 1
+            if not enabled:
+                self._last_activation_stats = None
+        return self
 
     def _loop_state(self):
         if getattr(self, "_loop", None) is None:
@@ -317,9 +374,11 @@ class MultiLayerNetwork:
         self._last_batch_size = int(features.shape[0])
         for _ in range(num_iterations):
             (self._params, self._updater_state, self._model_state,
-             score, _, self._loop) = self._jit_step(
+             score, _, self._loop, *acts) = self._jit_step(
                  self._params, self._updater_state, self._model_state,
                  self._loop_state(), features, labels, fmask, lmask)
+            if acts:
+                self._last_activation_stats = acts[0]
             self._score = score
             self.conf.iteration_count += 1
             for l in self.listeners:
@@ -355,9 +414,11 @@ class MultiLayerNetwork:
             fm_seg = fmask[:, t0:t0 + L] if fmask is not None else None
             lm_seg = lmask[:, t0:t0 + L] if lmask is not None else None
             (self._params, self._updater_state, self._model_state, score,
-             carries, self._loop) = self._jit_step(
+             carries, self._loop, *acts) = self._jit_step(
                  self._params, self._updater_state, self._model_state,
                  self._loop_state(), f_seg, l_seg, fm_seg, lm_seg, carries)
+            if acts:
+                self._last_activation_stats = acts[0]
             # stop gradient flow across segments (truncation) — carries are
             # fresh inputs to the next jitted call, so this is automatic.
             self._score = score
@@ -451,7 +512,7 @@ class MultiLayerNetwork:
         key = ("output", bool(train), fmask is not None)
         if key not in self._jit_forward:
             def fwd(params, state, x, fmask, rng):
-                h, _, _ = self._output_layer_input(params, state, x,
+                h, _, _, _ = self._output_layer_input(params, state, x,
                                                    train=train, rng=rng,
                                                    fmask=fmask)
                 out_layer = self.layers[-1]
@@ -498,7 +559,7 @@ class MultiLayerNetwork:
             self._rnn_state = self._init_carries(B)
         if "rnn_step" not in self._jit_forward:
             def fwd(params, state, x, rng, carries):
-                h, _, new_carries = self._output_layer_input(
+                h, _, new_carries, _ = self._output_layer_input(
                     params, state, x, train=False, rng=rng, carries=carries)
                 out_layer = self.layers[-1]
                 i = len(self.layers) - 1

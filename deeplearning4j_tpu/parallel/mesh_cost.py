@@ -1,0 +1,91 @@
+"""Mesh-program cost telemetry: collective counts/bytes from compiled HLO.
+
+SURVEY §4.6 simulated-pod pattern, taken one step further: beyond proving a
+sharded program RUNS on a virtual mesh, read its compiled HLO and account
+for every cross-device collective — an accidental re-replication (e.g. a
+missing `with_sharding_constraint` turning a ZeRO-partitioned optimizer
+update into an all-gather per step) shows up as a bytes regression here,
+long before any hardware run. `tests/test_collective_budget.py` pins each
+parallelism mode's per-step collective bytes against a committed budget;
+`__graft_entry__.dryrun_multichip` prints the same telemetry per mode.
+
+Entry points:
+  * `hlo_collective_footprint(hlo_text)` — parse a compiled module's text
+    into {op: {"count": n, "bytes": b}} over the collective ops
+    (all-reduce / all-gather / all-to-all / collective-permute /
+    reduce-scatter, plus their async -start forms counted once).
+  * `lowered_footprint(lowered)` — compile a `jax.jit(...).lower(...)`
+    result and return (footprint, memory-analysis-or-None).
+"""
+from __future__ import annotations
+
+import re
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1,
+    "u64": 8, "u32": 4, "u16": 2, "u8": 1,
+    "pred": 1, "c64": 8, "c128": 16,
+}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "all-to-all",
+                "collective-permute", "reduce-scatter")
+
+# `= <result-shape-or-tuple> <op>[-start](`; -done ops alias the -start's
+# buffer and must not double count
+_OP_RE = re.compile(
+    r"=\s+(\([^)]*\)|\S+)\s+(" + "|".join(_COLLECTIVES) + r")(-start)?\(")
+
+_SHAPE_RE = re.compile(
+    r"(" + "|".join(_DTYPE_BYTES) + r")\[([0-9,]*)\]")
+
+
+def shape_bytes(shape_text):
+    """Total bytes of every typed array shape in an HLO type string
+    (handles tuples by summing the components)."""
+    total = 0
+    for m in _SHAPE_RE.finditer(shape_text):
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[m.group(1)]
+    return total
+
+
+def hlo_collective_footprint(hlo_text):
+    """{collective-op: {"count": n, "bytes": b}} over a compiled module's
+    text. Bytes = result-shape bytes (the cross-device traffic proxy XLA
+    exposes without a hardware profile)."""
+    out = {}
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if m is None:
+            continue
+        rec = out.setdefault(m.group(2), {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += shape_bytes(m.group(1))
+    return out
+
+
+def footprint_totals(fp):
+    return {"count": sum(r["count"] for r in fp.values()),
+            "bytes": sum(r["bytes"] for r in fp.values())}
+
+
+def lowered_footprint(lowered):
+    """(collective footprint, memory analysis dict or None) for a
+    `jax.jit(...).lower(...)` result."""
+    compiled = lowered.compile()
+    fp = hlo_collective_footprint(compiled.as_text())
+    mem = None
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            mem = {k: int(getattr(ma, k)) for k in
+                   ("argument_size_in_bytes", "output_size_in_bytes",
+                    "temp_size_in_bytes", "generated_code_size_in_bytes")
+                   if hasattr(ma, k)}
+    except Exception:  # noqa: BLE001 — telemetry must not fail the run
+        pass
+    return fp, mem

@@ -193,45 +193,84 @@ class ParallelWrapper:
         return feats, labels, fmasks, lmasks
 
     # -- mode 1: per-step gradient allreduce (GSPMD via shardings) -----
-    def _fit_allreduce(self, it):
+    def _ensure_allreduce_step(self):
         net = self.model
+        act_gen = getattr(net, "_act_stats_gen", 0)
+        if self._jit_step is not None and \
+                getattr(self, "_act_gen", 0) != act_gen:
+            self._jit_step = None          # activation-stats toggle
         if self._jit_step is None:
-            raw = net.make_raw_step()
+            self._act_gen = act_gen
+            # honor the net's activation-stats mode (StatsListener arming
+            # works identically under the sharded path); the k-local-steps
+            # mode does NOT collect (k batches per program — see
+            # collect_activation_stats docstring)
+            collect = getattr(net, "_act_stats_cfg", None) is not None
+            self._collects_acts = collect
+            # positional only when armed: ComputationGraph's make_raw_step
+            # has no collect_acts parameter (and can never be armed)
+            raw = net.make_raw_step(True) if collect else net.make_raw_step()
             if self._ustate_shardings is not None:
                 inner, shardings = raw, self._ustate_shardings
 
                 def raw(params, ustate, state, batch):
-                    p, u, s, score, car = inner(params, ustate, state, batch)
+                    p, u, s, score, car, *acts = inner(params, ustate,
+                                                       state, batch)
                     # pin the ZeRO layout on the state OUTPUT so GSPMD keeps
                     # the optimizer update partitioned (and the donated input
                     # buffer is reusable) instead of re-replicating it
                     u = jax.tree.map(jax.lax.with_sharding_constraint, u,
                                      shardings)
-                    return p, u, s, score, car
+                    return (p, u, s, score, car) + tuple(acts)
             self._jit_step = jax.jit(raw, donate_argnums=(0, 1, 2))
+        return self._jit_step
+
+    def _sharded_batch(self, ds, step_rng):
+        net = self.model
+        feats, labels, fm, lm = self._canon_parts(ds)
+        put = self._put_batch
+        batch = {
+            "features": jax.tree.map(put, feats),
+            "labels": jax.tree.map(put, labels),
+            "fmask": jax.tree.map(put, fm) if fm is not None else None,
+            "lmask": jax.tree.map(put, lm) if lm is not None else None,
+            "iteration": jnp.asarray(net.conf.iteration_count, jnp.float32),
+            "rng": step_rng,
+        }
+        from .sharding import is_multiprocess_mesh
+        if is_multiprocess_mesh(self.mesh):
+            # host-committed scalars (same value on every process) are
+            # what a multi-process jit accepts; local device arrays are
+            # not addressable across hosts
+            batch["iteration"] = np.float32(net.conf.iteration_count)
+            batch["rng"] = np.asarray(step_rng)
+        return batch, feats
+
+    def lower_step(self, ds):
+        """Lower (trace+compile without executing) the sharded allreduce
+        step for one DataSet — the mesh-cost profiling hook
+        (`mesh_cost.hlo_collective_footprint` reads collective counts/bytes
+        off the compiled HLO to catch sharding regressions without
+        hardware)."""
+        net = self.model
+        self._ensure_sharded()
+        step = self._ensure_allreduce_step()
+        batch, _ = self._sharded_batch(ds, jax.random.PRNGKey(0))
+        return step.lower(net._params, net._updater_state,
+                          net._model_state, batch)
+
+    def _fit_allreduce(self, it):
+        net = self.model
+        step = self._ensure_allreduce_step()
         while it.has_next():
             ds = it.next_batch()
             net._rng, step_rng = jax.random.split(net._rng)
-            feats, labels, fm, lm = self._canon_parts(ds)
-            put = self._put_batch
-            batch = {
-                "features": jax.tree.map(put, feats),
-                "labels": jax.tree.map(put, labels),
-                "fmask": jax.tree.map(put, fm) if fm is not None else None,
-                "lmask": jax.tree.map(put, lm) if lm is not None else None,
-                "iteration": jnp.asarray(net.conf.iteration_count, jnp.float32),
-                "rng": step_rng,
-            }
-            from .sharding import is_multiprocess_mesh
-            if is_multiprocess_mesh(self.mesh):
-                # host-committed scalars (same value on every process) are
-                # what a multi-process jit accepts; local device arrays are
-                # not addressable across hosts
-                batch["iteration"] = np.float32(net.conf.iteration_count)
-                batch["rng"] = np.asarray(step_rng)
+            batch, feats = self._sharded_batch(ds, step_rng)
             (net._params, net._updater_state, net._model_state, score,
-             _) = self._jit_step(net._params, net._updater_state,
-                                 net._model_state, batch)
+             _, *acts) = step(net._params, net._updater_state,
+                              net._model_state, batch)
+            if acts:
+                net._last_activation_stats = acts[0]
             net._score = score
             net._last_batch_size = int(
                 jax.tree.leaves(feats)[0].shape[0])

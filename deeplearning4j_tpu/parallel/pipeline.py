@@ -230,8 +230,7 @@ class PipelineParallel:
         out = self._jit_fwd(self.stacked, self.aux, xs)
         return out.reshape((-1,) + out.shape[2:])
 
-    def fit_batch(self, x, y):
-        """One optimization step over a global batch; returns the loss."""
+    def _ensure_step(self):
         if self._vel is None:
             self._vel = jax.tree.map(jnp.zeros_like,
                                      (self.stacked, self.aux))
@@ -247,11 +246,27 @@ class PipelineParallel:
                 return stacked, aux, vel, loss
 
             self._jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
+        return self._jit_step
+
+    def fit_batch(self, x, y):
+        """One optimization step over a global batch; returns the loss."""
+        step = self._ensure_step()
         xs = self._put_micro(microbatch(np.asarray(x), self.n_micro))
         ys = self._put_micro(microbatch(np.asarray(y), self.n_micro))
         (self.stacked, self.aux, self._vel,
-         loss) = self._jit_step(self.stacked, self.aux, self._vel, xs, ys)
+         loss) = step(self.stacked, self.aux, self._vel, xs, ys)
         return float(loss)
+
+    def lower_step(self, x, y):
+        """Lower (trace+compile without executing) the pipeline step for a
+        global batch — the mesh-cost profiling hook: the caller reads
+        collective counts/bytes off the compiled HLO
+        (`mesh_cost.hlo_collective_footprint`) to catch sharding
+        regressions without hardware."""
+        step = self._ensure_step()
+        xs = self._put_micro(microbatch(np.asarray(x), self.n_micro))
+        ys = self._put_micro(microbatch(np.asarray(y), self.n_micro))
+        return step.lower(self.stacked, self.aux, self._vel, xs, ys)
 
     def _put_micro(self, a):
         """Place a microbatched [M, B_local, ...] numpy array on the mesh.

@@ -251,8 +251,11 @@ class PSClient:
                                               timeout=connect_timeout)
         # operations run UNBOUNDED: a PUSH ack legitimately blocks while
         # the server inbox is full (that block IS the backpressure
-        # contract) — an op timeout here would kill healthy workers
+        # contract) — an op timeout here would kill healthy workers.
+        # SO_KEEPALIVE still detects a silently-dead peer (host power
+        # loss / partition produces no FIN, and recv would hang forever)
         self._sock.settimeout(None)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
 
     @staticmethod
     def _expect(op, want, what):

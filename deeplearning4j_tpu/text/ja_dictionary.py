@@ -141,13 +141,21 @@ class CharacterDefinitions:
         return "DEFAULT"
 
 
-# builtin script-class -> pseudo category used when char.def/unk.def are
-# absent (the curated-lexicon unknown model keeps working on real
-# dictionaries shipped without those files)
+# builtin script-class -> pseudo category used when char.def is absent
+# (the curated-lexicon unknown model keeps working on real dictionaries
+# shipped without that file)
 _FALLBACK_FLAGS = {"katakana": (1, 1, 0), "latin": (1, 1, 0),
                    "digit": (1, 1, 0), "hangul": (1, 1, 0),
                    "han": (0, 0, 3), "hiragana": (0, 0, 3),
                    "other": (0, 0, 2)}
+
+# script-class -> the standard mecab char.def category name, so a
+# dictionary shipping unk.def WITHOUT char.def still has its unknown
+# templates honored (unk.def surfaces use the uppercase category names)
+_FALLBACK_UNK_CATEGORY = {"katakana": "KATAKANA", "latin": "ALPHA",
+                          "digit": "NUMERIC", "han": "KANJI",
+                          "hiragana": "HIRAGANA", "hangul": "HANGUL",
+                          "other": "DEFAULT"}
 
 
 class MecabDictionary:
@@ -196,10 +204,13 @@ class MecabDictionary:
             run = self._run(text, start,
                             lambda ch: self.char_defs.lookup(ch) == cat)
         else:
-            cat = _script(text[start])
+            script = _script(text[start])
             invoke, group, length = _FALLBACK_FLAGS.get(
-                cat, _FALLBACK_FLAGS["other"])
-            run = self._run(text, start, lambda ch: _script(ch) == cat)
+                script, _FALLBACK_FLAGS["other"])
+            run = self._run(text, start, lambda ch: _script(ch) == script)
+            # unk.def (if shipped without char.def) keys by the standard
+            # uppercase category names
+            cat = _FALLBACK_UNK_CATEGORY.get(script, "DEFAULT")
         if had_dict_match and not invoke:
             return []
         templates = self.unk_entries.get(cat) or [

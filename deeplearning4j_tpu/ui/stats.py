@@ -76,6 +76,7 @@ class StatsListener(IterationListener):
         # activations from the forward pass itself; the fused TPU step
         # doesn't surface intermediates, so a probe forward collects them)
         self.activation_probe = activation_probe
+        self._armed_models = set()
         self._last_report_time = None
         self._total_examples = 0
         self._total_minibatches = 0
@@ -129,10 +130,30 @@ class StatsListener(IterationListener):
                     for name, arr in params.items()
                     if name in self._prev_params}
             self._prev_params = params
-        if c.collect_activations and self.activation_probe is not None:
-            acts = self._activation_grids(model)
-            if acts:
-                report["activations"] = acts
+        if c.collect_activations:
+            live = getattr(model, "_last_activation_stats", None)
+            if live is not None:
+                # the fused step emitted summaries of the REAL training
+                # batch (BaseStatsListener.java:273-420 onForwardPass role)
+                report["activationStats"] = self._live_summaries(live)
+                grids = self._live_grids(live)
+                if grids:
+                    report["activations"] = grids
+            elif self.activation_probe is not None:
+                # legacy probe path: an extra forward on a user batch
+                acts = self._activation_grids(model)
+                if acts:
+                    report["activations"] = acts
+            elif (hasattr(model, "collect_activation_stats")
+                  and id(model) not in self._armed_models):
+                # no probe given: arm the fused step to emit summaries
+                # from the next iteration on (one recompile). Armed AT MOST
+                # ONCE per model — if the user later calls
+                # collect_activation_stats(False) explicitly, the listener
+                # must not silently re-arm it
+                self._armed_models.add(id(model))
+                model.collect_activation_stats(
+                    True, c.max_activation_channels, c.max_activation_size)
         self.router.put_update(report)
 
     # ------------------------------------------------------------------
@@ -179,6 +200,36 @@ class StatsListener(IterationListener):
         for i, l in enumerate(layers):
             out[getattr(l, "name", None) or str(i)] = float(
                 l.learning_rate or 0.0)
+        return out
+
+    @staticmethod
+    def _live_summaries(live):
+        """Scalar per-layer stats from the fused step's on-device
+        summaries."""
+        return {str(i): {k: float(v) for k, v in s.items() if k != "grid"}
+                for i, s in enumerate(live)}
+
+    @staticmethod
+    def _norm_grid(g):
+        g = np.asarray(g, np.float64)
+        lo, hi = float(g.min()), float(g.max())
+        return (np.zeros_like(g, np.uint8) if hi <= lo
+                else ((g - lo) / (hi - lo) * 255).astype(np.uint8))
+
+    def _live_grids(self, live):
+        """Conv activation images from the step-emitted downsampled grids
+        (ConvolutionalIterationListener image capture, no probe pass)."""
+        out = {}
+        for i, s in enumerate(live):
+            if "grid" not in s:
+                continue
+            g = np.asarray(s["grid"])           # [h, w, ch], first example
+            grids = [self._norm_grid(g[:, :, ci]).tolist()
+                     for ci in range(g.shape[2])]
+            if grids:
+                out[str(i)] = {"height": len(grids[0]),
+                               "width": len(grids[0][0]),
+                               "channels": grids}
         return out
 
     def _activation_grids(self, model):

@@ -93,6 +93,19 @@ class TestMecabCompile:
         out2 = viterbi_segment_dict("2026に住む", dic)
         assert [s for s, _, _ in out2] == ["2026", "に", "住む"]
 
+    def test_unk_def_without_char_def_still_honored(self, tmp_path):
+        """A dictionary shipping unk.def but no char.def: the builtin
+        script classes map to the standard uppercase category names, so
+        the user's unknown templates apply (not the hardcoded default)."""
+        d = tmp_path / "dict"
+        shutil.copytree(JA, d)
+        os.remove(d / "char.def")
+        dic = compile_dictionary(str(d))
+        assert dic.char_defs is None and "KATAKANA" in dic.unk_entries
+        out = viterbi_segment_dict("コンピュータに住む", dic)
+        assert [s for s, _, _ in out] == ["コンピュータ", "に", "住む"]
+        assert out[0][1][0] == "名詞"            # unk.def template features
+
     def test_compiled_artifact_round_trip(self, tmp_path):
         dic = compile_dictionary(JA, user_dict_path=os.path.join(
             JA, "userdict.txt"))

@@ -1,0 +1,126 @@
+"""Per-mode collective-cost budgets on the virtual 8-device mesh
+(VERDICT r4 item 9; SURVEY §4.6 simulated-pod pattern extended to cost).
+
+Each parallelism mode's training/step program is lowered (never executed),
+its compiled HLO parsed for cross-device collectives, and the totals pinned
+against the committed budget in tests/fixtures/collective_budgets.json —
+a >2x bytes (or count) regression fails, catching e.g. a lost sharding
+constraint that re-replicates the ZeRO-partitioned optimizer state with a
+per-step all-gather. Regenerate the budgets after an INTENTIONAL sharding
+change with:
+
+    UPDATE_COLLECTIVE_BUDGETS=1 python -m pytest \
+        tests/test_collective_budget.py -q
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+from deeplearning4j_tpu.parallel.mesh_cost import (footprint_totals,
+                                                   lowered_footprint)
+
+BUDGET_PATH = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "collective_budgets.json")
+N = 8
+
+
+def _mode_lowerings():
+    """name -> jax lowering for one step of each parallelism mode, the same
+    constructions dryrun_multichip exercises."""
+    devices = jax.devices()[:N]
+    rng = np.random.default_rng(0)
+    out = {}
+
+    # dp x tp with ZeRO-1 sharded optimizer state
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).updater("adam").learning_rate(1e-3).list()
+            .layer(0, ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                       activation="relu"))
+            .layer(1, SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(2, DenseLayer(n_out=32, activation="relu"))
+            .layer(3, OutputLayer(n_out=4, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = make_mesh(n_data=N // 2, n_model=2, devices=devices)
+    pw = (ParallelWrapper.Builder(net).mesh(mesh).tensor_parallel(True)
+          .sharded_updater_state(True).averaging_frequency(1).build())
+    x = rng.random((16, 8, 8, 2)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+    out["dp_tp_zero1"] = pw.lower_step(DataSet(x, y))
+
+    # GPipe pipeline transformer (pipe=4 x data=2)
+    from deeplearning4j_tpu.models.zoo.transformer import (embed_fn, init_lm,
+                                                           lm_loss,
+                                                           make_block_fn)
+    from deeplearning4j_tpu.parallel.pipeline import (PipelineParallel,
+                                                      make_pipeline_mesh)
+    pp_mesh = make_pipeline_mesh(n_pipe=4, n_data=2, devices=devices)
+    aux, blocks = init_lm(11, d_model=16, n_heads=2, n_layers=4,
+                          max_len=8, seed=3)
+    pp = PipelineParallel(make_block_fn(2), blocks, pp_mesh, loss_fn=lm_loss,
+                          aux_params=aux, pre_fn=embed_fn, n_micro=2,
+                          data_axis="data", learning_rate=0.1)
+    xt = rng.integers(0, 11, (8, 8)).astype(np.int32)
+    out["gpipe_pp"] = pp.lower_step(xt, (xt + 1) % 11)
+
+    # ring-attention sequence parallelism
+    from jax.sharding import Mesh
+    from deeplearning4j_tpu.parallel.ring_attention import ring_self_attention
+    seq_mesh = Mesh(np.array(devices), ("seq",))
+    q = jnp.asarray(rng.standard_normal((2, 4 * N, 2, 8)), jnp.float32)
+    out["ring_attention_sp"] = jax.jit(
+        lambda q, k, v: ring_self_attention(q, k, v, seq_mesh, axis="seq",
+                                            causal=True)).lower(q, q, q)
+
+    # Switch-MoE expert parallelism (all_to_all dispatch)
+    from deeplearning4j_tpu.parallel.moe import (init_moe, make_expert_mesh,
+                                                 moe_mlp_sharded,
+                                                 shard_moe_params)
+    ep_mesh = make_expert_mesh(N, devices=devices)
+    moe_p = shard_moe_params(init_moe(jax.random.PRNGKey(0), 16, N, 32),
+                             ep_mesh)
+    xm = jnp.asarray(rng.standard_normal((8 * N, 16)), jnp.float32)
+    out["moe_ep"] = jax.jit(moe_mlp_sharded(ep_mesh)).lower(moe_p, xm)
+    return out
+
+
+def test_collective_bytes_within_budget():
+    if len(jax.devices()) < N:
+        pytest.skip(f"needs {N} virtual devices")
+    measured = {}
+    for name, lowered in _mode_lowerings().items():
+        fp, _ = lowered_footprint(lowered)
+        measured[name] = {**footprint_totals(fp), "ops": fp}
+    if os.environ.get("UPDATE_COLLECTIVE_BUDGETS"):
+        with open(BUDGET_PATH, "w") as f:
+            json.dump(measured, f, indent=1, sort_keys=True)
+        pytest.skip(f"budgets regenerated at {BUDGET_PATH}")
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+    assert set(measured) == set(budget), (
+        "parallelism modes changed — regenerate the budget fixture")
+    for name, got in measured.items():
+        want = budget[name]
+        assert got["bytes"] <= 2 * max(want["bytes"], 1), (
+            f"{name}: per-step collective bytes regressed "
+            f"{want['bytes']} -> {got['bytes']} (>2x budget); if the "
+            f"sharding change is intentional, regenerate the fixture")
+        assert got["count"] <= 2 * max(want["count"], 1), (
+            f"{name}: collective op count regressed "
+            f"{want['count']} -> {got['count']} (>2x budget)")
+    # mode-shape sanity: the ring rides collective-permute, MoE all_to_all
+    assert "collective-permute" in measured["ring_attention_sp"]["ops"]
+    assert any(op.startswith("all-to-all")
+               for op in measured["moe_ep"]["ops"])
