@@ -218,14 +218,14 @@ def bench_resnet50_pipeline(rng, small=False):
             "vs_baseline": round(ips / BASELINE_RESNET50_IMAGES_PER_SEC, 3)}
 
 
-def bench_char_rnn(rng, small=False):
+def _bench_char_rnn_arm(rng, small, scan_unroll):
     import jax
     import numpy as np
 
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.models.zoo.char_rnn import char_rnn
     V, B, T = (77, 8, 50) if small else (77, 64, 200)
-    net = char_rnn(data_type="bfloat16")
+    net = char_rnn(data_type="bfloat16", scan_unroll=scan_unroll)
     x = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
     y = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
     ds = DataSet(jax.device_put(x), jax.device_put(y))
@@ -241,8 +241,25 @@ def bench_char_rnn(rng, small=False):
         float(net._score)
         dt = time.perf_counter() - t0
         cps = max(cps, B * T * iters / dt)
+    return cps, B, T
+
+
+def bench_char_rnn(rng, small=False):
+    cps, B, T = _bench_char_rnn_arm(rng, small, scan_unroll=1)
     return {"value": round(cps, 0), "unit": "chars/sec",
             "config": f"2x200 GravesLSTM, batch {B}, seq {T}, tbptt 50, bf16",
+            "vs_baseline": round(cps / BASELINE_CHARRNN_CHARS_PER_SEC, 3)}
+
+
+def bench_char_rnn_unroll(rng, small=False):
+    """A/B vs `char_rnn_lstm`: lax.scan unroll=8 fuses 8 timesteps per
+    loop body — the obvious LSTM lever for the per-step loop the scan
+    replaces (LSTMHelpers.java:157-171). Identical numerics; compare
+    `value` against the char_rnn_lstm record's."""
+    cps, B, T = _bench_char_rnn_arm(rng, small, scan_unroll=8)
+    return {"value": round(cps, 0), "unit": "chars/sec",
+            "config": f"2x200 GravesLSTM scan-unroll=8, batch {B}, seq {T}, "
+                      f"tbptt 50, bf16 (A/B vs char_rnn_lstm)",
             "vs_baseline": round(cps / BASELINE_CHARRNN_CHARS_PER_SEC, 3)}
 
 
@@ -422,6 +439,7 @@ SECONDARY_CONFIGS = {
     "resnet50_remat": (bench_resnet50_remat, 200),
     "lenet_mnist": (bench_lenet, 90),
     "char_rnn_lstm": (bench_char_rnn, 120),
+    "char_rnn_lstm_unroll": (bench_char_rnn_unroll, 120),
     "word2vec_skipgram": (bench_word2vec, 90),
     "decode_tokens_sec": (bench_decode, 90),
     "resnet50_fit_pipeline": (bench_resnet50_pipeline, 180),
@@ -634,8 +652,8 @@ def main():
             # from SECONDARY_CONFIGS so a renamed/added config can't drift
             # out of the second window silently
             backlog_first = ("resnet50_remat", "flash_attention_8k",
-                             "char_rnn_lstm", "decode_tokens_sec",
-                             "resnet50_fit_pipeline")
+                             "char_rnn_lstm", "char_rnn_lstm_unroll",
+                             "decode_tokens_sec", "resnet50_fit_pipeline")
             rerun_order = ([n for n in backlog_first
                             if n in SECONDARY_CONFIGS]
                            + [n for n in SECONDARY_CONFIGS

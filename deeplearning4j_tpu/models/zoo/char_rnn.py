@@ -14,7 +14,7 @@ from ...nn.conf.neural_net_configuration import NeuralNetConfiguration
 
 def char_rnn_conf(vocab_size=77, hidden=200, layers=2, tbptt_length=50,
                   seed=12345, learning_rate=0.1, updater="rmsprop",
-                  data_type="float32"):
+                  data_type="float32", scan_unroll=1):
     b = (NeuralNetConfiguration.Builder()
          .seed(seed)
          .updater(updater)
@@ -23,7 +23,8 @@ def char_rnn_conf(vocab_size=77, hidden=200, layers=2, tbptt_length=50,
          .data_type(data_type)
          .list())
     for i in range(layers):
-        b.layer(i, GravesLSTM(n_out=hidden, activation="tanh"))
+        b.layer(i, GravesLSTM(n_out=hidden, activation="tanh",
+                              scan_unroll=scan_unroll))
     b.layer(layers, RnnOutputLayer(n_out=vocab_size, activation="softmax",
                                    loss_function="mcxent"))
     return (b.set_input_type(InputType.recurrent(vocab_size))

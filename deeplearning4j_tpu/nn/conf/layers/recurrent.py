@@ -70,6 +70,14 @@ class GravesLSTM(BaseRecurrentLayer):
     n_out: int = None
     forget_gate_bias_init: float = 1.0
     gate_activation: str = "sigmoid"
+    # lax.scan unroll factor: >1 lets XLA fuse several timesteps into one
+    # loop body (fewer loop-carried DMA round trips on TPU, bigger fused
+    # elementwise chains) at compile-time/code-size cost. Same math,
+    # different fusion — equivalent to float-reassociation tolerance;
+    # bench A/B `char_rnn_lstm_unroll` measures the win on chip.
+    # reference seam: LSTMHelpers.java:157-171 (the per-timestep loop
+    # this scan replaces).
+    scan_unroll: int = 1
 
     def set_n_in(self, input_type, override=True):
         if self.n_in is None or override:
@@ -144,7 +152,8 @@ class GravesLSTM(BaseRecurrentLayer):
             return (h_keep, c_keep), m * h_new
 
         xs = xz_t if mask_t is None else (xz_t, mask_t)
-        (hT, cT), out_t = lax.scan(step, (h0, c0), xs)
+        (hT, cT), out_t = lax.scan(step, (h0, c0), xs,
+                                   unroll=max(1, int(self.scan_unroll or 1)))
         out = jnp.swapaxes(out_t, 0, 1)             # [B, T, H]
         return out, {"h": hT, "c": cT}
 

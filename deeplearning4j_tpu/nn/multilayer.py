@@ -373,6 +373,11 @@ class MultiLayerNetwork:
         lmask = jnp.asarray(ds.labels_mask) if ds.labels_mask is not None else None
         self._last_batch_size = int(features.shape[0])
         for _ in range(num_iterations):
+            if self._jit_step is None:
+                # a StatsListener may arm activation stats from
+                # iteration_done MID-fit (invalidating the step); rebuild
+                # rather than crash on the next iteration
+                self._jit_step = self._make_step()
             (self._params, self._updater_state, self._model_state,
              score, _, self._loop, *acts) = self._jit_step(
                  self._params, self._updater_state, self._model_state,
@@ -409,6 +414,8 @@ class MultiLayerNetwork:
         self._last_batch_size = B
         seq_labels = labels.ndim >= 3
         for t0 in range(0, T, L):
+            if self._jit_step is None:     # mid-fit arming (see _fit_batch)
+                self._jit_step = self._make_step()
             f_seg = features[:, t0:t0 + L]
             l_seg = labels[:, t0:t0 + L] if seq_labels else labels
             fm_seg = fmask[:, t0:t0 + L] if fmask is not None else None

@@ -261,8 +261,11 @@ class ParallelWrapper:
 
     def _fit_allreduce(self, it):
         net = self.model
-        step = self._ensure_allreduce_step()
         while it.has_next():
+            # re-checked per batch: a StatsListener may arm activation
+            # stats from iteration_done mid-fit (generation bump); the
+            # cached-step fast path is one attribute compare
+            step = self._ensure_allreduce_step()
             ds = it.next_batch()
             net._rng, step_rng = jax.random.split(net._rng)
             batch, feats = self._sharded_batch(ds, step_rng)

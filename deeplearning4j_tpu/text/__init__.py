@@ -3,6 +3,7 @@ from .annotation import (AnnotatedDocument, Annotation,
                          PosAnnotator, SentenceAnnotator,
                          StemAnnotator, TokenAnnotator,
                          standard_pipeline)
+from .pos_model import (PerceptronPosTagger, TrainedPosAnnotator)
 from .cjk_tokenization import (ChineseTokenizerFactory,
                                JapaneseTokenizerFactory,
                                KoreanTokenizerFactory)
@@ -48,7 +49,8 @@ __all__ = [
     "LabelledDocument", "LabelsSource", "LowCasePreProcessor",
     "NGramTokenizerFactory", "SentenceIterator", "SimpleLabelAwareIterator",
     "StemmingPreprocessor", "TfidfVectorizer", "TokenPreProcess",
-    "PosAnnotator", "SentenceAnnotator", "StemAnnotator",
+    "PerceptronPosTagger", "PosAnnotator", "SentenceAnnotator",
+    "StemAnnotator", "TrainedPosAnnotator",
     "TokenAnnotator", "Tokenizer", "TokenizerFactory", "porter_stem",
     "standard_pipeline",
 ]

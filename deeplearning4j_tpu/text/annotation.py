@@ -174,9 +174,16 @@ class AnnotationPipeline:
         return doc
 
 
-def standard_pipeline(tokenizer_factory=None):
+def standard_pipeline(tokenizer_factory=None, pos_model=None):
     """sentence -> token -> stem -> pos, the reference's default UIMA
-    aggregate."""
+    aggregate. `pos_model` (a `pos_model.PerceptronPosTagger` or a path to
+    a serialized model) swaps the suffix-heuristic tagger for the trained
+    one — the reference's PoStagger-loads-OpenNLP-model mechanism."""
+    if pos_model is not None:
+        from .pos_model import TrainedPosAnnotator
+        tagger = TrainedPosAnnotator(pos_model)
+    else:
+        tagger = PosAnnotator()
     return AnnotationPipeline(SentenceAnnotator(),
                               TokenAnnotator(tokenizer_factory),
-                              StemAnnotator(), PosAnnotator())
+                              StemAnnotator(), tagger)

@@ -1,0 +1,178 @@
+"""Trained statistical POS tagging with a serialized model format.
+
+The reference's UIMA annotators wrap TRAINED OpenNLP maxent models
+(deeplearning4j-nlp-uima PoStagger / text/corpora/treeparser/TreeParser.java
+loads en-pos-maxent.bin etc.); `annotation.PosAnnotator` is the offline
+suffix-heuristic stand-in. This module closes the mechanism gap: a
+greedy averaged-perceptron tagger (the shape of OpenNLP's beam=1 maxent
+decoder — per-token feature templates over word form, affixes and the
+previous tags) with train / save / load, so annotators are driven by a
+serialized trained model exactly like the reference, and models can be
+retrained on any tagged corpus. A tiny trained fixture is committed at
+tests/fixtures/pos_model.json.gz (trained by tools/train_pos_fixture.py)
+the same way the CIFAR/LFW format fixtures drive the data parsers.
+
+Model format: gzip JSON — {"format": "dl4j-tpu-pos-perceptron", "version",
+"tags": [...], "weights": {feature: {tag: float}}}. Features are string
+templates (below); weights are the AVERAGED perceptron weights.
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import os
+
+FORMAT_NAME = "dl4j-tpu-pos-perceptron"
+FORMAT_VERSION = 1
+
+START = ("-START-", "-START2-")
+
+
+def _features(i, word, context, prev, prev2):
+    """OpenNLP-style templates: word form, affixes, shape, neighbors and
+    the two previous predicted tags."""
+    w = word.lower()
+    feats = {
+        "bias",
+        f"w={w}",
+        f"suf3={w[-3:]}",
+        f"suf2={w[-2:]}",
+        f"suf1={w[-1:]}",
+        f"pre1={w[:1]}",
+        f"t-1={prev}",
+        f"t-2={prev2}",
+        f"t-1&w={prev}&{w}",
+        f"w-1={context[i - 1]}",
+        f"w+1={context[i + 1]}",
+    }
+    if word[:1].isupper() and i > 0:
+        feats.add("cap")
+    if any(c.isdigit() for c in word):
+        feats.add("digit")
+    if "-" in word:
+        feats.add("hyphen")
+    return feats
+
+
+class PerceptronPosTagger:
+    """Greedy left-to-right averaged perceptron tagger."""
+
+    def __init__(self, weights=None, tags=None):
+        self.weights = weights or {}       # feature -> {tag: weight}
+        self.tags = list(tags or [])
+
+    # -- inference ---------------------------------------------------------
+    def _predict(self, feats):
+        scores = dict.fromkeys(self.tags, 0.0)
+        for f in feats:
+            wf = self.weights.get(f)
+            if wf is None:
+                continue
+            for tag, w in wf.items():
+                scores[tag] += w
+        # deterministic argmax (score, then tag name)
+        return max(self.tags, key=lambda t: (scores[t], t))
+
+    def tag(self, words):
+        """[(word, tag)] for a tokenized sentence."""
+        context = [w.lower() for w in words]
+        context = ["-BOS-"] + context + ["-EOS-"]
+        prev, prev2 = START
+        out = []
+        for i, word in enumerate(words):
+            t = self._predict(_features(i + 1, word, context, prev, prev2))
+            out.append((word, t))
+            prev2, prev = prev, t
+        return out
+
+    # -- training ----------------------------------------------------------
+    @classmethod
+    def train(cls, sentences, epochs=8, seed=0):
+        """sentences: iterable of [(word, tag)] pairs. Averaged perceptron:
+        on a wrong greedy prediction, +1 the gold tag's feature weights and
+        -1 the predicted tag's; final weights are the average over every
+        update step (stabilizes the tiny-corpus case)."""
+        import random
+
+        sents = [list(s) for s in sentences]
+        tags = sorted({t for s in sents for _, t in s})
+        self = cls(weights={}, tags=tags)
+        totals = {}                        # (feat, tag) -> accumulated
+        stamps = {}                        # (feat, tag) -> step of last chg
+        step = 0
+        rng = random.Random(seed)
+
+        def upd(feat, tag, delta):
+            key = (feat, tag)
+            cur = self.weights.setdefault(feat, {}).get(tag, 0.0)
+            totals[key] = totals.get(key, 0.0) + (step - stamps.get(key, 0)) * cur
+            stamps[key] = step
+            self.weights[feat][tag] = cur + delta
+
+        for _ in range(epochs):
+            rng.shuffle(sents)
+            for sent in sents:
+                words = [w for w, _ in sent]
+                context = ["-BOS-"] + [w.lower() for w in words] + ["-EOS-"]
+                prev, prev2 = START
+                for i, (word, gold) in enumerate(sent):
+                    feats = _features(i + 1, word, context, prev, prev2)
+                    guess = self._predict(feats)
+                    if guess != gold:
+                        for f in feats:
+                            upd(f, gold, +1.0)
+                            upd(f, guess, -1.0)
+                    # gold tags feed the history during training
+                    # (teacher forcing, the OpenNLP training regime)
+                    prev2, prev = prev, gold
+                    step += 1
+        # finalize averages
+        for (feat, tag), total in totals.items():
+            cur = self.weights[feat][tag]
+            avg = (total + (step - stamps[(feat, tag)]) * cur) / max(step, 1)
+            if abs(avg) > 1e-9:
+                self.weights[feat][tag] = round(avg, 6)
+            else:
+                del self.weights[feat][tag]
+        self.weights = {f: wf for f, wf in self.weights.items() if wf}
+        return self
+
+    # -- serialization -----------------------------------------------------
+    def save(self, path):
+        doc = {"format": FORMAT_NAME, "version": FORMAT_VERSION,
+               "tags": self.tags, "weights": self.weights}
+        with gzip.open(path, "wt", encoding="utf-8") as f:
+            json.dump(doc, f)
+
+    @classmethod
+    def load(cls, path):
+        with gzip.open(path, "rt", encoding="utf-8") as f:
+            doc = json.load(f)
+        if doc.get("format") != FORMAT_NAME:
+            raise ValueError(f"not a {FORMAT_NAME} model: {path!r}")
+        if doc.get("version", 0) > FORMAT_VERSION:
+            raise ValueError(f"model version {doc['version']} newer than "
+                             f"supported {FORMAT_VERSION}")
+        return cls(weights=doc["weights"], tags=doc["tags"])
+
+
+class TrainedPosAnnotator:
+    """Annotator driven by a serialized trained model — the reference
+    PoStagger mechanism (load model, annotate `pos` features), replacing
+    the suffix-heuristic `PosAnnotator` when a model is available."""
+
+    def __init__(self, model):
+        if isinstance(model, (str, os.PathLike)):
+            model = PerceptronPosTagger.load(os.fspath(model))
+        self.model = model
+
+    def process(self, doc):
+        for sent in doc.select("sentence"):
+            toks = [t for t in doc.select("token")
+                    if t.begin >= sent.begin and t.end <= sent.end]
+            words = [t.features.get("text", t.covered_text(doc.text))
+                     for t in toks]
+            if not words:
+                continue
+            for t, (_, tag) in zip(toks, self.model.tag(words)):
+                t.features["pos"] = tag

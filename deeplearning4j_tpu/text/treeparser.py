@@ -147,8 +147,9 @@ class TreeParser:
     """reference: treeparser/TreeParser.java (getTrees / getTreesWithLabels
     over UIMA sentence+token annotations)."""
 
-    def __init__(self, tokenizer_factory=None):
-        self.pipeline = standard_pipeline(tokenizer_factory)
+    def __init__(self, tokenizer_factory=None, pos_model=None):
+        self.pipeline = standard_pipeline(tokenizer_factory,
+                                          pos_model=pos_model)
 
     def get_trees(self, text, pre_processor=None):
         """One S tree per sentence."""

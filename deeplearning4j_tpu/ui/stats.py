@@ -76,7 +76,6 @@ class StatsListener(IterationListener):
         # activations from the forward pass itself; the fused TPU step
         # doesn't surface intermediates, so a probe forward collects them)
         self.activation_probe = activation_probe
-        self._armed_models = set()
         self._last_report_time = None
         self._total_examples = 0
         self._total_minibatches = 0
@@ -145,13 +144,14 @@ class StatsListener(IterationListener):
                 if acts:
                     report["activations"] = acts
             elif (hasattr(model, "collect_activation_stats")
-                  and id(model) not in self._armed_models):
+                  and not getattr(model, "_stats_listener_armed", False)):
                 # no probe given: arm the fused step to emit summaries
                 # from the next iteration on (one recompile). Armed AT MOST
-                # ONCE per model — if the user later calls
-                # collect_activation_stats(False) explicitly, the listener
+                # ONCE per model (flag ON the model — an id() set would
+                # alias recycled addresses) — if the user later calls
+                # collect_activation_stats(False) explicitly, listeners
                 # must not silently re-arm it
-                self._armed_models.add(id(model))
+                model._stats_listener_armed = True
                 model.collect_activation_stats(
                     True, c.max_activation_channels, c.max_activation_size)
         self.router.put_update(report)

@@ -1,0 +1,64 @@
+"""CJK dictionary ingestion: compile a mecab-format dictionary (token
+CSVs + matrix.def + char.def + unk.def) and a Kuromoji-format user
+dictionary into the Japanese Viterbi lattice, and load a KoreanText-layout
+wordlist directory into the Korean analyzer.
+
+reference: com/atilika/kuromoji/ipadic/compile/DictionaryCompiler.java,
+dict/UserDictionary.java; deeplearning4j-nlp-korean KoreanTokenizer.java.
+"""
+import _common  # noqa: F401
+
+import os
+
+from deeplearning4j_tpu.text import (JapaneseLatticeTokenizer,
+                                     JapaneseLatticeTokenizerFactory,
+                                     KoreanMorphTokenizer,
+                                     KoreanMorphTokenizerFactory,
+                                     compile_dictionary, load_dictionary)
+
+FIX = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "fixtures")
+
+# --- Japanese: mecab-format dictionary + user dictionary ------------------
+ja = os.path.join(FIX, "ja_dict")
+fac = JapaneseLatticeTokenizerFactory(
+    dict_path=ja, user_dict_path=os.path.join(ja, "userdict.txt"))
+toks = fac.create("関西国際空港に行った")
+got = toks.get_tokens()                 # consumes, reference semantics
+print("user-dict segmentation:", "|".join(got), toks.pos_tags)
+assert got == ["関西", "国際", "空港", "に", "行った"]
+
+# the dictionary's costs pick 東京都 over 東京+都; the bundled lexicon
+# (no dict_path) segments by ITS costs — ingestion really changes behavior
+withdict = fac.create("東京都に住む").get_tokens()
+builtin = JapaneseLatticeTokenizer("東京都に住む").get_tokens()
+print("fixture dict:", "|".join(withdict), " builtin:", "|".join(builtin))
+assert withdict == ["東京都", "に", "住む"] and withdict != builtin
+
+# unknown words still segment via unk.def categories (katakana grouped)
+unk = fac.create("コンピュータに住む").get_tokens()
+assert unk == ["コンピュータ", "に", "住む"]
+
+# compiled-artifact round trip (the DictionaryCompiler output role)
+import tempfile
+dic = compile_dictionary(ja)
+with tempfile.TemporaryDirectory() as td:
+    p = os.path.join(td, "compiled.json")
+    dic.save_compiled(p)
+    from deeplearning4j_tpu.text import MecabDictionary
+    dic2 = MecabDictionary.load_compiled(p)
+    assert (JapaneseLatticeTokenizer("東京都に住む",
+                                     dictionary=dic2).get_tokens()
+            == ["東京都", "に", "住む"])
+
+# --- Korean: wordlist directory + runtime extension -----------------------
+ko = load_dictionary(os.path.join(FIX, "ko_dict"))
+kfac = KoreanMorphTokenizerFactory(dictionary=ko)
+ko_got = kfac.create("바다는 넓다").get_tokens()
+print("korean:", ko_got)
+assert ko_got == ["바다", "는", "넓", "다"]
+assert KoreanMorphTokenizer("바다").get_tokens() == ["바", "다"]  # heuristic
+ko.add_words("noun", ["도자기"])                 # addNounsToDictionary role
+assert kfac.create("도자기").get_tokens() == ["도자기"]
+
+print(True)

@@ -96,6 +96,7 @@ def _mode_lowerings():
     return out
 
 
+@pytest.mark.slow
 def test_collective_bytes_within_budget():
     if len(jax.devices()) < N:
         pytest.skip(f"needs {N} virtual devices")

@@ -187,6 +187,7 @@ class TestGraphGradients:
         net = ComputationGraph(conf).init()
         assert check_gradients(net, x, y, max_rel_error=1e-4, subset=60)
 
+    @pytest.mark.slow
     def test_gradcheck_duplicate_to_timeseries(self):
         r = _rng()
         B, T, F = 4, 5, 3

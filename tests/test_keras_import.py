@@ -233,6 +233,7 @@ def test_unsupported_layer_raises():
 # Functional Model -> ComputationGraph (reference KerasModel.java:57)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_functional_import_real_keras_fixture():
     """Committed h5 written by an actual Keras installation (generator:
     tests/fixtures/make_keras_fixture.py): Conv branches + Add + Concatenate

@@ -120,6 +120,7 @@ class TestPipelinedTransformer:
             last = pp.fit_batch(x, y)
         assert last < first * 0.5, (first, last)
 
+    @pytest.mark.slow
     def test_stage_params_sharded_over_pipe(self):
         V, D = 11, 32
         mesh = make_pipeline_mesh(n_pipe=4, n_data=2)
@@ -189,17 +190,20 @@ class TestPipelinedTransformer:
             lm.generate_batch(np.zeros((2, 10), np.int32),
                               max_new_tokens=10)
 
-    def test_generate_batch_jit_cache_is_bounded_lru(self):
+    def test_generate_batch_jit_cache_is_bounded_lru(self, monkeypatch):
         """A serving workload with varied (B, P, n_new) shapes must not
         accumulate compiled programs without bound; re-use must not
-        re-trace (the hot key stays resident under eviction pressure)."""
+        re-trace (the hot key stays resident under eviction pressure).
+        Cache cap patched to 3 so the eviction path is exercised with a
+        handful of compiles instead of GEN_JIT_CACHE_SIZE+4 of them."""
         from deeplearning4j_tpu.models.zoo import transformer as tr
+        monkeypatch.setattr(tr, "GEN_JIT_CACHE_SIZE", 3)
         lm = TransformerLM(11, d_model=16, n_heads=2, n_layers=1,
                            max_len=32)
         hot = np.zeros((1, 2), np.int32)
         lm.generate_batch(hot, max_new_tokens=1)
         hot_fn = lm._jit_gen_cache[(1, 2, 1)]
-        for p in range(3, 3 + tr.GEN_JIT_CACHE_SIZE + 4):
+        for p in range(3, 3 + tr.GEN_JIT_CACHE_SIZE + 2):
             lm.generate_batch(np.zeros((1, p), np.int32),
                               max_new_tokens=1)
             lm.generate_batch(hot, max_new_tokens=1)   # LRU touch

@@ -204,6 +204,7 @@ class TestRingFlashPath:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5)
 
+    @pytest.mark.slow
     def test_fused_ring_backward_bf16(self):
         """bf16 chunks: per-hop partials come back f32 and are rounded
         ONCE after the ring, tracking the f32 reference within bf16

@@ -48,6 +48,25 @@ class TestLSTMShapes:
         # W 3*20 + RW 5*20 + b 20 + peep 15 = 60+100+20+15 = 195; out 5*3+3=18
         assert net.num_params() == 195 + 18
 
+    def test_scan_unroll_equivalent_numerics(self):
+        """scan_unroll is a scheduling knob (lax.scan unroll=N): the same
+        math with different XLA fusion, so forward and a masked training
+        step match unroll=1 to float-reassociation tolerance — the bench
+        A/B `char_rnn_lstm_unroll` measures speed only."""
+        x, y = seq_data(dtype=np.float32)
+        mask = np.ones((4, 6), np.float32)
+        mask[2, 4:] = 0.0
+        outs, scores = [], []
+        for unroll in (1, 4):
+            net = MultiLayerNetwork(rnn_conf(
+                GravesLSTM(n_out=5, scan_unroll=unroll),
+                data_type="float32")).init()
+            outs.append(np.asarray(net.output(x, features_mask=mask)))
+            net.fit(DataSet(x, y, features_mask=mask))
+            scores.append(float(net._score))
+        np.testing.assert_allclose(outs[0], outs[1], atol=1e-6)
+        assert abs(scores[0] - scores[1]) < 1e-5
+
     def test_bidirectional_shape(self):
         net = MultiLayerNetwork(rnn_conf(GravesBidirectionalLSTM(n_out=5),
                                          data_type="float32")).init()

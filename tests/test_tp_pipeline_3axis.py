@@ -40,6 +40,7 @@ def _dense_params_from_tp(tp):
 
 
 class TestTensorParallelBlock:
+    @pytest.mark.slow
     def test_tp_block_matches_dense_block(self):
         """Head/hidden-sharded block over a 4-way model axis == the dense
         single-device block, to float tolerance."""
@@ -83,6 +84,7 @@ class TestThreeAxisPipeline:
             param_specs=tp_block_specs("pipe", "model"))
         return pp, aux, blocks
 
+    @pytest.mark.slow
     def test_loss_matches_sequential(self):
         """(data=2, model=2, pipe=2) pipelined+TP loss == running the
         dense-layout blocks sequentially on one device."""

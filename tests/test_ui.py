@@ -302,6 +302,37 @@ def test_activation_stats_from_fused_step_no_probe():
     assert net._act_stats_cfg is None
 
 
+def test_activation_arming_mid_fit_over_iterator():
+    """The listener arms the model from iteration_done MID-fit; the
+    remaining batches of the same fit() call must rebuild the step, not
+    crash on a nulled _jit_step (r5 review finding, reproduced: 2-batch
+    iterator fit died with TypeError on batch 2)."""
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+    conf = (NeuralNetConfiguration.Builder().seed(8)
+            .updater("sgd").learning_rate(0.01).list()
+            .layer(0, ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                       activation="relu"))
+            .layer(1, OutputLayer(n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.convolutional(10, 10, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    r = np.random.default_rng(9)
+    x = r.random((12, 10, 10, 1)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.integers(0, 3, 12)]
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(
+        storage, StatsUpdateConfiguration(collect_activations=True),
+        session_id="midfit"))
+    batches = list(DataSet(x, y).batch_by(4))       # 3 batches, ONE fit
+    net.fit(ListDataSetIterator(batches))
+    ups = storage.get_all_updates("midfit")
+    assert len(ups) == 3
+    # armed at iteration 0 -> iterations 1+ carry live stats
+    assert "activationStats" in ups[-1]
+
+
 def test_activation_stats_under_parallel_wrapper():
     """The sharded allreduce path honors the activation-stats arming the
     same way the single-chip step does (a PW-trained net with
@@ -325,8 +356,12 @@ def test_activation_stats_under_parallel_wrapper():
         storage, StatsUpdateConfiguration(collect_activations=True),
         session_id="pw1"))
     pw = ParallelWrapper.Builder(net).averaging_frequency(1).build()
-    for _ in range(3):
-        pw.fit(DataSet(x, y))
+    # ONE fit over a 3-batch iterator: mid-fit arming must take effect
+    # within the same fit call (the step is re-ensured per batch)
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    batches = list(DataSet(np.concatenate([x, x, x]),
+                           np.concatenate([y, y, y])).batch_by(8))
+    pw.fit(ListDataSetIterator(batches))
     last = storage.get_all_updates("pw1")[-1]
     assert "activationStats" in last and "0" in last["activationStats"]
 

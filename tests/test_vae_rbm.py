@@ -30,6 +30,7 @@ class TestVAE:
             reconstruction_distribution=dist, **kw
         ).apply_global_defaults({"weight_init": "xavier"})
 
+    @pytest.mark.slow
     def test_elbo_gradcheck_gaussian(self):
         """Numerical-vs-analytic gradients of the negative ELBO (the
         reference's VaeGradientCheckTests approach)."""

@@ -1,0 +1,143 @@
+"""Train the committed POS-model fixture (tests/fixtures/pos_model.json.gz).
+
+The corpus below is a small hand-tagged PTB-tagset sample authored for this
+repo (the role OpenNLP's training corpora play for the reference's
+en-pos-maxent.bin). Rerun after changing the tagger or corpus:
+
+    python tools/train_pos_fixture.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.text.pos_model import PerceptronPosTagger  # noqa: E402
+
+
+def _parse(block):
+    """'word/TAG word/TAG ...' lines -> [[(word, tag)]]."""
+    out = []
+    for line in block.strip().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        out.append([tuple(tok.rsplit("/", 1)) for tok in line.split()])
+    return out
+
+
+TRAIN = _parse("""
+the/DT cat/NN sat/VBD on/IN the/DT mat/NN ./.
+a/DT dog/NN chased/VBD the/DT quick/JJ fox/NN ./.
+she/PRP reads/VBZ a/DT good/JJ book/NN every/DT day/NN ./.
+they/PRP are/VBP walking/VBG to/TO the/DT old/JJ market/NN ./.
+he/PRP will/MD buy/VB three/CD new/JJ cars/NNS tomorrow/NN ./.
+John/NNP gave/VBD Mary/NNP a/DT small/JJ gift/NN ./.
+the/DT children/NNS played/VBD happily/RB in/IN the/DT park/NN ./.
+we/PRP have/VBP seen/VBN many/JJ beautiful/JJ birds/NNS ./.
+i/PRP can/MD run/VB very/RB fast/RB ./.
+the/DT weather/NN was/VBD cold/JJ and/CC windy/JJ yesterday/NN ./.
+my/PRP$ brother/NN works/VBZ at/IN a/DT big/JJ bank/NN ./.
+students/NNS should/MD study/VB hard/RB for/IN exams/NNS ./.
+the/DT red/JJ car/NN stopped/VBD near/IN the/DT bridge/NN ./.
+birds/NNS fly/VBP south/RB in/IN winter/NN ./.
+this/DT machine/NN makes/VBZ strange/JJ noises/NNS ./.
+Sarah/NNP quickly/RB finished/VBD her/PRP$ long/JJ report/NN ./.
+the/DT team/NN has/VBZ won/VBN five/CD games/NNS ./.
+old/JJ houses/NNS need/VBP constant/JJ repairs/NNS ./.
+he/PRP was/VBD eating/VBG lunch/NN with/IN his/PRP$ friends/NNS ./.
+the/DT river/NN flows/VBZ slowly/RB through/IN the/DT valley/NN ./.
+you/PRP must/MD clean/VB your/PRP$ room/NN today/NN ./.
+two/CD large/JJ ships/NNS arrived/VBD at/IN the/DT port/NN ./.
+the/DT teacher/NN explained/VBD the/DT difficult/JJ lesson/NN ./.
+it/PRP rains/VBZ heavily/RB during/IN the/DT summer/NN ./.
+farmers/NNS grow/VBP rice/NN and/CC wheat/NN here/RB ./.
+the/DT small/JJ girl/NN smiled/VBD at/IN her/PRP$ mother/NN ./.
+Tom/NNP and/CC Anna/NNP visited/VBD the/DT museum/NN ./.
+these/DT flowers/NNS bloom/VBP early/RB in/IN spring/NN ./.
+the/DT committee/NN will/MD discuss/VB the/DT plan/NN ./.
+he/PRP dropped/VBD the/DT heavy/JJ box/NN suddenly/RB ./.
+wolves/NNS hunt/VBP in/IN organized/VBN packs/NNS ./.
+the/DT new/JJ president/NN promised/VBD major/JJ changes/NNS ./.
+she/PRP is/VBZ writing/VBG another/DT mystery/NN novel/NN ./.
+workers/NNS built/VBD a/DT tall/JJ tower/NN quickly/RB ./.
+the/DT library/NN opens/VBZ at/IN nine/CD ./.
+i/PRP saw/VBD a/DT movie/NN about/IN ancient/JJ Rome/NNP ./.
+dogs/NNS bark/VBP loudly/RB at/IN strangers/NNS ./.
+the/DT price/NN of/IN oil/NN rose/VBD sharply/RB ./.
+many/JJ people/NNS enjoy/VBP quiet/JJ evenings/NNS ./.
+the/DT artist/NN painted/VBD a/DT wonderful/JJ portrait/NN ./.
+we/PRP were/VBD waiting/VBG for/IN the/DT late/JJ train/NN ./.
+the/DT company/NN sells/VBZ modern/JJ furniture/NN ./.
+children/NNS learn/VBP languages/NNS easily/RB ./.
+a/DT strong/JJ wind/NN damaged/VBD several/JJ roofs/NNS ./.
+the/DT doctor/NN examined/VBD the/DT young/JJ patient/NN carefully/RB ./.
+lions/NNS sleep/VBP during/IN the/DT hot/JJ afternoon/NN ./.
+the/DT students/NNS asked/VBD interesting/JJ questions/NNS ./.
+her/PRP$ garden/NN looks/VBZ lovely/JJ in/IN June/NNP ./.
+the/DT train/NN from/IN Boston/NNP arrived/VBD on/IN time/NN ./.
+he/PRP repaired/VBD the/DT broken/VBN fence/NN yesterday/NN ./.
+our/PRP$ neighbors/NNS moved/VBD to/TO Chicago/NNP last/JJ month/NN ./.
+the/DT chef/NN cooked/VBD a/DT delicious/JJ meal/NN ./.
+bees/NNS make/VBP sweet/JJ honey/NN from/IN flowers/NNS ./.
+the/DT judge/NN listened/VBD to/TO both/DT sides/NNS patiently/RB ./.
+snow/NN covered/VBD the/DT entire/JJ village/NN ./.
+the/DT gardener/NN watered/VBD the/DT dry/JJ plants/NNS ./.
+he/PRP painted/VBD his/PRP$ house/NN white/JJ ./.
+she/PRP lost/VBD her/PRP$ silver/JJ ring/NN ./.
+the/DT boy/NN kicked/VBD a/DT red/JJ ball/NN ./.
+green/JJ leaves/NNS fall/VBP in/IN autumn/NN ./.
+tall/JJ trees/NNS grow/VBP near/IN the/DT river/NN ./.
+the/DT engine/NN started/VBD loudly/RB ./.
+the/DT old/JJ engine/NN failed/VBD again/RB ./.
+we/PRP live/VBP here/RB now/RB ./.
+the/DT store/NN is/VBZ closed/VBN now/RB ./.
+they/PRP washed/VBD their/PRP$ dirty/JJ clothes/NNS ./.
+the/DT player/NN caught/VBD the/DT ball/NN easily/RB ./.
+a/DT white/JJ ball/NN rolled/VBD down/IN the/DT hill/NN ./.
+the/DT hunter/NN followed/VBD the/DT deer/NN quietly/RB ./.
+his/PRP$ answer/NN surprised/VBD the/DT whole/JJ class/NN ./.
+her/PRP$ dress/NN matched/VBD her/PRP$ blue/JJ shoes/NNS ./.
+the/DT cook/NN tasted/VBD the/DT hot/JJ soup/NN ./.
+strong/JJ horses/NNS pulled/VBD the/DT heavy/JJ cart/NN ./.
+the/DT clerk/NN counted/VBD the/DT money/NN twice/RB ./.
+wild/JJ geese/NNS crossed/VBD the/DT grey/JJ sky/NN ./.
+the/DT nurse/NN helped/VBD the/DT injured/VBN man/NN ./.
+my/PRP$ sister/NN cleaned/VBD her/PRP$ small/JJ desk/NN ./.
+the/DT crowd/NN cheered/VBD very/RB loudly/RB ./.
+young/JJ plants/NNS need/VBP water/NN daily/RB ./.
+the/DT manager/NN signed/VBD the/DT final/JJ contract/NN ./.
+the/DT hungry/JJ dog/NN barked/VBD loudly/RB ./.
+a/DT hungry/JJ cat/NN waited/VBD near/IN the/DT door/NN ./.
+the/DT brown/JJ dog/NN ran/VBD across/IN the/DT yard/NN ./.
+her/PRP$ dog/NN sleeps/VBZ on/IN the/DT soft/JJ couch/NN ./.
+""")
+
+HELDOUT = _parse("""
+the/DT old/JJ farmer/NN watered/VBD his/PRP$ green/JJ fields/NNS ./.
+she/PRP will/MD visit/VB London/NNP in/IN April/NNP ./.
+tired/JJ workers/NNS rested/VBD under/IN the/DT tall/JJ trees/NNS ./.
+the/DT engine/NN runs/VBZ smoothly/RB now/RB ./.
+two/CD boys/NNS kicked/VBD the/DT ball/NN happily/RB ./.
+""")
+
+
+def main():
+    model = PerceptronPosTagger.train(TRAIN, epochs=12, seed=0)
+    right = total = 0
+    for sent in HELDOUT:
+        got = model.tag([w for w, _ in sent])
+        for (_, gold), (_, guess) in zip(sent, got):
+            right += gold == guess
+            total += 1
+    acc = right / total
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "fixtures",
+        "pos_model.json.gz")
+    model.save(out)
+    print(f"held-out accuracy {acc:.3f} ({right}/{total}); "
+          f"model -> {out}")
+    assert acc >= 0.9, "fixture model regressed below 90% held-out accuracy"
+
+
+if __name__ == "__main__":
+    main()
