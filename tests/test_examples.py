@@ -1,6 +1,6 @@
-"""Examples bitrot guard: every example must at least byte-compile; the two
-fastest run end-to-end as subprocesses (the full set is exercised manually —
-each prints a success line; see examples/README.md)."""
+"""Examples bitrot guard: every example must at least byte-compile; the
+fast ones run end-to-end as subprocesses (the full set is exercised
+manually — each prints a success line; see examples/README.md)."""
 import os
 import py_compile
 import subprocess
@@ -26,11 +26,15 @@ def test_all_examples_compile():
 
 @pytest.mark.slow
 @pytest.mark.parametrize("name", ["ring_attention_long_context.py",
-                                  "moe_expert_parallel.py"])
+                                  "moe_expert_parallel.py",
+                                  "cjk_dictionary_tokenization.py",
+                                  "ps_cross_process.py"])
 def test_fast_examples_run(name):
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    # 420s: must exceed the largest internal budget any example carries
+    # (ps_cross_process.py: 240s worker + 60s server wait + scoring)
     p = subprocess.run([sys.executable, name], cwd=EXAMPLES, env=env,
-                       capture_output=True, text=True, timeout=280)
+                       capture_output=True, text=True, timeout=420)
     assert p.returncode == 0, p.stderr[-800:]
     assert "True" in p.stdout or "==" in p.stdout

@@ -1,0 +1,24 @@
+"""Driver entry-point contract: `entry()` must return a traceable forward
+(the driver compile-checks it single-chip every round — r5 caught it broken
+by an `_apply_graph` arity change, so this pins the contract in the core
+tier). `dryrun_multichip` has its own driver run + the parallel test
+suite; tracing the flagship forward here is the cheap guard."""
+import os
+import sys
+
+import jax
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_entry_traces_flagship_forward():
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__ as g
+    finally:
+        sys.path.pop(0)
+    fn, args = g.entry()
+    # eval_shape = full trace without XLA compilation (seconds, not
+    # minutes) — exactly what catches signature/arity/shape breakage
+    out = jax.eval_shape(fn, *args)
+    assert out.shape == (8, 1000)
