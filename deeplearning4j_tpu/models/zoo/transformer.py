@@ -438,8 +438,9 @@ class TransformerLM:
                     jnp.asarray([toks[-1]], jnp.int32))
         return toks
 
-    def generate_batch(self, prompts, max_new_tokens):
-        """Batched greedy KV-cache decode, entire generation in ONE jitted
+    def generate_batch(self, prompts, max_new_tokens, temperature=0.0,
+                       seed=0):
+        """Batched KV-cache decode, entire generation in ONE jitted
         program: a PARALLEL prefill (one causal forward over the whole
         prompt fills every layer's cache — MXU-shaped, not P sequential
         steps) followed by a `lax.scan` over the new tokens.
@@ -447,9 +448,11 @@ class TransformerLM:
         Contrast `generate(use_cache=True)`: that path round-trips
         host<->device per token to pick the next token in numpy — on a
         remote-attached chip the tunnel latency dominates. Here token
-        selection (greedy argmax) folds into the scan, so the host sees
-        the device exactly once per call. Greedy outputs are pinned
-        identical to `generate(use_cache=True)` row-by-row by test.
+        selection folds into the scan, so the host sees the device exactly
+        once per call. temperature<=0 = greedy argmax, pinned identical to
+        `generate(use_cache=True)` row-by-row by test; temperature>0 =
+        on-device categorical sampling (`jax.random.categorical`, keyed by
+        `seed` — deterministic per (seed, shapes), independent rows).
 
         prompts: [B, P] int array (equal-length prompts; the serving
         batcher pads/buckets upstream). Returns [B, P + max_new_tokens].
@@ -461,6 +464,7 @@ class TransformerLM:
         n_new = int(max_new_tokens)
         if n_new < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {n_new}")
+        sampled = float(temperature) > 0.0
         max_len = self.aux["pos"].shape[0]
         if P + n_new > max_len:
             raise ValueError(
@@ -469,7 +473,7 @@ class TransformerLM:
         cache = getattr(self, "_jit_gen_cache", None)
         if cache is None:
             cache = self._jit_gen_cache = collections.OrderedDict()
-        key = (B, P, n_new)
+        key = (B, P, n_new, sampled)
         if key in cache:
             cache.move_to_end(key)          # LRU touch
         else:
@@ -499,7 +503,7 @@ class TransformerLM:
                 h = h + m @ p["mlp"]["w2"] + p["mlp"]["b2"]
                 return h, kp, vp
 
-            def gen(aux, blocks, prompts):
+            def gen(aux, blocks, prompts, temp, rng):
                 # parallel prefill: one causal pass fills the caches
                 h = embed_fn(aux, prompts)                 # [B, P, D]
                 cache = []
@@ -512,16 +516,24 @@ class TransformerLM:
                 logit = logits_fn(aux, h[:, -1]).astype(jnp.float32)
                 pos = jnp.asarray(P, jnp.int32)
 
+                def pick(logit, rng):
+                    if not sampled:            # static: greedy program
+                        return jnp.argmax(logit, -1).astype(jnp.int32)
+                    return jax.random.categorical(
+                        rng, logit / temp, -1).astype(jnp.int32)
+
                 def dec_body(carry, _):
-                    cache, pos, logit = carry
-                    tok = jnp.argmax(logit, -1).astype(jnp.int32)
+                    cache, pos, logit, rng = carry
+                    rng, krng = jax.random.split(rng)
+                    tok = pick(logit, krng)
                     logit, cache = step_token(aux, blocks, cache, pos,
                                               tok)
-                    return (cache, pos + 1, logit), tok
+                    return (cache, pos + 1, logit, rng), tok
 
-                (_, _, logit), toks = jax.lax.scan(
-                    dec_body, (cache, pos, logit), None, length=n_new - 1)
-                last = jnp.argmax(logit, -1).astype(jnp.int32)
+                (_, _, logit, rng), toks = jax.lax.scan(
+                    dec_body, (cache, pos, logit, rng), None,
+                    length=n_new - 1)
+                last = pick(logit, jax.random.split(rng)[1])
                 return jnp.concatenate(
                     [toks, last[None, :]], 0).T            # [B, n_new]
 
@@ -533,5 +545,8 @@ class TransformerLM:
             cache[key] = jax.jit(gen)
             while len(cache) > GEN_JIT_CACHE_SIZE:
                 cache.popitem(last=False)
-        new = cache[key](self.aux, self.blocks, prompts)
+        new = cache[key](self.aux, self.blocks, prompts,
+                         jnp.asarray(max(float(temperature), 1e-6),
+                                     jnp.float32),
+                         jax.random.PRNGKey(int(seed)))
         return np.concatenate([np.asarray(prompts), np.asarray(new)], 1)

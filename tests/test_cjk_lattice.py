@@ -16,9 +16,10 @@ from deeplearning4j_tpu.text.ja_lexicon import build_entries
 class TestLexicon:
     def test_conjugation_expansion_scale(self):
         """A few hundred lemmas expand to thousands of surface forms —
-        the Kuromoji dictionary shape at 1/20 scale."""
+        the Kuromoji dictionary shape at small scale (r5: ~4.9k
+        surfaces after the everyday-vocabulary expansion)."""
         entries = build_entries()
-        assert len(entries) > 2000
+        assert len(entries) > 4500
         surfaces = {s for s, _, _ in entries}
         # expanded godan forms (never written in the lexicon literally)
         for form in ("行きました", "書いて", "読んだ", "買った", "話して",
@@ -59,6 +60,24 @@ class TestLatticeSegmentation:
     def test_te_iru_progressive(self):
         got = JapaneseLatticeTokenizer("彼女は新しい本を読んでいます")._tokens
         assert got == ["彼女", "は", "新しい", "本", "を", "読んでいます"]
+
+    def test_expanded_everyday_vocabulary(self):
+        """r5 lexicon expansion: everyday sentences over the new nouns/
+        verbs (weekdays, facilities, loanword nouns, expanded godan and
+        ichidan conjugations) segment correctly."""
+        cases = {
+            "昨日友達と映画館で面白い映画を見ました":
+                ["昨日", "友達", "と", "映画館", "で", "面白い", "映画",
+                 "を", "見ました"],
+            "来週の日曜日に家族と動物園へ行く予定です":
+                ["来週", "の", "日曜日", "に", "家族", "と", "動物園",
+                 "へ", "行く", "予定", "です"],
+            "冷蔵庫に牛乳とチーズが残っています":
+                ["冷蔵庫", "に", "牛乳", "と", "チーズ", "が",
+                 "残っています"],
+        }
+        for text, want in cases.items():
+            assert JapaneseLatticeTokenizer(text)._tokens == want, text
 
     def test_punctuation_splits_chunks(self):
         got = JapaneseLatticeTokenizer("今日は雨です。明日は晴れます。")._tokens

@@ -190,6 +190,27 @@ class TestPipelinedTransformer:
             lm.generate_batch(np.zeros((2, 10), np.int32),
                               max_new_tokens=10)
 
+    def test_generate_batch_sampling(self):
+        """temperature>0: on-device categorical sampling in the decode
+        scan — deterministic per seed, varies across seeds, near-greedy
+        as temperature -> 0."""
+        lm = TransformerLM(11, d_model=32, n_heads=4, n_layers=2,
+                           max_len=16, learning_rate=0.2, momentum=0.9)
+        x, y = _char_data()
+        for _ in range(40):
+            lm.fit_batch(x, y)
+        prompts = np.array([[2, 3, 4], [7, 8, 9]], np.int32)
+        a = lm.generate_batch(prompts, 5, temperature=1.0, seed=1)
+        b = lm.generate_batch(prompts, 5, temperature=1.0, seed=1)
+        c = lm.generate_batch(prompts, 5, temperature=1.0, seed=2)
+        np.testing.assert_array_equal(a, b)        # same seed = same toks
+        assert a.shape == (2, 8) and (a[:, 3:] < 11).all()
+        assert not np.array_equal(a, c)            # seeds diverge
+        # temperature -> 0 converges to the greedy program's output
+        greedy = lm.generate_batch(prompts, 5)
+        near = lm.generate_batch(prompts, 5, temperature=1e-4, seed=3)
+        np.testing.assert_array_equal(greedy, near)
+
     def test_generate_batch_jit_cache_is_bounded_lru(self, monkeypatch):
         """A serving workload with varied (B, P, n_new) shapes must not
         accumulate compiled programs without bound; re-use must not
@@ -202,10 +223,10 @@ class TestPipelinedTransformer:
                            max_len=32)
         hot = np.zeros((1, 2), np.int32)
         lm.generate_batch(hot, max_new_tokens=1)
-        hot_fn = lm._jit_gen_cache[(1, 2, 1)]
+        hot_fn = lm._jit_gen_cache[(1, 2, 1, False)]
         for p in range(3, 3 + tr.GEN_JIT_CACHE_SIZE + 2):
             lm.generate_batch(np.zeros((1, p), np.int32),
                               max_new_tokens=1)
             lm.generate_batch(hot, max_new_tokens=1)   # LRU touch
         assert len(lm._jit_gen_cache) <= tr.GEN_JIT_CACHE_SIZE
-        assert lm._jit_gen_cache[(1, 2, 1)] is hot_fn
+        assert lm._jit_gen_cache[(1, 2, 1, False)] is hot_fn
