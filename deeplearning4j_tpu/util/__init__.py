@@ -3,7 +3,9 @@ from . import model_serializer as ModelSerializer  # noqa: N812
 from .model_guesser import load_config_guess, load_model_guess
 from .model_serializer import (restore_computation_graph, restore_model,
                                restore_multi_layer_network, write_model)
+from .sharded_checkpoint import load_checkpoint, save_checkpoint
 
-__all__ = ["ModelGuesser", "ModelSerializer", "load_config_guess",
+__all__ = ["ModelGuesser", "ModelSerializer", "load_checkpoint",
+           "save_checkpoint", "load_config_guess",
            "load_model_guess", "restore_computation_graph", "restore_model",
            "restore_multi_layer_network", "write_model"]

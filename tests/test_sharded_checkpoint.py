@@ -1,0 +1,183 @@
+"""Mesh-sharded checkpointing (orbax): exact resume + sharding-preserving
+restore — the TPU-scale path the reference's single-JVM ModelSerializer
+zip (util/ModelSerializer.java) cannot express. The zip format keeps its
+own golden tests (test_regression_golden.py); these pin the sharded one."""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.util.sharded_checkpoint import (load_checkpoint,
+                                                        save_checkpoint)
+
+
+def _net(seed=11):
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater("adam").learning_rate(0.05).list()
+            .layer(0, DenseLayer(n_out=16, activation="relu"))
+            .layer(1, OutputLayer(n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.random((n, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+def test_exact_resume_round_trip(tmp_path):
+    """Save mid-training; a fresh net restored from the checkpoint
+    continues EXACTLY like the original (params, updater moments, rng,
+    iteration counter and the device loop state all round-trip)."""
+    ds = _data()
+    a = _net()
+    for _ in range(5):
+        a.fit(ds)
+    save_checkpoint(a, tmp_path / "ck")
+    b = load_checkpoint(_net(seed=99), tmp_path / "ck")
+    assert b.conf.iteration_count == a.conf.iteration_count
+    np.testing.assert_array_equal(np.asarray(a.params()),
+                                  np.asarray(b.params()))
+    # identical continuation: 3 more steps on each, scores match exactly
+    for _ in range(3):
+        a.fit(ds)
+        b.fit(ds)
+        assert float(a._score) == float(b._score)
+
+
+def test_fixed_path_periodic_resave(tmp_path):
+    """The periodic-save pattern: re-saving to the same path overwrites
+    (ModelSerializer semantics); overwrite=False raises instead."""
+    ds = _data()
+    a = _net()
+    a.fit(ds)
+    save_checkpoint(a, tmp_path / "latest")
+    a.fit(ds)
+    save_checkpoint(a, tmp_path / "latest")      # overwrite, no raise
+    b = load_checkpoint(_net(seed=2), tmp_path / "latest")
+    assert b.conf.iteration_count == a.conf.iteration_count
+    with pytest.raises(ValueError):
+        save_checkpoint(a, tmp_path / "latest", overwrite=False)
+
+
+def test_unfitted_net_round_trip(tmp_path):
+    """No loop state yet (never fitted): the placeholder keeps the pytree
+    structure fixed and restore leaves the loop unset."""
+    a = _net()
+    save_checkpoint(a, tmp_path / "ck")
+    b = load_checkpoint(_net(seed=5), tmp_path / "ck")
+    assert b._loop is None
+    np.testing.assert_array_equal(np.asarray(a.params()),
+                                  np.asarray(b.params()))
+
+
+def test_computation_graph_round_trip(tmp_path):
+    """Same module serves ComputationGraph (dict-keyed pytrees)."""
+    from deeplearning4j_tpu import ComputationGraph
+    from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+
+    def build(seed=3):
+        conf = (NeuralNetConfiguration.Builder().seed(seed)
+                .updater("adam").learning_rate(0.05)
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=8, activation="tanh"),
+                           "in")
+                .add_layer("d2", DenseLayer(n_out=8, activation="relu"),
+                           "in")
+                .add_vertex("m", MergeVertex(), "d1", "d2")
+                .add_layer("out", OutputLayer(
+                    n_out=3, activation="softmax",
+                    loss_function="mcxent"), "m")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(5))
+                .build())
+        return ComputationGraph(conf).init()
+
+    ds = _data()
+    a = build()
+    for _ in range(4):
+        a.fit(ds)
+    save_checkpoint(a, tmp_path / "ck")
+    b = load_checkpoint(build(seed=77), tmp_path / "ck")
+    for _ in range(2):
+        a.fit(ds)
+        b.fit(ds)
+        assert float(a._score) == float(b._score)
+
+
+@pytest.mark.multiprocess
+def test_two_process_sharded_save_restore(tmp_path):
+    """2 real processes x 2 devices: every process writes only its own
+    shards on save (orbax multihost commit over the jax.distributed
+    coordinator), restore lands ZeRO-partitioned, continuation identical
+    across processes."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO
+    script = os.path.join(REPO, "tests", "multihost_worker_ckpt.py")
+    procs = [subprocess.Popen(
+        [sys.executable, script, str(i), "2", coord,
+         str(tmp_path / "ck")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env) for i in range(2)]
+    outs = [p.communicate(timeout=280)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT"):
+                _, pid, ssum, sc = line.split()
+                results[int(pid)] = (ssum, sc)
+    assert set(results) == {0, 1}, outs
+    assert results[0] == results[1]              # bit-identical across procs
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_restore_into_zero1_sharded_layout(tmp_path):
+    """Restore places shards onto the CURRENT sharding of the target: a
+    fresh net sharded by ParallelWrapper (ZeRO-1 optimizer partitioning)
+    restores with the Adam moments landing partitioned over 'data' — no
+    host ever holds the replicated whole."""
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+    ds = _data()
+    a = _net()
+    pw_a = (ParallelWrapper.Builder(a)
+            .mesh(make_mesh(n_data=8, n_model=1))
+            .sharded_updater_state(True).averaging_frequency(1).build())
+    pw_a.fit(ds)
+    save_checkpoint(a, tmp_path / "ck")
+
+    b = _net(seed=42)
+    pw_b = (ParallelWrapper.Builder(b)
+            .mesh(make_mesh(n_data=8, n_model=1))
+            .sharded_updater_state(True).averaging_frequency(1).build())
+    pw_b._ensure_sharded()
+    load_checkpoint(b, tmp_path / "ck")
+    np.testing.assert_array_equal(np.asarray(a.params()),
+                                  np.asarray(b.params()))
+    # the restored Adam moment landed ZeRO-partitioned, not replicated
+    m = b._updater_state[0]["W"]["m"]
+    assert "data" in jax.tree_util.tree_leaves(
+        [tuple(m.sharding.spec)])  # spec mentions the data axis
+    # and training continues identically to the original sharded run
+    pw_a.fit(ds)
+    pw_b.fit(ds)
+    assert float(a._score) == float(b._score)
