@@ -1,4 +1,4 @@
-"""On-chip perf sweep for the round-4 levers (run when the TPU is up).
+"""On-chip perf sweep for the round-4/5 levers (run when the TPU is up).
 
 Interleaved A/B measurements that bench.py's fixed budget doesn't cover:
 
@@ -7,11 +7,15 @@ Interleaved A/B measurements that bench.py's fixed budget doesn't cover:
      showed flash at 0.86x/0.71x of dense with the einsum-recompute VJP
      and dense failing outright at 8192; this measures what the fused
      backward changed.
-  2. Ring+flash training step at T=8192 over a 1-axis mesh (single chip:
-     ring of 1 — kernel path sanity under grad).
+  2. LSTM scan-unroll sweep (r5 lever): char-RNN chars/sec at
+     unroll = 1 / 4 / 8 / 16 — picks the bench default for the
+     BASELINE config #3 path (LSTMHelpers.java:157-171 seam).
 
 Prints one JSON line per measurement (records are self-contained; safe
-under any timeout). Usage: python perf_sweep.py [--budget SECONDS]
+under any timeout).
+Usage: python perf_sweep.py [--budget SECONDS] [--skip-flash]
+(--skip-flash: run only the LSTM sweep — the attention sweep needs a real
+TPU; interpret-mode Pallas on CPU is minutes per step.)
 """
 from __future__ import annotations
 
@@ -20,7 +24,7 @@ import sys
 import time
 
 
-def main(budget_s=900.0):
+def main(budget_s=900.0, skip_flash=False):
     t0 = time.perf_counter()
     import jax
     import jax.numpy as jnp
@@ -52,6 +56,8 @@ def main(budget_s=900.0):
         return best
 
     for T in (2048, 4096, 8192):
+        if skip_flash:
+            break
         if time.perf_counter() - t0 > budget_s - 120:
             print(json.dumps({"skipped": f"T={T}", "reason": "budget"}),
                   flush=True)
@@ -70,6 +76,39 @@ def main(budget_s=900.0):
             rec["flash_vs_dense"] = round(rec["flash"] / rec["dense"], 3)
         print(json.dumps(rec), flush=True)
 
+    # --- r5: LSTM scan-unroll sweep (char-RNN, BASELINE config #3) ------
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.zoo.char_rnn import char_rnn
+
+    def lstm_chars_s(unroll, Bc=64, Tc=200, steps=10):
+        net = char_rnn(data_type="bfloat16", scan_unroll=unroll)
+        x = np.eye(77, dtype=np.float32)[rng.integers(0, 77, (Bc, Tc))]
+        y = np.eye(77, dtype=np.float32)[rng.integers(0, 77, (Bc, Tc))]
+        ds = DataSet(jax.device_put(x), jax.device_put(y))
+        for _ in range(2):
+            net.fit(ds)
+        float(net._score)
+        best = 0.0
+        for _ in range(2):
+            t = time.perf_counter()
+            for _ in range(steps):
+                net.fit(ds)
+            float(net._score)
+            best = max(best, Bc * Tc * steps / (time.perf_counter() - t))
+        return best
+
+    lstm_rec = {"metric": "char-RNN chars/sec by scan unroll",
+                "config": "2x200 GravesLSTM B=64 T=200 tbptt 50 bf16"}
+    for unroll in (1, 4, 8, 16):
+        if time.perf_counter() - t0 > budget_s - 90:
+            lstm_rec[f"unroll{unroll}"] = "skipped (budget)"
+            continue
+        try:
+            lstm_rec[f"unroll{unroll}"] = round(lstm_chars_s(unroll), 0)
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            lstm_rec[f"unroll{unroll}_error"] = str(e)[:200]
+    print(json.dumps(lstm_rec), flush=True)
+
     print(json.dumps({"sweep": "done",
                       "wall_s": round(time.perf_counter() - t0, 1)}),
           flush=True)
@@ -79,4 +118,6 @@ if __name__ == "__main__":
     budget = 900.0
     if "--budget" in sys.argv:
         budget = float(sys.argv[sys.argv.index("--budget") + 1])
-    main(budget)
+    # --skip-flash: the attention sweep needs a real TPU (interpret-mode
+    # Pallas is minutes per step); the LSTM sweep runs anywhere
+    main(budget, skip_flash="--skip-flash" in sys.argv)

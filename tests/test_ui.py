@@ -333,6 +333,7 @@ def test_activation_arming_mid_fit_over_iterator():
     assert "activationStats" in ups[-1]
 
 
+@pytest.mark.slow
 def test_activation_stats_under_parallel_wrapper():
     """The sharded allreduce path honors the activation-stats arming the
     same way the single-chip step does (a PW-trained net with
