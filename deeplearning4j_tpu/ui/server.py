@@ -130,6 +130,15 @@ function drawScatter(svg, pts, labels){
 async function latestSession(){
  const s=await (await fetch('/api/sessions')).json();
  return s.length? s[s.length-1] : null;}
+function syncSelect(sel, names, chosen, onPick, label){
+ // rebuild only when the option count changes; returns the active name
+ if(sel.options.length!==names.length){
+  sel.textContent='';
+  for(const n of names){const o=el('option', label? label+n : n);
+    o.value=n; sel.appendChild(o);}
+  sel.onchange=()=>onPick(sel.value);
+ }
+ return chosen || names[0];}
 """
 
 
@@ -171,9 +180,12 @@ _MODEL = _page(
     "Model",
     """<div class="card"><h2>Layers</h2><div id="layers"></div></div>
 <div class="card"><h2>Mean magnitude vs iteration
- <select id="param"></select></h2><svg id="mm"></svg></div>""",
+ <select id="param"></select></h2><svg id="mm"></svg></div>
+<div class="card" id="actCard" style="display:none">
+ <h2>Activation mean magnitude vs iteration
+ <select id="actLayer"></select></h2><svg id="am"></svg></div>""",
     """
-let chosen=null;
+let chosen=null, chosenAct=null;
 async function refresh(){
  const sid = await latestSession(); if(!sid) return;
  const st = await (await fetch('/api/static/'+sid)).json();
@@ -201,16 +213,26 @@ async function refresh(){
    div.appendChild(t);
   }catch(e){div.appendChild(el('pre','config parse error: '+e));}
  }
+ // live per-layer activation stats (the fused step's on-device
+ // summaries of the real training batch — BaseStatsListener role).
+ // Drawn BEFORE the param chart: activation-only monitoring
+ // (collect_mean/stdev/histograms all False) has no `parameters` key
+ // and must not be starved by the param guard below.
+ const withA = ups.filter(u=>u.activationStats);
+ if(withA.length){
+  document.getElementById('actCard').style.display='';
+  const an = syncSelect(document.getElementById('actLayer'),
+    Object.keys(withA[withA.length-1].activationStats),
+    chosenAct, v=>{chosenAct=v; refresh();}, 'layer ');
+  const apts = withA.filter(u=>u.activationStats[an])
+    .map(u=>[u.iteration, u.activationStats[an].meanMagnitude]);
+  drawLine(document.getElementById('am'), apts, '#705');
+ }
  const withP = ups.filter(u=>u.parameters);
  if(!withP.length) return;
- const names = Object.keys(withP[withP.length-1].parameters);
- const sel=document.getElementById('param');
- if(sel.options.length!==names.length){
-  sel.textContent='';
-  for(const n of names){const o=el('option',n); o.value=n; sel.appendChild(o);}
-  sel.onchange=()=>{chosen=sel.value; refresh();};
- }
- const name = chosen || names[0];
+ const name = syncSelect(document.getElementById('param'),
+   Object.keys(withP[withP.length-1].parameters),
+   chosen, v=>{chosen=v; refresh();});
  const pts = withP.filter(u=>u.parameters[name])
    .map(u=>[u.iteration, u.parameters[name].meanMagnitude]);
  drawLine(document.getElementById('mm'), pts, '#083');

@@ -292,6 +292,34 @@ def test_activation_stats_from_fused_step_no_probe():
     # conv grids captured from the step, downsample/channel caps honored
     g = last["activations"]["0"]
     assert g["height"] == 8 and len(g["channels"]) == 2
+    # the model page charts the live per-layer activation stats; verify
+    # the full data path the page's JS consumes, for the activation-ONLY
+    # configuration (no parameter stats collected — the chart must not be
+    # starved by the param guard)
+    storage2 = InMemoryStatsStorage()
+    net2 = MultiLayerNetwork(conf).init()
+    net2.set_listeners(StatsListener(
+        storage2, StatsUpdateConfiguration(
+            collect_mean=False, collect_stdev=False,
+            collect_histograms=False, collect_activations=True),
+        session_id="actonly"))
+    for _ in range(3):
+        net2.fit(DataSet(x, y))
+    server = UIServer(port=0).attach(storage2)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/train/model") as r2:
+            assert "Activation mean magnitude" in r2.read().decode()
+        with urllib.request.urlopen(base + "/api/updates/actonly") as r2:
+            ups2 = json.load(r2)
+        with_a = [u for u in ups2 if "activationStats" in u]
+        assert with_a and all("parameters" not in u for u in ups2)
+        # exactly what the JS plots: (iteration, meanMagnitude) points
+        assert all(
+            isinstance(u["activationStats"]["0"]["meanMagnitude"], float)
+            for u in with_a)
+    finally:
+        server.stop()
     # toggling off restores the fast-path step; the listener must NOT
     # silently re-arm a model the user explicitly disabled
     net.collect_activation_stats(False)
