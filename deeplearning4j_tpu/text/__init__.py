@@ -3,7 +3,8 @@ from .annotation import (AnnotatedDocument, Annotation,
                          PosAnnotator, SentenceAnnotator,
                          StemAnnotator, TokenAnnotator,
                          standard_pipeline)
-from .pos_model import (PerceptronPosTagger, TrainedPosAnnotator)
+from .pos_model import (PerceptronChunker, PerceptronPosTagger,
+                        TrainedPosAnnotator)
 from .cjk_tokenization import (ChineseTokenizerFactory,
                                JapaneseTokenizerFactory,
                                KoreanTokenizerFactory)
@@ -49,7 +50,8 @@ __all__ = [
     "LabelledDocument", "LabelsSource", "LowCasePreProcessor",
     "NGramTokenizerFactory", "SentenceIterator", "SimpleLabelAwareIterator",
     "StemmingPreprocessor", "TfidfVectorizer", "TokenPreProcess",
-    "PerceptronPosTagger", "PosAnnotator", "SentenceAnnotator",
+    "PerceptronChunker", "PerceptronPosTagger", "PosAnnotator",
+    "SentenceAnnotator",
     "StemAnnotator", "TrainedPosAnnotator",
     "TokenAnnotator", "Tokenizer", "TokenizerFactory", "porter_stem",
     "standard_pipeline",

@@ -1,20 +1,23 @@
-"""Trained statistical POS tagging with a serialized model format.
+"""Trained statistical POS tagging + chunking with a serialized model
+format.
 
 The reference's UIMA annotators wrap TRAINED OpenNLP maxent models
 (deeplearning4j-nlp-uima PoStagger / text/corpora/treeparser/TreeParser.java
-loads en-pos-maxent.bin etc.); `annotation.PosAnnotator` is the offline
-suffix-heuristic stand-in. This module closes the mechanism gap: a
-greedy averaged-perceptron tagger (the shape of OpenNLP's beam=1 maxent
-decoder — per-token feature templates over word form, affixes and the
-previous tags) with train / save / load, so annotators are driven by a
-serialized trained model exactly like the reference, and models can be
-retrained on any tagged corpus. A tiny trained fixture is committed at
-tests/fixtures/pos_model.json.gz (trained by tools/train_pos_fixture.py)
+loads en-pos-maxent.bin, en-chunker.bin etc.); `annotation.PosAnnotator`
+and the rule chunker in `treeparser._chunk` are the offline stand-ins.
+This module closes the mechanism gap: greedy averaged-perceptron sequence
+taggers (the shape of OpenNLP's beam=1 maxent decoders — per-position
+feature templates over the input and the previous predicted tags) with
+train / save / load, so annotators and the tree parser are driven by
+serialized trained models exactly like the reference, retrainable on any
+tagged corpus. Tiny trained fixtures are committed at
+tests/fixtures/pos_model.json.gz and tests/fixtures/chunk_model.json.gz
+(trained by tools/train_pos_fixture.py / tools/train_chunker_fixture.py)
 the same way the CIFAR/LFW format fixtures drive the data parsers.
 
-Model format: gzip JSON — {"format": "dl4j-tpu-pos-perceptron", "version",
-"tags": [...], "weights": {feature: {tag: float}}}. Features are string
-templates (below); weights are the AVERAGED perceptron weights.
+Model format: gzip JSON — {"format": <per-model name>, "version",
+"tags": [...], "weights": {feature: {tag: float}}}. Weights are the
+AVERAGED perceptron weights.
 """
 from __future__ import annotations
 
@@ -22,44 +25,30 @@ import gzip
 import json
 import os
 
-FORMAT_NAME = "dl4j-tpu-pos-perceptron"
+FORMAT_NAME = "dl4j-tpu-pos-perceptron"          # kept for back-compat
 FORMAT_VERSION = 1
 
 START = ("-START-", "-START2-")
 
 
-def _features(i, word, context, prev, prev2):
-    """OpenNLP-style templates: word form, affixes, shape, neighbors and
-    the two previous predicted tags."""
-    w = word.lower()
-    feats = {
-        "bias",
-        f"w={w}",
-        f"suf3={w[-3:]}",
-        f"suf2={w[-2:]}",
-        f"suf1={w[-1:]}",
-        f"pre1={w[:1]}",
-        f"t-1={prev}",
-        f"t-2={prev2}",
-        f"t-1&w={prev}&{w}",
-        f"w-1={context[i - 1]}",
-        f"w+1={context[i + 1]}",
-    }
-    if word[:1].isupper() and i > 0:
-        feats.add("cap")
-    if any(c.isdigit() for c in word):
-        feats.add("digit")
-    if "-" in word:
-        feats.add("hyphen")
-    return feats
+class _AveragedPerceptron:
+    """Greedy left-to-right averaged-perceptron sequence tagger core.
+    Subclasses define the input item type via `_context` (one per
+    sequence) and `_features_at` (one per position, may read the two
+    previous predicted tags — teacher-forced during training)."""
 
-
-class PerceptronPosTagger:
-    """Greedy left-to-right averaged perceptron tagger."""
+    FORMAT = None
 
     def __init__(self, weights=None, tags=None):
         self.weights = weights or {}       # feature -> {tag: weight}
         self.tags = list(tags or [])
+
+    # -- hooks -------------------------------------------------------------
+    def _context(self, seq):
+        raise NotImplementedError
+
+    def _features_at(self, i, ctx, prev, prev2):
+        raise NotImplementedError
 
     # -- inference ---------------------------------------------------------
     def _predict(self, feats):
@@ -73,25 +62,27 @@ class PerceptronPosTagger:
         # deterministic argmax (score, then tag name)
         return max(self.tags, key=lambda t: (scores[t], t))
 
-    def tag(self, words):
-        """[(word, tag)] for a tokenized sentence."""
-        context = [w.lower() for w in words]
-        context = ["-BOS-"] + context + ["-EOS-"]
+    def tag(self, seq):
+        """[(item, tag)] for one input sequence."""
+        seq = list(seq)
+        ctx = self._context(seq)
         prev, prev2 = START
         out = []
-        for i, word in enumerate(words):
-            t = self._predict(_features(i + 1, word, context, prev, prev2))
-            out.append((word, t))
+        for i, item in enumerate(seq):
+            t = self._predict(self._features_at(i, ctx, prev, prev2))
+            out.append((item, t))
             prev2, prev = prev, t
         return out
 
     # -- training ----------------------------------------------------------
     @classmethod
     def train(cls, sentences, epochs=8, seed=0):
-        """sentences: iterable of [(word, tag)] pairs. Averaged perceptron:
-        on a wrong greedy prediction, +1 the gold tag's feature weights and
-        -1 the predicted tag's; final weights are the average over every
-        update step (stabilizes the tiny-corpus case)."""
+        """sentences: iterable of [(item, gold)] pairs. Averaged
+        perceptron: on a wrong greedy prediction, +1 the gold tag's
+        feature weights and -1 the predicted tag's; final weights are the
+        average over every update step (stabilizes the tiny-corpus
+        case). Gold tags feed the history (teacher forcing, the OpenNLP
+        training regime)."""
         import random
 
         sents = [list(s) for s in sentences]
@@ -105,25 +96,23 @@ class PerceptronPosTagger:
         def upd(feat, tag, delta):
             key = (feat, tag)
             cur = self.weights.setdefault(feat, {}).get(tag, 0.0)
-            totals[key] = totals.get(key, 0.0) + (step - stamps.get(key, 0)) * cur
+            totals[key] = (totals.get(key, 0.0)
+                           + (step - stamps.get(key, 0)) * cur)
             stamps[key] = step
             self.weights[feat][tag] = cur + delta
 
         for _ in range(epochs):
             rng.shuffle(sents)
             for sent in sents:
-                words = [w for w, _ in sent]
-                context = ["-BOS-"] + [w.lower() for w in words] + ["-EOS-"]
+                ctx = self._context([item for item, _ in sent])
                 prev, prev2 = START
-                for i, (word, gold) in enumerate(sent):
-                    feats = _features(i + 1, word, context, prev, prev2)
+                for i, (_item, gold) in enumerate(sent):
+                    feats = self._features_at(i, ctx, prev, prev2)
                     guess = self._predict(feats)
                     if guess != gold:
                         for f in feats:
                             upd(f, gold, +1.0)
                             upd(f, guess, -1.0)
-                    # gold tags feed the history during training
-                    # (teacher forcing, the OpenNLP training regime)
                     prev2, prev = prev, gold
                     step += 1
         # finalize averages
@@ -139,7 +128,7 @@ class PerceptronPosTagger:
 
     # -- serialization -----------------------------------------------------
     def save(self, path):
-        doc = {"format": FORMAT_NAME, "version": FORMAT_VERSION,
+        doc = {"format": type(self).FORMAT, "version": FORMAT_VERSION,
                "tags": self.tags, "weights": self.weights}
         with gzip.open(path, "wt", encoding="utf-8") as f:
             json.dump(doc, f)
@@ -148,12 +137,89 @@ class PerceptronPosTagger:
     def load(cls, path):
         with gzip.open(path, "rt", encoding="utf-8") as f:
             doc = json.load(f)
-        if doc.get("format") != FORMAT_NAME:
-            raise ValueError(f"not a {FORMAT_NAME} model: {path!r}")
+        if doc.get("format") != cls.FORMAT:
+            raise ValueError(f"not a {cls.FORMAT} model: {path!r} "
+                             f"(format {doc.get('format')!r})")
         if doc.get("version", 0) > FORMAT_VERSION:
             raise ValueError(f"model version {doc['version']} newer than "
                              f"supported {FORMAT_VERSION}")
         return cls(weights=doc["weights"], tags=doc["tags"])
+
+    @classmethod
+    def coerce(cls, model):
+        """Accept a model instance or a path to a serialized model — the
+        ONE place the path-or-instance idiom lives for every consumer
+        (annotators, TreeParser)."""
+        if isinstance(model, (str, os.PathLike)):
+            return cls.load(os.fspath(model))
+        return model
+
+
+class PerceptronPosTagger(_AveragedPerceptron):
+    """POS tagger over raw words (OpenNLP en-pos-maxent role)."""
+
+    FORMAT = FORMAT_NAME
+
+    def _context(self, words):
+        return (["-BOS-"] + [w.lower() for w in words] + ["-EOS-"], words)
+
+    def _features_at(self, i, ctx, prev, prev2):
+        """OpenNLP-style templates: word form, affixes, shape, neighbors
+        and the two previous predicted tags."""
+        context, words = ctx
+        word = words[i]
+        w = word.lower()
+        feats = {
+            "bias",
+            f"w={w}",
+            f"suf3={w[-3:]}",
+            f"suf2={w[-2:]}",
+            f"suf1={w[-1:]}",
+            f"pre1={w[:1]}",
+            f"t-1={prev}",
+            f"t-2={prev2}",
+            f"t-1&w={prev}&{w}",
+            f"w-1={context[i]}",           # context is BOS-padded by one
+            f"w+1={context[i + 2]}",
+        }
+        if word[:1].isupper():
+            feats.add("cap")
+        if any(c.isdigit() for c in word):
+            feats.add("digit")
+        if "-" in word:
+            feats.add("hyphen")
+        return feats
+
+
+class PerceptronChunker(_AveragedPerceptron):
+    """BIO shallow chunker over (word, pos) pairs (OpenNLP en-chunker
+    role): tags B-NP/I-NP/B-VP/I-VP/B-PP/I-PP/O, consumed by
+    `treeparser.TreeParser(chunk_model=...)`."""
+
+    FORMAT = "dl4j-tpu-chunk-perceptron"
+
+    def _context(self, pairs):
+        words = ["-BOS-"] + [w.lower() for w, _ in pairs] + ["-EOS-"]
+        pos = ["-BOS-"] + [p for _, p in pairs] + ["-EOS-"]
+        return (words, pos)
+
+    def _features_at(self, i, ctx, prev, prev2):
+        words, pos = ctx
+        j = i + 1                           # padded index
+        return {
+            "bias",
+            f"w={words[j]}",
+            f"p={pos[j]}",
+            f"p-1={pos[j - 1]}",
+            f"p+1={pos[j + 1]}",
+            f"p-1&p={pos[j - 1]}&{pos[j]}",
+            f"p&p+1={pos[j]}&{pos[j + 1]}",
+            f"w-1={words[j - 1]}",
+            f"w+1={words[j + 1]}",
+            f"t-1={prev}",
+            f"t-2={prev2}",
+            f"t-1&p={prev}&{pos[j]}",
+        }
 
 
 class TrainedPosAnnotator:
@@ -162,9 +228,7 @@ class TrainedPosAnnotator:
     the suffix-heuristic `PosAnnotator` when a model is available."""
 
     def __init__(self, model):
-        if isinstance(model, (str, os.PathLike)):
-            model = PerceptronPosTagger.load(os.fspath(model))
-        self.model = model
+        self.model = PerceptronPosTagger.coerce(model)
 
     def process(self, doc):
         for sent in doc.select("sentence"):

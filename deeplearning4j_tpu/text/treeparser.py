@@ -143,13 +143,56 @@ def _chunk(tokens):
     return out
 
 
+def _chunks_from_bio(toks, tagged):
+    """Group (word, pos, begin, end) tokens into phrase Trees from BIO
+    chunk tags (the trained-chunker path): B-X opens a phrase, I-X
+    continues it (an orphan I-X opens one — standard BIO repair), O is a
+    bare POS leaf."""
+    out = []
+    cur_label, cur = None, []
+
+    def leaf(tok):
+        w, p, b, e = tok
+        return Tree(p, value=w, begin=b, end=e)
+
+    def flush():
+        nonlocal cur_label, cur
+        if cur:
+            out.append(Tree(cur_label, cur, begin=cur[0].begin,
+                            end=cur[-1].end))
+        cur_label, cur = None, []
+
+    for tok, (_, tag) in zip(toks, tagged):
+        if tag == "O":
+            flush()
+            out.append(leaf(tok))
+        elif tag.startswith("B-") or (tag.startswith("I-")
+                                      and cur_label != tag[2:]):
+            flush()
+            cur_label = tag[2:]
+            cur = [leaf(tok)]
+        else:                                  # I-X continuing X
+            cur.append(leaf(tok))
+    flush()
+    return out
+
+
 class TreeParser:
     """reference: treeparser/TreeParser.java (getTrees / getTreesWithLabels
-    over UIMA sentence+token annotations)."""
+    over UIMA sentence+token annotations). `pos_model` / `chunk_model`
+    (serialized `pos_model.PerceptronPosTagger` / `PerceptronChunker`
+    instances or paths) swap the heuristic tagger and the rule chunker for
+    trained models — the reference's OpenNLP en-pos-maxent.bin +
+    en-chunker.bin mechanism."""
 
-    def __init__(self, tokenizer_factory=None, pos_model=None):
+    def __init__(self, tokenizer_factory=None, pos_model=None,
+                 chunk_model=None):
         self.pipeline = standard_pipeline(tokenizer_factory,
                                           pos_model=pos_model)
+        if chunk_model is not None:
+            from .pos_model import PerceptronChunker
+            chunk_model = PerceptronChunker.coerce(chunk_model)
+        self.chunk_model = chunk_model
 
     def get_trees(self, text, pre_processor=None):
         """One S tree per sentence."""
@@ -163,7 +206,12 @@ class TreeParser:
                     for t in doc.covered(sent, "token")]
             if not toks:
                 continue
-            chunks = _chunk(toks)
+            if self.chunk_model is not None:
+                tagged = self.chunk_model.tag([(w, p)
+                                               for w, p, _, _ in toks])
+                chunks = _chunks_from_bio(toks, tagged)
+            else:
+                chunks = _chunk(toks)
             trees.append(Tree("S", chunks, begin=sent.begin,
                               end=sent.end))
         return trees

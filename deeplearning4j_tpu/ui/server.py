@@ -131,14 +131,18 @@ async function latestSession(){
  const s=await (await fetch('/api/sessions')).json();
  return s.length? s[s.length-1] : null;}
 function syncSelect(sel, names, chosen, onPick, label){
- // rebuild only when the option count changes; returns the active name
+ // rebuild only when the option count changes; returns the active name.
+ // A stale choice (not in the current name set) falls back to names[0],
+ // and the widget is synced to whatever is actually plotted.
  if(sel.options.length!==names.length){
   sel.textContent='';
   for(const n of names){const o=el('option', label? label+n : n);
     o.value=n; sel.appendChild(o);}
   sel.onchange=()=>onPick(sel.value);
  }
- return chosen || names[0];}
+ const active = names.includes(chosen)? chosen : names[0];
+ if(sel.value!==active) sel.value=active;
+ return active;}
 """
 
 
@@ -219,8 +223,9 @@ async function refresh(){
  // (collect_mean/stdev/histograms all False) has no `parameters` key
  // and must not be starved by the param guard below.
  const withA = ups.filter(u=>u.activationStats);
+ document.getElementById('actCard').style.display =
+   withA.length? '' : 'none';   // re-hide on a session without stats
  if(withA.length){
-  document.getElementById('actCard').style.display='';
   const an = syncSelect(document.getElementById('actLayer'),
     Object.keys(withA[withA.length-1].activationStats),
     chosenAct, v=>{chosenAct=v; refresh();}, 'layer ');

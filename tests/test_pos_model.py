@@ -98,3 +98,41 @@ class TestAnnotatorIntegration:
         m = PerceptronPosTagger.load(FIXTURE)
         ann = TrainedPosAnnotator(m)
         assert ann.model is m
+
+
+CHUNK_FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                             "chunk_model.json.gz")
+
+
+class TestTrainedChunker:
+    def test_fixture_loads_and_tags_bio(self):
+        from deeplearning4j_tpu.text.pos_model import PerceptronChunker
+        m = PerceptronChunker.load(CHUNK_FIXTURE)
+        pairs = [("the", "DT"), ("old", "JJ"), ("farmer", "NN"),
+                 ("watered", "VBD"), ("his", "PRP$"), ("fields", "NNS"),
+                 (".", ".")]
+        tags = [t for _, t in m.tag(pairs)]
+        assert tags[:3] == ["B-NP", "I-NP", "I-NP"]
+        assert tags[3] == "B-VP" and tags[-1] == "O"
+
+    def test_format_guard_rejects_pos_model(self):
+        from deeplearning4j_tpu.text.pos_model import PerceptronChunker
+        with pytest.raises(ValueError):
+            PerceptronChunker.load(FIXTURE)     # pos format != chunk
+
+    def test_tree_parser_with_trained_chunker(self):
+        parser = TreeParser(pos_model=FIXTURE, chunk_model=CHUNK_FIXTURE)
+        trees = parser.get_trees("Two large ships arrived at the port")
+        s = trees[0].to_string()
+        assert "(NP" in s and "(VP" in s and "(PP" in s
+        np_words = next(n for n in trees[0] if n.label == "NP")
+        assert np_words.yield_words() == ["Two", "large", "ships"]
+
+    def test_bio_repair_orphan_inside_tag(self):
+        """An I-X with no open X phrase opens one (standard BIO repair)."""
+        from deeplearning4j_tpu.text.treeparser import _chunks_from_bio
+        toks = [("ships", "NNS", 0, 5), ("sail", "VBP", 6, 10)]
+        tagged = [(("ships", "NNS"), "I-NP"), (("sail", "VBP"), "B-VP")]
+        out = _chunks_from_bio(toks, tagged)
+        assert [n.label for n in out] == ["NP", "VP"]
+        assert out[0].yield_words() == ["ships"]

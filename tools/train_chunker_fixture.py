@@ -1,0 +1,62 @@
+"""Train the committed chunker fixture (tests/fixtures/chunk_model.json.gz).
+
+Corpus: BIO chunk tags over the hand-tagged POS corpus
+(tools/train_pos_fixture.py), derived by DISTILLING the rule chunker
+(`treeparser._chunk`) on the gold POS tags — the trained model learns the
+same phrase grammar from features (word/POS context + tag history) and
+generalizes it to unseen words and heuristic-POS noise, the role OpenNLP's
+en-chunker.bin plays for the reference. Rerun after changing the chunker,
+features or corpus:
+
+    python tools/train_chunker_fixture.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.text.pos_model import PerceptronChunker  # noqa: E402
+from deeplearning4j_tpu.text.treeparser import _chunk  # noqa: E402
+from train_pos_fixture import HELDOUT, TRAIN  # noqa: E402
+
+
+def to_bio(sent):
+    """[(word, pos)] -> [((word, pos), bio-tag)] via the rule chunker."""
+    toks = [(w, p, i, i + 1) for i, (w, p) in enumerate(sent)]
+    out = []
+    for node in _chunk(toks):
+        if node.is_leaf():
+            out.append(((node.value, node.label), "O"))
+        else:
+            leaves = node.leaves()
+            out.append(((leaves[0].value, leaves[0].label),
+                        "B-" + node.label))
+            out.extend(((l.value, l.label), "I-" + node.label)
+                       for l in leaves[1:])
+    return out
+
+
+def main():
+    train = [to_bio(s) for s in TRAIN]
+    heldout = [to_bio(s) for s in HELDOUT]
+    model = PerceptronChunker.train(train, epochs=10, seed=0)
+    right = total = 0
+    for sent in heldout:
+        got = model.tag([item for item, _ in sent])
+        for (_, gold), (_, guess) in zip(sent, got):
+            right += gold == guess
+            total += 1
+    acc = right / total
+    print(f"held-out BIO accuracy {acc:.3f} ({right}/{total})")
+    # gate BEFORE writing: a regressed retrain must not clobber the
+    # committed fixture
+    assert acc >= 0.9, "chunker fixture regressed below 90% held-out"
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "fixtures",
+        "chunk_model.json.gz")
+    model.save(out)
+    print(f"model -> {out}")
+
+
+if __name__ == "__main__":
+    main()

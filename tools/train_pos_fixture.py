@@ -130,13 +130,15 @@ def main():
             right += gold == guess
             total += 1
     acc = right / total
+    print(f"held-out accuracy {acc:.3f} ({right}/{total})")
+    # gate BEFORE writing: a regressed retrain must not clobber the
+    # committed fixture
+    assert acc >= 0.9, "fixture model regressed below 90% held-out accuracy"
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "tests", "fixtures",
         "pos_model.json.gz")
     model.save(out)
-    print(f"held-out accuracy {acc:.3f} ({right}/{total}); "
-          f"model -> {out}")
-    assert acc >= 0.9, "fixture model regressed below 90% held-out accuracy"
+    print(f"model -> {out}")
 
 
 if __name__ == "__main__":
