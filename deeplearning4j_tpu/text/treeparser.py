@@ -5,13 +5,15 @@ TPU-native equivalent of reference deeplearning4j-nlp-uima
 text/corpora/treeparser/ (TreeParser.java, TreeFactory.java,
 BinarizeTreeTransformer.java, CollapseUnaries.java, HeadWordFinder.java,
 TreeVectorizer.java, TreeIterator.java — 1,352 LoC). The reference drives
-a trained OpenNLP constituency parser through UIMA; trained parser models
-are unavailable offline, so the parse itself is an Abney-style shallow
-chunker over the heuristic POS annotations (`annotation.PosAnnotator`) —
-explicitly approximate, but producing the same artifact family: labeled
-`Tree`s with spans, the binarize/collapse transformers the reference
-applies before RNTN-style training, head-word finding, and batch
-vectorization/iteration.
+trained OpenNLP models through UIMA; here the parse is a shallow chunk
+layer with BOTH the reference's mechanism and an offline default:
+`TreeParser(pos_model=..., chunk_model=...)` loads serialized trained
+perceptron models (`pos_model.PerceptronPosTagger` / `PerceptronChunker`
+— committed fixtures under tests/fixtures/), while the no-model default
+is an Abney-style rule chunker over the heuristic POS annotations.
+Either way the artifact family matches: labeled `Tree`s with spans, the
+binarize/collapse transformers the reference applies before RNTN-style
+training, head-word finding, and batch vectorization/iteration.
 """
 from __future__ import annotations
 
