@@ -1,22 +1,25 @@
 """Mixture-of-Experts with expert parallelism over an "expert" mesh axis.
 
 The reference has NO MoE / expert parallelism (SURVEY.md §2.5 marks EP as
-absent/optional) — this is a TPU-first extension following the Switch
-Transformer recipe: top-1 routing, fixed expert capacity, and an
-``lax.all_to_all`` token shuffle over ICI so each device hosts exactly one
-(or E/devices) expert's FFN. The dense einsum path (`moe_mlp_dense`) is the
-single-chip reference implementation the sharded path is tested against.
+absent/optional) — this is a TPU-first extension: top-1 routing with raw
+router-prob gates (the Switch Transformer recipe) or top-k with
+renormalized combine weights (GShard/Mixtral, ``k=2``), fixed expert
+capacity, and an ``lax.all_to_all`` token shuffle over ICI so each device
+hosts exactly one (or E/devices) expert's FFN. The dense einsum path
+(`moe_mlp_dense`) is the single-chip reference implementation the sharded
+path is tested against, at every k.
 
 Shapes: tokens [B, D]; E experts, capacity C per (source device, expert).
 Dispatch (per device, inside shard_map over axis "expert"):
 
-  1. gate logits -> top-1 expert + gate prob per token
-  2. tokens scatter into a [E, C, D] send buffer (position = rank of the
-     token within its expert group; overflow tokens are DROPPED — their
-     residual path passes them through, standard Switch behavior)
+  1. gate logits -> top-k experts + combine weights per token
+  2. each (token, choice) dispatch unit scatters into a [E, C, D] send
+     buffer, token-major (position = rank within its expert group;
+     overflow units are DROPPED — the residual path passes those tokens
+     through, standard Switch behavior)
   3. all_to_all: device e receives every device's buffer-for-e -> [n, C, D]
   4. local expert FFN over the received tokens (one big MXU matmul)
-  5. reverse all_to_all; each token gathers its result * gate prob
+  5. reverse all_to_all; each token sums its k gated returns
 """
 from __future__ import annotations
 
@@ -57,12 +60,20 @@ def _expert_ffn(w1, b1, w2, b2, x):
     return jax.nn.gelu(x @ w1 + b1) @ w2 + b2
 
 
-def _route(gate_w, x):
-    """Top-1 routing: (expert id [B], gate prob [B], full probs [B, E])."""
+def _route_topk(gate_w, x, k):
+    """Top-k routing (GShard/Mixtral shape): expert ids [B, k] by
+    descending router prob, gates renormalized over the k winners so each
+    token's combine weights sum to 1, full probs [B, E] for the aux loss
+    (which stays over the TOP-1 assignment, the standard choice)."""
     probs = jax.nn.softmax((x @ gate_w).astype(jnp.float32), -1)
-    expert = jnp.argmax(probs, -1)
-    gate = jnp.take_along_axis(probs, expert[:, None], -1)[:, 0]
-    return expert, gate.astype(x.dtype), probs
+    top_p, experts = jax.lax.top_k(probs, k)                  # [B, k]
+    if k == 1:
+        gates = top_p            # Switch: raw router prob as the weight
+    else:
+        # GShard/Mixtral: combine weights renormalized over the winners
+        gates = top_p / jnp.maximum(jnp.sum(top_p, -1, keepdims=True),
+                                    1e-9)
+    return experts, gates.astype(x.dtype), probs
 
 
 def _route_fractions(probs, expert, n_experts):
@@ -81,41 +92,51 @@ def load_balance_loss(probs, expert, n_experts):
     return n_experts * jnp.sum(f * p)
 
 
-def moe_mlp_dense(params, x, capacity=None, n_shards=1):
-    """Single-chip reference: every expert computes every token, the top-1
-    mask selects. With `capacity`, tokens past an expert's capacity are
-    dropped; ranking is computed within each of `n_shards` contiguous
-    batch shards, matching how `moe_mlp_sharded` drops per (source shard,
-    expert) — set n_shards = the mesh axis size for exact equality with
-    the sharded dispatch. Returns (y, aux_loss)."""
+def moe_mlp_dense(params, x, capacity=None, n_shards=1, k=1):
+    """Single-chip reference: every expert computes every token, the
+    top-k mask selects (k=1 = Switch, k=2 = GShard/Mixtral combine).
+    With `capacity`, (token, choice) dispatch units past an expert's
+    capacity are dropped; ranking is computed token-major within each of
+    `n_shards` contiguous batch shards, matching exactly how
+    `moe_mlp_sharded` drops per (source shard, expert) — set n_shards =
+    the mesh axis size for exact equality with the sharded dispatch.
+    Returns (y, aux_loss); aux stays over the top-1 assignment."""
     E = params["w1"].shape[0]
-    expert, gate, probs = _route(params["gate"], x)
-    onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)           # [B, E]
+    experts, gates, probs = _route_topk(params["gate"], x, k)   # [B, k]
+    B = x.shape[0]
+    # virtual dispatch units, token-major: (b0,c0),(b0,c1),(b1,c0),...
+    ev = experts.reshape(B * k)
+    gv = gates.reshape(B * k)
+    onehot_v = jax.nn.one_hot(ev, E, dtype=x.dtype)             # [B*k, E]
     if capacity is not None:
-        B = x.shape[0]
-        oh = onehot.reshape(n_shards, B // n_shards, E)
-        pos = (jnp.cumsum(oh, 1) - oh).reshape(B, E)    # rank within shard
-        keep = (jnp.take_along_axis(pos, expert[:, None], -1)[:, 0]
+        oh = onehot_v.reshape(n_shards, (B * k) // n_shards, E)
+        pos = (jnp.cumsum(oh, 1) - oh).reshape(B * k, E)
+        keep = (jnp.take_along_axis(pos, ev[:, None], -1)[:, 0]
                 < capacity).astype(x.dtype)
-        gate = gate * keep
+        gv = gv * keep
     # [E, B, D] all-experts compute (fine for small E; the EP path exists
     # for when it is not)
     y_all = jax.vmap(_expert_ffn)(params["w1"], params["b1"], params["w2"],
                                   params["b2"],
                                   jnp.broadcast_to(x, (E,) + x.shape))
-    y = jnp.einsum("ebd,be->bd", y_all, onehot) * gate[:, None]
-    return y, load_balance_loss(probs, expert, E)
+    combine = (onehot_v * gv[:, None]).reshape(B, k, E).sum(1)  # [B, E]
+    y = jnp.einsum("ebd,be->bd", y_all, combine)
+    return y, load_balance_loss(probs, experts[:, 0], E)
 
 
-def moe_mlp_sharded(mesh, axis="expert", capacity=None):
+def moe_mlp_sharded(mesh, axis="expert", capacity=None, k=1):
     """Build the expert-parallel apply fn: tokens sharded over `axis`,
     expert FFNs one-per-device-slice, all_to_all dispatch/return.
 
     Returns fn(params_sharded, x[B, D]) -> (y[B, D], aux_loss). B must be
-    divisible by the axis size. `capacity` bounds tokens per (source
-    device, expert) buffer; tokens past it are dropped (output 0 — the
-    caller's residual connection passes them through, Switch-style).
-    Default None = B_local, which can never drop.
+    divisible by the axis size. `capacity` bounds dispatch units per
+    (source device, expert) buffer; units past it are dropped (that
+    choice contributes 0 — the caller's residual connection passes the
+    token through, Switch-style). Default None = k*B_local, which can
+    never drop. k>1 = GShard/Mixtral top-k combine: each token ships to
+    its k experts as k token-major virtual dispatch units through the
+    SAME scatter/all_to_all machinery, and the returns sum weighted by
+    the renormalized gates (pinned == `moe_mlp_dense(k=...)` by test).
     """
     n = mesh.shape[axis]
 
@@ -123,8 +144,13 @@ def moe_mlp_sharded(mesh, axis="expert", capacity=None):
         B_loc, D = x_local.shape
         E = prm["w1"].shape[0] * n          # global expert count
         e_per_dev = prm["w1"].shape[0]
-        C = B_loc if capacity is None else min(int(capacity), B_loc)
-        expert, gate, probs = _route(prm["gate"], x_local)
+        V = B_loc * k                       # virtual dispatch units
+        C = V if capacity is None else min(int(capacity), V)
+        experts, gates, probs = _route_topk(prm["gate"], x_local, k)
+        # token-major virtual expansion (matches moe_mlp_dense exactly)
+        expert = experts.reshape(V)
+        gate = gates.reshape(V)
+        x_v = jnp.repeat(x_local, k, axis=0)           # [V, D]
         onehot = jax.nn.one_hot(expert, E, dtype=x_local.dtype)
         pos = (jnp.cumsum(onehot, 0) - onehot)
         pos_t = jnp.take_along_axis(
@@ -133,7 +159,7 @@ def moe_mlp_sharded(mesh, axis="expert", capacity=None):
         # scatter into [E, C, D] send buffer
         buf = jnp.zeros((E, C, D), x_local.dtype)
         buf = buf.at[expert, jnp.where(keep, pos_t, C - 1)].add(
-            x_local * keep[:, None].astype(x_local.dtype))
+            x_v * keep[:, None].astype(x_local.dtype))
         # group by destination device: [n, e_per_dev*C, D]
         buf = buf.reshape(n, e_per_dev * C, D)
         recv = jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
@@ -150,14 +176,15 @@ def moe_mlp_sharded(mesh, axis="expert", capacity=None):
         back = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=0,
                                   tiled=True)
         back = back.reshape(E, C, D)
-        out = back[expert, jnp.where(keep, pos_t, 0)] * \
+        out_v = back[expert, jnp.where(keep, pos_t, 0)] * \
             (gate * keep.astype(gate.dtype))[:, None]
+        out = out_v.reshape(B_loc, k, D).sum(1)        # combine k returns
         # global-batch aux loss: pmean f and P separately FIRST, then form
         # E*sum(f*P). pmean of per-shard losses would differ (the product
         # is nonlinear in f, P); shards hold equal token counts, so the
         # pmean of per-shard means IS the global mean and aux matches
-        # moe_mlp_dense exactly (pinned by test).
-        f_loc, p_loc = _route_fractions(probs, expert, E)
+        # moe_mlp_dense exactly (pinned by test). Aux stays over top-1.
+        f_loc, p_loc = _route_fractions(probs, experts[:, 0], E)
         aux = E * jnp.sum(jax.lax.pmean(f_loc, axis) *
                           jax.lax.pmean(p_loc, axis))
         return out, aux

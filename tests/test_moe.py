@@ -47,6 +47,39 @@ class TestExpertParallelDispatch:
         np.testing.assert_allclose(float(aux_ep), float(aux_dense),
                                    rtol=1e-6)
 
+    def test_top2_matches_dense_reference(self):
+        """k=2 (GShard/Mixtral combine): each token ships to its two
+        experts as token-major virtual dispatch units through the same
+        all_to_all machinery; the gated sum == the dense k=2 reference,
+        with and without capacity drops."""
+        params, ps, x, mesh = _setup(3)
+        y_ep, aux_ep = jax.jit(moe_mlp_sharded(mesh, k=2))(ps, x)
+        y_dense, aux_dense = moe_mlp_dense(params, x, k=2)
+        np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
+                                   atol=1e-5)
+        np.testing.assert_allclose(float(aux_ep), float(aux_dense),
+                                   rtol=1e-6)
+        y_c, _ = jax.jit(moe_mlp_sharded(mesh, capacity=3, k=2))(ps, x)
+        y_dc, _ = moe_mlp_dense(params, x, capacity=3, n_shards=8, k=2)
+        np.testing.assert_allclose(np.asarray(y_c), np.asarray(y_dc),
+                                   atol=1e-5)
+        # top-2 combine genuinely differs from top-1 (both experts used)
+        y1, _ = moe_mlp_dense(params, x, k=1)
+        assert not np.allclose(np.asarray(y_dense), np.asarray(y1),
+                               atol=1e-4)
+
+    def test_top2_gates_renormalized(self):
+        """k=2 combine weights sum to 1 per token (k=1 keeps the raw
+        Switch prob)."""
+        from deeplearning4j_tpu.parallel.moe import _route_topk
+        params, _, x, _ = _setup(5)
+        _, g2, _ = _route_topk(params["gate"], x, 2)
+        np.testing.assert_allclose(np.asarray(g2).sum(-1), 1.0, atol=1e-6)
+        _, g1, probs = _route_topk(params["gate"], x, 1)
+        assert (np.asarray(g1)[:, 0] < 1.0).all()
+        np.testing.assert_allclose(np.asarray(g1)[:, 0],
+                                   np.asarray(probs).max(-1), atol=1e-6)
+
     def test_capacity_drops_to_residual_zero(self):
         """All-identical tokens route to one expert; capacity=1 keeps one
         token per source shard and zeroes the rest (Switch drop)."""
