@@ -175,11 +175,13 @@ class GravesBidirectionalLSTM(BaseRecurrentLayer):
     n_out: int = None
     forget_gate_bias_init: float = 1.0
     gate_activation: str = "sigmoid"
+    scan_unroll: int = 1                 # see GravesLSTM.scan_unroll
 
     def _sub(self):
         l = GravesLSTM(n_in=self.n_in, n_out=self.n_out,
                        forget_gate_bias_init=self.forget_gate_bias_init,
-                       gate_activation=self.gate_activation)
+                       gate_activation=self.gate_activation,
+                       scan_unroll=self.scan_unroll)
         l.activation = self.activation
         l.weight_init = self.weight_init
         l.dist = self.dist

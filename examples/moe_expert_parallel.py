@@ -31,8 +31,7 @@ print("expert weights sharding:", sharded["w1"].sharding.spec)
 
 # top-2 combine (GShard/Mixtral): same all_to_all dispatch, each token
 # summing two gated expert returns
-import jax as _jax
-y2, _ = _jax.jit(moe_mlp_sharded(mesh, k=2))(sharded, x)
+y2, _ = jax.jit(moe_mlp_sharded(mesh, k=2))(sharded, x)
 y2_ref, _ = moe_mlp_dense(params, x, k=2)
 print("top-2 expert-parallel == dense:",
       bool(jnp.allclose(y2, y2_ref, atol=1e-5)))
