@@ -3,9 +3,13 @@ from . import model_serializer as ModelSerializer  # noqa: N812
 from .model_guesser import load_config_guess, load_model_guess
 from .model_serializer import (restore_computation_graph, restore_model,
                                restore_multi_layer_network, write_model)
-from .sharded_checkpoint import load_checkpoint, save_checkpoint
+from .sharded_checkpoint import (ShardedCheckpointManager,
+                                 ShardedModelSaver,
+                                 load_checkpoint, save_checkpoint)
 
-__all__ = ["ModelGuesser", "ModelSerializer", "load_checkpoint",
+__all__ = ["ModelGuesser", "ModelSerializer",
+           "ShardedCheckpointManager", "ShardedModelSaver",
+           "load_checkpoint",
            "save_checkpoint", "load_config_guess",
            "load_model_guess", "restore_computation_graph", "restore_model",
            "restore_multi_layer_network", "write_model"]

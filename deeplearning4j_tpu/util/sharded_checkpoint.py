@@ -83,6 +83,173 @@ def save_checkpoint(net, path, overwrite=True):
         ckptr.wait_until_finished()
 
 
+class ShardedCheckpointManager:
+    """Step-numbered sharded checkpoints with retention — the
+    CheckpointListener/CheckpointManager role over the mesh-sharded
+    format: keep the last `keep_last` steps plus the best-scoring one,
+    prune the rest.
+
+    Layout: `<directory>/ckpt_<step>/` per checkpoint +
+    `<directory>/manager.json` metadata (steps, scores, best). On a
+    multi-host mesh every process calls `save` (per-process shard
+    writes); metadata writes and pruning happen on process 0 only."""
+
+    def __init__(self, directory, keep_last=3, mode="min"):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self.directory = os.path.abspath(directory)
+        self.keep_last = max(1, int(keep_last))
+        self.mode = mode
+        os.makedirs(self.directory, exist_ok=True)
+        self._meta_path = os.path.join(self.directory, "manager.json")
+        self._meta = {"steps": [], "scores": {}}
+        if os.path.exists(self._meta_path):
+            import json
+            with open(self._meta_path) as f:
+                self._meta = json.load(f)
+            # retention policy is PERSISTED and validated: resuming with a
+            # different mode would invert best_step and prune the true
+            # best checkpoint — fail loudly instead
+            for key, mine in (("mode", self.mode),
+                              ("keep_last", self.keep_last)):
+                stored = self._meta.get(key)
+                if stored is not None and stored != mine:
+                    raise ValueError(
+                        f"checkpoint dir was managed with {key}={stored!r}"
+                        f"; refusing to resume with {key}={mine!r} (pass "
+                        f"the original value)")
+
+    def _path(self, step):
+        return os.path.join(self.directory, f"ckpt_{int(step)}")
+
+    def steps(self):
+        return list(self._meta["steps"])
+
+    def best_step(self):
+        scores = {int(s): v for s, v in self._meta["scores"].items()
+                  if v is not None}
+        if not scores:
+            return None
+        pick = min if self.mode == "min" else max
+        return pick(scores, key=lambda s: (scores[s], -s))
+
+    def save(self, net, step, score=None):
+        """Checkpoint `net` at `step` (optionally scored), then prune to
+        the retention policy. Returns the checkpoint path.
+
+        Crash-safety ordering: the checkpoint is committed first (orbax is
+        atomic), then the metadata is REPLACED atomically, and only then
+        are pruned directories deleted — a crash at any point leaves
+        metadata that references only fully-committed checkpoints (at
+        worst some orphan directories, swept on the next save)."""
+        step = int(step)
+        path = self._path(step)
+        save_checkpoint(net, path)
+        if step not in self._meta["steps"]:
+            self._meta["steps"].append(step)
+            self._meta["steps"].sort()
+        if score is not None or str(step) not in self._meta["scores"]:
+            # never erase a recorded score with a score-less re-save: the
+            # former best must not silently become prunable
+            self._meta["scores"][str(step)] = (None if score is None
+                                               else float(score))
+        stale = self._compute_prune()
+        self._write_meta()
+        if jax.process_index() == 0:
+            import shutil
+            for s in stale:
+                shutil.rmtree(self._path(s), ignore_errors=True)
+            self._sweep_orphans()
+        return path
+
+    def _compute_prune(self):
+        """Drop out-of-policy steps from the metadata; return them (the
+        directories are deleted AFTER the metadata write)."""
+        keep = set(self._meta["steps"][-self.keep_last:])
+        best = self.best_step()
+        if best is not None:
+            keep.add(best)
+        stale = [s for s in self._meta["steps"] if s not in keep]
+        for step in stale:
+            self._meta["steps"].remove(step)
+            self._meta["scores"].pop(str(step), None)
+        return stale
+
+    def _write_meta(self):
+        if jax.process_index() != 0:
+            return
+        import json
+        self._meta["mode"] = self.mode
+        self._meta["keep_last"] = self.keep_last
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._meta, f)
+        os.replace(tmp, self._meta_path)       # atomic on POSIX
+
+    def _sweep_orphans(self):
+        """Delete ckpt_<step> dirs the metadata no longer references
+        (left by a crash between metadata write and deletion)."""
+        import shutil
+        live = {f"ckpt_{s}" for s in self._meta["steps"]}
+        for name in os.listdir(self.directory):
+            if (name.startswith("ckpt_") and name not in live
+                    and name[len("ckpt_"):].isdigit()):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    def restore(self, net, step):
+        return load_checkpoint(net, self._path(int(step)))
+
+    def restore_latest(self, net):
+        if not self._meta["steps"]:
+            raise FileNotFoundError(f"no checkpoints under "
+                                    f"{self.directory!r}")
+        return self.restore(net, self._meta["steps"][-1])
+
+    def restore_best(self, net):
+        best = self.best_step()
+        if best is None:
+            raise FileNotFoundError("no SCORED checkpoints under "
+                                    f"{self.directory!r}")
+        return self.restore(net, best)
+
+
+class ShardedModelSaver:
+    """Early-stopping saver SPI over the sharded format (reference
+    earlystopping/saver/LocalFileModelSaver.java, which writes the zip).
+    The sharded format is not self-describing (no embedded conf), so the
+    saver takes `net_factory` — a zero-arg callable building the same
+    architecture — for the restore side."""
+
+    def __init__(self, directory, net_factory):
+        self.directory = os.path.abspath(directory)
+        self.net_factory = net_factory
+        os.makedirs(self.directory, exist_ok=True)
+
+    @property
+    def best_path(self):
+        return os.path.join(self.directory, "bestModel")
+
+    @property
+    def latest_path(self):
+        return os.path.join(self.directory, "latestModel")
+
+    def save_best_model(self, net, score):
+        save_checkpoint(net, self.best_path)
+
+    def save_latest_model(self, net, score):
+        save_checkpoint(net, self.latest_path)
+
+    def get_best_model(self):
+        return load_checkpoint(self.net_factory(), self.best_path)
+
+    def get_latest_model(self):
+        return load_checkpoint(self.net_factory(), self.latest_path)
+
+    saveBestModel = save_best_model
+    getBestModel = get_best_model
+
+
 def load_checkpoint(net, path):
     """Restore a checkpoint INTO `net`, placing every shard onto the
     sharding each array currently has (shard a fresh net first — e.g. via

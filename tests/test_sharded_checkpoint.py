@@ -113,6 +113,75 @@ def test_computation_graph_round_trip(tmp_path):
         assert float(a._score) == float(b._score)
 
 
+def test_checkpoint_manager_retention(tmp_path):
+    """keep-last-k + keep-best retention (the CheckpointListener role):
+    the best-scoring checkpoint survives pruning even when old."""
+    import os
+    from deeplearning4j_tpu.util.sharded_checkpoint import \
+        ShardedCheckpointManager
+    ds = _data()
+    net = _net()
+    mgr = ShardedCheckpointManager(tmp_path / "ckpts", keep_last=2,
+                                   mode="min")
+    # step 1 gets the BEST (lowest) score; later steps score worse
+    scores = {1: 0.10, 2: 0.50, 3: 0.40, 4: 0.60, 5: 0.70}
+    for step, score in scores.items():
+        net.fit(ds)
+        mgr.save(net, step, score=score)
+    assert mgr.steps() == [1, 4, 5]          # last 2 + best
+    assert mgr.best_step() == 1
+    kept = sorted(d for d in os.listdir(tmp_path / "ckpts")
+                  if d.startswith("ckpt_"))
+    assert kept == ["ckpt_1", "ckpt_4", "ckpt_5"]
+    # restores: latest continues exactly; best differs from latest
+    b = mgr.restore_latest(_net(seed=2))
+    assert b.conf.iteration_count == net.conf.iteration_count
+    best = mgr.restore_best(_net(seed=3))
+    assert best.conf.iteration_count < b.conf.iteration_count
+    # a fresh manager over the same dir reloads the metadata
+    mgr2 = ShardedCheckpointManager(tmp_path / "ckpts", keep_last=2)
+    assert mgr2.steps() == [1, 4, 5] and mgr2.best_step() == 1
+    # a mismatched retention policy on resume fails loudly (a silent
+    # mode flip would invert best_step and prune the true best)
+    with pytest.raises(ValueError):
+        ShardedCheckpointManager(tmp_path / "ckpts", keep_last=2,
+                                 mode="max")
+    # a score-less re-save of a scored step keeps the recorded score
+    net.fit(ds)
+    mgr2.save(net, 1)
+    assert mgr2.best_step() == 1
+    # orphan sweep: a dir left by a crash (metadata written, delete
+    # missed) disappears on the next save
+    os.makedirs(tmp_path / "ckpts" / "ckpt_99")
+    net.fit(ds)
+    mgr2.save(net, 6, score=0.8)
+    assert not (tmp_path / "ckpts" / "ckpt_99").exists()
+
+
+def test_sharded_saver_in_early_stopping(tmp_path):
+    """ShardedModelSaver drives the early-stopping trainer the way
+    LocalFileModelSaver does (reference saver SPI), restoring the best
+    model from the sharded format via the architecture factory."""
+    from deeplearning4j_tpu.earlystopping.early_stopping import (
+        DataSetLossCalculator, EarlyStoppingConfiguration,
+        EarlyStoppingTrainer, MaxEpochsTerminationCondition)
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.util.sharded_checkpoint import \
+        ShardedModelSaver
+    train = ListDataSetIterator(list(_data(64, 1).batch_by(16)))
+    val = ListDataSetIterator(list(_data(32, 2).batch_by(16)))
+    es = (EarlyStoppingConfiguration.Builder()
+          .score_calculator(DataSetLossCalculator(val))
+          .epoch_termination_conditions(MaxEpochsTerminationCondition(2))
+          .model_saver(ShardedModelSaver(str(tmp_path), _net))
+          .build())
+    result = EarlyStoppingTrainer(es, _net(), train).fit()
+    best = result.get_best_model()
+    assert best is not None
+    assert (tmp_path / "bestModel").exists()
+    assert np.asarray(best.output(_data(32).features)).shape == (32, 3)
+
+
 @pytest.mark.multiprocess
 def test_two_process_sharded_save_restore(tmp_path):
     """2 real processes x 2 devices: every process writes only its own
