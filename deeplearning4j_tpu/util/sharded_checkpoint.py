@@ -130,8 +130,10 @@ class ShardedCheckpointManager:
                   if v is not None}
         if not scores:
             return None
-        pick = min if self.mode == "min" else max
-        return pick(scores, key=lambda s: (scores[s], -s))
+        if self.mode == "min":
+            # latest wins ties: smaller score first, then larger step
+            return min(scores, key=lambda s: (scores[s], -s))
+        return max(scores, key=lambda s: (scores[s], s))
 
     def save(self, net, step, score=None):
         """Checkpoint `net` at `step` (optionally scored), then prune to

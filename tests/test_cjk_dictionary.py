@@ -199,3 +199,23 @@ class TestKoreanDictionary:
     def test_missing_dir_raises(self, tmp_path):
         with pytest.raises(ValueError):
             load_dictionary(str(tmp_path))
+
+
+class TestDictionaryEdgeCases:
+    def test_empty_and_unknown_only_text(self):
+        dic = compile_dictionary(JA)
+        assert viterbi_segment_dict("", dic) == []
+        # archaic kana with no dictionary entry: the unknown model still
+        # produces a connected lattice (never raises, never drops text)
+        out = viterbi_segment_dict("ゑゐ", dic)
+        assert "".join(s for s, _, _ in out) == "ゑゐ"
+
+    def test_no_entries_raises(self, tmp_path):
+        (tmp_path / "matrix.def").write_text("1 1\n0 0 0\n")
+        with pytest.raises(ValueError):
+            compile_dictionary(str(tmp_path))
+
+    def test_short_line_raises(self, tmp_path):
+        (tmp_path / "bad.csv").write_text("只,1,2\n", encoding="utf-8")
+        with pytest.raises(ValueError):
+            compile_dictionary(str(tmp_path))

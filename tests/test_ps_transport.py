@@ -103,3 +103,31 @@ def test_ps_leaf_serialization_round_trip():
     for a, b in zip(leaves, out):
         assert a.dtype == b.dtype and a.shape == b.shape
         np.testing.assert_array_equal(a, b)
+
+
+def test_client_errors_are_loud():
+    """A dead server is a ConnectionError at connect; a half-open server
+    that closes mid-protocol raises instead of hanging or mis-parsing."""
+    import socket
+    import threading
+    from deeplearning4j_tpu.parallel.ps_transport import PSClient
+    with pytest.raises(OSError):
+        PSClient("127.0.0.1", 1, connect_timeout=1)
+    # server that accepts then immediately closes: pull() must raise a
+    # ConnectionError (peer closed), not return garbage
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def accept_close():
+        conn, _ = srv.accept()
+        conn.close()
+
+    t = threading.Thread(target=accept_close, daemon=True)
+    t.start()
+    c = PSClient("127.0.0.1", port, connect_timeout=5)
+    with pytest.raises(ConnectionError):
+        c.pull()
+    t.join(timeout=5)
+    srv.close()

@@ -250,3 +250,23 @@ def test_restore_into_zero1_sharded_layout(tmp_path):
     pw_a.fit(ds)
     pw_b.fit(ds)
     assert float(a._score) == float(b._score)
+
+
+def test_wrong_architecture_restore_fails_loudly(tmp_path):
+    """Restoring into a mismatched architecture raises (orbax shape
+    check) — never silently truncates or pads."""
+    ds = _data()
+    a = _net()
+    a.fit(ds)
+    save_checkpoint(a, tmp_path / "ck")
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    other = MultiLayerNetwork(
+        (NeuralNetConfiguration.Builder().seed(1).updater("adam")
+         .learning_rate(0.05).list()
+         .layer(0, DenseLayer(n_out=99, activation="relu"))
+         .layer(1, OutputLayer(n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+         .set_input_type(InputType.feed_forward(5)).build())).init()
+    with pytest.raises(Exception):
+        load_checkpoint(other, tmp_path / "ck")
