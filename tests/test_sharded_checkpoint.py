@@ -78,6 +78,7 @@ def test_unfitted_net_round_trip(tmp_path):
                                   np.asarray(b.params()))
 
 
+@pytest.mark.slow
 def test_computation_graph_round_trip(tmp_path):
     """Same module serves ComputationGraph (dict-keyed pytrees)."""
     from deeplearning4j_tpu import ComputationGraph
@@ -158,6 +159,7 @@ def test_checkpoint_manager_retention(tmp_path):
     assert not (tmp_path / "ckpts" / "ckpt_99").exists()
 
 
+@pytest.mark.slow
 def test_sharded_saver_in_early_stopping(tmp_path):
     """ShardedModelSaver drives the early-stopping trainer the way
     LocalFileModelSaver does (reference saver SPI), restoring the best
