@@ -124,12 +124,15 @@ def moe_mlp_dense(params, x, capacity=None, n_shards=1, k=1):
     return y, load_balance_loss(probs, experts[:, 0], E)
 
 
-def moe_mlp_sharded(mesh, axis="expert", capacity=None, k=1):
+def moe_mlp_sharded(mesh, axis="expert", capacity=None, k=1,
+                    data_axis=None):
     """Build the expert-parallel apply fn: tokens sharded over `axis`,
     expert FFNs one-per-device-slice, all_to_all dispatch/return.
 
     Returns fn(params_sharded, x[B, D]) -> (y[B, D], aux_loss). B must be
-    divisible by the axis size. `capacity` bounds dispatch units per
+    divisible by the axis size (by the PRODUCT of both axis sizes when
+    `data_axis` is set — the batch shards over the joint
+    (data_axis, axis) grid). `capacity` bounds dispatch units per
     (source device, expert) buffer; units past it are dropped (that
     choice contributes 0 — the caller's residual connection passes the
     token through, Switch-style). Default None = k*B_local, which can
@@ -137,6 +140,12 @@ def moe_mlp_sharded(mesh, axis="expert", capacity=None, k=1):
     its k experts as k token-major virtual dispatch units through the
     SAME scatter/all_to_all machinery, and the returns sum weighted by
     the renormalized gates (pinned == `moe_mlp_dense(k=...)` by test).
+
+    `data_axis`: dp x ep composition on a 2-axis mesh — the batch shards
+    over (data_axis, axis) jointly, expert params replicate across
+    `data_axis`, and each data slice runs its own expert all_to_all ring
+    (collectives stay within the expert groups; the aux loss pmean's over
+    BOTH axes so it is the global-batch value).
     """
     n = mesh.shape[axis]
 
@@ -185,15 +194,18 @@ def moe_mlp_sharded(mesh, axis="expert", capacity=None, k=1):
         # pmean of per-shard means IS the global mean and aux matches
         # moe_mlp_dense exactly (pinned by test). Aux stays over top-1.
         f_loc, p_loc = _route_fractions(probs, experts[:, 0], E)
-        aux = E * jnp.sum(jax.lax.pmean(f_loc, axis) *
-                          jax.lax.pmean(p_loc, axis))
+        mean_axes = (axis,) if data_axis is None else (data_axis, axis)
+        aux = E * jnp.sum(jax.lax.pmean(f_loc, mean_axes) *
+                          jax.lax.pmean(p_loc, mean_axes))
         return out, aux
 
     pspec = {"gate": P(), "w1": P(axis), "b1": P(axis), "w2": P(axis),
              "b2": P(axis)}
+    batch_spec = (P(axis) if data_axis is None
+                  else P((data_axis, axis)))
     fn = shard_map(spmd, mesh=mesh,
-                   in_specs=(pspec, P(axis)),
-                   out_specs=(P(axis), P()),
+                   in_specs=(pspec, batch_spec),
+                   out_specs=(batch_spec, P()),
                    check_vma=False)
 
     def apply(params, x):
