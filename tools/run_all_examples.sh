@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Run EVERY example end-to-end on the CPU backend (virtual 8-device mesh)
+# and report pass/fail per file — the full-bitrot sweep behind the
+# examples test tier (tests/test_examples.py runs a fast subset; this is
+# the whole set, ~15-25 min on a single-core box).
+#
+# Usage: tools/run_all_examples.sh [timeout_seconds_per_example]
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+T="${1:-360}"
+fails=0
+cd "$REPO/examples"
+for f in *.py; do
+  [ "$f" = "_common.py" ] && continue
+  if timeout "$T" env PYTHONPATH="$REPO" JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python "$f" >"/tmp/example_$f.out" 2>&1 < /dev/null; then
+    echo "PASS $f"
+  else
+    echo "FAIL $f (rc=$?, log /tmp/example_$f.out)"
+    fails=$((fails + 1))
+  fi
+done
+exit "$fails"
