@@ -145,6 +145,20 @@ class TestPipelinedTransformer:
         pred = np.asarray(jnp.argmax(lm.logits(x), -1))
         assert (pred == y).mean() > 0.8
 
+    def test_decode_paths_token_identical_untrained(self):
+        """Core-tier pin of every decode path with NO training loop (path
+        equality doesn't need learned weights): per-token KV-cache decode
+        and the one-program generate_batch both reproduce the recompute
+        generate() tokens on a freshly-initialized model."""
+        lm = TransformerLM(11, d_model=16, n_heads=2, n_layers=2,
+                           max_len=12)
+        out = lm.generate([2, 3, 4], max_new_tokens=4)
+        assert lm.generate([2, 3, 4], max_new_tokens=4,
+                           use_cache=True) == out
+        batched = lm.generate_batch(np.array([[2, 3, 4]], np.int32),
+                                    max_new_tokens=4)
+        assert list(batched[0]) == out
+
     @pytest.mark.slow
     def test_generate_continues_learned_pattern(self):
         """After learning the +1 shift task, greedy generate() continues
@@ -167,10 +181,13 @@ class TestPipelinedTransformer:
         with pytest.raises(ValueError):
             lm.generate([1] * 10, max_new_tokens=10, use_cache=True)
 
+    @pytest.mark.slow
     def test_generate_batch_matches_cached_decode(self):
         """generate_batch (one on-device prefill+decode scan program) is
         token-identical, row by row, to the per-token KV-cache decode —
-        the same greedy outputs with one host round trip per call."""
+        the same greedy outputs with one host round trip per call.
+        Full tier: core still pins greedy==cached per-token decode and the
+        generate_batch LRU/shape contract; this is the cross-path sweep."""
         lm = TransformerLM(11, d_model=32, n_heads=4, n_layers=2,
                            max_len=16, learning_rate=0.2, momentum=0.9)
         x, y = _char_data()

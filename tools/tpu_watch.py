@@ -2,9 +2,17 @@
 
 The axon relay (127.0.0.1:8103) is the only path to the chip and can be
 down/wedged for hours (see BENCH_r02..r04 history). This loop does a
-zero-risk TCP check first; only when the port accepts does it spend a
-real jax-init probe (subprocess, generous timeout — killing a chip job
-can wedge the relay, so we only probe when the TCP layer looks alive).
+zero-risk TCP check first and normally spends a real jax-init probe
+(subprocess, generous timeout) only when the port accepts — but the
+port has been observed both refusing while a client was mid-init and
+flapping open with no chip behind it, so it is a heuristic, not a
+proven proxy for the axon dial path. Every FORCE_EVERYth iteration the
+jax probe therefore runs unconditionally. A timeout-killed probe could
+in principle wedge a half-live relay (the reason for the original
+TCP-only gate), but a wedged-invisible relay is indistinguishable from
+that state from in here, and the forced probes are spaced
+FORCE_EVERY*INTERVAL apart (~15 min default) to bound the exposure;
+the driver's own bench capture performs the same init+timeout pattern.
 
 Appends one JSON line per probe to /tmp/tpu_probe.log and, when the chip
 answers, writes /tmp/tpu_up.json with the device kind so the main agent
@@ -21,6 +29,10 @@ LOG = "/tmp/tpu_probe.log"
 UP = "/tmp/tpu_up.json"
 PORT = int(os.environ.get("TPU_WATCH_PORT", "8103"))
 INTERVAL = int(os.environ.get("TPU_WATCH_INTERVAL_S", "300"))
+FORCE_EVERY = max(1, int(os.environ.get("TPU_WATCH_FORCE_EVERY", "3")))
+# self-expire so a forgotten watcher's jax-init subprocess can never hold
+# a device grant while the driver's end-of-round bench capture probes
+MAX_HOURS = float(os.environ.get("TPU_WATCH_MAX_HOURS", "10.5"))
 JAX_PROBE_TIMEOUT = int(os.environ.get("TPU_WATCH_PROBE_TIMEOUT_S", "300"))
 
 PROBE_CODE = """
@@ -62,8 +74,25 @@ def stale_up():
 
 
 def main():
-    while True:
-        if not tcp_open():
+    it = 0
+    t0 = time.monotonic()   # wall-clock steps must not extend the expiry
+    # deadline excludes a worst-case in-flight probe + sleep so no probe
+    # subprocess can still be holding a device grant past MAX_HOURS;
+    # clamped so a tiny MAX_HOURS still watches at least one iteration
+    budget = max(MAX_HOURS * 3600 - JAX_PROBE_TIMEOUT - INTERVAL,
+                 INTERVAL + 1)
+    while time.monotonic() - t0 < budget:
+        it += 1
+        # The TCP gate is a cheap heuristic, but the relay port is not a
+        # proven proxy for the axon dial path (r5 continuation session:
+        # the port flapped open once with no chip behind it, and refused
+        # while a live client was mid-init — distinct boots of this
+        # container behave differently). Every FORCE_EVERYth iteration
+        # run the real jax probe regardless, so a recovery the TCP layer
+        # can't see is still caught within ~3 intervals.
+        force = it % FORCE_EVERY == 0
+        tcp = tcp_open() if not force else None
+        if not force and not tcp:
             log({"status": "no-relay"})
             stale_up()
         else:
@@ -75,19 +104,24 @@ def main():
                 if p.returncode == 0 and p.stdout.strip():
                     info = json.loads(p.stdout.strip().splitlines()[-1])
                     info["probed_at"] = time.time()
+                    info["forced"] = force
                     log({"status": "tpu-up", **info})
                     with open(UP, "w") as f:
                         json.dump(info, f)
                 else:
                     log({"status": "probe-failed", "rc": p.returncode,
-                         "err": p.stderr[-400:]})
+                         "forced": force, "err": p.stderr[-400:]})
                     stale_up()
             except subprocess.TimeoutExpired:
-                log({"status": "probe-timeout"})
+                log({"status": "probe-timeout", "forced": force})
                 stale_up()
             except Exception as e:  # keep the watcher alive no matter what
                 log({"status": "watcher-error", "err": repr(e)})
+                stale_up()      # errors must not preserve an old UP marker
         time.sleep(INTERVAL)
+    # expiry must not leave a stale chip-is-up signal behind either
+    stale_up()
+    log({"status": "expired", "after_s": round(time.monotonic() - t0)})
 
 
 if __name__ == "__main__":
