@@ -31,6 +31,16 @@ class DataSet:
     def num_examples(self):
         return int(self.features.shape[0])
 
+    def shallow_copy(self):
+        """New DataSet sharing the same arrays — lets a pre-processor
+        rebind .features without mutating a cached original."""
+        out = DataSet.__new__(DataSet)
+        out.features = self.features
+        out.labels = self.labels
+        out.features_mask = self.features_mask
+        out.labels_mask = self.labels_mask
+        return out
+
     def get_features(self):
         return self.features
 
@@ -111,6 +121,16 @@ class MultiDataSet:
 
     def num_examples(self):
         return int(self.features[0].shape[0])
+
+    def shallow_copy(self):
+        out = MultiDataSet.__new__(MultiDataSet)
+        out.features = list(self.features)
+        out.labels = list(self.labels)
+        out.features_masks = (list(self.features_masks)
+                              if self.features_masks else self.features_masks)
+        out.labels_masks = (list(self.labels_masks)
+                            if self.labels_masks else self.labels_masks)
+        return out
 
 
 def _as_list(x):

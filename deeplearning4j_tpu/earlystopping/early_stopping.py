@@ -19,6 +19,8 @@ import time
 
 import numpy as np
 
+from ..datasets.iterators import next_processed
+
 
 # ---------------------------------------------------------------------------
 # Score calculators
@@ -37,7 +39,7 @@ class DataSetLossCalculator:
         self.iterator.reset()
         total, count = 0.0, 0
         while self.iterator.has_next():
-            ds = self.iterator.next_batch()
+            ds = next_processed(self.iterator)
             n = ds.num_examples()
             total += net.score(ds) * n
             count += n
@@ -367,7 +369,7 @@ class EarlyStoppingTrainer:
         Subclasses (the TrainingMaster trainer) override the epoch body."""
         self.train_iterator.reset()
         while self.train_iterator.has_next():
-            ds = self.train_iterator.next_batch()
+            ds = next_processed(self.train_iterator)
             self._fit_batch(ds)
             stop = self._check_iteration_termination(c,
                                                      float(self.net.score()))

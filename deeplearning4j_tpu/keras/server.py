@@ -23,6 +23,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..datasets.iterators import next_processed
+
 
 def _load_array(path, key):
     if str(path).endswith((".h5", ".hdf5")):
@@ -101,7 +103,7 @@ class DeepLearning4jEntryPoint:
             for _ in range(int(nb_epoch)):
                 it.reset()
                 while it.has_next():
-                    net.fit(it.next_batch())
+                    net.fit(next_processed(it))
             return float(net.score())
 
     def predict(self, model_path, features_path):

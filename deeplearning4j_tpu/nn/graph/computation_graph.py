@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...datasets.dataset import DataSet, MultiDataSet
+from ...datasets.iterators import next_processed
 from ..conf.computation_graph_configuration import ComputationGraphConfiguration
 from ..conf.layers.base import LayerConf
 from ..conf.layers.recurrent import BaseRecurrentLayer
@@ -452,7 +453,7 @@ class ComputationGraph:
                                   if isinstance(ds, DataSet) else ds)
             else:
                 while data.has_next():
-                    ds = data.next_batch()
+                    ds = next_processed(data)
                     self._fit_mds(_dataset_to_mds(ds)
                                   if isinstance(ds, DataSet) else ds)
             self.conf.epoch_count += 1
@@ -755,7 +756,7 @@ class ComputationGraph:
             data.reset()
             items = []
             while data.has_next():
-                items.append(data.next_batch())
+                items.append(next_processed(data))
             data = items
         for ds in data:
             mds = _dataset_to_mds(ds) if isinstance(ds, DataSet) else ds

@@ -34,7 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..datasets.dataset import DataSet
-from ..datasets.iterators import AsyncDataSetIterator, DataSetIterator, ListDataSetIterator
+from ..datasets.iterators import (AsyncDataSetIterator, DataSetIterator,
+                                  ListDataSetIterator, next_processed)
 from .conf.neural_net_configuration import MultiLayerConfiguration
 from .updater import updaters as U
 
@@ -355,7 +356,7 @@ class MultiLayerNetwork:
                 if hasattr(l, "on_epoch_start"):
                     l.on_epoch_start(self)
             while async_it.has_next():
-                ds = async_it.next_batch()
+                ds = next_processed(async_it)
                 self._fit_batch(ds)
             for l in self.listeners:
                 if hasattr(l, "on_epoch_end"):
@@ -494,7 +495,7 @@ class MultiLayerNetwork:
         for _ in range(num_epochs):
             data.reset()
             while data.has_next():
-                ds = data.next_batch()
+                ds = next_processed(data)
                 self._rng, rng = jax.random.split(self._rng)
                 new_p, ustate, loss = jit_step(
                     self._params, ustate, self._model_state,

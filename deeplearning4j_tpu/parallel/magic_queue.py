@@ -18,6 +18,7 @@ import queue
 import threading
 
 from ..datasets.dataset import DataSet
+from ..datasets.iterators import next_processed
 
 _EOS = object()     # end-of-stream marker, distinct from any shard
 
@@ -60,7 +61,7 @@ class MagicQueue:
                 else:
                     if not iterator.has_next():
                         break
-                    ds = iterator.next_batch()
+                    ds = next_processed(iterator)
                 n = len(self.devices)
                 b = ds.num_examples()
                 per = -(-b // n)

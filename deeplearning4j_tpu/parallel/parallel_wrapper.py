@@ -36,7 +36,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..datasets.dataset import DataSet
-from ..datasets.iterators import ListDataSetIterator
+from ..datasets.iterators import ListDataSetIterator, next_processed
 from .sharding import make_mesh, put_sharded, replicate, shard_params
 
 log = logging.getLogger(__name__)
@@ -266,7 +266,7 @@ class ParallelWrapper:
             # stats from iteration_done mid-fit (generation bump); the
             # cached-step fast path is one attribute compare
             step = self._ensure_allreduce_step()
-            ds = it.next_batch()
+            ds = next_processed(it)
             net._rng, step_rng = jax.random.split(net._rng)
             batch, feats = self._sharded_batch(ds, step_rng)
             (net._params, net._updater_state, net._model_state, score,
@@ -286,7 +286,7 @@ class ParallelWrapper:
         k = self.averaging_frequency
         pending = []
         while it.has_next():
-            pending.append(it.next_batch())
+            pending.append(next_processed(it))
             if len(pending) == k:
                 self._run_kstep(pending)
                 pending = []

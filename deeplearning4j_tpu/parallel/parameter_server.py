@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from ..datasets.dataset import DataSet
-from ..datasets.iterators import ListDataSetIterator
+from ..datasets.iterators import ListDataSetIterator, next_processed
 
 log = logging.getLogger(__name__)
 
@@ -235,7 +235,7 @@ class ParameterServerParallelWrapper:
                 shards = [[] for _ in range(self.workers)]
                 i = 0
                 while data.has_next():
-                    shards[i % self.workers].append(data.next_batch())
+                    shards[i % self.workers].append(next_processed(data))
                     i += 1
 
                 def worker(batches, wrng):

@@ -38,6 +38,8 @@ import socket
 import struct
 import threading
 
+from ..datasets.iterators import next_processed
+
 import numpy as np
 
 log = logging.getLogger(__name__)
@@ -330,7 +332,7 @@ def ps_worker_fit(net, host, port, data, num_epochs=1, seed=0):
     for _ in range(num_epochs):
         data.reset()
         while data.has_next():
-            ds = data.next_batch()
+            ds = next_processed(data)
             pleaves, sleaves, version = client.pull()
             params = jax.tree_util.tree_unflatten(treedef, pleaves)
             state = (jax.tree_util.tree_unflatten(sdef, sleaves)

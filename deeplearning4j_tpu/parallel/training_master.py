@@ -25,7 +25,7 @@ import logging
 import time
 
 from ..datasets.dataset import DataSet
-from ..datasets.iterators import ListDataSetIterator
+from ..datasets.iterators import ListDataSetIterator, next_processed
 from .parallel_wrapper import ParallelWrapper
 
 log = logging.getLogger(__name__)
@@ -292,7 +292,7 @@ class ParameterAveragingTrainingMaster:
         out = []
         it.reset()
         while it.has_next():
-            out.append(it.next_batch())
+            out.append(next_processed(it))
         return out
 
     executeTraining = execute_training
@@ -357,7 +357,7 @@ class ParameterAveragingTrainingMaster:
         else:
             data.reset()
             while data.has_next():
-                yield data.next_batch()
+                yield next_processed(data)
 
     @staticmethod
     def _collect_examples(data):
@@ -369,7 +369,7 @@ class ParameterAveragingTrainingMaster:
         data.reset()
         items = []
         while data.has_next():
-            items.append(data.next_batch())
+            items.append(next_processed(data))
         return DataSet.merge(items)
 
 

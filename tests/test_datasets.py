@@ -363,3 +363,170 @@ def test_existing_iterator_one_shot_generator_replays():
     # and a second full pass replays identically
     vals2 = [float(ds.features[0, 0]) for ds in it]
     assert vals2 == [0.0, 1.0, 2.0]
+
+
+class TestWirePipeline:
+    """r5 host->HBM wire-bytes levers (AsyncDataSetIterator transfer_dtype /
+    device_transform) + DataSetIterator.set_pre_processor parity
+    (reference DataSetIterator.setPreProcessor, applied on the async
+    prefetch thread like AsyncDataSetIterator.java)."""
+
+    def _data(self, n=8, f=6, c=3, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.random((n, f)).astype(np.float32)
+        y = np.eye(c, dtype=np.float32)[rng.integers(0, c, n)]
+        return x, y
+
+    def test_set_pre_processor_applied_by_iteration(self):
+        from deeplearning4j_tpu.datasets.iterators import ArraysDataSetIterator
+        x, y = self._data()
+        it = ArraysDataSetIterator((x, y), batch_size=4)
+
+        def double(ds):
+            ds.features = ds.features * 2
+            return ds
+
+        it.set_pre_processor(double)
+        batches = list(it)
+        np.testing.assert_allclose(np.asarray(batches[0].features), x[:4] * 2)
+
+    def test_async_applies_underlying_pre_processor_on_worker(self):
+        from deeplearning4j_tpu.datasets.iterators import (
+            ArraysDataSetIterator, AsyncDataSetIterator)
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        x, y = self._data(n=16)
+        norm = NormalizerStandardize().fit(
+            ArraysDataSetIterator((x, y), batch_size=8))
+        base = ArraysDataSetIterator((x, y), batch_size=8)
+        base.set_pre_processor(norm)
+        got = np.concatenate([np.asarray(ds.features) for ds in
+                              AsyncDataSetIterator(base, queue_size=2)])
+        np.testing.assert_allclose(got, (x - norm.mean) / norm.std, rtol=2e-5)
+
+    def test_transfer_dtype_casts_floats_only(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.datasets.iterators import (
+            ArraysDataSetIterator, AsyncDataSetIterator)
+        rng = np.random.default_rng(1)
+        x8 = rng.integers(0, 256, (8, 5), dtype=np.uint8)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        it = AsyncDataSetIterator(
+            ArraysDataSetIterator((x8, y), batch_size=4),
+            transfer_dtype="bfloat16")
+        ds = it.next_batch()
+        assert ds.features.dtype == np.uint8          # ints stay compact
+        assert ds.labels.dtype == jnp.bfloat16        # floats shrink 2x
+        # one-hot labels are exact in bf16
+        np.testing.assert_array_equal(
+            np.asarray(ds.labels, dtype=np.float32), y[:4])
+
+    def test_uint8_wire_plus_device_scale_matches_host_normalize(self):
+        """End-to-end: raw uint8 over the wire + ImagePreProcessingScaler
+        on device == the reference-style host-side f32 transform."""
+        from deeplearning4j_tpu.datasets.iterators import (
+            ArraysDataSetIterator, AsyncDataSetIterator)
+        from deeplearning4j_tpu.datasets.normalizers import (
+            ImagePreProcessingScaler)
+        rng = np.random.default_rng(2)
+        x8 = rng.integers(0, 256, (8, 4, 4, 3), dtype=np.uint8)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        scaler = ImagePreProcessingScaler()
+        it = AsyncDataSetIterator(
+            ArraysDataSetIterator((x8, y), batch_size=8),
+            device_transform=scaler)
+        dev = np.asarray(it.next_batch().features, dtype=np.float32)
+        host = x8.astype(np.float32) / 255.0
+        # bf16 (8-bit mantissa) rounds twice: the 1/255 constant and the
+        # product — ~2^-7 relative worst case on values in [0, 1]
+        np.testing.assert_allclose(dev, host, atol=2.0 ** -7)
+
+    def test_device_apply_standardize_and_minmax_match_transform(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import ArraysDataSetIterator
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerMinMaxScaler, NormalizerStandardize)
+        x, y = self._data(n=12)
+        for norm in (NormalizerStandardize(), NormalizerMinMaxScaler(-1, 1)):
+            norm.fit(ArraysDataSetIterator((x, y), batch_size=6))
+            host = np.asarray(
+                norm.transform(DataSet(x.copy(), y)).features)
+            dev = np.asarray(norm.device_apply(jnp.asarray(x)),
+                             dtype=np.float32)
+            np.testing.assert_allclose(dev, host, rtol=1e-4, atol=1e-5)
+
+    def test_num_workers_preserves_order_and_content(self):
+        from deeplearning4j_tpu.datasets.iterators import (
+            ArraysDataSetIterator, AsyncDataSetIterator)
+        rng = np.random.default_rng(3)
+        x = np.arange(64, dtype=np.float32).reshape(16, 4)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        it = AsyncDataSetIterator(
+            ArraysDataSetIterator((x, y), batch_size=2),
+            queue_size=3, num_workers=4)
+        feats = [np.asarray(ds.features) for ds in it]
+        assert len(feats) == 8
+        np.testing.assert_array_equal(np.concatenate(feats), x)
+        # reset + second pass identical (pool restarts cleanly)
+        feats2 = [np.asarray(ds.features) for ds in it]
+        np.testing.assert_array_equal(np.concatenate(feats2), x)
+
+    def test_num_workers_propagates_worker_error(self):
+        from deeplearning4j_tpu.datasets.iterators import (
+            AsyncDataSetIterator, DataSetIterator)
+
+        class Boom(DataSetIterator):
+            def __init__(self):
+                self._i = 0
+
+            def has_next(self):
+                return self._i < 4
+
+            def next_batch(self):
+                self._i += 1
+                if self._i == 3:
+                    raise ValueError("boom")
+                from deeplearning4j_tpu.datasets.dataset import DataSet
+                return DataSet(np.zeros((2, 2), np.float32),
+                               np.zeros((2, 2), np.float32))
+
+            def reset(self):
+                self._i = 0
+
+        it = AsyncDataSetIterator(Boom(), num_workers=3)
+        with pytest.raises((RuntimeError, ValueError)):
+            while it.has_next():
+                it.next_batch()
+
+    def test_pre_processor_not_reapplied_to_cached_batches(self):
+        """Cached-batch iterators hand out the same DataSet objects every
+        epoch; the pre-processor must transform a shallow copy, or epoch 2
+        trains on double-normalized data."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import (
+            AsyncDataSetIterator, ListDataSetIterator, next_processed)
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        x, y = self._data(n=8)
+        base = ListDataSetIterator(DataSet(x.copy(), y), batch_size=4)
+        norm = NormalizerStandardize().fit(DataSet(x.copy(), y))
+        base.set_pre_processor(norm)
+        expect = (x - norm.mean) / norm.std
+        for _pass in range(3):   # plain path: next() over 3 epochs
+            base.reset()
+            got = []
+            while base.has_next():
+                got.append(np.asarray(next_processed(base).features))
+            np.testing.assert_allclose(np.concatenate(got), expect,
+                                       rtol=2e-5, err_msg=f"pass {_pass}")
+        for _pass in range(3):   # async path: worker-applied, 3 epochs
+            it = AsyncDataSetIterator(base, queue_size=2)
+            got = np.concatenate([np.asarray(ds.features) for ds in it])
+            np.testing.assert_allclose(got, expect, rtol=2e-5)
+        # the cached originals are untouched raw data
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(b.features)
+                            for b in base._batches]), x)
