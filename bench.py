@@ -566,6 +566,12 @@ def _run_config_subprocess(name, timeout, env_overlay=None, small=False):
 
 def main():
     t_start = time.perf_counter()
+    inj = os.environ.get("DL4J_TPU_BENCH_FAIL_ONCE")
+    if inj:
+        try:
+            os.remove(os.path.join("/tmp", f"bench_fail_once_{inj}"))
+        except OSError:
+            pass
     # r3 measured: 5 configs ≈ 390 s end-to-end on the remote-attached
     # chip; 660 leaves room for the extras. Safe against any driver
     # timeout because every line printed so far is a complete record.
@@ -643,6 +649,28 @@ def main():
             name, timeout=min(remaining, est_s * 2.5),
             env_overlay=env_overlay, small=small)
         emit()
+        # one budget-gated retry: a transient tunnel hiccup (the dominant
+        # failure mode on a remote-attached chip) should cost a config one
+        # extra attempt, not its record — the primary already retries 3x.
+        # Only on the accelerator path: in CPU fallback an error is
+        # deterministic and an identical retry would just starve the
+        # second-probe window's budget.
+        if (tpu_err is None
+                and "value" not in record["secondary"][name]
+                and "skipped" not in record["secondary"][name]):
+            remaining = deadline - time.perf_counter()
+            if remaining >= est_s + 5:
+                time.sleep(5)   # let a tunnel blip pass (as the primary does)
+                retry = _run_config_subprocess(
+                    name, timeout=min(remaining - 5, est_s * 2.5),
+                    env_overlay=env_overlay, small=small)
+                if "value" in retry:
+                    retry["retried"] = 1
+                    record["secondary"][name] = retry
+                else:
+                    record["secondary"][name]["retry_error"] = (
+                        f"{retry.get('error', retry)!s:.200}")
+                emit()
 
     # --- second TPU probe window (r5, VERDICT r4 item 1) ---
     # A flaky tunnel sometimes comes back minutes later; after a CPU
@@ -717,6 +745,17 @@ def main():
 
 
 def run_single_config(name, small=False):
+    # fault injection for the secondary-retry path: fail the named
+    # config's FIRST attempt (sentinel file marks it consumed; main()
+    # clears stale sentinels at startup so the injection can't silently
+    # no-op on a second run)
+    inj = os.environ.get("DL4J_TPU_BENCH_FAIL_ONCE")
+    if inj == name:
+        sentinel = os.path.join("/tmp", f"bench_fail_once_{name}")
+        if not os.path.exists(sentinel):
+            open(sentinel, "w").close()
+            print("injected failure", file=sys.stderr)
+            sys.exit(1)
     if os.environ.get("DL4J_TPU_BENCH_CPU"):
         import jax
         jax.config.update("jax_platforms", "cpu")
