@@ -61,7 +61,14 @@ class Normalizer:
         Memoized per (normalizer, dtype): every iterator built over the
         same fitted normalizer shares ONE function object, so jax.jit
         reuses one compiled program instead of re-tracing per iterator
-        (re-fitting clears the cache)."""
+        (re-fitting clears the cache).
+
+        NOTE: the statistics are baked into the compiled program as
+        constants at trace time — construct iterators AFTER the final
+        fit(). An iterator built before a re-fit keeps normalizing with
+        the old statistics (re-fitting invalidates this memo so NEW
+        iterators pick up the new stats, but cannot reach programs
+        already compiled inside existing iterators)."""
         import jax.numpy as jnp
         dt = jnp.dtype(dtype)
         cache = self.__dict__.setdefault("_device_transform_cache", {})

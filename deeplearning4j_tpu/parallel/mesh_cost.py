@@ -62,9 +62,20 @@ def hlo_collective_footprint(hlo_text):
         m = _OP_RE.search(line)
         if m is None:
             continue
+        shape = m.group(1)
+        b = shape_bytes(shape)
+        if m.group(3):
+            # async form: the -start result tuple aliases the operand as
+            # its leading component(s) — count only the produced half so
+            # sync and async lowerings of the same collective agree (else
+            # a backend flip sync<->async looks like a 2x traffic
+            # regression against the committed budgets)
+            shapes = [sm.group(0) for sm in _SHAPE_RE.finditer(shape)]
+            if len(shapes) > 1:
+                b = sum(shape_bytes(s) for s in shapes[len(shapes) // 2:])
         rec = out.setdefault(m.group(2), {"count": 0, "bytes": 0})
         rec["count"] += 1
-        rec["bytes"] += shape_bytes(m.group(1))
+        rec["bytes"] += b
     return out
 
 

@@ -141,8 +141,12 @@ class PSServer:
 
         self.net = net
         self._jax = jax
-        self._treedef = jax.tree_util.tree_structure(net._params)
+        # the accumulator initializes a fresh net (GradientsAccumulator
+        # calls _ensure_init); capture the treedef AFTER it so a server
+        # built around a never-fit network doesn't freeze the empty
+        # None-pytree and break every subsequent PUSH unflatten
         self._acc = GradientsAccumulator(net, queue_size, max_staleness)
+        self._treedef = jax.tree_util.tree_structure(net._params)
         self._n_workers = int(n_workers)
         self._done = 0
         self._done_evt = threading.Event()

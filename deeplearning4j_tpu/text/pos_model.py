@@ -232,8 +232,7 @@ class TrainedPosAnnotator:
 
     def process(self, doc):
         for sent in doc.select("sentence"):
-            toks = [t for t in doc.select("token")
-                    if t.begin >= sent.begin and t.end <= sent.end]
+            toks = doc.covered(sent, "token")
             words = [t.features.get("text", t.covered_text(doc.text))
                      for t in toks]
             if not words:

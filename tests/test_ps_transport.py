@@ -131,3 +131,33 @@ def test_client_errors_are_loud():
         c.pull()
     t.join(timeout=5)
     srv.close()
+
+
+def test_server_on_fresh_net_accepts_push():
+    """Regression: PSServer built around a NEVER-initialized net captured
+    the treedef before GradientsAccumulator ran _ensure_init, freezing the
+    empty None-pytree and making every PUSH unflatten blow up."""
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel.ps_transport import PSClient, PSServer
+
+    conf = (NeuralNetConfiguration.Builder().seed(3)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, DenseLayer(n_out=8, activation="relu"))
+            .layer(1, OutputLayer(n_out=2, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf)    # NOT .init()'ed by the caller
+    assert net._params is None       # precondition: genuinely uninitialized
+    srv = PSServer(net, n_workers=1)   # serving starts in __init__
+    try:
+        c = PSClient("127.0.0.1", srv.port)
+        leaves, _state, version = c.pull()
+        assert len(leaves) > 0
+        grads = [np.zeros_like(np.asarray(l)) for l in leaves]
+        c.push(grads, 1.0, version)    # raised before the fix
+        c.done()
+    finally:
+        srv.stop()
