@@ -289,11 +289,16 @@ class AsyncDataSetIterator(DataSetIterator):
         self._device_transform = device_transform
         if device_transform is not None:
             import jax
-            # eager wrapper (compiles on first call): workers share one
-            # jit object, so no lazy-init race between staging threads.
-            # Normalizer.as_device_transform() memoizes per instance, so
-            # iterators over the same normalizer share ONE compiled program
-            self._device_fn = jax.jit(device_transform)
+            # one shared jit object per iterator (created eagerly: no
+            # lazy-init race between staging threads). A Normalizer's
+            # as_device_transform() already returns a memoized JITTED
+            # function — use it as-is so every iterator over the same
+            # normalizer shares one compiled program (re-wrapping in
+            # jax.jit would give each iterator its own executable cache)
+            if hasattr(device_transform, "lower"):   # already jit-wrapped
+                self._device_fn = device_transform
+            else:
+                self._device_fn = jax.jit(device_transform)
         else:
             self._device_fn = None
         # >1 overlaps per-batch prepare+transfer latency — for hosts where

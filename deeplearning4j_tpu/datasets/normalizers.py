@@ -69,11 +69,17 @@ class Normalizer:
         the old statistics (re-fitting invalidates this memo so NEW
         iterators pick up the new stats, but cannot reach programs
         already compiled inside existing iterators)."""
+        import jax
         import jax.numpy as jnp
         dt = jnp.dtype(dtype)
         cache = self.__dict__.setdefault("_device_transform_cache", {})
         if dt not in cache:
-            cache[dt] = lambda x: self.device_apply(x.astype(dt))
+            # the JITTED wrapper is what must be shared: distinct jax.jit
+            # objects never share executables even over the same callable,
+            # so memoizing a bare lambda and re-jitting per iterator would
+            # re-trace/re-compile in every iterator (and inside any timed
+            # fit() that builds iterators per epoch)
+            cache[dt] = jax.jit(lambda x: self.device_apply(x.astype(dt)))
         return cache[dt]
 
     @staticmethod

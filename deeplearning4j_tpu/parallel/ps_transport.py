@@ -67,7 +67,11 @@ def pack_leaves(leaves):
         # NOT ascontiguousarray: it promotes 0-d scalars to 1-d, and
         # tobytes() below already emits C-order for any layout
         a = np.asarray(leaf)
-        dt = a.dtype.str.encode()
+        # dtype by NAME, not .str: ml_dtypes types (bfloat16, fp8) have
+        # .str '<V2'/'<V1' (raw void) which round-trips as opaque bytes;
+        # np.dtype('bfloat16') resolves correctly once ml_dtypes is
+        # registered (importing jax registers it on both ends)
+        dt = a.dtype.name.encode()
         out.append(struct.pack("<B", len(dt)))
         out.append(dt)
         out.append(struct.pack("<B", a.ndim))

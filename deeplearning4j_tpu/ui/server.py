@@ -131,10 +131,13 @@ async function latestSession(){
  const s=await (await fetch('/api/sessions')).json();
  return s.length? s[s.length-1] : null;}
 function syncSelect(sel, names, chosen, onPick, label){
- // rebuild only when the option count changes; returns the active name.
- // A stale choice (not in the current name set) falls back to names[0],
- // and the widget is synced to whatever is actually plotted.
- if(sel.options.length!==names.length){
+ // rebuild when the option NAME SET changes (count alone misses a new
+ // session with the same number of differently-named layers, leaving
+ // the dropdown showing an option that is not what is plotted); returns
+ // the active name. A stale choice falls back to names[0], and the
+ // widget is synced to whatever is actually plotted.
+ const current=[...sel.options].map(o=>o.value);
+ if(current.length!==names.length||current.some((v,i)=>v!==names[i])){
   sel.textContent='';
   for(const n of names){const o=el('option', label? label+n : n);
     o.value=n; sel.appendChild(o);}

@@ -133,7 +133,11 @@ class StatsListener(IterationListener):
             live = getattr(model, "_last_activation_stats", None)
             if live is not None:
                 # the fused step emitted summaries of the REAL training
-                # batch (BaseStatsListener.java:273-420 onForwardPass role)
+                # batch (BaseStatsListener.java:273-420 onForwardPass role).
+                # CONSUME it: training modes whose steps don't emit stats
+                # (k-local-steps averaging, PS wrapper) must not re-report
+                # this batch's summaries as fresh data forever after
+                model._last_activation_stats = None
                 report["activationStats"] = self._live_summaries(live)
                 grids = self._live_grids(live)
                 if grids:

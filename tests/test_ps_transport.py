@@ -105,6 +105,25 @@ def test_ps_leaf_serialization_round_trip():
         np.testing.assert_array_equal(a, b)
 
 
+def test_leaf_serialization_bfloat16_round_trip():
+    """Regression: dtype was serialized as numpy dtype.str, which for
+    ml_dtypes types is raw void ('<V2') — a bf16 model's params/grads
+    came back as opaque void arrays on the peer."""
+    import ml_dtypes
+
+    from deeplearning4j_tpu.parallel.ps_transport import (pack_leaves,
+                                                          unpack_leaves)
+    leaves = [np.linspace(-2, 2, 8).astype(ml_dtypes.bfloat16),
+              np.float32(1.5),
+              np.arange(6, dtype=np.int32).reshape(2, 3)]
+    out, _ = unpack_leaves(pack_leaves(leaves))
+    assert out[0].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out[0], np.float32), np.asarray(leaves[0], np.float32))
+    assert out[1].dtype == np.float32 and float(out[1]) == 1.5
+    np.testing.assert_array_equal(out[2], leaves[2])
+
+
 def test_client_errors_are_loud():
     """A dead server is a ConnectionError at connect; a half-open server
     that closes mid-protocol raises instead of hanging or mis-parsing."""
