@@ -178,6 +178,24 @@ class TestRecordReaders:
         assert np.isfinite(net.score())
 
 
+class _OneShotIterator:
+    """Yields one (Multi)DataSet then is exhausted; reset() re-arms."""
+
+    def __init__(self, item):
+        self._item = item
+        self._done = False
+
+    def has_next(self):
+        return not self._done
+
+    def next_batch(self):
+        self._done = True
+        return self._item
+
+    def reset(self):
+        self._done = False
+
+
 class TestMultiInputPipeline:
     @pytest.mark.slow
     def test_csv_multi_reader_async_feeds_computation_graph(self, tmp_path):
@@ -250,22 +268,7 @@ class TestMultiInputPipeline:
         fm = [np.tril(np.ones((4, 5), np.float32))]
         lm = [np.triu(np.ones((4, 5), np.float32))]
         mds = MultiDataSet(f, l, fm, lm)
-
-        class _OneShot:
-            def __init__(self):
-                self._done = False
-
-            def has_next(self):
-                return not self._done
-
-            def next_batch(self):
-                self._done = True
-                return mds
-
-            def reset(self):
-                self._done = False
-
-        it = AsyncMultiDataSetIterator(_OneShot(), queue_size=2)
+        it = AsyncMultiDataSetIterator(_OneShotIterator(mds), queue_size=2)
         staged = it.next_batch()
         assert np.array_equal(np.asarray(staged.features_masks[0]), fm[0])
         assert np.array_equal(np.asarray(staged.labels_masks[0]), lm[0])
@@ -530,3 +533,33 @@ class TestWirePipeline:
         np.testing.assert_array_equal(
             np.concatenate([np.asarray(b.features)
                             for b in base._batches]), x)
+
+    def test_async_multi_wire_levers(self):
+        """transfer_dtype + device_transform on the MultiDataSet path
+        (ComputationGraph pipelines): uint8 inputs stay compact on the
+        wire, float labels shrink to bf16, scaling happens post-stage."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.datasets import AsyncMultiDataSetIterator
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.datasets.normalizers import (
+            ImagePreProcessingScaler)
+        rng = np.random.default_rng(5)
+        x8a = rng.integers(0, 256, (4, 3, 3, 1), dtype=np.uint8)
+        x8b = rng.integers(0, 256, (4, 2), dtype=np.uint8)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        mds = MultiDataSet([x8a, x8b], [y])
+        it = AsyncMultiDataSetIterator(
+            _OneShotIterator(mds), transfer_dtype="bfloat16",
+            device_transform=ImagePreProcessingScaler())
+        # the wire format itself: ints pass through untouched, floats shrink
+        wired = it._cast_for_wire(mds)
+        assert wired.features[0].dtype == np.uint8
+        assert wired.features[1].dtype == np.uint8
+        assert wired.labels[0].dtype == jnp.bfloat16
+        got = it.next_batch()
+        assert got.labels[0].dtype == jnp.bfloat16
+        for raw, dev in zip((x8a, x8b), got.features):
+            np.testing.assert_allclose(
+                np.asarray(dev, np.float32),
+                raw.astype(np.float32) / 255.0, atol=2.0 ** -7)
