@@ -275,11 +275,18 @@ class AsyncDataSetIterator(DataSetIterator):
     """
 
     def __init__(self, underlying, queue_size=2, device_put=True,
-                 transfer_dtype=None, device_transform=None, num_workers=1):
+                 transfer_dtype=None, device_transform=None, num_workers=1,
+                 cast_labels=True):
         self.underlying = underlying
         self.queue_size = max(1, int(queue_size))
         self._device_put = device_put
         self._transfer_dtype = transfer_dtype
+        # cast_labels=False: shrink FEATURES only — for a bf16 model the
+        # step casts features to bf16 anyway, so a bf16 feature wire is
+        # BIT-IDENTICAL training; labels can matter at full precision
+        # (regression targets), so the auto-enabled fit() path leaves them
+        # alone and only explicit opt-in casts them
+        self._cast_labels = bool(cast_labels)
         if device_transform is not None and not device_put:
             raise ValueError(
                 "device_transform requires device_put=True (the transform "
@@ -392,11 +399,12 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def _cast_for_wire(self, ds):
         cast = _wire_caster(self._transfer_dtype)
+        keep = (lambda a: a) if not self._cast_labels else cast
         out = DataSet.__new__(DataSet)
         out.features = cast(ds.features)
-        out.labels = cast(ds.labels)
-        out.features_mask = cast(ds.features_mask)
-        out.labels_mask = cast(ds.labels_mask)
+        out.labels = keep(ds.labels)
+        out.features_mask = keep(ds.features_mask)
+        out.labels_mask = keep(ds.labels_mask)
         return out
 
     def _raise_if_failed(self):
@@ -459,12 +467,13 @@ class AsyncMultiDataSetIterator(AsyncDataSetIterator):
     def _cast_for_wire(self, mds):
         from .dataset import MultiDataSet
         cast = _wire_caster(self._transfer_dtype)
+        keep = (lambda a: a) if not self._cast_labels else cast
         out = MultiDataSet.__new__(MultiDataSet)
         out.features = [cast(f) for f in mds.features]
-        out.labels = [cast(l) for l in mds.labels]
-        out.features_masks = ([cast(m) for m in mds.features_masks]
+        out.labels = [keep(l) for l in mds.labels]
+        out.features_masks = ([keep(m) for m in mds.features_masks]
                               if mds.features_masks else mds.features_masks)
-        out.labels_masks = ([cast(m) for m in mds.labels_masks]
+        out.labels_masks = ([keep(m) for m in mds.labels_masks]
                             if mds.labels_masks else mds.labels_masks)
         return out
 

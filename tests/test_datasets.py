@@ -563,3 +563,50 @@ class TestWirePipeline:
             np.testing.assert_allclose(
                 np.asarray(dev, np.float32),
                 raw.astype(np.float32) / 255.0, atol=2.0 ** -7)
+
+    def test_bf16_model_auto_wire_is_bit_identical(self):
+        """fit(plain_iterator) on a bf16 model auto-ships features as bf16
+        (the step casts them to bf16 anyway) — training must be
+        BIT-identical to the f32-wire path, and non-bf16 models must not
+        be wire-cast at all."""
+        from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.datasets.iterators import (
+            ArraysDataSetIterator, AsyncDataSetIterator)
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        rng = np.random.default_rng(11)
+        x = rng.random((32, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+
+        def build(dt):
+            conf = (NeuralNetConfiguration.Builder().seed(5)
+                    .updater("sgd").learning_rate(0.05)
+                    .data_type(dt).list()
+                    .layer(0, DenseLayer(n_out=8, activation="relu"))
+                    .layer(1, OutputLayer(n_out=3, activation="softmax",
+                                          loss_function="mcxent"))
+                    .set_input_type(InputType.feed_forward(6))
+                    .build())
+            return MultiLayerNetwork(conf).init()
+
+        a = build("bfloat16")
+        a.fit(ArraysDataSetIterator((x, y), batch_size=16), num_epochs=4)
+        b = build("bfloat16")
+        b.fit(AsyncDataSetIterator(               # explicit f32 wire
+            ArraysDataSetIterator((x, y), batch_size=16)), num_epochs=4)
+        assert float(a._score) == float(b._score)
+        np.testing.assert_array_equal(np.asarray(a.params(), np.float32),
+                                      np.asarray(b.params(), np.float32))
+        # float64 (gradient-check) models keep a full-precision wire:
+        # plain-iterator fit (auto path) must be bit-identical to an
+        # explicit no-wire async iterator — a wrongly-applied bf16 wire
+        # would truncate features and break the equality
+        c = build("float64")
+        c.fit(ArraysDataSetIterator((x, y), batch_size=16), num_epochs=2)
+        d = build("float64")
+        d.fit(AsyncDataSetIterator(
+            ArraysDataSetIterator((x, y), batch_size=16)), num_epochs=2)
+        assert c.params().dtype == np.float64
+        np.testing.assert_array_equal(np.asarray(c.params()),
+                                      np.asarray(d.params()))
