@@ -43,6 +43,19 @@ def next_processed(it):
     return nxt() if callable(nxt) else it.next_batch()
 
 
+def wrap_async_for_fit(it, compute_dtype):
+    """fit()'s auto-wrap policy, shared by MultiLayerNetwork and
+    ComputationGraph: async prefetch (queue 2), and for bf16 models a bf16
+    FEATURE wire — bit-identical training (the fused step casts features
+    to bf16 anyway) with labels/masks kept at full precision."""
+    import jax.numpy as jnp
+    if isinstance(it, AsyncDataSetIterator):
+        return it
+    wire = "bfloat16" if compute_dtype == jnp.bfloat16 else None
+    return AsyncDataSetIterator(it, queue_size=2, transfer_dtype=wire,
+                                cast_labels=False)
+
+
 def _wire_caster(transfer_dtype):
     """Array cast for the host->device wire: floats shrink to
     transfer_dtype (lossless-for-training at bf16); ints (uint8 pixels,
@@ -398,6 +411,12 @@ class AsyncDataSetIterator(DataSetIterator):
             self._q.put(self._sentinel)
 
     def _cast_for_wire(self, ds):
+        from .dataset import MultiDataSet
+        if isinstance(ds, MultiDataSet):
+            # a plain DataSetIterator can legally yield MultiDataSets
+            # (ExistingDataSetIterator over a MultiDataSet list feeding
+            # ComputationGraph.fit) — dispatch per batch type
+            return AsyncMultiDataSetIterator._cast_for_wire(self, ds)
         cast = _wire_caster(self._transfer_dtype)
         keep = (lambda a: a) if not self._cast_labels else cast
         out = DataSet.__new__(DataSet)
@@ -414,6 +433,10 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def _stage(self, ds):
         import jax
+
+        from .dataset import MultiDataSet
+        if isinstance(ds, MultiDataSet):
+            return AsyncMultiDataSetIterator._stage(self, ds)
         staged = DataSet.__new__(DataSet)
         staged.features = jax.device_put(ds.features)
         if self._device_fn is not None:

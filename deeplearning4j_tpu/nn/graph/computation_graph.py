@@ -442,9 +442,22 @@ class ComputationGraph:
             data = _dataset_to_mds(data)
         if isinstance(data, MultiDataSet):
             return self._fit_mds(data)
-        # iterator of DataSet / MultiDataSet
-        for _ in range(num_epochs):
-            if hasattr(data, "reset"):
+        # iterator of DataSet / MultiDataSet: prefetch + stage off the
+        # training thread like the reference (ComputationGraph.fit wraps
+        # in Async(Multi)DataSetIterator), with the bf16 feature wire for
+        # bf16 models (bit-identical — the step casts features anyway)
+        from ...datasets.iterators import (DataSetIterator,
+                                           wrap_async_for_fit)
+        if isinstance(data, DataSetIterator):
+            # the wrapper stages DataSet AND MultiDataSet batches
+            # (per-batch dispatch), so one class covers both protocols
+            data = wrap_async_for_fit(data, self.compute_dtype)
+        for epoch in range(num_epochs):
+            # a fresh async wrapper is already prefetching; resetting it
+            # on epoch 0 would drain (and stage) one full pass unseen
+            if hasattr(data, "reset") and (
+                    epoch > 0 or not getattr(data, "has_next",
+                                             lambda: False)()):
                 data.reset()
             it = iter(data) if not hasattr(data, "has_next") else None
             if it is not None:

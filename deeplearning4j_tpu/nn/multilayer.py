@@ -345,15 +345,8 @@ class MultiLayerNetwork:
         raise TypeError(f"Cannot fit on {type(data)}")
 
     def _fit_iterator(self, it, num_epochs=1):
-        # bf16 models: ship features over the host->device wire in bf16 —
-        # the fused step casts them to bf16 anyway, so training is
-        # BIT-IDENTICAL while the transfer halves (labels/masks stay at
-        # full precision; see AsyncDataSetIterator cast_labels)
-        wire = ("bfloat16" if self.compute_dtype == jnp.bfloat16 else None)
-        async_it = (it if isinstance(it, AsyncDataSetIterator)
-                    else AsyncDataSetIterator(it, queue_size=2,
-                                              transfer_dtype=wire,
-                                              cast_labels=False))
+        from ..datasets.iterators import wrap_async_for_fit
+        async_it = wrap_async_for_fit(it, self.compute_dtype)
         if self._jit_step is None:
             self._jit_step = self._make_step()
         for epoch in range(num_epochs):

@@ -299,3 +299,47 @@ class TestGraphTraining:
         outs = net.output([xa, xb])
         assert np.asarray(outs[0]).shape == (16, 2)
         assert np.asarray(outs[1]).shape == (16, 1)
+
+
+class TestGraphAsyncFit:
+    """r5: ComputationGraph.fit auto-wraps plain iterators in async
+    prefetch (reference AsyncMultiDataSetIterator role) with the bf16
+    feature wire for bf16 models — including DataSetIterator
+    implementations that yield MultiDataSets (per-batch dispatch)."""
+
+    def _conf(self, dt="float32"):
+        b = (NeuralNetConfiguration.Builder().seed(9)
+             .updater("sgd").learning_rate(0.05))
+        if dt != "float32":
+            b = b.data_type(dt)
+        return (b.graph_builder()
+                .add_inputs("in")
+                .add_layer("h", DenseLayer(n_out=8, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss_function="mcxent"), "h")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+
+    def test_plain_dataset_iterator_trains_and_bf16_wire_bit_identical(self):
+        from deeplearning4j_tpu.datasets.iterators import (
+            ArraysDataSetIterator, AsyncDataSetIterator)
+        x, y = _xy(32)
+        a = ComputationGraph(self._conf("bfloat16")).init()
+        a.fit(ArraysDataSetIterator((x, y), batch_size=16), num_epochs=3)
+        b = ComputationGraph(self._conf("bfloat16")).init()
+        b.fit(AsyncDataSetIterator(                 # explicit f32 wire
+            ArraysDataSetIterator((x, y), batch_size=16)), num_epochs=3)
+        np.testing.assert_array_equal(np.asarray(a.params(), np.float32),
+                                      np.asarray(b.params(), np.float32))
+
+    def test_iterator_yielding_multidatasets_dispatches(self):
+        from deeplearning4j_tpu.datasets.iterators import (
+            ExistingDataSetIterator)
+        x, y = _xy(24)
+        batches = [MultiDataSet([x[i:i + 8]], [y[i:i + 8]])
+                   for i in range(0, 24, 8)]
+        net = ComputationGraph(self._conf()).init()
+        s0 = net.score(DataSet(x, y))
+        net.fit(ExistingDataSetIterator(batches), num_epochs=8)
+        assert net.score(DataSet(x, y)) < s0
