@@ -233,7 +233,8 @@ def bench_resnet50_pipeline(rng, small=False):
     u8_base = ArraysDataSetIterator((x8, y), batch_size=batch)
     ips = run(lambda: AsyncDataSetIterator(
         u8_base, queue_size=4, transfer_dtype="bfloat16",
-        device_transform=scaler), epochs=1 if small else 2)
+        device_transform=scaler.as_device_transform("bfloat16")),
+        epochs=1 if small else 2)
 
     xf = (x8.astype(np.float32) / 255.0)
     f32_base = ArraysDataSetIterator((xf, y), batch_size=batch)

@@ -222,7 +222,9 @@ class MultipleEpochsIterator(DataSetIterator):
         return False
 
     def next_batch(self):
-        return self.underlying.next_batch()
+        # through the underlying's pre-processor-applying path, so a
+        # normalizer attached to the inner iterator survives the wrap
+        return next_processed(self.underlying)
 
     def reset(self):
         self._epoch = 0
@@ -343,6 +345,14 @@ class AsyncDataSetIterator(DataSetIterator):
             # epoch's staging threads instead of leaking a pool per epoch
             old_pool.shutdown(wait=False)
             self._pool = None
+        # per-generation stop event: reset()/_start() signals the OLD
+        # generation's threads to exit so a failed collector can't leave
+        # the producer blocked on a full future queue, and a restart can't
+        # race the old producer's next_batch() against underlying.reset()
+        old_stop = getattr(self, "_stop", None)
+        if old_stop is not None:
+            old_stop.set()
+        self._stop = threading.Event()
         if self.num_workers == 1:
             self._thread = threading.Thread(target=self._worker, daemon=True)
             self._thread.start()
@@ -356,9 +366,12 @@ class AsyncDataSetIterator(DataSetIterator):
                 thread_name_prefix="async-ds-stage")
             self._futs = queue.Queue(maxsize=self.queue_size
                                      + self.num_workers)
-            threading.Thread(target=self._producer, daemon=True).start()
-            self._thread = threading.Thread(target=self._collector,
-                                            daemon=True)
+            threading.Thread(target=self._producer,
+                             args=(self._futs, self._stop),
+                             daemon=True).start()
+            self._thread = threading.Thread(
+                target=self._collector, args=(self._futs, self._stop),
+                daemon=True)
             self._thread.start()
         self._next = self._q.get()
         self._raise_if_failed()
@@ -384,22 +397,34 @@ class AsyncDataSetIterator(DataSetIterator):
         finally:
             self._q.put(self._sentinel)
 
-    def _producer(self):
+    def _producer(self, futs, stop):
         try:
-            while self.underlying.has_next():
+            while not stop.is_set() and self.underlying.has_next():
                 # next_batch() stays on ONE thread (iterators aren't
                 # thread-safe); only prepare/stage fans out
                 ds = self.underlying.next_batch()
-                self._futs.put(self._pool.submit(self._prepare, ds))
+                fut = self._pool.submit(self._prepare, ds)
+                while not stop.is_set():
+                    try:
+                        futs.put(fut, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
         except BaseException as e:  # surfaced by the collector
-            self._futs.put(e)
+            try:
+                futs.put(e, timeout=0.2)
+            except queue.Full:
+                pass
         finally:
-            self._futs.put(self._sentinel)
+            try:
+                futs.put(self._sentinel, timeout=0.2)
+            except queue.Full:
+                pass
 
-    def _collector(self):
+    def _collector(self, futs, stop):
         try:
-            while True:
-                fut = self._futs.get()
+            while not stop.is_set():
+                fut = futs.get()
                 if fut is self._sentinel:
                     break
                 if isinstance(fut, BaseException):
@@ -407,6 +432,12 @@ class AsyncDataSetIterator(DataSetIterator):
                 self._q.put(fut.result())
         except BaseException as e:
             self._error = e
+            stop.set()            # unblock the producer's bounded put
+            while True:           # drain so its in-flight put releases
+                try:
+                    futs.get_nowait()
+                except queue.Empty:
+                    break
         finally:
             self._q.put(self._sentinel)
 
@@ -469,6 +500,17 @@ class AsyncDataSetIterator(DataSetIterator):
         # on the prefetch thread in _prepare(); re-applying here would
         # double-normalize
         return self.next_batch()
+
+    def set_pre_processor(self, p):
+        # the prefetch worker started in __init__ and has already prepared
+        # up to queue_size+2 batches with the OLD (absent) pre-processor —
+        # attaching now would silently train the first batches raw.
+        # Attach to the underlying iterator BEFORE wrapping instead (the
+        # worker applies it), or pass it at construction time.
+        raise RuntimeError(
+            "set_pre_processor on a running AsyncDataSetIterator would "
+            "miss already-prefetched batches; attach the pre-processor "
+            "to the underlying iterator before wrapping")
 
     def reset(self):
         # drain and restart

@@ -55,9 +55,15 @@ class Normalizer:
             return x.astype(jnp.float32)
         return x
 
-    def as_device_transform(self, dtype="bfloat16"):
-        """Callable for AsyncDataSetIterator(device_transform=...): casts to
-        `dtype` (the model compute dtype) then applies device_apply.
+    def as_device_transform(self, dtype=None):
+        """Callable for AsyncDataSetIterator(device_transform=...).
+        dtype=None (default): apply device_apply directly — integer wire
+        formats are promoted to float32 by _float_input, preserving full
+        precision for any source depth (uint16 medical images keep 16
+        significant bits). Pass the model compute dtype (e.g. "bfloat16"
+        for a bf16 model) to ALSO pre-cast on device, halving the HBM
+        write of the staged batch — only safe when the training step
+        would cast to that dtype anyway.
         Memoized per (normalizer, dtype): every iterator built over the
         same fitted normalizer shares ONE function object, so jax.jit
         reuses one compiled program instead of re-tracing per iterator
@@ -71,7 +77,7 @@ class Normalizer:
         already compiled inside existing iterators)."""
         import jax
         import jax.numpy as jnp
-        dt = jnp.dtype(dtype)
+        dt = None if dtype is None else jnp.dtype(dtype)
         cache = self.__dict__.setdefault("_device_transform_cache", {})
         if dt not in cache:
             # the JITTED wrapper is what must be shared: distinct jax.jit
@@ -79,7 +85,11 @@ class Normalizer:
             # so memoizing a bare lambda and re-jitting per iterator would
             # re-trace/re-compile in every iterator (and inside any timed
             # fit() that builds iterators per epoch)
-            cache[dt] = jax.jit(lambda x: self.device_apply(x.astype(dt)))
+            if dt is None:
+                cache[dt] = jax.jit(self.device_apply)
+            else:
+                cache[dt] = jax.jit(
+                    lambda x: self.device_apply(x.astype(dt)))
         return cache[dt]
 
     @staticmethod

@@ -385,6 +385,7 @@ class MultiLayerNetwork:
                  self._loop_state(), features, labels, fmask, lmask)
             if acts:
                 self._last_activation_stats = acts[0]
+                self._last_activation_stats_iter = self.conf.iteration_count
             self._score = score
             self.conf.iteration_count += 1
             for l in self.listeners:
@@ -427,6 +428,7 @@ class MultiLayerNetwork:
                  self._loop_state(), f_seg, l_seg, fm_seg, lm_seg, carries)
             if acts:
                 self._last_activation_stats = acts[0]
+                self._last_activation_stats_iter = self.conf.iteration_count
             # stop gradient flow across segments (truncation) — carries are
             # fresh inputs to the next jitted call, so this is automatic.
             self._score = score

@@ -66,13 +66,14 @@ def hlo_collective_footprint(hlo_text):
         b = shape_bytes(shape)
         if m.group(3):
             # async form: the -start result tuple aliases the operand as
-            # its leading component(s) — count only the produced half so
-            # sync and async lowerings of the same collective agree (else
-            # a backend flip sync<->async looks like a 2x traffic
-            # regression against the committed budgets)
+            # its FIRST component (remaining components are the produced
+            # result + tiny context scalars on some lowerings) — subtract
+            # the operand so sync and async lowerings of the same
+            # collective agree (else a backend flip sync<->async looks
+            # like a 2x traffic regression against committed budgets)
             shapes = [sm.group(0) for sm in _SHAPE_RE.finditer(shape)]
             if len(shapes) > 1:
-                b = sum(shape_bytes(s) for s in shapes[len(shapes) // 2:])
+                b -= shape_bytes(shapes[0])
         rec = out.setdefault(m.group(2), {"count": 0, "bytes": 0})
         rec["count"] += 1
         rec["bytes"] += b

@@ -274,6 +274,7 @@ class ParallelWrapper:
                               net._model_state, batch)
             if acts:
                 net._last_activation_stats = acts[0]
+                net._last_activation_stats_iter = net.conf.iteration_count
             net._score = score
             net._last_batch_size = int(
                 jax.tree.leaves(feats)[0].shape[0])

@@ -70,7 +70,11 @@ def pack_leaves(leaves):
         # dtype by NAME, not .str: ml_dtypes types (bfloat16, fp8) have
         # .str '<V2'/'<V1' (raw void) which round-trips as opaque bytes;
         # np.dtype('bfloat16') resolves correctly once ml_dtypes is
-        # registered (importing jax registers it on both ends)
+        # registered (importing jax registers it on both ends). The name
+        # drops byte order, so normalize non-native-endian sources (a
+        # '>f4' leaf loaded from an h5 file) to native first.
+        if a.dtype.byteorder == ">":
+            a = a.astype(a.dtype.newbyteorder("="))
         dt = a.dtype.name.encode()
         out.append(struct.pack("<B", len(dt)))
         out.append(dt)

@@ -131,13 +131,19 @@ class StatsListener(IterationListener):
             self._prev_params = params
         if c.collect_activations:
             live = getattr(model, "_last_activation_stats", None)
-            if live is not None:
+            live_iter = getattr(model, "_last_activation_stats_iter", None)
+            fresh = (live is not None
+                     and live_iter != getattr(self, "_last_seen_act_iter",
+                                              object()))
+            if fresh:
                 # the fused step emitted summaries of the REAL training
                 # batch (BaseStatsListener.java:273-420 onForwardPass role).
-                # CONSUME it: training modes whose steps don't emit stats
+                # Freshness is tracked PER LISTENER by the writing
+                # iteration: training modes whose steps don't emit stats
                 # (k-local-steps averaging, PS wrapper) must not re-report
-                # this batch's summaries as fresh data forever after
-                model._last_activation_stats = None
+                # a stale batch as new data, while a second attached
+                # listener still sees the same fresh summaries
+                self._last_seen_act_iter = live_iter
                 report["activationStats"] = self._live_summaries(live)
                 grids = self._live_grids(live)
                 if grids:

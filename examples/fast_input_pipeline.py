@@ -31,20 +31,27 @@ rng = np.random.default_rng(0)
 x8 = rng.integers(0, 256, (256, 28, 28, 1), dtype=np.uint8)
 y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 256)]
 
-conf = (NeuralNetConfiguration.Builder()
-        .seed(123)
-        .updater("adam").learning_rate(1e-3)
-        .data_type("bfloat16")
-        .list()
-        .layer(0, ConvolutionLayer(n_out=8, kernel_size=(3, 3),
-                                   activation="relu"))
-        .layer(1, SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
-        .layer(2, DenseLayer(n_out=64, activation="relu"))
-        .layer(3, OutputLayer(n_out=10, activation="softmax",
-                              loss_function="mcxent"))
-        .set_input_type(InputType.convolutional(28, 28, 1))
-        .build())
-net = MultiLayerNetwork(conf).init()
+def build_net():
+    # fresh configuration per network: conf carries iteration/epoch
+    # counters, so sharing one instance would skew LR schedules between
+    # the two arms
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(123)
+            .updater("adam").learning_rate(1e-3)
+            .data_type("bfloat16")
+            .list()
+            .layer(0, ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                       activation="relu"))
+            .layer(1, SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(2, DenseLayer(n_out=64, activation="relu"))
+            .layer(3, OutputLayer(n_out=10, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+net = build_net()
 
 scaler = ImagePreProcessingScaler()          # [0, 255] -> [0, 1]
 base = ArraysDataSetIterator((x8, y), batch_size=64)
@@ -52,16 +59,18 @@ it = AsyncDataSetIterator(
     base,
     queue_size=4,
     transfer_dtype="bfloat16",     # float arrays (labels) ship as bf16
-    device_transform=scaler,       # uint8 pixels scale on device
+    # uint8 pixels scale on device; pass the model dtype so the staged
+    # batch is written once in bf16 (safe: the step casts to bf16 anyway)
+    device_transform=scaler.as_device_transform("bfloat16"),
 )
 net.fit(it, num_epochs=3)
 score = float(net._score)
 print("final score:", score)
 
-# same data through the reference-style host-side f32 path — identical model
+# same data through the reference-style host-side f32 path — identical
+# model (fixed seed => identical init)
 xf = x8.astype(np.float32) / 255.0
-net2 = MultiLayerNetwork(conf).init()
-net2.set_params(MultiLayerNetwork(conf).init().params())
+net2 = build_net()
 itf = ArraysDataSetIterator((xf, y), batch_size=64)
 net2.fit(AsyncDataSetIterator(itf, queue_size=4), num_epochs=3)
 print("host-f32 score:", float(net2._score))
