@@ -610,3 +610,31 @@ class TestWirePipeline:
         assert c.params().dtype == np.float64
         np.testing.assert_array_equal(np.asarray(c.params()),
                                       np.asarray(d.params()))
+
+    def test_multiple_epochs_wrapper_applies_inner_pre_processor(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import (
+            ListDataSetIterator, MultipleEpochsIterator, next_processed)
+        x, y = self._data(n=8)
+        base = ListDataSetIterator(DataSet(x.copy(), y), batch_size=4)
+
+        def shift(ds):
+            ds.features = ds.features + 100.0
+            return ds
+
+        base.set_pre_processor(shift)
+        wrapped = MultipleEpochsIterator(2, base)
+        got = []
+        while wrapped.has_next():
+            got.append(np.asarray(next_processed(wrapped).features))
+        assert len(got) == 4                     # 2 epochs x 2 batches
+        np.testing.assert_allclose(np.concatenate(got[:2]), x + 100.0)
+        np.testing.assert_allclose(np.concatenate(got[2:]), x + 100.0)
+
+    def test_async_rejects_late_pre_processor_attach(self):
+        from deeplearning4j_tpu.datasets.iterators import (
+            ArraysDataSetIterator, AsyncDataSetIterator)
+        x, y = self._data()
+        it = AsyncDataSetIterator(ArraysDataSetIterator((x, y), batch_size=4))
+        with pytest.raises(RuntimeError, match="underlying iterator"):
+            it.set_pre_processor(lambda ds: ds)
