@@ -1,0 +1,221 @@
+"""Property-based invariants (hypothesis) across cross-cutting seams.
+
+The reference's unit tests pin examples; these pin LAWS the examples are
+instances of — the SURVEY §4 strategy deepened one level. Each property is
+cheap (numpy-level or tiny nets, bounded example counts) so the module
+stays in the core tier.
+"""
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")   # optional dependency
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+SET = settings(max_examples=25, deadline=None,
+               suppress_health_check=[HealthCheck.too_slow])
+
+
+# --------------------------------------------------------------------------
+# DataSet algebra: merge(batch_by(ds)) == ds, shuffle is a permutation
+# --------------------------------------------------------------------------
+@SET
+@given(n=st.integers(1, 40), f=st.integers(1, 8), bs=st.integers(1, 17),
+       seed=st.integers(0, 2**31 - 1))
+def test_dataset_batch_by_merge_round_trip(n, f, bs, seed):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, f)).astype(np.float32)
+    y = rng.random((n, 3)).astype(np.float32)
+    ds = DataSet(x, y)
+    batches = list(ds.batch_by(bs))
+    assert sum(b.num_examples() for b in batches) == n
+    assert all(b.num_examples() <= bs for b in batches)
+    back = DataSet.merge(batches)
+    np.testing.assert_array_equal(np.asarray(back.features), x)
+    np.testing.assert_array_equal(np.asarray(back.labels), y)
+
+
+@SET
+@given(n=st.integers(2, 40), seed=st.integers(0, 2**31 - 1))
+def test_dataset_shuffle_is_a_permutation(n, seed):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    y = np.arange(n, dtype=np.float32).reshape(n, 1) * 10
+    ds = DataSet(x.copy(), y.copy())
+    ds.shuffle(seed=seed)
+    xs = np.asarray(ds.features).ravel()
+    ys = np.asarray(ds.labels).ravel()
+    assert sorted(xs.tolist()) == list(range(n))
+    # feature/label alignment survives the shuffle
+    np.testing.assert_array_equal(ys, xs * 10)
+
+
+# --------------------------------------------------------------------------
+# Wire caster: floats shrink, ints/bools/None pass through, values survive
+# --------------------------------------------------------------------------
+@SET
+@given(dt=st.sampled_from(["float32", "float64", "uint8", "uint16",
+                           "int32", "bool"]),
+       shape=st.lists(st.integers(1, 6), min_size=1, max_size=3),
+       seed=st.integers(0, 2**31 - 1))
+def test_wire_caster_laws(dt, shape, seed):
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.iterators import _wire_caster
+    rng = np.random.default_rng(seed)
+    a = (rng.random(shape) * 100).astype(dt)
+    cast = _wire_caster("bfloat16")
+    out = cast(a)
+    assert cast(None) is None
+    if np.dtype(dt).kind == "f":
+        assert out.dtype == jnp.bfloat16
+        # bf16 has an 8-bit mantissa: relative error bounded by 2^-8
+        np.testing.assert_allclose(np.asarray(out, np.float64),
+                                   a.astype(np.float64),
+                                   rtol=2.0 ** -8, atol=2.0 ** -8)
+    else:
+        assert out.dtype == a.dtype
+        np.testing.assert_array_equal(out, a)
+
+
+# --------------------------------------------------------------------------
+# Normalizers: transform laws + device/host agreement on any input dtype
+# --------------------------------------------------------------------------
+@SET
+@given(n=st.integers(4, 60), f=st.integers(1, 6),
+       seed=st.integers(0, 2**31 - 1))
+def test_standardize_yields_zero_mean_unit_var(n, f, seed):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+    rng = np.random.default_rng(seed)
+    x = (rng.random((n, f)) * 50 - 10).astype(np.float32)
+    norm = NormalizerStandardize().fit(DataSet(x.copy(), None))
+    out = np.asarray(norm.transform(DataSet(x.copy(), None)).features,
+                     np.float64)
+    np.testing.assert_allclose(out.mean(0), 0, atol=1e-3)
+    # constant columns keep std 0 (epsilon floor), others normalize to 1
+    live = x.std(0) > 1e-4
+    np.testing.assert_allclose(out.std(0)[live], 1, atol=1e-2)
+
+
+@SET
+@given(dt=st.sampled_from(["uint8", "uint16", "float32"]),
+       lo=st.floats(-2, 0), hi=st.floats(0.5, 3),
+       seed=st.integers(0, 2**31 - 1))
+def test_minmax_output_bounded_and_device_matches_host(dt, lo, hi, seed):
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.normalizers import NormalizerMinMaxScaler
+    rng = np.random.default_rng(seed)
+    x = (rng.random((12, 4)) * 200).astype(dt)
+    norm = NormalizerMinMaxScaler(lo, hi).fit(
+        DataSet(x.astype(np.float32), None))
+    host = np.asarray(
+        norm.transform(DataSet(x.astype(np.float32), None)).features,
+        np.float64)
+    assert host.min() >= lo - 1e-4 and host.max() <= hi + 1e-4
+    dev = np.asarray(norm.device_apply(jnp.asarray(x)), np.float64)
+    np.testing.assert_allclose(dev, host, rtol=1e-4, atol=1e-4)
+
+
+# --------------------------------------------------------------------------
+# Evaluation.merge: splitting a prediction stream changes nothing
+# --------------------------------------------------------------------------
+@SET
+@given(n=st.integers(2, 60), c=st.integers(2, 5), cut=st.floats(0.1, 0.9),
+       seed=st.integers(0, 2**31 - 1))
+def test_evaluation_merge_equals_whole(n, c, cut, seed):
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    rng = np.random.default_rng(seed)
+    labels = np.eye(c, dtype=np.float32)[rng.integers(0, c, n)]
+    preds = rng.random((n, c)).astype(np.float32)
+    preds /= preds.sum(1, keepdims=True)
+    whole = Evaluation()
+    whole.eval(labels, preds)
+    k = max(1, min(n - 1, int(n * cut)))
+    a, b = Evaluation(), Evaluation()
+    a.eval(labels[:k], preds[:k])
+    b.eval(labels[k:], preds[k:])
+    a.merge(b)
+    assert a.accuracy() == pytest.approx(whole.accuracy())
+    assert a.f1() == pytest.approx(whole.f1())
+
+
+# --------------------------------------------------------------------------
+# Huffman: prefix-free codes, shorter codes for more frequent words
+# --------------------------------------------------------------------------
+@SET
+@given(counts=st.lists(st.integers(1, 10_000), min_size=2, max_size=40))
+def test_huffman_codes_prefix_free_and_ordered(counts):
+    from deeplearning4j_tpu.models.word2vec.vocab import (VocabCache,
+                                                          build_huffman)
+    vocab = VocabCache()
+    for i, cnt in enumerate(counts):
+        vocab.add_token(f"w{i}", cnt)
+    vocab.finish()
+    build_huffman(vocab)
+    words = list(vocab.vocab_words())
+    codes = ["".join(str(b) for b in w.codes) for w in words]
+    assert len(set(codes)) == len(codes)
+    for i, ci in enumerate(codes):          # prefix-freeness
+        for j, cj in enumerate(codes):
+            if i != j:
+                assert not cj.startswith(ci)
+    # optimality consequence, tie-tolerant pairwise form: a STRICTLY more
+    # frequent word never gets a strictly longer code
+    for wi in words:
+        for wj in words:
+            if wi.count > wj.count:
+                assert len(wi.codes) <= len(wj.codes), (wi, wj)
+
+
+# --------------------------------------------------------------------------
+# Japanese lattice tokenizer: lossless segmentation (offsets partition)
+# --------------------------------------------------------------------------
+_JA = st.text(
+    alphabet=st.sampled_from(
+        "すもももものうち私は学生でカタナひらが混在漢字山川水日本語食べる高い"),
+    min_size=1, max_size=20)
+
+
+@SET
+@given(s=_JA)
+def test_japanese_lattice_segmentation_is_lossless(s):
+    from deeplearning4j_tpu.text.ja_lattice import JapaneseLatticeTokenizer
+    toks = JapaneseLatticeTokenizer(s).get_tokens()
+    assert "".join(toks) == s
+
+
+# --------------------------------------------------------------------------
+# Flat-params contract: params()/set_params round-trips exactly for random
+# layer stacks (the reference's single-flat-vector law)
+# --------------------------------------------------------------------------
+@settings(max_examples=8, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(widths=st.lists(st.integers(1, 9), min_size=1, max_size=3),
+       act=st.sampled_from(["relu", "tanh", "sigmoid"]),
+       seed=st.integers(0, 2**31 - 1))
+def test_flat_params_round_trip_random_stacks(widths, act, seed):
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    b = (NeuralNetConfiguration.Builder().seed(seed)
+         .updater("sgd").learning_rate(0.1).list())
+    for i, w in enumerate(widths):
+        b = b.layer(i, DenseLayer(n_out=w, activation=act))
+    b = b.layer(len(widths), OutputLayer(n_out=2, activation="softmax",
+                                         loss_function="mcxent"))
+    conf = b.set_input_type(InputType.feed_forward(3)).build()
+    net = MultiLayerNetwork(conf).init()
+    flat = np.asarray(net.params())
+    assert flat.ndim == 1 and flat.size == net.num_params()
+    net2 = MultiLayerNetwork(conf).init()
+    net2.set_params(flat)
+    np.testing.assert_array_equal(np.asarray(net2.params()), flat)
+    # config serde: json -> rebuild -> identical json
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        MultiLayerConfiguration)
+    j = conf.to_json()
+    assert MultiLayerConfiguration.from_json(j).to_json() == j
