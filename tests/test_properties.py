@@ -188,6 +188,21 @@ def test_japanese_lattice_segmentation_is_lossless(s):
     assert "".join(toks) == s
 
 
+def _mln(widths, act, updater, lr, seed):
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    b = (NeuralNetConfiguration.Builder().seed(seed)
+         .updater(updater).learning_rate(lr).list())
+    for i, w in enumerate(widths):
+        b = b.layer(i, DenseLayer(n_out=w, activation=act))
+    b = b.layer(len(widths), OutputLayer(n_out=2, activation="softmax",
+                                         loss_function="mcxent"))
+    conf = b.set_input_type(InputType.feed_forward(3)).build()
+    from deeplearning4j_tpu import MultiLayerNetwork as _M
+    return _M(conf).init(), conf
+
+
 # --------------------------------------------------------------------------
 # Flat-params contract: params()/set_params round-trips exactly for random
 # layer stacks (the reference's single-flat-vector law)
@@ -198,17 +213,8 @@ def test_japanese_lattice_segmentation_is_lossless(s):
        act=st.sampled_from(["relu", "tanh", "sigmoid"]),
        seed=st.integers(0, 2**31 - 1))
 def test_flat_params_round_trip_random_stacks(widths, act, seed):
-    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
-                                    NeuralNetConfiguration)
-    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
-    b = (NeuralNetConfiguration.Builder().seed(seed)
-         .updater("sgd").learning_rate(0.1).list())
-    for i, w in enumerate(widths):
-        b = b.layer(i, DenseLayer(n_out=w, activation=act))
-    b = b.layer(len(widths), OutputLayer(n_out=2, activation="softmax",
-                                         loss_function="mcxent"))
-    conf = b.set_input_type(InputType.feed_forward(3)).build()
-    net = MultiLayerNetwork(conf).init()
+    from deeplearning4j_tpu import MultiLayerNetwork
+    net, conf = _mln(widths, act, "sgd", 0.1, seed)
     flat = np.asarray(net.params())
     assert flat.ndim == 1 and flat.size == net.num_params()
     net2 = MultiLayerNetwork(conf).init()
@@ -219,3 +225,103 @@ def test_flat_params_round_trip_random_stacks(widths, act, seed):
         MultiLayerConfiguration)
     j = conf.to_json()
     assert MultiLayerConfiguration.from_json(j).to_json() == j
+
+
+# --------------------------------------------------------------------------
+# Serialization format laws: word-vector text/binary round-trips, model
+# zip save/restore identity, ROC bounds
+# --------------------------------------------------------------------------
+_WORD = st.text(alphabet=st.characters(min_codepoint=33, max_codepoint=126,
+                                       exclude_characters=" "),
+                min_size=1, max_size=10)
+
+
+class _VecModel:
+    def __init__(self, vocab, lookup):
+        self.vocab, self.lookup = vocab, lookup
+
+
+def _random_vec_model(words, dim, seed):
+    from deeplearning4j_tpu.models.embeddings.lookup_table import (
+        InMemoryLookupTable)
+    from deeplearning4j_tpu.models.word2vec.vocab import VocabCache
+    rng = np.random.default_rng(seed)
+    vocab = VocabCache()
+    # descending counts keep rank order stable through (de)serialization
+    for i, w in enumerate(words):
+        vocab.add_token(w, len(words) + 1 - i)
+    vocab.finish()
+    lookup = InMemoryLookupTable(vocab, dim)
+    lookup.syn0 = rng.standard_normal((len(words), dim)).astype(np.float32)
+    return _VecModel(vocab, lookup)
+
+
+@SET
+@given(words=st.lists(_WORD, min_size=1, max_size=12, unique=True),
+       dim=st.integers(1, 16), seed=st.integers(0, 2**31 - 1),
+       binary=st.booleans())
+def test_word_vector_serialization_round_trip(tmp_path_factory, words, dim,
+                                              seed, binary):
+    from deeplearning4j_tpu.models.embeddings.serializer import (
+        read_word2vec_binary, read_word2vec_text, write_word2vec_binary,
+        write_word2vec_text)
+    model = _random_vec_model(words, dim, seed)
+    path = str(tmp_path_factory.mktemp("wv") / ("m.bin" if binary else "m.txt"))
+    if binary:
+        write_word2vec_binary(model, path)
+        back = read_word2vec_binary(path)
+    else:
+        write_word2vec_text(model, path)
+        back = read_word2vec_text(path)
+    assert [w.word for w in back.vocab.vocab_words()] == list(words)
+    tol = 0 if binary else 5e-6          # text format prints %.6f
+    np.testing.assert_allclose(back.lookup.syn0, model.lookup.syn0,
+                               atol=tol)
+
+
+@settings(max_examples=6, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(widths=st.lists(st.integers(1, 8), min_size=1, max_size=2),
+       seed=st.integers(0, 2**31 - 1))
+def test_model_zip_save_restore_identity(tmp_path_factory, widths, seed):
+    import jax
+
+    from deeplearning4j_tpu.util.model_serializer import (restore_model,
+                                                          write_model)
+    net, conf = _mln(widths, "relu", "adam", 0.05, seed)
+    rng = np.random.default_rng(seed)
+    x = rng.random((8, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    net.fit(x, y)                         # non-trivial updater state
+    path = str(tmp_path_factory.mktemp("mz") / "model.zip")
+    write_model(net, path)
+    back = restore_model(path)
+    np.testing.assert_array_equal(np.asarray(back.params()),
+                                  np.asarray(net.params()))
+    np.testing.assert_allclose(np.asarray(back.output(x), np.float64),
+                               np.asarray(net.output(x), np.float64),
+                               rtol=1e-6)
+    # the Adam moments themselves round-trip (not just params/outputs)
+    for a, b2 in zip(jax.tree.leaves(net._updater_state),
+                     jax.tree.leaves(back._updater_state)):
+        np.testing.assert_allclose(np.asarray(a, np.float64),
+                                   np.asarray(b2, np.float64), rtol=1e-7)
+
+
+@SET
+@given(n=st.integers(4, 80), seed=st.integers(0, 2**31 - 1))
+def test_roc_auc_laws(n, seed):
+    from deeplearning4j_tpu.eval.roc import ROC
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 2, n)
+    if labels.min() == labels.max():      # need both classes
+        labels[0] = 1 - labels[0]
+    probs = rng.random(n)
+    roc = ROC(threshold_steps=200)
+    roc.eval(labels, probs)
+    auc = roc.calculate_auc()
+    assert 0.0 <= auc <= 1.0
+    # perfectly separated scores give AUC ~ 1
+    perfect = ROC(threshold_steps=200)
+    perfect.eval(labels, labels * 0.8 + 0.1)
+    assert perfect.calculate_auc() >= 0.99
