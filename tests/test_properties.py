@@ -325,3 +325,59 @@ def test_roc_auc_laws(n, seed):
     perfect = ROC(threshold_steps=200)
     perfect.eval(labels, labels * 0.8 + 0.1)
     assert perfect.calculate_auc() >= 0.99
+
+
+# --------------------------------------------------------------------------
+# Spatial trees: exact agreement with brute force on random point sets
+# --------------------------------------------------------------------------
+@SET
+@given(n=st.integers(2, 60), d=st.integers(1, 5), k=st.integers(1, 5),
+       seed=st.integers(0, 2**31 - 1))
+def test_vptree_knn_matches_brute_force(n, d, k, seed):
+    from deeplearning4j_tpu.clustering.trees import VPTree
+    rng = np.random.default_rng(seed)
+    pts = rng.random((n, d))
+    q = rng.random(d)
+    k = min(k, n)
+    got = VPTree(pts).knn(q, k)
+    dists = np.linalg.norm(pts - q, axis=1)
+    want = np.sort(dists)[:k]
+    np.testing.assert_allclose(sorted(dd for dd, _ in got), want,
+                               rtol=1e-9, atol=1e-12)
+    for dd, idx in got:                     # returned indices are genuine
+        assert dd == pytest.approx(dists[idx])
+
+
+@SET
+@given(n=st.integers(1, 60), d=st.integers(1, 5),
+       seed=st.integers(0, 2**31 - 1))
+def test_kdtree_nn_matches_brute_force(n, d, seed):
+    from deeplearning4j_tpu.clustering.trees import KDTree
+    rng = np.random.default_rng(seed)
+    pts = rng.random((n, d))
+    q = rng.random(d)
+    dist, idx = KDTree(pts).nn(q)
+    dists = np.linalg.norm(pts - q, axis=1)
+    assert dist == pytest.approx(dists.min())
+    assert dists[idx] == pytest.approx(dists.min())
+
+
+# --------------------------------------------------------------------------
+# CSV record reader: numeric matrices survive a write/read round-trip
+# --------------------------------------------------------------------------
+@SET
+@given(n=st.integers(1, 20), f=st.integers(1, 6),
+       seed=st.integers(0, 2**31 - 1))
+def test_csv_record_reader_round_trip(tmp_path_factory, n, f, seed):
+    from deeplearning4j_tpu.datasets.records import CSVRecordReader
+    rng = np.random.default_rng(seed)
+    raw = rng.standard_normal((n, f)) * 100
+    text = "\n".join(",".join(f"{v:.6f}" for v in row) for row in raw)
+    # ground truth = exactly what the file says
+    m = np.asarray([[float(v) for v in line.split(",")]
+                    for line in text.splitlines()])
+    p = tmp_path_factory.mktemp("csv") / "m.csv"
+    p.write_text(text + "\n")
+    rows = [[float(v) for v in rec] for rec in CSVRecordReader(str(p))]
+    # the reader parses to float32 (DataSet feature dtype) — exact to f32
+    np.testing.assert_allclose(np.asarray(rows), m, rtol=2e-7, atol=1e-7)
