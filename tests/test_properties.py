@@ -381,3 +381,73 @@ def test_csv_record_reader_round_trip(tmp_path_factory, n, f, seed):
     rows = [[float(v) for v in rec] for rec in CSVRecordReader(str(p))]
     # the reader parses to float32 (DataSet feature dtype) — exact to f32
     np.testing.assert_allclose(np.asarray(rows), m, rtol=2e-7, atol=1e-7)
+
+
+# --------------------------------------------------------------------------
+# Graph walks, k-means, and text vectorizer laws
+# --------------------------------------------------------------------------
+@SET
+@given(n=st.integers(2, 15), extra=st.integers(0, 20), wl=st.integers(1, 8),
+       seed=st.integers(0, 2**31 - 1))
+def test_random_walks_stay_on_edges(n, extra, wl, seed):
+    from deeplearning4j_tpu.graph.graph import Graph
+    from deeplearning4j_tpu.graph.walks import RandomWalkIterator
+    rng = np.random.default_rng(seed)
+    g = Graph(n)
+    edges = set()
+    for i in range(n):                     # ring keeps it connected
+        g.add_edge(i, (i + 1) % n)
+        edges |= {(i, (i + 1) % n), ((i + 1) % n, i)}
+    for _ in range(extra):
+        a, b = rng.integers(0, n, 2)
+        g.add_edge(int(a), int(b))
+        edges |= {(int(a), int(b)), (int(b), int(a))}
+    it = RandomWalkIterator(g, wl, seed=seed)
+    starts = []
+    while it.has_next():
+        walk = list(it.next())
+        starts.append(walk[0])
+        # walk_length counts NODES (reference RandomWalkIterator semantics)
+        assert len(walk) == max(1, wl)
+        for a, b in zip(walk, walk[1:]):
+            assert (a, b) in edges or a == b   # self-loop fallback
+    assert sorted(starts) == list(range(n))    # one walk per vertex
+
+
+@SET
+@given(n=st.integers(6, 60), d=st.integers(1, 4), k=st.integers(1, 4),
+       seed=st.integers(0, 2**31 - 1))
+def test_kmeans_assignments_are_nearest_center(n, d, k, seed):
+    from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, d)).astype(np.float32)   # the impl computes in f32
+    k = min(k, n)
+    km = KMeansClustering(k, seed=seed)
+    km.fit(x)
+    assign = np.asarray(km.predict(x))
+    centers = np.asarray(km.centers)
+    d2 = ((x[:, None, :] - centers[None]) ** 2).sum(-1)
+    np.testing.assert_allclose(d2[np.arange(n), assign], d2.min(1),
+                               rtol=1e-5, atol=1e-7)
+    # cost is the total squared distance to assigned centers (f32 math)
+    assert km.cost == pytest.approx(float(d2.min(1).sum()), rel=1e-3) or \
+        km.cost == pytest.approx(float(d2.min(1).mean()), rel=1e-3)
+
+
+_DOC = st.lists(st.sampled_from("cat dog fish bird tree sun moon".split()),
+                min_size=1, max_size=12).map(" ".join)
+
+
+@SET
+@given(docs=st.lists(_DOC, min_size=1, max_size=8))
+def test_bow_counts_match_manual(docs):
+    from deeplearning4j_tpu.text.vectorizers import BagOfWordsVectorizer
+    bow = BagOfWordsVectorizer().fit(docs)
+    for doc in docs:
+        vec = np.asarray(bow.transform(doc))
+        assert vec.sum() == len(doc.split())
+        for w in set(doc.split()):
+            if bow.vocab.contains_word(w) if hasattr(bow.vocab, "contains_word") else True:
+                idx = bow.vocab.word_for(w).index if hasattr(bow.vocab, "word_for") else None
+                if idx is not None:
+                    assert vec[idx] == doc.split().count(w)
