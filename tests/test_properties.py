@@ -451,3 +451,28 @@ def test_bow_counts_match_manual(docs):
                 idx = bow.vocab.word_for(w).index if hasattr(bow.vocab, "word_for") else None
                 if idx is not None:
                     assert vec[idx] == doc.split().count(w)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _fixture_dictionary():
+    import os
+
+    from deeplearning4j_tpu.text.ja_dictionary import compile_dictionary
+    return compile_dictionary(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "fixtures", "ja_dict"))
+
+
+@SET
+@given(s=_JA)
+def test_compiled_dictionary_segmentation_is_lossless(s):
+    """Same losslessness law over the mecab-format COMPILED dictionary
+    path (tests/fixtures/ja_dict) as over the builtin lexicon — the
+    ingestion pipeline must never drop or duplicate characters either."""
+    from deeplearning4j_tpu.text.ja_lattice import JapaneseLatticeTokenizer
+    toks = JapaneseLatticeTokenizer(
+        s, dictionary=_fixture_dictionary()).get_tokens()
+    assert "".join(toks) == s
