@@ -33,12 +33,16 @@ class DataSet:
 
     def shallow_copy(self):
         """New DataSet sharing the same arrays — lets a pre-processor
-        rebind .features without mutating a cached original."""
+        rebind .features without mutating a cached original. Per-example
+        metadata (Prediction error-analysis queries) rides along."""
         out = DataSet.__new__(DataSet)
         out.features = self.features
         out.labels = self.labels
         out.features_mask = self.features_mask
         out.labels_mask = self.labels_mask
+        metas = getattr(self, "example_metas", None)
+        if metas is not None:
+            out.example_metas = metas
         return out
 
     def get_features(self):

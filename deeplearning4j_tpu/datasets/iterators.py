@@ -56,6 +56,16 @@ def wrap_async_for_fit(it, compute_dtype):
                                 cast_labels=False)
 
 
+def _carry_metas(src, dst):
+    """Per-example metadata (DataSet.example_metas — the Prediction
+    error-analysis channel) must survive every batch rebuild in the
+    staging pipeline, or evaluate(meta=...) silently loses it."""
+    metas = getattr(src, "example_metas", None)
+    if metas is not None:
+        dst.example_metas = metas
+    return dst
+
+
 def _wire_caster(transfer_dtype):
     """Array cast for the host->device wire: floats shrink to
     transfer_dtype (lossless-for-training at bf16); ints (uint8 pixels,
@@ -339,6 +349,7 @@ class AsyncDataSetIterator(DataSetIterator):
     def _start(self):
         self._q = queue.Queue(maxsize=self.queue_size)
         self._error = None
+        self._consumed_any = False
         old_pool = getattr(self, "_pool", None)
         if old_pool is not None:
             # reset() re-runs _start() every epoch; reclaim the previous
@@ -455,6 +466,7 @@ class AsyncDataSetIterator(DataSetIterator):
         out.labels = keep(ds.labels)
         out.features_mask = keep(ds.features_mask)
         out.labels_mask = keep(ds.labels_mask)
+        _carry_metas(ds, out)
         return out
 
     def _raise_if_failed(self):
@@ -481,6 +493,7 @@ class AsyncDataSetIterator(DataSetIterator):
                                 if ds.features_mask is not None else None)
         staged.labels_mask = (jax.device_put(ds.labels_mask)
                               if ds.labels_mask is not None else None)
+        _carry_metas(ds, staged)
         return staged
 
     def has_next(self):
@@ -492,6 +505,7 @@ class AsyncDataSetIterator(DataSetIterator):
         if b is self._sentinel:
             self._raise_if_failed()
             raise StopIteration("iterator exhausted")
+        self._consumed_any = True
         self._next = self._q.get()
         return b
 
@@ -500,6 +514,16 @@ class AsyncDataSetIterator(DataSetIterator):
         # on the prefetch thread in _prepare(); re-applying here would
         # double-normalize
         return self.next_batch()
+
+    def __iter__(self):
+        # a FRESH wrapper is already prefetching from position 0; the base
+        # reset-first iteration protocol would drain one fully-staged pass
+        # unseen. Only reset when batches were consumed (mid-stream rewind)
+        # or the stream is exhausted (re-iteration).
+        if self._consumed_any or not self.has_next():
+            self.reset()
+        while self.has_next():
+            yield self.next()
 
     def set_pre_processor(self, p):
         # the prefetch worker started in __init__ and has already prepared
@@ -559,6 +583,7 @@ class AsyncMultiDataSetIterator(AsyncDataSetIterator):
         staged.labels_masks = ([put(m) if m is not None else None
                                 for m in mds.labels_masks]
                                if mds.labels_masks else mds.labels_masks)
+        _carry_metas(mds, staged)
         return staged
 
 

@@ -761,16 +761,17 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     def evaluate(self, data, output_index=0):
         from ...eval.evaluation import Evaluation
-        from ...datasets.iterators import DataSetIterator
+        from ...datasets.iterators import (DataSetIterator,
+                                           wrap_async_for_fit)
         ev = Evaluation()
         if isinstance(data, (DataSet, MultiDataSet)):
             data = [data]
         if isinstance(data, DataSetIterator):
+            # full-pass guarantee (the old explicit reset), then stream
+            # through the async wrapper (prefetch + staging overlap the
+            # eval compute; one batch resident instead of the whole set)
             data.reset()
-            items = []
-            while data.has_next():
-                items.append(next_processed(data))
-            data = items
+            data = wrap_async_for_fit(data, self.compute_dtype)
         for ds in data:
             mds = _dataset_to_mds(ds) if isinstance(ds, DataSet) else ds
             outs = self.output(mds.features,

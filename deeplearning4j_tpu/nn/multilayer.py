@@ -710,10 +710,19 @@ class MultiLayerNetwork:
         iteration order, or per-DataSet `example_metas` attribute) enabling
         Evaluation's Prediction error-analysis queries — reference
         MultiLayerNetwork.evaluate + eval(..., List<Serializable> meta)."""
+        from ..datasets.iterators import wrap_async_for_fit
         from ..eval.evaluation import Evaluation
         ev = Evaluation()
         if isinstance(data, DataSet):
             data = ListDataSetIterator([data])
+        if isinstance(data, DataSetIterator):
+            # full-pass guarantee first (the old base-__iter__ behavior —
+            # also keeps positional `meta` aligned with example 0), then
+            # prefetch + device staging overlap eval compute (and the
+            # bf16 feature wire for bf16 models — inference casts features
+            # to the compute dtype anyway, so outputs are bit-identical)
+            data.reset()
+            data = wrap_async_for_fit(data, self.compute_dtype)
         pos = 0
         for ds in data:
             out = self.output(ds.features, features_mask=ds.features_mask)
@@ -726,10 +735,14 @@ class MultiLayerNetwork:
         return ev
 
     def evaluate_regression(self, data):
+        from ..datasets.iterators import wrap_async_for_fit
         from ..eval.regression import RegressionEvaluation
         ev = None
         if isinstance(data, DataSet):
             data = ListDataSetIterator([data])
+        if isinstance(data, DataSetIterator):
+            data.reset()                    # full-pass guarantee
+            data = wrap_async_for_fit(data, self.compute_dtype)
         for ds in data:
             out = self.output(ds.features, features_mask=ds.features_mask)
             if ev is None:
