@@ -1,0 +1,5 @@
+from .resilience import (FaultInjected, FaultInjector, NonRetryableError,
+                         RetryPolicy)
+
+__all__ = ["FaultInjected", "FaultInjector", "NonRetryableError",
+           "RetryPolicy"]

@@ -1,0 +1,25 @@
+"""jax version-compatibility shims.
+
+The codebase targets current jax (top-level `jax.shard_map`, `check_vma`
+kwarg); older jaxlib stacks (0.4.x) ship shard_map under
+`jax.experimental.shard_map` with the replication check named `check_rep`.
+This module is the ONE place that difference lives — import `shard_map`
+from here, pass either kwarg name, and the active jax gets the one it
+understands. Kept out of `common/__init__` so the numpy-only worker paths
+(`common.resilience`, PS clients) never pull jax in transitively.
+"""
+from __future__ import annotations
+
+try:                          # jax >= 0.6: top-level export, check_vma
+    from jax import shard_map as _shard_map
+    _CHECK_KW = "check_vma"
+except ImportError:           # older jax: experimental namespace, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, **kwargs):
+    for alias in ("check_vma", "check_rep"):
+        if alias in kwargs and alias != _CHECK_KW:
+            kwargs[_CHECK_KW] = kwargs.pop(alias)
+    return _shard_map(f, **kwargs)

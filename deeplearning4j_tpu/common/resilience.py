@@ -1,0 +1,209 @@
+"""Fault-tolerance primitives: retry policies and deterministic fault
+injection.
+
+The reference stack leans on Aeron (lossy-network-tolerant UDP transport)
+and Spark (task re-execution) for resilience; the TCP reimplementation here
+(`parallel/ps_transport.py`, `parallel/training_master.py`) needs those
+semantics made explicit. This module is the one place they are defined:
+
+  * `RetryPolicy` — bounded exponential backoff with deterministic jitter,
+    an optional wall-clock deadline over all attempts, and retryable-
+    exception classification. Shared by the PS client's reconnect path and
+    available to any caller that talks across a process/network boundary.
+  * `NonRetryableError` — marker mix-in: an exception carrying it is never
+    retried, regardless of the policy's `retryable` tuple (e.g. a push the
+    server REFUSED is a terminal condition, while a dropped connection is
+    not, even though both subclass ConnectionError).
+  * `FaultInjector` — deterministic, seeded fault schedules keyed by call
+    site. Production code exposes named sites (`client.push.sent`,
+    `master.round`, ...) and the injector decides per call whether to
+    delay, sever a connection, and/or raise — so every failure mode the
+    retry/heartbeat/resume machinery handles has a repeatable test driving
+    it through the REAL code path, not a mock.
+
+Everything here is stdlib-only (no jax/numpy): the PS worker side is
+numpy-only by design and must stay importable without jax.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+
+class NonRetryableError(Exception):
+    """Marker mix-in: never retried by any RetryPolicy, even when the
+    concrete type also matches the policy's `retryable` classes."""
+
+
+class FaultInjected(ConnectionError):
+    """Default exception raised at an injected fault site."""
+
+
+class RetryPolicy:
+    """Bounded exponential backoff with deterministic jitter.
+
+    delay(attempt) = min(max_delay, base_delay * multiplier**attempt),
+    scaled by a seeded uniform jitter in [1-jitter, 1+jitter] — the seed
+    makes backoff sequences reproducible in tests while still decorrelating
+    real workers (give each worker a different seed).
+
+    `deadline` bounds the TOTAL wall clock across all attempts: a retry
+    whose backoff sleep would overrun the deadline re-raises instead.
+    `sleep`/`clock` are injectable for tests (fake time).
+    """
+
+    def __init__(self, max_retries=5, base_delay=0.05, max_delay=2.0,
+                 multiplier=2.0, jitter=0.25, deadline=None,
+                 retryable=(ConnectionError, TimeoutError, OSError),
+                 seed=0, sleep=None, clock=None):
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        self.max_retries = int(max_retries)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.deadline = None if deadline is None else float(deadline)
+        self.retryable = tuple(retryable)
+        self._rng = random.Random(seed)
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+
+    def is_retryable(self, exc):
+        if isinstance(exc, NonRetryableError):
+            return False
+        return isinstance(exc, self.retryable)
+
+    def delay(self, attempt):
+        """Backoff before retry number `attempt` (0-based). Consumes one
+        jitter draw from the seeded rng (thread-safe)."""
+        d = min(self.max_delay, self.base_delay * self.multiplier ** attempt)
+        if self.jitter:
+            with self._lock:
+                u = self._rng.uniform(-1.0, 1.0)
+            d = max(0.0, d * (1.0 + self.jitter * u))
+        return d
+
+    def call(self, fn, on_retry=None):
+        """Run `fn()` with retries. `on_retry(attempt, exc, delay)` fires
+        before each backoff sleep (logging/metrics hook). Non-retryable
+        exceptions, exhausted attempts, and deadline overruns re-raise the
+        last error unchanged."""
+        start = self._clock()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if not self.is_retryable(e) or attempt >= self.max_retries:
+                    raise
+                d = self.delay(attempt)
+                if self.deadline is not None and \
+                        self._clock() - start + d > self.deadline:
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e, d)
+                self._sleep(d)
+                attempt += 1
+
+
+class _Rule:
+    __slots__ = ("on_calls", "prob", "remaining", "exc", "delay", "sever")
+
+
+class FaultInjector:
+    """Deterministic fault schedules keyed by instrumented call site.
+
+    A site is a string name a production code path fires on every pass
+    (`injector.fire("client.push.sent", on_sever=...)`); each site keeps a
+    call counter. Rules planned against the site decide, per call, whether
+    to inject — by explicit call index (`on_call`/`on_calls`, exactly
+    reproducible) or by seeded probability (`prob`, reproducible for a
+    given seed + call sequence). A firing rule can sleep (`delay`), invoke
+    the site's sever callback (`sever=True` — e.g. the PS client closes its
+    socket, simulating a network cut), and raise (`exc`: class or
+    instance; None = fault without raising, for pure delay/sever).
+
+    `times` caps how often a rule fires (default: once per planned call
+    index, or once for prob/always rules).
+    """
+
+    def __init__(self, seed=0):
+        self._rng = random.Random(seed)
+        self._rules = {}
+        self._calls = {}
+        self._fired = []
+        self._lock = threading.Lock()
+        self._sleep = time.sleep
+
+    def plan(self, site, on_call=None, on_calls=None, prob=None, times=None,
+             exc=FaultInjected, delay=0.0, sever=False):
+        """Schedule a fault at `site`; returns self for chaining."""
+        if on_call is not None and on_calls is not None:
+            raise ValueError("pass on_call or on_calls, not both")
+        if on_call is not None:
+            on_calls = [on_call]
+        rule = _Rule()
+        rule.on_calls = (None if on_calls is None
+                         else {int(c) for c in on_calls})
+        rule.prob = None if prob is None else float(prob)
+        if times is None:
+            times = len(rule.on_calls) if rule.on_calls is not None else 1
+        rule.remaining = int(times)
+        rule.exc = exc
+        rule.delay = float(delay)
+        rule.sever = bool(sever)
+        with self._lock:
+            self._rules.setdefault(site, []).append(rule)
+        return self
+
+    def fire(self, site, on_sever=None):
+        """Instrumentation point: bump the site's call counter and apply
+        the first matching rule (delay -> sever -> raise)."""
+        with self._lock:
+            n = self._calls.get(site, 0)
+            self._calls[site] = n + 1
+            hit = None
+            for rule in self._rules.get(site, ()):
+                if rule.remaining <= 0:
+                    continue
+                if rule.on_calls is not None:
+                    match = n in rule.on_calls
+                elif rule.prob is not None:
+                    match = self._rng.random() < rule.prob
+                else:
+                    match = True
+                if match:
+                    rule.remaining -= 1
+                    hit = rule
+                    self._fired.append((site, n))
+                    break
+        if hit is None:
+            return
+        log.warning("fault injected at %s (call #%d): delay=%.3fs sever=%s",
+                    site, n, hit.delay, hit.sever)
+        if hit.delay:
+            self._sleep(hit.delay)
+        if hit.sever and on_sever is not None:
+            on_sever()
+        exc = hit.exc
+        if exc is None:
+            return
+        if isinstance(exc, BaseException):
+            raise exc
+        raise exc(f"injected fault at {site} (call #{n})")
+
+    def calls(self, site):
+        """How many times `site` has fired its instrumentation point."""
+        with self._lock:
+            return self._calls.get(site, 0)
+
+    def fired(self, site=None):
+        """(site, call_index) events for faults actually injected."""
+        with self._lock:
+            return [e for e in self._fired if site is None or e[0] == site]

@@ -134,6 +134,11 @@ class MultiDataSet:
                               if self.features_masks else self.features_masks)
         out.labels_masks = (list(self.labels_masks)
                             if self.labels_masks else self.labels_masks)
+        # symmetric with DataSet.shallow_copy: per-example metadata rides
+        # along through pre-processor/staging rebuilds
+        metas = getattr(self, "example_metas", None)
+        if metas is not None:
+            out.example_metas = metas
         return out
 
 

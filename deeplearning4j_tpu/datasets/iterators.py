@@ -111,6 +111,18 @@ class DataSetIterator:
     pre_processor = None
 
     def set_pre_processor(self, p):
+        """Attach a pre-processor applied by next().
+
+        CONTRACT — rebind, don't mutate: a pre-processor receives a
+        SHALLOW COPY of the batch (see _apply_pre) and must REBIND fields
+        (``ds.features = scaled``) rather than transform arrays in place
+        (``ds.features *= s``, ``np.clip(..., out=...)``). The copy shares
+        the underlying arrays with the source, and cached-batch iterators
+        (ListDataSetIterator, ExistingDataSetIterator's replay cache) hand
+        out the same DataSet objects every epoch — an in-place write goes
+        through to the cache, corrupting the stored batch and
+        double-normalizing from epoch 2 on. All built-in normalizers
+        rebind; custom callables must follow the same rule."""
         self.pre_processor = p
         return self
 
@@ -377,9 +389,13 @@ class AsyncDataSetIterator(DataSetIterator):
                 thread_name_prefix="async-ds-stage")
             self._futs = queue.Queue(maxsize=self.queue_size
                                      + self.num_workers)
-            threading.Thread(target=self._producer,
-                             args=(self._futs, self._stop),
-                             daemon=True).start()
+            # kept joinable: reset() must wait for an in-flight
+            # next_batch() before it may touch the (non-thread-safe)
+            # underlying iterator
+            self._producer_thread = threading.Thread(
+                target=self._producer, args=(self._futs, self._stop),
+                daemon=True)
+            self._producer_thread.start()
             self._thread = threading.Thread(
                 target=self._collector, args=(self._futs, self._stop),
                 daemon=True)
@@ -400,13 +416,41 @@ class AsyncDataSetIterator(DataSetIterator):
         return ds
 
     def _worker(self):
+        stop = self._stop      # THIS generation's stop event
         try:
-            while self.underlying.has_next():
-                self._q.put(self._prepare(self.underlying.next_batch()))
+            while not stop.is_set() and self.underlying.has_next():
+                item = self._prepare(self.underlying.next_batch())
+                # stop-aware put: reset() signals stop FIRST, so a
+                # mid-stream reset stops staging within one batch
+                # instead of preparing the whole remaining pass just to
+                # drain it (the consumer-side drain keeps this live)
+                while not stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
         except BaseException as e:  # re-raised on the consumer thread
             self._error = e
         finally:
             self._q.put(self._sentinel)
+
+    @staticmethod
+    def _put_control(futs, stop, item):
+        """Stop-aware blocking put for CONTROL items (a mid-stream
+        exception, the end sentinel). A single timed attempt under a full
+        queue — the steady state whenever the training step is slower than
+        staging — would silently drop the item and leave the collector
+        blocked on futs.get() forever, turning a data error into a hang
+        (ADVICE r5). Retry until it lands or the generation stops (a dead
+        collector has already drained futs and sentinel'd the consumer
+        queue, so giving up on stop is safe)."""
+        while not stop.is_set():
+            try:
+                futs.put(item, timeout=0.2)
+                return
+            except queue.Full:
+                continue
 
     def _producer(self, futs, stop):
         try:
@@ -422,20 +466,22 @@ class AsyncDataSetIterator(DataSetIterator):
                     except queue.Full:
                         continue
         except BaseException as e:  # surfaced by the collector
-            try:
-                futs.put(e, timeout=0.2)
-            except queue.Full:
-                pass
+            self._put_control(futs, stop, e)
         finally:
-            try:
-                futs.put(self._sentinel, timeout=0.2)
-            except queue.Full:
-                pass
+            self._put_control(futs, stop, self._sentinel)
 
     def _collector(self, futs, stop):
         try:
             while not stop.is_set():
-                fut = futs.get()
+                # timed get, not a bare block: when reset() stops this
+                # generation the producer may exit WITHOUT a sentinel
+                # (its control put is stop-aware), and a collector parked
+                # in futs.get() would never wake to deliver its own
+                # sentinel — deadlocking the reset drain
+                try:
+                    fut = futs.get(timeout=0.2)
+                except queue.Empty:
+                    continue
                 if fut is self._sentinel:
                     break
                 if isinstance(fut, BaseException):
@@ -537,9 +583,25 @@ class AsyncDataSetIterator(DataSetIterator):
             "to the underlying iterator before wrapping")
 
     def reset(self):
-        # drain and restart
+        # signal the CURRENT generation to stop producing BEFORE draining:
+        # without it the drain consumes (and stages — pre-process +
+        # device_put, the expensive part) every remaining batch just to
+        # reach the sentinel; with it, at most the in-flight batches are
+        # discarded. The consumer-side drain keeps the producer's final
+        # puts live until its sentinel lands.
+        stop = getattr(self, "_stop", None)
+        if stop is not None:
+            stop.set()
         while self._next is not self._sentinel:
             self._next = self._q.get()
+        # multi-worker: the stop-aware producer may still be INSIDE
+        # underlying.next_batch() when the (collector-sentinelled) drain
+        # completes — join it before resetting the non-thread-safe
+        # underlying iterator. Single-worker needs no join: its sentinel
+        # only appears after its loop left the underlying for good.
+        pt = getattr(self, "_producer_thread", None)
+        if pt is not None and pt.is_alive():
+            pt.join()
         self.underlying.reset()
         self._start()
 
@@ -564,6 +626,9 @@ class AsyncMultiDataSetIterator(AsyncDataSetIterator):
                               if mds.features_masks else mds.features_masks)
         out.labels_masks = ([keep(m) for m in mds.labels_masks]
                             if mds.labels_masks else mds.labels_masks)
+        # symmetric with the DataSet wire path: per-example metadata must
+        # survive the bf16-wire rebuild too (ADVICE r5)
+        _carry_metas(mds, out)
         return out
 
     def _stage(self, mds):
@@ -753,7 +818,14 @@ class CombinedPreProcessor:
     """Chain DataSet pre-processors — reference
     datasets/iterator/CombinedPreProcessor.java (Builder.addPreProcessor).
     A pre-processor is any object with pre_process(ds) (normalizers
-    qualify)."""
+    qualify).
+
+    Every step is bound by the same rebind-only contract as
+    `DataSetIterator.set_pre_processor`: transform by REBINDING fields on
+    the DataSet it receives (or returning a new DataSet), never by
+    mutating the arrays in place — the chain runs on a shallow copy whose
+    arrays are shared with the iterator's (possibly cached) source batch,
+    so an in-place write corrupts replayed epochs."""
 
     class Builder:
         def __init__(self):

@@ -446,18 +446,28 @@ class ComputationGraph:
         # training thread like the reference (ComputationGraph.fit wraps
         # in Async(Multi)DataSetIterator), with the bf16 feature wire for
         # bf16 models (bit-identical — the step casts features anyway)
-        from ...datasets.iterators import (DataSetIterator,
+        from ...datasets.iterators import (AsyncDataSetIterator,
+                                           DataSetIterator,
                                            wrap_async_for_fit)
+        wrapped_here = False
         if isinstance(data, DataSetIterator):
             # the wrapper stages DataSet AND MultiDataSet batches
-            # (per-batch dispatch), so one class covers both protocols
+            # (per-batch dispatch), so one class covers both protocols.
+            # A caller-supplied plain iterator may be mid-stream: reset
+            # BEFORE wrapping so the fresh wrapper prefetches from 0 and
+            # the epoch-0 reset skip is trivially safe (ADVICE r5)
+            wrapped_here = not isinstance(data, AsyncDataSetIterator)
+            if wrapped_here:
+                data.reset()
             data = wrap_async_for_fit(data, self.compute_dtype)
         for epoch in range(num_epochs):
-            # a fresh async wrapper is already prefetching; resetting it
-            # on epoch 0 would drain (and stage) one full pass unseen
+            # a fresh async wrapper fit() itself created is already
+            # prefetching; resetting it on epoch 0 would drain (and
+            # stage) one full pass unseen. CALLER-supplied iterators may
+            # be mid-stream and reset unconditionally (ADVICE r5)
             if hasattr(data, "reset") and (
-                    epoch > 0 or not getattr(data, "has_next",
-                                             lambda: False)()):
+                    epoch > 0 or not wrapped_here
+                    or not getattr(data, "has_next", lambda: False)()):
                 data.reset()
             it = iter(data) if not hasattr(data, "has_next") else None
             if it is not None:

@@ -345,12 +345,21 @@ class MultiLayerNetwork:
         raise TypeError(f"Cannot fit on {type(data)}")
 
     def _fit_iterator(self, it, num_epochs=1):
-        from ..datasets.iterators import wrap_async_for_fit
+        from ..datasets.iterators import (AsyncDataSetIterator,
+                                          wrap_async_for_fit)
+        # a CALLER-supplied iterator may be mid-stream and must start the
+        # first epoch from position 0 (ADVICE r5): plain iterators are
+        # reset BEFORE wrapping (so the fresh wrapper prefetches from 0
+        # and the epoch-0 reset skip below is trivially safe); an async
+        # iterator the caller built themselves resets in the loop
+        wrapped_here = not isinstance(it, AsyncDataSetIterator)
+        if wrapped_here:
+            it.reset()
         async_it = wrap_async_for_fit(it, self.compute_dtype)
         if self._jit_step is None:
             self._jit_step = self._make_step()
         for epoch in range(num_epochs):
-            if epoch > 0 or not async_it.has_next():
+            if epoch > 0 or not wrapped_here or not async_it.has_next():
                 async_it.reset()
             for l in self.listeners:
                 if hasattr(l, "on_epoch_start"):

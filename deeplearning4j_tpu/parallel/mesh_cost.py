@@ -65,15 +65,25 @@ def hlo_collective_footprint(hlo_text):
         shape = m.group(1)
         b = shape_bytes(shape)
         if m.group(3):
-            # async form: the -start result tuple aliases the operand as
-            # its FIRST component (remaining components are the produced
-            # result + tiny context scalars on some lowerings) — subtract
-            # the operand so sync and async lowerings of the same
-            # collective agree (else a backend flip sync<->async looks
-            # like a 2x traffic regression against committed budgets)
+            # async form: the -start result tuple aliases the OPERANDS as
+            # its leading components (the trailing half is the produced
+            # results, plus tiny context scalars on some lowerings) —
+            # subtract the operand aliases so sync and async lowerings of
+            # the same collective agree (else a backend flip sync<->async
+            # looks like a 2x traffic regression against committed
+            # budgets). A VARIADIC collective has N operand aliases, not
+            # one: strip trailing context scalars, then subtract the
+            # first half of the remaining 2k components; an odd remainder
+            # falls back to the single-operand assumption (shapes[0]).
             shapes = [sm.group(0) for sm in _SHAPE_RE.finditer(shape)]
             if len(shapes) > 1:
-                b -= shape_bytes(shapes[0])
+                core = list(shapes)
+                while len(core) > 2 and core[-1] in ("u32[]", "s32[]"):
+                    core.pop()
+                if len(core) % 2 == 0:
+                    b -= sum(shape_bytes(s) for s in core[:len(core) // 2])
+                else:
+                    b -= shape_bytes(shapes[0])
         rec = out.setdefault(m.group(2), {"count": 0, "bytes": 0})
         rec["count"] += 1
         rec["bytes"] += b

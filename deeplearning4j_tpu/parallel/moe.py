@@ -28,7 +28,7 @@ import math
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ..common.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 

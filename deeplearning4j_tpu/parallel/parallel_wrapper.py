@@ -53,6 +53,31 @@ class ParallelWrapper:
             self._tensor_parallel = False
             self._sharded_updater_state = False
             self._mesh = None
+            self._checkpoint = None
+            self._fault_injector = None
+
+        def checkpointing(self, directory, every_n_rounds=1, keep_last=3,
+                          resume=True):
+            """Periodic checkpoint + crash-resume: every `every_n_rounds`
+            averaging rounds (allreduce mode: one round = one batch;
+            k-local-steps mode: one round = one k-group) the model's full
+            training state is saved to a ShardedCheckpointManager under
+            `directory`. When `resume` (default) and the directory already
+            holds checkpoints, a fit() on a FRESH model restores the
+            newest one and fast-forwards through the rounds it covers —
+            re-running the same fit command after a crash resumes
+            mid-epoch instead of restarting. Use a fresh directory for a
+            genuinely new run."""
+            self._checkpoint = {"directory": str(directory),
+                                "every": max(1, int(every_n_rounds)),
+                                "keep_last": max(1, int(keep_last)),
+                                "resume": bool(resume)}
+            return self
+
+        def fault_injector(self, inj):
+            """Install a `common.resilience.FaultInjector`; the wrapper
+            fires site "wrapper.round" before each averaging round."""
+            self._fault_injector = inj; return self
 
         def workers(self, n):
             self._workers = int(n); return self
@@ -93,11 +118,13 @@ class ParallelWrapper:
         def build(self):
             return ParallelWrapper(self.model, self._workers, self._avg_freq,
                                    self._avg_updaters, self._tensor_parallel,
-                                   self._mesh, self._sharded_updater_state)
+                                   self._mesh, self._sharded_updater_state,
+                                   self._checkpoint, self._fault_injector)
 
     def __init__(self, model, workers=None, averaging_frequency=1,
                  average_updaters=True, tensor_parallel=False, mesh=None,
-                 sharded_updater_state=False):
+                 sharded_updater_state=False, checkpoint=None,
+                 fault_injector=None):
         self.model = model
         model._ensure_init()
         if mesh is None:
@@ -115,6 +142,18 @@ class ParallelWrapper:
             raise ValueError(
                 "sharded_updater_state requires averaging_frequency=1 "
                 "(k-local-steps carries updater state device-locally)")
+        self.checkpoint = checkpoint
+        self.fault_injector = fault_injector
+        # round counter + checkpoint/resume gate (one shared protocol —
+        # see util.sharded_checkpoint.RoundCheckpointer); rounds are
+        # monotonic across fit() calls/epochs
+        from ..util.sharded_checkpoint import RoundCheckpointer
+        cp = checkpoint or {}
+        self._gate = RoundCheckpointer(cp.get("directory"),
+                                       every=cp.get("every", 1),
+                                       keep_last=cp.get("keep_last", 3),
+                                       resume=cp.get("resume", True),
+                                       owner="parallel wrapper")
         self._sharded = False
         self._jit_step = None
         self._jit_kstep = None
@@ -149,9 +188,36 @@ class ParallelWrapper:
         spec[0] = "data"
         return put_sharded(arr, NamedSharding(self.mesh, P(*spec)))
 
+    # -- checkpoint / crash-resume (resilience layer) -------------------
+    @property
+    def _round(self):
+        return self._gate.round
+
+    @property
+    def _resume_round(self):
+        return self._gate.resume_round
+
+    def _round_starts(self):
+        """True when this averaging round must actually run; False when a
+        restored checkpoint already contains it (the round's batches are
+        still consumed from the iterator so the stream stays aligned)."""
+        if not self._gate.round_starts():
+            return False
+        if self.fault_injector is not None:
+            self.fault_injector.fire("wrapper.round")
+        return True
+
+    def _round_done(self):
+        self._gate.round_done(self.model)
+
     # ------------------------------------------------------------------
     def fit(self, data, num_epochs=1):
         net = self.model
+        # resume BEFORE sharding: the restore then lands on host/default-
+        # device arrays and the normal sharding pass distributes them —
+        # identical to the fresh-net flow (restoring into already-mesh-
+        # sharded donated buffers aborts XLA CPU)
+        self._gate.maybe_resume(net)
         self._ensure_sharded()
         from ..datasets.dataset import MultiDataSet
         if isinstance(data, (DataSet, MultiDataSet)):
@@ -267,6 +333,8 @@ class ParallelWrapper:
             # cached-step fast path is one attribute compare
             step = self._ensure_allreduce_step()
             ds = next_processed(it)
+            if not self._round_starts():
+                continue      # round covered by the restored checkpoint
             net._rng, step_rng = jax.random.split(net._rng)
             batch, feats = self._sharded_batch(ds, step_rng)
             (net._params, net._updater_state, net._model_state, score,
@@ -281,6 +349,7 @@ class ParallelWrapper:
             net.conf.iteration_count += 1
             for l in net.listeners:
                 l.iteration_done(net, net.conf.iteration_count - 1)
+            self._round_done()
 
     # -- mode 2: k local steps then parameter averaging ----------------
     def _fit_local_steps(self, it):
@@ -289,12 +358,16 @@ class ParallelWrapper:
         while it.has_next():
             pending.append(next_processed(it))
             if len(pending) == k:
-                self._run_kstep(pending)
+                if self._round_starts():
+                    self._run_kstep(pending)
+                    self._round_done()
                 pending = []
         if pending:
             # ragged tail: run the true remaining batches (the jitted k-step
             # retraces for the smaller leading axis) — no duplicated steps.
-            self._run_kstep(pending)
+            if self._round_starts():
+                self._run_kstep(pending)
+                self._round_done()
 
     @staticmethod
     def _pad_to(arr, b):
@@ -310,7 +383,7 @@ class ParallelWrapper:
         mesh = self.mesh
         avg_upd = self.average_updaters
         raw = net.make_raw_step()
-        from jax import shard_map
+        from ..common.jax_compat import shard_map
 
         def local_steps(params, ustate, state, batches):
             def body(carry, batch_t):

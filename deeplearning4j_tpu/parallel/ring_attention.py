@@ -224,7 +224,7 @@ def ring_self_attention(q, k, v, mesh, axis="seq", causal=False,
     use_flash: per-hop compute via the Pallas flash kernel (kv_mask not
     supported on that path)."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ..common.jax_compat import shard_map
 
     if use_flash and kv_mask is not None:
         raise ValueError("use_flash does not support kv_mask; pad-free "

@@ -74,6 +74,10 @@ class ParameterAveragingTrainingMaster:
             self._approach = "export"
             self._export_dir = None
             self._training_hook = None
+            self._checkpoint_dir = None
+            self._checkpoint_freq = 1
+            self._keep_checkpoints = 3
+            self._fault_injector = None
 
         def rdd_training_approach(self, v):
             """'export' (reference default: batch to disk, stream per split —
@@ -125,16 +129,49 @@ class ParameterAveragingTrainingMaster:
 
         trainingHook = training_hook
 
+        def checkpoint_directory(self, d):
+            """Enable periodic checkpoint + crash-resume: after every
+            `checkpoint_frequency` averaging rounds the network's full
+            training state is saved to a `ShardedCheckpointManager` under
+            `d`, and a master pointed at a non-empty `d` (with a FRESH
+            net) restores the newest checkpoint and fast-forwards through
+            the averaging rounds it already contains — re-running the same
+            training command after a mid-epoch crash resumes instead of
+            restarting. Use a fresh directory for a genuinely new run."""
+            self._checkpoint_dir = str(d); return self
+
+        checkpointDirectory = checkpoint_directory
+
+        def checkpoint_frequency(self, n):
+            """Save every n averaging rounds (default 1)."""
+            self._checkpoint_freq = max(1, int(n)); return self
+
+        checkpointFrequency = checkpoint_frequency
+
+        def keep_checkpoints(self, k):
+            """Retention for the checkpoint manager (last k + best)."""
+            self._keep_checkpoints = max(1, int(k)); return self
+
+        def fault_injector(self, inj):
+            """Install a `common.resilience.FaultInjector`; the master
+            fires site "master.round" before each averaging round
+            trains (crash-injection point for resume tests)."""
+            self._fault_injector = inj; return self
+
         def build(self):
             return ParameterAveragingTrainingMaster(
                 self._batch, self._workers, self._avg_freq,
                 self._avg_updaters, self._collect_stats, self._mesh,
-                self._approach, self._export_dir, self._training_hook)
+                self._approach, self._export_dir, self._training_hook,
+                self._checkpoint_dir, self._checkpoint_freq,
+                self._keep_checkpoints, self._fault_injector)
 
     def __init__(self, batch_size_per_worker=16, workers=None,
                  averaging_frequency=5, average_updaters=True,
                  collect_stats=False, mesh=None, approach="export",
-                 export_dir=None, training_hook=None):
+                 export_dir=None, training_hook=None, checkpoint_dir=None,
+                 checkpoint_frequency=1, keep_checkpoints=3,
+                 fault_injector=None):
         import jax
         self.batch_size = int(batch_size_per_worker)
         self.num_workers = int(workers or len(jax.devices()))
@@ -146,6 +183,19 @@ class ParameterAveragingTrainingMaster:
         self.export_dir = export_dir
         self.stats = TrainingMasterStats() if collect_stats else None
         self.training_hook = training_hook
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_frequency = max(1, int(checkpoint_frequency))
+        self.keep_checkpoints = max(1, int(keep_checkpoints))
+        self.fault_injector = fault_injector
+        # round counter + checkpoint/resume gate (one shared protocol —
+        # see util.sharded_checkpoint.RoundCheckpointer); rounds are
+        # monotonic across execute_training calls (the facade calls once
+        # per epoch)
+        from ..util.sharded_checkpoint import RoundCheckpointer
+        self._gate = RoundCheckpointer(checkpoint_dir,
+                                       every=self.checkpoint_frequency,
+                                       keep_last=self.keep_checkpoints,
+                                       owner="training master")
         self._pw = None
         # (data object, [paths], owned_tmpdir) — holds a strong reference to
         # the source and compares with `is`: an id() key could collide when
@@ -162,6 +212,9 @@ class ParameterAveragingTrainingMaster:
             "averageUpdaters": self.average_updaters,
             "rddTrainingApproach": self.approach,
             "exportDirectory": self.export_dir,
+            "checkpointDirectory": self.checkpoint_dir,
+            "checkpointFrequency": self.checkpoint_frequency,
+            "keepCheckpoints": self.keep_checkpoints,
         })
 
     toJson = to_json
@@ -173,7 +226,10 @@ class ParameterAveragingTrainingMaster:
             d.get("batchSizePerWorker", 16), d.get("workers"),
             d.get("averagingFrequency", 5), d.get("averageUpdaters", True),
             approach=d.get("rddTrainingApproach", "export"),
-            export_dir=d.get("exportDirectory"))
+            export_dir=d.get("exportDirectory"),
+            checkpoint_dir=d.get("checkpointDirectory"),
+            checkpoint_frequency=d.get("checkpointFrequency", 1),
+            keep_checkpoints=d.get("keepCheckpoints", 3))
 
     fromJson = from_json
 
@@ -192,6 +248,27 @@ class ParameterAveragingTrainingMaster:
                         .build())
         return self._pw
 
+    # -- checkpoint / crash-resume (resilience layer) -------------------
+    @property
+    def _round(self):
+        return self._gate.round
+
+    @property
+    def _resume_round(self):
+        return self._gate.resume_round
+
+    def _run_round(self, net, batches, hook, hook_trains):
+        """One averaging round with resume gating, fault injection and
+        periodic checkpointing. Returns True when the round actually
+        trained (False = covered by a restored checkpoint)."""
+        if not self._gate.round_starts():
+            return False
+        if self.fault_injector is not None:
+            self.fault_injector.fire("master.round")
+        self._train_split(net, batches, hook, hook_trains)
+        self._gate.round_done(net)
+        return True
+
     def execute_training(self, net, data):
         """data: list[DataSet] | DataSetIterator | one big DataSet.
         reference: executeTraining:344 — split, broadcast, map, aggregate.
@@ -201,10 +278,16 @@ class ParameterAveragingTrainingMaster:
         global-batch .npz files (one per ParallelWrapper step), then splits
         stream batch-by-batch from disk — host memory holds at most one
         global batch, so datasets larger than RAM train. approach='direct'
-        materializes everything in memory (the reference's Direct mode)."""
+        materializes everything in memory (the reference's Direct mode).
+
+        With a checkpoint directory configured (Builder
+        .checkpoint_directory), every round is checkpointed and a re-run
+        after a crash resumes from the last completed averaging round —
+        see _maybe_resume."""
         hook = self.training_hook
         hook_trains = hook is not None and getattr(hook, "handles_training",
                                                    False)
+        self._gate.maybe_resume(net)
         global_batch = self.num_workers * self.batch_size
         if not hook_trains:
             pw = self._ensure_pw(net)
@@ -232,9 +315,10 @@ class ParameterAveragingTrainingMaster:
                     t1 = time.time()
                     split_paths = paths[s0:s0 + k]
                     from ..datasets.iterators import FileDataSetIterator
-                    self._train_split(net, FileDataSetIterator(split_paths),
-                                      hook, hook_trains)
-                    if self.stats:
+                    trained = self._run_round(
+                        net, FileDataSetIterator(split_paths), hook,
+                        hook_trains)
+                    if self.stats and trained:
                         self.stats.record("fit", t1, time.time() - t1,
                                           {"minibatches": len(split_paths)})
                 return net
@@ -257,8 +341,8 @@ class ParameterAveragingTrainingMaster:
                                       {"examples": split.num_examples()})
                 t1 = time.time()
                 batches = list(split.batch_by(global_batch))
-                self._train_split(net, batches, hook, hook_trains)
-                if self.stats:
+                trained = self._run_round(net, batches, hook, hook_trains)
+                if self.stats and trained:
                     self.stats.record("fit", t1, time.time() - t1,
                                       {"minibatches": len(batches)})
             return net

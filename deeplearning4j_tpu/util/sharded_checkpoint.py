@@ -125,6 +125,12 @@ class ShardedCheckpointManager:
     def steps(self):
         return list(self._meta["steps"])
 
+    def latest_step(self):
+        """Newest checkpointed step, or None for an empty directory — the
+        crash-resume probe (TrainingMaster/ParallelWrapper fast-forward
+        past this many averaging rounds on a re-run)."""
+        return self._meta["steps"][-1] if self._meta["steps"] else None
+
     def best_step(self):
         scores = {int(s): v for s, v in self._meta["scores"].items()
                   if v is not None}
@@ -216,6 +222,79 @@ class ShardedCheckpointManager:
         return self.restore(net, best)
 
 
+class RoundCheckpointer:
+    """Per-averaging-round checkpoint + crash-resume gate — the ONE
+    implementation of the resume protocol shared by
+    `ParameterAveragingTrainingMaster` and `ParallelWrapper` (the round is
+    the resume unit: master = one split; wrapper = one batch in allreduce
+    mode / one k-group in k-step mode).
+
+    With `directory=None` it is a pure round counter (checkpointing off).
+    Otherwise: `maybe_resume(net)` — once per lifetime, and only into a
+    never-trained net (iteration_count 0; a warm net is an in-process
+    continuation, not a crash restart) — restores the newest checkpoint
+    and records how many rounds it covers; `round_starts()` then gates
+    those rounds off (the caller still consumes their batches so the data
+    stream stays aligned); `round_done(net)` saves every `every` rounds.
+    Re-running the same training command after a crash therefore resumes
+    from the last completed averaging round with the exact rng/counters,
+    making the result bit-comparable to an uninterrupted run."""
+
+    def __init__(self, directory=None, every=1, keep_last=3, resume=True,
+                 owner="trainer"):
+        self.directory = None if directory is None else str(directory)
+        self.every = max(1, int(every))
+        self.keep_last = max(1, int(keep_last))
+        self.resume = bool(resume)
+        self.owner = owner
+        self.round = 0           # rounds dispatched, monotonic for life
+        self.resume_round = 0    # rounds covered by a restored checkpoint
+        self._mgr = None
+        self._checked = False
+
+    def manager(self):
+        if self.directory is None:
+            return None
+        if self._mgr is None:
+            self._mgr = ShardedCheckpointManager(self.directory,
+                                                 keep_last=self.keep_last)
+        return self._mgr
+
+    def maybe_resume(self, net):
+        if self._checked:
+            return
+        self._checked = True
+        mgr = self.manager()
+        if mgr is None:
+            return
+        last = mgr.latest_step()
+        if (not self.resume or last is None
+                or net.conf.iteration_count != 0):
+            return
+        mgr.restore(net, last)
+        self.resume_round = last
+        import logging
+        logging.getLogger(__name__).warning(
+            "%s: resuming from checkpoint round %d under %s — "
+            "fast-forwarding past the already-trained rounds of the "
+            "re-run", self.owner, last, self.directory)
+
+    def round_starts(self):
+        """True when this round must actually run; False when a restored
+        checkpoint already contains it."""
+        r = self.round
+        self.round += 1
+        return r >= self.resume_round
+
+    def round_done(self, net):
+        mgr = self.manager()
+        if mgr is None or self.round % self.every != 0:
+            return
+        score = getattr(net, "_score", None)
+        mgr.save(net, self.round,
+                 score=None if score is None else float(score))
+
+
 class ShardedModelSaver:
     """Early-stopping saver SPI over the sharded format (reference
     earlystopping/saver/LocalFileModelSaver.java, which writes the zip).
@@ -252,12 +331,38 @@ class ShardedModelSaver:
     getBestModel = get_best_model
 
 
+def _check_restore_shapes(tpl, metadata):
+    """Loud architecture check: orbax (0.7) silently restores the SAVED
+    shape when the template disagrees, so a checkpoint restored into the
+    wrong architecture would hand the net mis-shaped parameters that only
+    blow up (or worse, silently mistrain) later. Compare every array leaf
+    the template and the stored metadata share and fail with the full
+    mismatch list instead."""
+    def flat(tree):
+        out = {}
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            shape = getattr(leaf, "shape", None)
+            if shape is not None:
+                out[jax.tree_util.keystr(kp)] = tuple(shape)
+        return out
+    want, saved = flat(tpl), flat(metadata)
+    bad = sorted(k for k in want.keys() & saved.keys()
+                 if want[k] != saved[k])
+    if bad:
+        detail = "; ".join(f"{k}: saved {saved[k]} vs net {want[k]}"
+                           for k in bad[:8])
+        raise ValueError(
+            f"checkpoint does not match the target architecture "
+            f"({len(bad)} mismatched arrays): {detail}")
+
+
 def load_checkpoint(net, path):
     """Restore a checkpoint INTO `net`, placing every shard onto the
     sharding each array currently has (shard a fresh net first — e.g. via
     ParallelWrapper's ZeRO/TP layouts — and the restore lands distributed;
     leave it unsharded and the restore lands replicated/local). The
-    architecture must match the saved one (same pytree structure/shapes).
+    architecture must match the saved one (same pytree structure/shapes) —
+    a mismatch raises instead of silently restoring the saved shapes.
     Returns `net`."""
     import orbax.checkpoint as ocp
     net._ensure_init()
@@ -277,6 +382,12 @@ def load_checkpoint(net, path):
         return a
     tpl = jax.tree.map(abstract, _tree(net))
     with ocp.StandardCheckpointer() as ckptr:
+        try:
+            metadata = ckptr.metadata(os.path.abspath(path))
+        except Exception:  # noqa: BLE001 — older layouts: let orbax decide
+            metadata = None
+        if metadata is not None:
+            _check_restore_shapes(tpl, metadata)
         doc = ckptr.restore(os.path.abspath(path), tpl)
     net._params = doc["params"]
     net._updater_state = doc["updater_state"]

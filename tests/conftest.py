@@ -32,11 +32,16 @@ jax.config.update("jax_enable_x64", True)
 
 # The suite's wall clock is dominated by XLA compiles of hundreds of tiny
 # programs (the r5 single-core timing: 444 s, top-25 tests = 220 s, almost
-# all compile). The workspace persists between CI runs, so a persistent
-# compilation cache makes warm runs fit the core-tier budget; cold runs
-# are unchanged. Keyed by program+flags, so correctness is XLA's problem,
-# not ours. Disable with DL4J_TPU_NO_TEST_CACHE=1.
-if not os.environ.get("DL4J_TPU_NO_TEST_CACHE"):
+# all compile). A persistent compilation cache made warm runs ~3x faster —
+# but on this jaxlib (0.4.37 CPU) reading entries back SEGFAULTS the
+# interpreter roughly every other run (reproduced in isolation on the
+# pristine seed tree: cold write passes, warm reads crash in executable
+# deserialization), killing the whole pytest process mid-suite and making
+# the tier-1 pass count a coin flip (r6 measured 144 vs 348 dots on
+# identical code). Robustness beats warm-run speed: the cache is now
+# OPT-IN via DL4J_TPU_TEST_CACHE=1 for environments whose jaxlib
+# deserializes reliably; the uncached suite still fits the tier-1 budget.
+if os.environ.get("DL4J_TPU_TEST_CACHE"):
     _cache_dir = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), ".jax_test_cache")
     jax.config.update("jax_compilation_cache_dir", _cache_dir)

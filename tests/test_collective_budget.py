@@ -125,3 +125,42 @@ def test_collective_bytes_within_budget():
     assert "collective-permute" in measured["ring_attention_sp"]["ops"]
     assert any(op.startswith("all-to-all")
                for op in measured["moe_ep"]["ops"])
+
+
+def test_async_variadic_collective_accounting():
+    """ADVICE r5 (mesh_cost.py): a VARIADIC async -start tuple aliases ALL
+    its operands as the leading components, not just the first — the
+    accounting must subtract the first half (after stripping trailing
+    context scalars) so committed collective-bytes budgets don't shift on
+    a sync<->async backend flip. Pure HLO-text parsing, no lowering."""
+    from deeplearning4j_tpu.parallel.mesh_cost import (
+        hlo_collective_footprint, shape_bytes)
+
+    sync = ("  %ar = (f32[128,4]{1,0}, f32[64]{0}) "
+            "all-reduce(f32[128,4] %a, f32[64] %b), replica_groups={}")
+    sync_bytes = hlo_collective_footprint(sync)["all-reduce"]["bytes"]
+    assert sync_bytes == 128 * 4 * 4 + 64 * 4
+
+    # variadic async: 2 operand aliases + 2 results — must equal sync
+    async_ = ("  %ars = (f32[128,4]{1,0}, f32[64]{0}, f32[128,4]{1,0}, "
+              "f32[64]{0}) all-reduce-start(f32[128,4] %a, f32[64] %b), "
+              "replica_groups={}")
+    fp = hlo_collective_footprint(async_)["all-reduce"]
+    assert fp["count"] == 1
+    assert fp["bytes"] == sync_bytes
+
+    # trailing context scalars (some lowerings) are stripped before the
+    # half-split and stay counted, exactly as in the single-operand case
+    async_ctx = ("  %ars = (f32[128,4]{1,0}, f32[64]{0}, f32[128,4]{1,0}, "
+                 "f32[64]{0}, u32[], u32[]) all-reduce-start("
+                 "f32[128,4] %a, f32[64] %b), replica_groups={}")
+    fp_ctx = hlo_collective_footprint(async_ctx)["all-reduce"]
+    assert fp_ctx["bytes"] == sync_bytes + 2 * shape_bytes("u32[]")
+
+    # single-operand behavior unchanged: (operand, result) subtracts the
+    # operand alias, matching the sync lowering
+    s1 = "  %r = f32[32]{0} all-reduce(f32[32] %x), replica_groups={}"
+    a1 = ("  %rs = (f32[32]{0}, f32[32]{0}) all-reduce-start(f32[32] %x), "
+          "replica_groups={}")
+    assert (hlo_collective_footprint(a1)["all-reduce"]["bytes"]
+            == hlo_collective_footprint(s1)["all-reduce"]["bytes"])

@@ -274,6 +274,31 @@ class TestMultiInputPipeline:
         assert np.array_equal(np.asarray(staged.labels_masks[0]), lm[0])
         assert not it.has_next()
 
+    def test_multidataset_metas_survive_wire_and_shallow_copy(self):
+        """Symmetry with the DataSet paths (ADVICE r5): example_metas must
+        survive MultiDataSet.shallow_copy AND the bf16-wire staging
+        rebuild in AsyncMultiDataSetIterator._cast_for_wire."""
+        from deeplearning4j_tpu.datasets import AsyncMultiDataSetIterator
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        metas = [{"id": i} for i in range(4)]
+        mds = MultiDataSet([np.ones((4, 3), np.float32)],
+                           [np.ones((4, 2), np.float32)])
+        mds.example_metas = metas
+        assert mds.shallow_copy().example_metas is metas
+        # bf16 wire, host-only (device staging covered above): the cast
+        # rebuild used to drop metas while the DataSet path carried them
+        it = AsyncMultiDataSetIterator(_OneShotIterator(mds), queue_size=2,
+                                       transfer_dtype="bfloat16",
+                                       cast_labels=False, device_put=False)
+        out = it.next_batch()
+        assert getattr(out, "example_metas", None) is metas
+        # device-staged variant keeps them too (full wire path)
+        it2 = AsyncMultiDataSetIterator(_OneShotIterator(mds), queue_size=2,
+                                        transfer_dtype="bfloat16",
+                                        cast_labels=False)
+        out2 = it2.next_batch()
+        assert getattr(out2, "example_metas", None) is metas
+
 
 class TestUtilityIterators:
     """Reference datasets/iterator utility long tail:

@@ -1,0 +1,537 @@
+"""Fault-tolerant distributed training (ISSUE 1 acceptance criteria).
+
+Every failure mode is driven deterministically through
+`common.resilience.FaultInjector` against the REAL code paths — no mocks:
+
+  (a) a severed PSClient connection reconnects with backoff and training
+      converges to the same applied-gradient count, with a retried PUSH
+      applied exactly once (server-side (worker, seq) dedup);
+  (b) a killed worker is reaped via heartbeat timeout and the remaining
+      workers finish the run (graceful degradation, counted in stats);
+  (c) a TrainingMaster run killed mid-epoch resumes from the last
+      checkpoint and completes with a matching final averaging-round
+      count (and bit-matching parameters vs. an uninterrupted run);
+  (d) a mid-stream producer exception in the multi-worker async staging
+      pipeline surfaces as a raised error under a full queue, not a hang.
+
+Tiering: the deterministic fast tests run in tier-1; the timing-heavy
+random-churn stress run is @pytest.mark.slow.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.common.resilience import (FaultInjected,
+                                                  FaultInjector,
+                                                  NonRetryableError,
+                                                  RetryPolicy)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+
+def _net(seed=7):
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, DenseLayer(n_out=16, activation="relu"))
+            .layer(1, OutputLayer(n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=256, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.random((n, 5)).astype(np.float32)
+    w = r.random((5, 3))
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return DataSet(x, y)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_backoff_deterministic_and_bounded():
+    a = RetryPolicy(seed=3, sleep=lambda d: None)
+    b = RetryPolicy(seed=3, sleep=lambda d: None)
+    assert [a.delay(i) for i in range(6)] == [b.delay(i) for i in range(6)]
+    # bounded: never negative, never beyond max_delay * (1 + jitter)
+    c = RetryPolicy(seed=9, base_delay=0.05, max_delay=2.0, jitter=0.25)
+    for i in range(30):
+        d = c.delay(i)
+        assert 0.0 <= d <= 2.0 * 1.25
+
+
+def test_retry_policy_retries_then_succeeds():
+    sleeps = []
+    pol = RetryPolicy(max_retries=5, base_delay=0.0, jitter=0.0,
+                      sleep=sleeps.append, seed=0)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert pol.call(flaky) == "ok"
+    assert calls["n"] == 3
+    assert len(sleeps) == 2
+
+
+def test_retry_policy_classification():
+    pol = RetryPolicy(max_retries=5, base_delay=0.0, jitter=0.0,
+                      sleep=lambda d: None)
+
+    # a non-retryable marker wins even when the type matches `retryable`
+    class Refused(ConnectionError, NonRetryableError):
+        pass
+
+    n = {"v": 0}
+
+    def refused():
+        n["v"] += 1
+        raise Refused("terminal")
+
+    with pytest.raises(Refused):
+        pol.call(refused)
+    assert n["v"] == 1         # no retries
+
+    # an unclassified exception is never retried
+    m = {"v": 0}
+
+    def broken():
+        m["v"] += 1
+        raise ValueError("bug, not weather")
+
+    with pytest.raises(ValueError):
+        pol.call(broken)
+    assert m["v"] == 1
+
+
+def test_retry_policy_deadline_and_exhaustion():
+    t = {"now": 0.0}
+    pol = RetryPolicy(max_retries=100, base_delay=1.0, max_delay=1.0,
+                      jitter=0.0, deadline=3.5,
+                      sleep=lambda d: t.__setitem__("now", t["now"] + d),
+                      clock=lambda: t["now"])
+    n = {"v": 0}
+
+    def always():
+        n["v"] += 1
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        pol.call(always)
+    # attempts at t=0,1,2,3; the next backoff would cross the 3.5s deadline
+    assert n["v"] == 4
+
+    pol2 = RetryPolicy(max_retries=2, base_delay=0.0, jitter=0.0,
+                       sleep=lambda d: None)
+    m = {"v": 0}
+
+    def always2():
+        m["v"] += 1
+        raise TimeoutError("down")
+
+    with pytest.raises(TimeoutError):
+        pol2.call(always2)
+    assert m["v"] == 3          # initial + 2 retries
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+def test_fault_injector_explicit_schedule():
+    inj = FaultInjector(seed=0)
+    inj.plan("op", on_calls=[2, 5])
+    hits = []
+    for i in range(8):
+        try:
+            inj.fire("op")
+        except FaultInjected:
+            hits.append(i)
+    assert hits == [2, 5]
+    assert inj.calls("op") == 8
+    assert inj.fired("op") == [("op", 2), ("op", 5)]
+
+
+def test_fault_injector_prob_schedule_is_seed_deterministic():
+    def run(seed):
+        inj = FaultInjector(seed=seed)
+        inj.plan("op", prob=0.4, times=5)
+        hits = []
+        for i in range(25):
+            try:
+                inj.fire("op")
+            except FaultInjected:
+                hits.append(i)
+        return hits
+
+    assert run(11) == run(11)       # reproducible
+    assert len(run(11)) == 5        # capped by times
+    assert run(11) != run(12)       # seed actually matters
+
+
+def test_fault_injector_sever_callback_and_custom_exc():
+    inj = FaultInjector()
+    inj.plan("op", on_call=0, sever=True, exc=RuntimeError("boom"))
+    severed = []
+    with pytest.raises(RuntimeError, match="boom"):
+        inj.fire("op", on_sever=lambda: severed.append(1))
+    assert severed == [1]
+    # exc=None: fault (sever/delay) without raising
+    inj.plan("quiet", on_call=0, sever=True, exc=None)
+    inj.fire("quiet", on_sever=lambda: severed.append(2))
+    assert severed == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# (a) severed connection: reconnect + at-most-once push
+# ---------------------------------------------------------------------------
+
+def test_severed_connection_reconnects_and_push_applies_once():
+    import jax
+    from deeplearning4j_tpu.parallel.parameter_server import (_jitted_ps_fns,
+                                                              ps_batch)
+    from deeplearning4j_tpu.parallel.ps_transport import PSClient, PSServer
+
+    net = _net()
+    ds = _data(96)
+    s0 = float(net.score(ds))
+    srv = PSServer(net, queue_size=4, n_workers=1)
+    client = None
+    try:
+        inj = FaultInjector(seed=0)
+        # sever right AFTER push #2's bytes hit the wire: the server
+        # applies the gradient, the client never sees the ack and must
+        # reconnect + resend the SAME seq — applied at most once
+        inj.plan("client.push.sent", on_call=1, sever=True)
+        # and a pull severed mid-flight is retried (idempotent read)
+        inj.plan("client.pull.sent", on_call=3, sever=True)
+        pol = RetryPolicy(max_retries=8, base_delay=0.01, max_delay=0.1,
+                          seed=1)
+        client = PSClient("127.0.0.1", srv.port, retry_policy=pol,
+                          fault_injector=inj)
+
+        worker = _net(seed=9)          # architecture donor only
+        worker._ensure_init()
+        grad_fn = _jitted_ps_fns(worker)[0]
+        treedef = jax.tree_util.tree_structure(worker._params)
+        rng = jax.random.PRNGKey(0)
+        batches = list(ds.batch_by(16))          # 6 logical pushes
+        for j, b in enumerate(batches):
+            pleaves, _sleaves, version = client.pull()
+            params = jax.tree_util.tree_unflatten(treedef, pleaves)
+            batch = ps_batch(b, jax.random.fold_in(rng, j))
+            grads, score, _state, _ = grad_fn(params, worker._model_state,
+                                              batch)
+            client.push(
+                [np.asarray(l) for l in jax.tree_util.tree_leaves(grads)],
+                float(score), version)
+        client.done()
+        final = srv.wait(timeout=120)
+    finally:
+        srv.stop()
+        if client is not None:
+            client.close()
+    assert len(inj.fired()) == 2                 # both faults fired
+    assert client.reconnects >= 2                # both paths re-dialed
+    assert final["dup_pushes"] >= 1              # the retry was detected
+    # the retried push was applied EXACTLY once: every logical push
+    # counted, none double-applied
+    assert final["applied"] == len(batches)
+    assert float(net.score(ds)) < s0             # and training trained
+
+
+# ---------------------------------------------------------------------------
+# (b) heartbeat reaping: a crashed worker doesn't deadlock the survivors
+# ---------------------------------------------------------------------------
+
+def test_dead_worker_is_reaped_and_survivors_finish():
+    from deeplearning4j_tpu.parallel.ps_transport import PSClient, PSServer
+
+    net = _net()
+    srv = PSServer(net, queue_size=4, n_workers=2, heartbeat_timeout=1.0)
+    alive = dead = None
+    try:
+        alive = PSClient("127.0.0.1", srv.port, heartbeat_interval=0.1)
+        dead = PSClient("127.0.0.1", srv.port, heartbeat_interval=0.1)
+        assert alive.worker_id != dead.worker_id
+
+        def zero_push(c):
+            pleaves, _s, version = c.pull()
+            c.push([np.zeros_like(np.asarray(l)) for l in pleaves],
+                   1.0, version)
+
+        zero_push(dead)
+        dead.kill()                # crash: no DONE, heartbeats stop
+        for _ in range(3):
+            zero_push(alive)       # survivor keeps training
+        alive.done()
+        t0 = time.monotonic()
+        stats = srv.wait(timeout=60)
+        waited = time.monotonic() - t0
+    finally:
+        srv.stop()
+        for c in (alive, dead):
+            if c is not None:
+                c.close()
+    assert stats["workers_reaped"] == 1
+    assert stats["workers_done"] == 1
+    assert stats["applied"] == 4       # dead's 1 + alive's 3 all landed
+    # wait() returned via the reaper, not a lucky race: the barrier held
+    # until the heartbeat timeout had passed, then released
+    assert waited < 30
+
+
+def test_restarted_worker_reusing_id_resumes_seq_numbering():
+    """A restarted worker PROCESS that proposes its old worker_id must not
+    have its fresh pushes (seq restarting from 1) dedup'd against its
+    previous life's seqs — the HELLO reply carries the last applied seq
+    and the client resumes above it."""
+    from deeplearning4j_tpu.parallel.ps_transport import PSClient, PSServer
+
+    net = _net()
+    srv = PSServer(net, queue_size=4, n_workers=1)
+    try:
+        def zero_push(c):
+            pleaves, _s, version = c.pull()
+            c.push([np.zeros_like(np.asarray(l)) for l in pleaves],
+                   1.0, version)
+
+        first = PSClient("127.0.0.1", srv.port, worker_id=3)
+        for _ in range(3):
+            zero_push(first)
+        first.kill()                      # process dies, no DONE
+
+        # "restart": fresh client, same identity, fresh seq counter
+        second = PSClient("127.0.0.1", srv.port, worker_id=3)
+        assert second._push_seq == 3      # resumed above the applied seqs
+        for _ in range(2):
+            zero_push(second)
+        second.done()
+        final = srv.wait(timeout=60)
+    finally:
+        srv.stop()
+    assert final["dup_pushes"] == 0       # nothing silently discarded
+    assert final["applied"] == 5          # all 3 + 2 gradients landed
+
+
+def test_worker_that_never_connects_is_reaped():
+    """n_workers promises a worker that crashes before HELLO: the server
+    must still release wait() instead of blocking forever."""
+    from deeplearning4j_tpu.parallel.ps_transport import PSClient, PSServer
+
+    net = _net()
+    srv = PSServer(net, queue_size=4, n_workers=2, heartbeat_timeout=0.6)
+    c = None
+    try:
+        c = PSClient("127.0.0.1", srv.port, heartbeat_interval=0.1)
+        c.done()
+        stats = srv.wait(timeout=60)
+    finally:
+        srv.stop()
+        if c is not None:
+            c.close()
+    assert stats["workers_done"] == 1
+    assert stats["workers_reaped"] == 1
+
+
+# ---------------------------------------------------------------------------
+# (c) TrainingMaster / ParallelWrapper crash-resume
+# ---------------------------------------------------------------------------
+
+def _master(ckpt_dir=None, inj=None):
+    from deeplearning4j_tpu.parallel import ParameterAveragingTrainingMaster
+    b = (ParameterAveragingTrainingMaster.Builder(batch_size_per_worker=8)
+         .workers(4).averaging_frequency(2).rdd_training_approach("direct"))
+    if ckpt_dir is not None:
+        b = b.checkpoint_directory(str(ckpt_dir))
+    if inj is not None:
+        b = b.fault_injector(inj)
+    return b.build()
+
+
+def test_training_master_crash_resume_matches_clean_run(tmp_path):
+    ds = _data(256, seed=3)        # 8 global batches -> 4 rounds per pass
+
+    # clean reference: two passes (epochs), 8 averaging rounds total
+    ref = _net(seed=11)
+    tm_ref = _master()
+    tm_ref.execute_training(ref, ds)
+    tm_ref.execute_training(ref, ds)
+    assert tm_ref._round == 8
+
+    # crashing run: checkpoint every round, die at round index 5
+    # (mid-second-epoch)
+    inj = FaultInjector()
+    inj.plan("master.round", on_call=5, exc=RuntimeError("injected crash"))
+    net1 = _net(seed=11)
+    tm1 = _master(tmp_path / "ck", inj)
+    tm1.execute_training(net1, ds)                 # first pass: rounds 0-3
+    with pytest.raises(RuntimeError, match="injected crash"):
+        tm1.execute_training(net1, ds)             # dies entering round 5
+
+    # resume: FRESH net + FRESH master on the same checkpoint dir re-runs
+    # the same two passes; rounds 0-4 fast-forward from the restored
+    # checkpoint, rounds 5-7 train
+    net2 = _net(seed=11)
+    tm2 = _master(tmp_path / "ck")
+    tm2.execute_training(net2, ds)
+    tm2.execute_training(net2, ds)
+    assert tm2._round == 8                         # matching round count
+    assert tm2._resume_round == 5
+    assert net2.conf.iteration_count == ref.conf.iteration_count
+    np.testing.assert_allclose(np.asarray(net2.params()),
+                               np.asarray(ref.params()), atol=1e-6)
+
+
+def test_parallel_wrapper_crash_resume_matches_clean_run(tmp_path):
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+
+    batches = list(_data(128, seed=5).batch_by(16))     # 8 batches
+
+    def wrapper(net, ckpt=None, inj=None):
+        b = (ParallelWrapper.Builder(net).workers(4)
+             .averaging_frequency(2))
+        if ckpt is not None:
+            b = b.checkpointing(str(ckpt))
+        if inj is not None:
+            b = b.fault_injector(inj)
+        return b.build()
+
+    ref = _net(seed=5)
+    wrapper(ref).fit(ListDataSetIterator(batches), num_epochs=2)
+
+    inj = FaultInjector()
+    inj.plan("wrapper.round", on_call=5, exc=RuntimeError("injected crash"))
+    net1 = _net(seed=5)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        wrapper(net1, tmp_path / "ck", inj).fit(
+            ListDataSetIterator(batches), num_epochs=2)
+
+    net2 = _net(seed=5)
+    pw2 = wrapper(net2, tmp_path / "ck")
+    pw2.fit(ListDataSetIterator(batches), num_epochs=2)
+    assert pw2._round == 8
+    assert pw2._resume_round == 5
+    assert net2.conf.iteration_count == ref.conf.iteration_count
+    np.testing.assert_allclose(np.asarray(net2.params()),
+                               np.asarray(ref.params()), atol=1e-6)
+
+
+def test_warm_net_is_not_clobbered_by_resume(tmp_path):
+    """A model that already trained IN THIS PROCESS is a continuation,
+    not a crash restart: pointing it at a populated checkpoint dir must
+    not roll it back."""
+    ds = _data(128, seed=1)
+    net = _net(seed=2)
+    tm = _master(tmp_path / "ck")
+    tm.execute_training(net, ds)
+    it_after = net.conf.iteration_count
+    assert it_after > 0
+    # same net, new master over the SAME populated dir: no rollback
+    tm2 = _master(tmp_path / "ck")
+    tm2.execute_training(net, ds)
+    assert net.conf.iteration_count > it_after
+
+
+# ---------------------------------------------------------------------------
+# (d) mid-stream producer error surfaces under a full queue
+# ---------------------------------------------------------------------------
+
+def test_producer_error_surfaces_not_hangs_under_full_queue():
+    from deeplearning4j_tpu.datasets.iterators import (AsyncDataSetIterator,
+                                                       DataSetIterator)
+
+    class MidStreamCorruption(DataSetIterator):
+        """10 good batches, then the source blows up (a corrupt file in
+        FileDataSetIterator, a flaky decoder...)."""
+
+        def __init__(self):
+            self._i = 0
+
+        def reset(self):
+            self._i = 0
+
+        def has_next(self):
+            return True            # the source still PROMISES more
+
+        def next_batch(self):
+            if self._i >= 10:
+                raise ValueError("corrupt record mid-stream")
+            self._i += 1
+            return DataSet(np.zeros((2, 3), np.float32),
+                           np.zeros((2, 1), np.float32))
+
+    result = {}
+
+    def consume():
+        try:
+            # tiny queues + a consumer slower than staging keep the
+            # bounded futs queue FULL when the producer hits the error —
+            # exactly the state that used to drop the exception and the
+            # sentinel and hang the consumer forever (ADVICE r5)
+            it = AsyncDataSetIterator(MidStreamCorruption(), queue_size=1,
+                                      num_workers=2, device_put=False)
+            n = 0
+            while it.has_next():
+                time.sleep(0.05)
+                it.next_batch()
+                n += 1
+            result["consumed"] = n
+        except BaseException as e:  # noqa: BLE001 — recorded for asserts
+            result["err"] = e
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive(), \
+        "consumer hung: the producer's mid-stream error was dropped"
+    err = result.get("err")
+    assert isinstance(err, RuntimeError)
+    assert isinstance(err.__cause__, ValueError)
+    assert "corrupt record" in str(err.__cause__)
+
+
+# ---------------------------------------------------------------------------
+# random-churn stress (timing-heavy -> slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_worker_fit_survives_random_severs_with_exact_accounting():
+    """A full ps_worker_fit run with seeded random connection severs on
+    both pull and push: the run completes, no worker is reaped (heartbeats
+    ride a separate socket), and the applied count is EXACT — dedup keeps
+    every retried push at-most-once even under churn."""
+    from deeplearning4j_tpu.parallel.ps_transport import (PSServer,
+                                                          ps_worker_fit)
+
+    net = _net()
+    ds = _data(256, seed=4)
+    srv = PSServer(net, queue_size=4, n_workers=1, heartbeat_timeout=5.0)
+    try:
+        inj = FaultInjector(seed=7)
+        inj.plan("client.push.sent", prob=0.25, times=4, sever=True)
+        inj.plan("client.pull", prob=0.2, times=3, sever=True)
+        pol = RetryPolicy(max_retries=10, base_delay=0.01, max_delay=0.05,
+                          seed=2)
+        worker = _net(seed=3)
+        ps_worker_fit(worker, "127.0.0.1", srv.port,
+                      ListDataSetIterator(list(ds.batch_by(32))),
+                      num_epochs=2, retry_policy=pol,
+                      heartbeat_interval=0.2, fault_injector=inj)
+        final = srv.wait(timeout=240)
+    finally:
+        srv.stop()
+    assert final["applied"] + final["stale_dropped"] == 16  # 8 x 2 epochs
+    assert final["workers_reaped"] == 0
+    assert len(inj.fired()) >= 1

@@ -53,6 +53,54 @@ def test_async_iterator_propagates_worker_error():
             it.next_batch()
 
 
+def test_caller_supplied_async_iterator_resets_on_epoch0():
+    """ADVICE r5: fit() skips the epoch-0 reset only for the async wrapper
+    it CREATED (freshly prefetching from position 0). A caller-supplied
+    async iterator may be mid-stream and must be reset, or the first
+    epoch silently trains truncated."""
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.datasets.iterators import next_processed
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+    def mk():
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(4).updater("sgd").learning_rate(0.1).list()
+                .layer(0, DenseLayer(n_out=8, activation="relu"))
+                .layer(1, OutputLayer(n_out=2, activation="softmax",
+                                      loss_function="mcxent"))
+                .set_input_type(InputType.feed_forward(3))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    net = mk()
+    rng = np.random.default_rng(0)
+    batches = [DataSet(rng.random((4, 3)).astype(np.float32),
+                       np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)])
+               for _ in range(4)]
+    ait = AsyncDataSetIterator(ListDataSetIterator(batches), queue_size=2,
+                               device_put=False)
+    next_processed(ait)          # caller consumed 2 of 4 batches...
+    next_processed(ait)
+    net.fit(ait, num_epochs=1)   # ...fit must still train the FULL epoch
+    assert net.conf.iteration_count == 4
+
+    # same for a caller-supplied PLAIN iterator mid-stream: fit() resets
+    # the underlying before wrapping it
+    net3 = mk()
+    plain = ListDataSetIterator(batches)
+    next_processed(plain)
+    next_processed(plain)
+    net3.fit(plain, num_epochs=1)
+    assert net3.conf.iteration_count == 4
+
+    # the wrapper fit() itself creates still avoids the double-drain:
+    # a plain iterator trains exactly one pass per epoch
+    net2 = mk()
+    net2.fit(ListDataSetIterator(batches), num_epochs=2)
+    assert net2.conf.iteration_count == 8
+
+
 def test_evaluation_2d_mask():
     ev = Evaluation()
     labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]

@@ -126,14 +126,16 @@ def test_leaf_serialization_bfloat16_round_trip():
 
 def test_client_errors_are_loud():
     """A dead server is a ConnectionError at connect; a half-open server
-    that closes mid-protocol raises instead of hanging or mis-parsing."""
+    that closes mid-protocol raises instead of hanging or mis-parsing.
+    Since the resilience round every connection opens with a HELLO
+    handshake, so the mid-protocol close surfaces at construction."""
     import socket
     import threading
     from deeplearning4j_tpu.parallel.ps_transport import PSClient
     with pytest.raises(OSError):
         PSClient("127.0.0.1", 1, connect_timeout=1)
-    # server that accepts then immediately closes: pull() must raise a
-    # ConnectionError (peer closed), not return garbage
+    # server that accepts then immediately closes: the HELLO handshake
+    # must raise a ConnectionError (peer closed), not return garbage
     srv = socket.socket()
     srv.bind(("127.0.0.1", 0))
     srv.listen(1)
@@ -145,9 +147,8 @@ def test_client_errors_are_loud():
 
     t = threading.Thread(target=accept_close, daemon=True)
     t.start()
-    c = PSClient("127.0.0.1", port, connect_timeout=5)
     with pytest.raises(ConnectionError):
-        c.pull()
+        PSClient("127.0.0.1", port, connect_timeout=5)
     t.join(timeout=5)
     srv.close()
 
