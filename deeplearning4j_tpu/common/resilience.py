@@ -16,10 +16,12 @@ semantics made explicit. This module is the one place they are defined:
     not, even though both subclass ConnectionError).
   * `FaultInjector` — deterministic, seeded fault schedules keyed by call
     site. Production code exposes named sites (`client.push.sent`,
-    `master.round`, ...) and the injector decides per call whether to
-    delay, sever a connection, and/or raise — so every failure mode the
-    retry/heartbeat/resume machinery handles has a repeatable test driving
-    it through the REAL code path, not a mock.
+    `master.round`, `data.batch`, ...) and the injector decides per call
+    whether to delay, sever a connection, corrupt a payload (NaN/Inf/
+    value-poison — the data-path fault the training-health watchdog
+    handles), and/or raise — so every failure mode the retry/heartbeat/
+    resume/watchdog machinery handles has a repeatable test driving it
+    through the REAL code path, not a mock.
 
 Everything here is stdlib-only (no jax/numpy): the PS worker side is
 numpy-only by design and must stay importable without jax.
@@ -51,9 +53,11 @@ class RetryPolicy:
     makes backoff sequences reproducible in tests while still decorrelating
     real workers (give each worker a different seed).
 
-    `deadline` bounds the TOTAL wall clock across all attempts: a retry
-    whose backoff sleep would overrun the deadline re-raises instead.
-    `sleep`/`clock` are injectable for tests (fake time).
+    `deadline` bounds the TOTAL wall clock across all attempts: the final
+    backoff sleep is CAPPED by the remaining deadline (never sleeping past
+    it), buying one last attempt at the deadline edge; once the deadline
+    is spent the last error re-raises. `sleep`/`clock` are injectable for
+    tests (fake time).
     """
 
     def __init__(self, max_retries=5, base_delay=0.05, max_delay=2.0,
@@ -103,9 +107,15 @@ class RetryPolicy:
                 if not self.is_retryable(e) or attempt >= self.max_retries:
                     raise
                 d = self.delay(attempt)
-                if self.deadline is not None and \
-                        self._clock() - start + d > self.deadline:
-                    raise
+                if self.deadline is not None:
+                    remaining = self.deadline - (self._clock() - start)
+                    if remaining <= 0:
+                        raise
+                    # cap the final backoff by the remaining wall clock:
+                    # never sleep past the deadline, but do spend the
+                    # remainder on one last attempt instead of forfeiting
+                    # it by raising early
+                    d = min(d, remaining)
                 if on_retry is not None:
                     on_retry(attempt, e, d)
                 self._sleep(d)
@@ -113,7 +123,28 @@ class RetryPolicy:
 
 
 class _Rule:
-    __slots__ = ("on_calls", "prob", "remaining", "exc", "delay", "sever")
+    __slots__ = ("on_calls", "prob", "remaining", "exc", "delay", "sever",
+                 "corrupt")
+
+
+def _poison(payload, value):
+    """Duck-typed payload corruption: fill a COPY of an array-like payload
+    with `value` (float('nan'), float('inf'), or any finite float —
+    finite poison models the loss-spike class, non-finite the NaN/Inf
+    class). The original is never mutated — sites rebind the returned
+    payload, matching the pipeline's rebind-only contract. Covers numpy
+    (.copy + in-place .fill), immutable array types like jax.Array
+    (arithmetic broadcast keeps shape/dtype), and bare scalars."""
+    copy = getattr(payload, "copy", None)
+    fill = getattr(payload, "fill", None)
+    if callable(copy) and callable(fill):
+        out = payload.copy()
+        out.fill(value)
+        return out
+    if hasattr(payload, "shape") and hasattr(payload, "__mul__"):
+        # immutable arrays (jax.Array): broadcast the poison, same shape
+        return payload * 0 + value
+    return value
 
 
 class FaultInjector:
@@ -126,8 +157,11 @@ class FaultInjector:
     reproducible) or by seeded probability (`prob`, reproducible for a
     given seed + call sequence). A firing rule can sleep (`delay`), invoke
     the site's sever callback (`sever=True` — e.g. the PS client closes its
-    socket, simulating a network cut), and raise (`exc`: class or
-    instance; None = fault without raising, for pure delay/sever).
+    socket, simulating a network cut), corrupt the payload the site passed
+    (`corrupt`: "nan" / "inf" / a float — `fire` returns a poisoned COPY
+    the site rebinds, the data-path analog of a network fault), and raise
+    (`exc`: class or instance; None = fault without raising, for pure
+    delay/sever/corrupt).
 
     `times` caps how often a rule fires (default: once per planned call
     index, or once for prob/always rules).
@@ -142,8 +176,14 @@ class FaultInjector:
         self._sleep = time.sleep
 
     def plan(self, site, on_call=None, on_calls=None, prob=None, times=None,
-             exc=FaultInjected, delay=0.0, sever=False):
-        """Schedule a fault at `site`; returns self for chaining."""
+             exc=FaultInjected, delay=0.0, sever=False, corrupt=None):
+        """Schedule a fault at `site`; returns self for chaining.
+
+        `corrupt`: poison the site's payload — "nan", "inf", or any float
+        fill value. A corrupt-only plan defaults `exc` to None (the
+        poisoned payload flowing onward IS the fault; raising as well
+        would mask the data path under test). Pass `exc` explicitly to
+        combine."""
         if on_call is not None and on_calls is not None:
             raise ValueError("pass on_call or on_calls, not both")
         if on_call is not None:
@@ -155,6 +195,14 @@ class FaultInjector:
         if times is None:
             times = len(rule.on_calls) if rule.on_calls is not None else 1
         rule.remaining = int(times)
+        if corrupt is None:
+            rule.corrupt = None
+        else:
+            named = {"nan": float("nan"), "inf": float("inf")}
+            rule.corrupt = (named[corrupt] if isinstance(corrupt, str)
+                            else float(corrupt))
+            if exc is FaultInjected:
+                exc = None
         rule.exc = exc
         rule.delay = float(delay)
         rule.sever = bool(sever)
@@ -162,9 +210,11 @@ class FaultInjector:
             self._rules.setdefault(site, []).append(rule)
         return self
 
-    def fire(self, site, on_sever=None):
+    def fire(self, site, on_sever=None, payload=None):
         """Instrumentation point: bump the site's call counter and apply
-        the first matching rule (delay -> sever -> raise)."""
+        the first matching rule (delay -> sever -> corrupt -> raise).
+        Returns `payload` — poisoned (a corrupted COPY; the site must
+        rebind it) when a corrupt rule fired, untouched otherwise."""
         with self._lock:
             n = self._calls.get(site, 0)
             self._calls[site] = n + 1
@@ -184,16 +234,19 @@ class FaultInjector:
                     self._fired.append((site, n))
                     break
         if hit is None:
-            return
-        log.warning("fault injected at %s (call #%d): delay=%.3fs sever=%s",
-                    site, n, hit.delay, hit.sever)
+            return payload
+        log.warning("fault injected at %s (call #%d): delay=%.3fs sever=%s"
+                    " corrupt=%s", site, n, hit.delay, hit.sever,
+                    hit.corrupt)
         if hit.delay:
             self._sleep(hit.delay)
         if hit.sever and on_sever is not None:
             on_sever()
+        if hit.corrupt is not None and payload is not None:
+            payload = _poison(payload, hit.corrupt)
         exc = hit.exc
         if exc is None:
-            return
+            return payload
         if isinstance(exc, BaseException):
             raise exc
         raise exc(f"injected fault at {site} (call #{n})")

@@ -56,6 +56,157 @@ def wrap_async_for_fit(it, compute_dtype):
                                 cast_labels=False)
 
 
+class BatchValidationError(ValueError):
+    """A batch failed DataSetValidator checks under the 'raise' policy."""
+
+
+def inject_features(injector, site, ds):
+    """The ONE payload-corruption seam shared by DataSetValidator and
+    ParallelWrapper: fire `site` with the batch's (first) feature array
+    as the payload; when a planned `corrupt` rule hands back a poisoned
+    COPY, rebind it onto a shallow copy of the DataSet (the cached
+    source batch is never mutated — the rebind-only contract)."""
+    if injector is None:
+        return ds
+    feats = ds.features
+    multi = isinstance(feats, (list, tuple))
+    arr = feats[0] if multi else feats
+    out = injector.fire(site, payload=arr)
+    if out is arr:
+        return ds
+    ds = ds.shallow_copy()
+    ds.features = [out] + list(feats[1:]) if multi else out
+    return ds
+
+
+class DataSetValidator:
+    """Batch validation at the iterator boundary: shape/dtype/finiteness
+    checks with a configurable corrupt-record policy.
+
+    policy: 'raise' (fail the run loudly — the default, matching the
+    fail-fast posture of the checkpoint loader), 'skip' (drop the bad
+    batch from the stream and count it), or 'count' (let it through but
+    count it — for runs that rely on the training-health watchdog's
+    on-device skip instead).
+
+    Checks (all optional except presence/alignment):
+      * features present, features/labels leading dims agree;
+      * `feature_shape` / `label_shape`: expected trailing (per-example)
+        dims;
+      * `dtypes`: allowed numpy dtype KINDS for features (e.g. "fiub");
+      * `check_finite`: every float array (features, labels, masks) is
+        NaN/Inf-free.
+
+    `fault_injector` exposes the named site "data.batch" on every batch's
+    features BEFORE validation — a planned `corrupt` rule NaN/Inf/value-
+    poisons a COPY (rebound on a shallow copy of the DataSet, never
+    mutating the cached source), making data faults injectable exactly
+    like network faults. `health_policy` (a
+    `common.health.TrainingHealthPolicy`) aggregates rejects into the
+    run-health counters the UI shows.
+
+    Works standalone (`validate`), wrapped (`ValidatingDataSetIterator`),
+    or through the async staging path (`AsyncDataSetIterator(...,
+    validator=...)` — validation runs on the prefetch thread, and a
+    'skip'-rejected batch never reaches the staging queue)."""
+
+    def __init__(self, policy="raise", check_finite=True,
+                 feature_shape=None, label_shape=None, dtypes=None,
+                 fault_injector=None, site="data.batch",
+                 health_policy=None):
+        if policy not in ("raise", "skip", "count"):
+            raise ValueError(f"policy must be raise/skip/count, "
+                             f"got {policy!r}")
+        self.policy = policy
+        self.check_finite = bool(check_finite)
+        self.feature_shape = (None if feature_shape is None
+                              else tuple(feature_shape))
+        self.label_shape = (None if label_shape is None
+                            else tuple(label_shape))
+        self.dtypes = dtypes            # allowed numpy dtype kinds, e.g. "f"
+        self.fault_injector = fault_injector
+        self.site = site
+        self.health_policy = health_policy
+        # counters are mutated from the async staging pool's threads
+        # (num_workers > 1 validates batches concurrently) — guarded so
+        # the run-health numbers the UI shows don't lose increments
+        self._lock = threading.Lock()
+        self.rejected = 0
+        self.passed = 0
+        self.last_error = None
+
+    # -- the checks -----------------------------------------------------
+    def _problem(self, ds):
+        feats, labs = ds.features, getattr(ds, "labels", None)
+        if feats is None:
+            return "batch has no features"
+        flist = list(feats) if isinstance(feats, (list, tuple)) else [feats]
+        llist = (list(labs) if isinstance(labs, (list, tuple))
+                 else ([labs] if labs is not None else []))
+        n = np.asarray(flist[0]).shape[0] if np.asarray(flist[0]).ndim else 0
+        for a in flist:
+            a = np.asarray(a)
+            if a.ndim == 0 or a.shape[0] != n:
+                return (f"feature batch dims disagree: {a.shape} vs "
+                        f"leading {n}")
+            if self.dtypes is not None and a.dtype.kind not in self.dtypes:
+                return (f"feature dtype {a.dtype} not in allowed kinds "
+                        f"{self.dtypes!r}")
+            if (self.feature_shape is not None
+                    and tuple(a.shape[1:]) != self.feature_shape):
+                return (f"feature shape {tuple(a.shape[1:])} != expected "
+                        f"{self.feature_shape}")
+        for a in llist:
+            if a is None:
+                continue
+            a = np.asarray(a)
+            if a.ndim == 0 or a.shape[0] != n:
+                return (f"label batch size {a.shape} disagrees with "
+                        f"features ({n})")
+            if (self.label_shape is not None
+                    and tuple(a.shape[1:]) != self.label_shape):
+                return (f"label shape {tuple(a.shape[1:])} != expected "
+                        f"{self.label_shape}")
+        if self.check_finite:
+            masks = [getattr(ds, k, None) for k in
+                     ("features_mask", "labels_mask")]
+            for mk in ("features_masks", "labels_masks"):
+                ms = getattr(ds, mk, None)
+                if ms:
+                    masks.extend(ms)
+            for a in flist + llist + masks:
+                if a is None:
+                    continue
+                a = np.asarray(a)
+                if a.dtype.kind == "f" and not np.isfinite(a).all():
+                    bad = int(a.size - np.isfinite(a).sum())
+                    return f"non-finite values in batch ({bad} elements)"
+        return None
+
+    def validate(self, ds, batch_index=None):
+        """Returns the (possibly injector-poisoned) batch, or None when
+        the batch was rejected under the 'skip' policy. Raises
+        BatchValidationError under 'raise'."""
+        ds = inject_features(self.fault_injector, self.site, ds)
+        problem = self._problem(ds)
+        if problem is None:
+            with self._lock:
+                self.passed += 1
+            return ds
+        with self._lock:
+            self.rejected += 1
+            self.last_error = problem
+        if self.health_policy is not None:
+            self.health_policy.record_validation_reject(
+                problem, batch_index=batch_index)
+        if self.policy == "raise":
+            raise BatchValidationError(
+                f"corrupt batch rejected: {problem}")
+        if self.policy == "skip":
+            return None
+        return ds                       # 'count': pass through, counted
+
+
 def _carry_metas(src, dst):
     """Per-example metadata (DataSet.example_metas — the Prediction
     error-analysis channel) must survive every batch rebuild in the
@@ -137,6 +288,50 @@ class DataSetIterator:
         self.reset()
         while self.has_next():
             yield self.next()
+
+
+class ValidatingDataSetIterator(DataSetIterator):
+    """Wrap any DataSetIterator with a DataSetValidator. Under the 'skip'
+    policy rejected batches silently vanish from the stream (has_next
+    looks ahead past them); 'raise' surfaces on next()/has_next; 'count'
+    passes everything through. The underlying iterator's pre-processor
+    runs FIRST (validation sees what training would see)."""
+
+    def __init__(self, underlying, validator):
+        self.underlying = underlying
+        self.validator = validator
+        self._pending = None
+        self._index = 0
+
+    def _advance(self):
+        while self._pending is None and self.underlying.has_next():
+            ds = self.validator.validate(next_processed(self.underlying),
+                                         batch_index=self._index)
+            self._index += 1
+            if ds is not None:
+                self._pending = ds
+
+    def has_next(self):
+        self._advance()
+        return self._pending is not None
+
+    def next_batch(self):
+        self._advance()
+        if self._pending is None:
+            raise StopIteration("iterator exhausted")
+        b, self._pending = self._pending, None
+        return b
+
+    def reset(self):
+        self.underlying.reset()
+        self._pending = None
+        self._index = 0
+
+    def batch(self):
+        return self.underlying.batch()
+
+    def total_outcomes(self):
+        return self.underlying.total_outcomes()
 
 
 class FileDataSetIterator(DataSetIterator):
@@ -313,11 +508,16 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def __init__(self, underlying, queue_size=2, device_put=True,
                  transfer_dtype=None, device_transform=None, num_workers=1,
-                 cast_labels=True):
+                 cast_labels=True, validator=None):
         self.underlying = underlying
         self.queue_size = max(1, int(queue_size))
         self._device_put = device_put
         self._transfer_dtype = transfer_dtype
+        # optional DataSetValidator: runs on the prefetch thread, AFTER
+        # pre-processors and BEFORE the wire cast/staging — a 'skip'-
+        # rejected batch never reaches the staging queue, a 'raise'
+        # surfaces through the producer-error path (not a hang)
+        self._validator = validator
         # cast_labels=False: shrink FEATURES only — for a bf16 model the
         # step casts features to bf16 anyway, so a bf16 feature wire is
         # BIT-IDENTICAL training; labels can matter at full precision
@@ -409,6 +609,10 @@ class AsyncDataSetIterator(DataSetIterator):
         reference AsyncDataSetIterator), wire-cast, stage."""
         ds = _apply_pre(getattr(self.underlying, "pre_processor", None), ds)
         ds = _apply_pre(self.pre_processor, ds)
+        if self._validator is not None:
+            ds = self._validator.validate(ds)
+            if ds is None:          # rejected under the 'skip' policy
+                return None
         if self._transfer_dtype is not None:
             ds = self._cast_for_wire(ds)
         if self._device_put:
@@ -420,6 +624,8 @@ class AsyncDataSetIterator(DataSetIterator):
         try:
             while not stop.is_set() and self.underlying.has_next():
                 item = self._prepare(self.underlying.next_batch())
+                if item is None:
+                    continue           # validator-skipped batch
                 # stop-aware put: reset() signals stop FIRST, so a
                 # mid-stream reset stops staging within one batch
                 # instead of preparing the whole remaining pass just to
@@ -486,7 +692,10 @@ class AsyncDataSetIterator(DataSetIterator):
                     break
                 if isinstance(fut, BaseException):
                     raise fut
-                self._q.put(fut.result())
+                res = fut.result()
+                if res is None:
+                    continue           # validator-skipped batch
+                self._q.put(res)
         except BaseException as e:
             self._error = e
             stop.set()            # unblock the producer's bounded put

@@ -390,7 +390,12 @@ class ComputationGraph:
 
         return apply_updates
 
-    def make_raw_step(self):
+    def make_raw_step(self, emit_health=False):
+        """Same contract as MultiLayerNetwork.make_raw_step:
+        emit_health=True appends the scalar health pytree to the return
+        tuple and gates the whole update on the all-finite predicate
+        (`jnp.where` — a poisoned batch is skipped on device); False
+        compiles the identical program as before."""
         grad_fn = self.make_grad_fn()
         apply_updates = self.make_apply_fn()
 
@@ -399,12 +404,26 @@ class ComputationGraph:
                                                            batch)
             new_params, new_ustate = apply_updates(params, ustate, grads,
                                                    batch["iteration"])
+            if emit_health:
+                from ...common import health as H
+                health = H.grad_health(grads, score)
+                ok = health["all_finite"]
+                new_params = H.gate_update(ok, new_params, params)
+                new_ustate = H.gate_update(ok, new_ustate, ustate)
+                new_state = H.gate_update(ok, new_state, state)
+                if batch.get("carries") is not None:
+                    new_carries = H.gate_update(ok, new_carries,
+                                                batch["carries"])
+                return (new_params, new_ustate, new_state, score,
+                        new_carries, health)
             return new_params, new_ustate, new_state, score, new_carries
 
         return step
 
     def _make_step(self):
-        raw = self.make_raw_step()
+        emit_health = getattr(self, "_health_policy", None) is not None
+        self._step_emits_health = emit_health
+        raw = self.make_raw_step(emit_health)
 
         def step(params, ustate, state, loop, features, labels, fmask, lmask,
                  carries=None):
@@ -415,11 +434,21 @@ class ComputationGraph:
             batch = {"features": features, "labels": labels, "fmask": fmask,
                      "lmask": lmask, "iteration": loop["iteration"],
                      "rng": rng, "carries": carries}
-            p, u, s, score, car = raw(params, ustate, state, batch)
+            p, u, s, score, car, *extras = raw(params, ustate, state, batch)
+            # loop state advances on skipped steps too (see multilayer.py)
             new_loop = {"iteration": loop["iteration"] + 1.0, "rng": next_rng}
-            return p, u, s, score, car, new_loop
+            return (p, u, s, score, car, new_loop) + tuple(extras)
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def training_health(self, policy=True, checkpoint_dir=None,
+                        checkpoint_every=10, keep_checkpoints=3):
+        """Arm the training-health watchdog (see
+        MultiLayerNetwork.training_health — identical contract)."""
+        from ...common import health as H
+        H.install(self, policy, checkpoint_dir, checkpoint_every,
+                  keep_checkpoints)
+        return self
 
     def _loop_state(self):
         if self._loop is None:
@@ -503,13 +532,23 @@ class ComputationGraph:
         num_iterations = int(self.conf.global_conf.get("num_iterations", 1))
         for _ in range(num_iterations):
             (self._params, self._updater_state, self._model_state,
-             score, _, self._loop) = self._jit_step(
+             score, _, self._loop, *extras) = self._jit_step(
                  self._params, self._updater_state, self._model_state,
                  self._loop_state(), features, labels, fmasks, lmasks)
-            self._score = score
+            action = "ok"
+            if not getattr(self, "_step_emits_health", False):
+                self._score = score
+            else:
+                from ...common import health as H
+                action = H.finish_step(self, extras[-1], score)
+                if action == "rollback":
+                    break           # counters/rng restored; next batch
             self.conf.iteration_count += 1
             for l in self.listeners:
                 l.iteration_done(self, self.conf.iteration_count - 1)
+            if action == "ok" and getattr(self, "_step_emits_health", False):
+                from ...common.health import fit_loop_checkpoint
+                fit_loop_checkpoint(self)
         return self
 
     # ------------------------------------------------------------------
@@ -549,13 +588,23 @@ class ComputationGraph:
                       if fmasks else None)
             lm_seg = ([_seg(m) for m in lmasks] if lmasks else None)
             (self._params, self._updater_state, self._model_state, score,
-             carries, self._loop) = self._jit_step(
+             carries, self._loop, *extras) = self._jit_step(
                  self._params, self._updater_state, self._model_state,
                  self._loop_state(), f_seg, l_seg, fm_seg, lm_seg, carries)
-            self._score = score
+            action = "ok"
+            if not getattr(self, "_step_emits_health", False):
+                self._score = score
+            else:
+                from ...common import health as H
+                action = H.finish_step(self, extras[-1], score)
+                if action == "rollback":
+                    break       # abandon the rest of this sequence
             self.conf.iteration_count += 1
             for l in self.listeners:
                 l.iteration_done(self, self.conf.iteration_count - 1)
+            if action == "ok" and getattr(self, "_step_emits_health", False):
+                from ...common.health import fit_loop_checkpoint
+                fit_loop_checkpoint(self)
         return self
 
     def rnn_time_step(self, *features):

@@ -253,13 +253,19 @@ class MultiLayerNetwork:
 
         return apply_updates
 
-    def make_raw_step(self, collect_acts=False):
+    def make_raw_step(self, collect_acts=False, emit_health=False):
         """The un-jitted training step over a batch dict — the compilation
         unit shared by the single-chip path, ParallelWrapper's sharded paths,
         and TrainingMaster. batch keys: features, labels, fmask, lmask,
         iteration, rng, carries (optional). collect_acts=True appends the
-        on-device activation summaries to the return tuple (the fast path's
-        tuple shape — and compiled program — is untouched when False)."""
+        on-device activation summaries to the return tuple; emit_health=True
+        appends (LAST) the scalar health pytree (grad norms, score, finite
+        flag) and applies the update CONDITIONALLY — `jnp.where` on the
+        all-finite predicate, so a NaN/Inf batch leaves params, updater
+        state, model state and carries bit-identical without a host
+        round-trip (the training-health watchdog's on-device sentinel).
+        With both flags False the tuple shape — and compiled program — is
+        untouched."""
         grad_fn = self.make_grad_fn(collect_acts)
         apply_updates = self.make_apply_fn()
 
@@ -268,6 +274,18 @@ class MultiLayerNetwork:
                 params, state, batch)
             new_params, new_ustate = apply_updates(params, ustate, grads,
                                                    batch["iteration"])
+            if emit_health:
+                from ..common import health as H
+                health = H.grad_health(grads, score)
+                ok = health["all_finite"]
+                new_params = H.gate_update(ok, new_params, params)
+                new_ustate = H.gate_update(ok, new_ustate, ustate)
+                new_state = H.gate_update(ok, new_state, state)
+                if batch.get("carries") is not None:
+                    new_carries = H.gate_update(ok, new_carries,
+                                                batch["carries"])
+                return ((new_params, new_ustate, new_state, score,
+                         new_carries) + tuple(acts) + (health,))
             return ((new_params, new_ustate, new_state, score, new_carries)
                     + tuple(acts))
 
@@ -275,7 +293,10 @@ class MultiLayerNetwork:
 
     def _make_step(self):
         collect_acts = self._act_stats_cfg is not None
-        raw = self.make_raw_step(collect_acts)
+        emit_health = getattr(self, "_health_policy", None) is not None
+        self._step_emits_acts = collect_acts
+        self._step_emits_health = emit_health
+        raw = self.make_raw_step(collect_acts, emit_health)
 
         def step(params, ustate, state, loop, features, labels, fmask,
                  lmask, carries=None):
@@ -288,9 +309,12 @@ class MultiLayerNetwork:
             batch = {"features": features, "labels": labels, "fmask": fmask,
                      "lmask": lmask, "iteration": loop["iteration"],
                      "rng": rng, "carries": carries}
-            p, u, s, score, car, *acts = raw(params, ustate, state, batch)
+            p, u, s, score, car, *extras = raw(params, ustate, state, batch)
+            # the loop counter/rng advance on a SKIPPED step too: skips
+            # consume an iteration (PaLM-style skip-and-continue), keeping
+            # the device counter and the host's iteration_count in lockstep
             new_loop = {"iteration": loop["iteration"] + 1.0, "rng": next_rng}
-            return (p, u, s, score, car, new_loop) + tuple(acts)
+            return (p, u, s, score, car, new_loop) + tuple(extras)
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
@@ -312,6 +336,24 @@ class MultiLayerNetwork:
             self._act_stats_gen = getattr(self, "_act_stats_gen", 0) + 1
             if not enabled:
                 self._last_activation_stats = None
+        return self
+
+    def training_health(self, policy=True, checkpoint_dir=None,
+                        checkpoint_every=10, keep_checkpoints=3):
+        """Arm the training-health watchdog: the fused step emits grad
+        norms + finite flags and SKIPS non-finite updates on device
+        (`jnp.where`, no host round-trip); the fit loop classifies each
+        step through the policy (NaN/Inf skip, EMA-z-score loss spike,
+        grad-norm explosion) and responds — count-and-skip, rollback to
+        the last good round (when `checkpoint_dir` gives the fit loop a
+        ShardedCheckpointManager seam), abort after N consecutive bad
+        steps with a diagnostic naming the offending rounds. policy=True
+        uses TrainingHealthPolicy defaults; None/False disarms. One
+        recompile per toggle; disarmed compiles the identical HLO as
+        never-armed."""
+        from ..common import health as H
+        H.install(self, policy, checkpoint_dir, checkpoint_every,
+                  keep_checkpoints)
         return self
 
     def _loop_state(self):
@@ -389,16 +431,28 @@ class MultiLayerNetwork:
                 # rather than crash on the next iteration
                 self._jit_step = self._make_step()
             (self._params, self._updater_state, self._model_state,
-             score, _, self._loop, *acts) = self._jit_step(
+             score, _, self._loop, *extras) = self._jit_step(
                  self._params, self._updater_state, self._model_state,
                  self._loop_state(), features, labels, fmask, lmask)
-            if acts:
-                self._last_activation_stats = acts[0]
+            health = (extras.pop() if getattr(self, "_step_emits_health",
+                                              False) else None)
+            if extras:
+                self._last_activation_stats = extras[0]
                 self._last_activation_stats_iter = self.conf.iteration_count
-            self._score = score
+            action = "ok"
+            if health is None:
+                self._score = score
+            else:
+                from ..common import health as H
+                action = H.finish_step(self, health, score)
+                if action == "rollback":
+                    break           # counters/rng restored; next batch
             self.conf.iteration_count += 1
             for l in self.listeners:
                 l.iteration_done(self, self.conf.iteration_count - 1)
+            if health is not None and action == "ok":
+                from ..common.health import fit_loop_checkpoint
+                fit_loop_checkpoint(self)
         return self
 
     def _init_carries(self, batch_size):
@@ -432,18 +486,30 @@ class MultiLayerNetwork:
             fm_seg = fmask[:, t0:t0 + L] if fmask is not None else None
             lm_seg = lmask[:, t0:t0 + L] if lmask is not None else None
             (self._params, self._updater_state, self._model_state, score,
-             carries, self._loop, *acts) = self._jit_step(
+             carries, self._loop, *extras) = self._jit_step(
                  self._params, self._updater_state, self._model_state,
                  self._loop_state(), f_seg, l_seg, fm_seg, lm_seg, carries)
-            if acts:
-                self._last_activation_stats = acts[0]
+            health = (extras.pop() if getattr(self, "_step_emits_health",
+                                              False) else None)
+            if extras:
+                self._last_activation_stats = extras[0]
                 self._last_activation_stats_iter = self.conf.iteration_count
             # stop gradient flow across segments (truncation) — carries are
             # fresh inputs to the next jitted call, so this is automatic.
-            self._score = score
+            action = "ok"
+            if health is None:
+                self._score = score
+            else:
+                from ..common import health as H
+                action = H.finish_step(self, health, score)
+                if action == "rollback":
+                    break       # abandon the rest of this sequence
             self.conf.iteration_count += 1
             for l in self.listeners:
                 l.iteration_done(self, self.conf.iteration_count - 1)
+            if health is not None and action == "ok":
+                from ..common.health import fit_loop_checkpoint
+                fit_loop_checkpoint(self)
         return self
 
     # ------------------------------------------------------------------

@@ -55,6 +55,7 @@ class ParallelWrapper:
             self._mesh = None
             self._checkpoint = None
             self._fault_injector = None
+            self._health_policy = None
 
         def checkpointing(self, directory, every_n_rounds=1, keep_last=3,
                           resume=True):
@@ -76,8 +77,23 @@ class ParallelWrapper:
 
         def fault_injector(self, inj):
             """Install a `common.resilience.FaultInjector`; the wrapper
-            fires site "wrapper.round" before each averaging round."""
+            fires site "wrapper.round" before each averaging round (the
+            crash seam) and site "wrapper.batch" on every batch's
+            features BEFORE staging (payload-corruption seam: a planned
+            `corrupt` rule NaN/Inf/value-poisons the batch through the
+            real step, exercising the training-health watchdog)."""
             self._fault_injector = inj; return self
+
+        def health_policy(self, policy):
+            """Arm the training-health watchdog
+            (`common.health.TrainingHealthPolicy`, or True for defaults):
+            the sharded step emits grad norms + finite flags and skips
+            non-finite updates on device; the wrapper classifies each
+            round and responds — count-and-skip, rollback to the last
+            checkpointed round (requires `.checkpointing(...)`; a master
+            may install its own seam via `_ext_rollback`), abort after N
+            consecutive bad rounds."""
+            self._health_policy = policy; return self
 
         def workers(self, n):
             self._workers = int(n); return self
@@ -119,12 +135,13 @@ class ParallelWrapper:
             return ParallelWrapper(self.model, self._workers, self._avg_freq,
                                    self._avg_updaters, self._tensor_parallel,
                                    self._mesh, self._sharded_updater_state,
-                                   self._checkpoint, self._fault_injector)
+                                   self._checkpoint, self._fault_injector,
+                                   self._health_policy)
 
     def __init__(self, model, workers=None, averaging_frequency=1,
                  average_updaters=True, tensor_parallel=False, mesh=None,
                  sharded_updater_state=False, checkpoint=None,
-                 fault_injector=None):
+                 fault_injector=None, health_policy=None):
         self.model = model
         model._ensure_init()
         if mesh is None:
@@ -154,6 +171,14 @@ class ParallelWrapper:
                                        keep_last=cp.get("keep_last", 3),
                                        resume=cp.get("resume", True),
                                        owner="parallel wrapper")
+        # training-health watchdog: arm the NET (the step emits health, the
+        # policy lives on the model so StatsListener finds it); the wrapper
+        # supplies the rollback seam — its own round checkpoints, or an
+        # externally installed (manager, on_restored) pair (TrainingMaster)
+        if health_policy is not None:
+            from ..common import health as H
+            H.install(model, health_policy)
+        self._ext_rollback = None
         self._sharded = False
         self._jit_step = None
         self._jit_kstep = None
@@ -210,6 +235,51 @@ class ParallelWrapper:
     def _round_done(self):
         self._gate.round_done(self.model)
 
+    def _inject_batch(self, ds):
+        """Payload-corruption seam: site "wrapper.batch" over the shared
+        poison-copy/rebind helper (see iterators.inject_features)."""
+        from ..datasets.iterators import inject_features
+        return inject_features(self.fault_injector, "wrapper.batch", ds)
+
+    def _handle_health(self, health, round_index):
+        """Classify one round's health and act. Rollback goes through the
+        round-checkpoint seam; returns the action taken (abort raises)."""
+        from ..common import health as H
+        return H.apply_policy(self.model._health_policy, health,
+                              round_index=round_index,
+                              rollback=self._health_rollback)
+
+    def _health_rollback(self):
+        """Restore the last checkpointed round — the wrapper's own
+        `.checkpointing(...)` manager, or an externally installed seam
+        (`self._ext_rollback = (manager, on_restored)`, the
+        TrainingMaster hookup). The restore rewinds params, updater/model
+        state, rng AND counters, then the normal sharding pass
+        redistributes (restoring straight into mesh-sharded donated
+        buffers is not supported — same constraint as crash-resume).
+        Returns the restored round, or False when no checkpoint exists."""
+        net = self.model
+        if self._ext_rollback is not None:
+            mgr, on_restored = self._ext_rollback
+        else:
+            mgr = self._gate.manager()
+            on_restored = lambda s: setattr(self._gate, "round", int(s))  # noqa: E731
+        if mgr is None or mgr.latest_step() is None:
+            return False
+        last = mgr.latest_step()
+        # materialize to host so the restore template is unsharded, then
+        # re-run the sharding pass (single-process meshes; a multi-host
+        # rollback would restore sharded directly like crash-resume)
+        for attr in ("_params", "_updater_state", "_model_state"):
+            setattr(net, attr,
+                    jax.tree.map(lambda a: np.asarray(a),
+                                 getattr(net, attr)))
+        mgr.restore(net, last)
+        self._sharded = False
+        self._ensure_sharded()
+        on_restored(last)
+        return last
+
     # ------------------------------------------------------------------
     def fit(self, data, num_epochs=1):
         net = self.model
@@ -262,32 +332,44 @@ class ParallelWrapper:
     def _ensure_allreduce_step(self):
         net = self.model
         act_gen = getattr(net, "_act_stats_gen", 0)
+        health_gen = getattr(net, "_health_gen", 0)
         if self._jit_step is not None and \
-                getattr(self, "_act_gen", 0) != act_gen:
-            self._jit_step = None          # activation-stats toggle
+                (getattr(self, "_act_gen", 0) != act_gen
+                 or getattr(self, "_health_gen", 0) != health_gen):
+            self._jit_step = None     # activation-stats / watchdog toggle
         if self._jit_step is None:
             self._act_gen = act_gen
+            self._health_gen = health_gen
             # honor the net's activation-stats mode (StatsListener arming
             # works identically under the sharded path); the k-local-steps
             # mode does NOT collect (k batches per program — see
             # collect_activation_stats docstring)
             collect = getattr(net, "_act_stats_cfg", None) is not None
+            emit_h = getattr(net, "_health_policy", None) is not None
             self._collects_acts = collect
+            self._emits_health = emit_h
             # positional only when armed: ComputationGraph's make_raw_step
-            # has no collect_acts parameter (and can never be armed)
-            raw = net.make_raw_step(True) if collect else net.make_raw_step()
+            # has no collect_acts parameter (and can never be armed). The
+            # psum'd gradients are replicated, so the health predicate —
+            # and the on-device skip — is identical on every device.
+            if collect:
+                raw = net.make_raw_step(True, emit_health=emit_h)
+            elif emit_h:
+                raw = net.make_raw_step(emit_health=True)
+            else:
+                raw = net.make_raw_step()
             if self._ustate_shardings is not None:
                 inner, shardings = raw, self._ustate_shardings
 
                 def raw(params, ustate, state, batch):
-                    p, u, s, score, car, *acts = inner(params, ustate,
-                                                       state, batch)
+                    p, u, s, score, car, *extras = inner(params, ustate,
+                                                         state, batch)
                     # pin the ZeRO layout on the state OUTPUT so GSPMD keeps
                     # the optimizer update partitioned (and the donated input
                     # buffer is reusable) instead of re-replicating it
                     u = jax.tree.map(jax.lax.with_sharding_constraint, u,
                                      shardings)
-                    return (p, u, s, score, car) + tuple(acts)
+                    return (p, u, s, score, car) + tuple(extras)
             self._jit_step = jax.jit(raw, donate_argnums=(0, 1, 2))
         return self._jit_step
 
@@ -335,39 +417,51 @@ class ParallelWrapper:
             ds = next_processed(it)
             if not self._round_starts():
                 continue      # round covered by the restored checkpoint
+            ds = self._inject_batch(ds)
             net._rng, step_rng = jax.random.split(net._rng)
             batch, feats = self._sharded_batch(ds, step_rng)
             (net._params, net._updater_state, net._model_state, score,
-             _, *acts) = step(net._params, net._updater_state,
-                              net._model_state, batch)
-            if acts:
-                net._last_activation_stats = acts[0]
+             _, *extras) = step(net._params, net._updater_state,
+                                net._model_state, batch)
+            health = (extras.pop() if getattr(self, "_emits_health", False)
+                      else None)
+            if extras:
+                net._last_activation_stats = extras[0]
                 net._last_activation_stats_iter = net.conf.iteration_count
-            net._score = score
+            action = "ok"
+            if health is not None:
+                action = self._handle_health(health, self._gate.round)
+                if action == "rollback":
+                    continue    # counters/rng rewound; next batch retrains
+            if action != "skip":
+                net._score = score
             net._last_batch_size = int(
                 jax.tree.leaves(feats)[0].shape[0])
             net.conf.iteration_count += 1
             for l in net.listeners:
                 l.iteration_done(net, net.conf.iteration_count - 1)
-            self._round_done()
+            if action == "ok" or health is None:
+                # a skipped/diverged round is never checkpointed — the
+                # last-good-round invariant the rollback seam relies on
+                self._round_done()
 
     # -- mode 2: k local steps then parameter averaging ----------------
     def _fit_local_steps(self, it):
         k = self.averaging_frequency
         pending = []
         while it.has_next():
-            pending.append(next_processed(it))
+            pending.append(self._inject_batch(next_processed(it)))
             if len(pending) == k:
                 if self._round_starts():
-                    self._run_kstep(pending)
-                    self._round_done()
+                    if self._run_kstep(pending) == "ok":
+                        self._round_done()
                 pending = []
         if pending:
             # ragged tail: run the true remaining batches (the jitted k-step
             # retraces for the smaller leading axis) — no duplicated steps.
             if self._round_starts():
-                self._run_kstep(pending)
-                self._round_done()
+                if self._run_kstep(pending) == "ok":
+                    self._round_done()
 
     @staticmethod
     def _pad_to(arr, b):
@@ -382,23 +476,57 @@ class ParallelWrapper:
         net = self.model
         mesh = self.mesh
         avg_upd = self.average_updaters
-        raw = net.make_raw_step()
+        emit_h = getattr(net, "_health_policy", None) is not None
+        self._kstep_emits_health = emit_h
+        raw = (net.make_raw_step(emit_health=True) if emit_h
+               else net.make_raw_step())
         from ..common.jax_compat import shard_map
 
         def local_steps(params, ustate, state, batches):
             def body(carry, batch_t):
                 p, u, s = carry
-                p, u, s, score, _ = raw(p, u, s, batch_t)
-                return (p, u, s), score
-            (p, u, s), scores = jax.lax.scan(body, (params, ustate, state),
-                                             batches)
+                p, u, s, score, _, *h = raw(p, u, s, batch_t)
+                return (p, u, s), ((score, h[0]) if emit_h else score)
+            (p, u, s), ys = jax.lax.scan(body, (params, ustate, state),
+                                         batches)
+            scores = ys[0] if emit_h else ys
             # the TPU-native averageAndPropagate: pmean over ICI
             p = jax.lax.pmean(p, "data")
             if avg_upd:
                 u = jax.lax.pmean(u, "data")
             s = jax.lax.pmean(s, "data")
-            score = jax.lax.pmean(jnp.mean(scores), "data")
-            return p, u, s, score
+            if not emit_h:
+                score = jax.lax.pmean(jnp.mean(scores), "data")
+                return p, u, s, score
+            # each device skipped ITS bad local steps independently (its
+            # shard, its predicate); the pmean then averages the healthy
+            # survivors. The round score averages the FINITE step scores
+            # only — a skipped step's NaN must not poison the score of a
+            # round whose averaged params are healthy. The emitted health
+            # is the round's WORST case across the k steps and the data
+            # axis plus a skipped-step count, so the host policy can tell
+            # a partial round (some steps skipped, progress made) from a
+            # fully-poisoned one.
+            hs = ys[1]
+            fin = hs["all_finite"]                       # [k] per device
+            n_ok = jax.lax.psum(jnp.sum(fin.astype(jnp.float32)), "data")
+            s_sum = jax.lax.psum(jnp.sum(jnp.where(fin, scores, 0.0)),
+                                 "data")
+            score = jnp.where(n_ok > 0, s_sum / jnp.maximum(n_ok, 1.0),
+                              jnp.float32(jnp.nan))
+            health = {
+                "score": score,
+                "grad_norm": jax.lax.pmax(jnp.max(hs["grad_norm"]), "data"),
+                "layer_grad_norms": jax.tree.map(
+                    lambda a: jax.lax.pmax(jnp.max(a), "data"),
+                    hs["layer_grad_norms"]),
+                "bad_steps": jax.lax.psum(
+                    jnp.sum(1 - fin.astype(jnp.int32)), "data"),
+                "steps": fin.shape[0] * jax.lax.psum(1, "data"),
+                "all_finite": jax.lax.pmin(
+                    jnp.all(fin).astype(jnp.int32), "data"),
+            }
+            return p, u, s, score, health
 
         repl = P()
         _SHARDED_KEYS = ("features", "labels", "fmask", "lmask")
@@ -409,9 +537,12 @@ class ParallelWrapper:
             sspec = jax.tree.map(lambda _: repl, net._model_state)
             bspec = {k: (P(None, "data") if k in _SHARDED_KEYS else P())
                      for k, v in batches_tree.items() if v is not None}
+            out_specs = (pspec, uspec, sspec, repl)
+            if emit_h:
+                out_specs = out_specs + (repl,)   # prefix for the health dict
             fn = shard_map(local_steps, mesh=mesh,
                            in_specs=(pspec, uspec, sspec, bspec),
-                           out_specs=(pspec, uspec, sspec, repl))
+                           out_specs=out_specs)
             return jax.jit(fn, donate_argnums=(0, 1, 2))
         return build
 
@@ -455,13 +586,25 @@ class ParallelWrapper:
                 batches_tree[key] = jax.tree.map(
                     lambda a: put_sharded(a, NamedSharding(self.mesh, sp)),
                     batches_tree[key])
+        h_gen = getattr(net, "_health_gen", 0)
+        if self._jit_kstep is not None and \
+                getattr(self, "_kstep_health_gen", 0) != h_gen:
+            self._jit_kstep = None         # watchdog toggled mid-life
+        self._kstep_health_gen = h_gen
         if self._jit_kstep is None:
             self._jit_kstep = self._build_kstep()(batches_tree)
         (net._params, net._updater_state, net._model_state,
-         score) = self._jit_kstep(net._params, net._updater_state,
-                                  net._model_state, batches_tree)
-        net._score = score
+         score, *extra) = self._jit_kstep(net._params, net._updater_state,
+                                          net._model_state, batches_tree)
+        action = "ok"
+        if getattr(self, "_kstep_emits_health", False):
+            action = self._handle_health(extra[0], self._gate.round)
+            if action == "rollback":
+                return action   # counters/rng rewound by the restore
+        if action != "skip":
+            net._score = score
         net._last_batch_size = B
         net.conf.iteration_count += k
         for l in net.listeners:
             l.iteration_done(net, net.conf.iteration_count - 1)
+        return action

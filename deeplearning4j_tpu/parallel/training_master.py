@@ -78,6 +78,7 @@ class ParameterAveragingTrainingMaster:
             self._checkpoint_freq = 1
             self._keep_checkpoints = 3
             self._fault_injector = None
+            self._health_policy = None
 
         def rdd_training_approach(self, v):
             """'export' (reference default: batch to disk, stream per split —
@@ -155,8 +156,19 @@ class ParameterAveragingTrainingMaster:
         def fault_injector(self, inj):
             """Install a `common.resilience.FaultInjector`; the master
             fires site "master.round" before each averaging round
-            trains (crash-injection point for resume tests)."""
+            trains (crash-injection point for resume tests). The
+            injector is also handed to the inner ParallelWrapper, whose
+            "wrapper.batch" site is the data-corruption seam."""
             self._fault_injector = inj; return self
+
+        def health_policy(self, policy):
+            """Arm the training-health watchdog
+            (`common.health.TrainingHealthPolicy`, or True for defaults)
+            on the trained network: NaN/Inf batches are skipped inside
+            the compiled step, divergence rolls back to the master's
+            last round checkpoint (requires `.checkpoint_directory`),
+            and N consecutive bad rounds abort with a diagnostic."""
+            self._health_policy = policy; return self
 
         def build(self):
             return ParameterAveragingTrainingMaster(
@@ -164,14 +176,15 @@ class ParameterAveragingTrainingMaster:
                 self._avg_updaters, self._collect_stats, self._mesh,
                 self._approach, self._export_dir, self._training_hook,
                 self._checkpoint_dir, self._checkpoint_freq,
-                self._keep_checkpoints, self._fault_injector)
+                self._keep_checkpoints, self._fault_injector,
+                self._health_policy)
 
     def __init__(self, batch_size_per_worker=16, workers=None,
                  averaging_frequency=5, average_updaters=True,
                  collect_stats=False, mesh=None, approach="export",
                  export_dir=None, training_hook=None, checkpoint_dir=None,
                  checkpoint_frequency=1, keep_checkpoints=3,
-                 fault_injector=None):
+                 fault_injector=None, health_policy=None):
         import jax
         self.batch_size = int(batch_size_per_worker)
         self.num_workers = int(workers or len(jax.devices()))
@@ -187,6 +200,7 @@ class ParameterAveragingTrainingMaster:
         self.checkpoint_frequency = max(1, int(checkpoint_frequency))
         self.keep_checkpoints = max(1, int(keep_checkpoints))
         self.fault_injector = fault_injector
+        self.health_policy = health_policy
         # round counter + checkpoint/resume gate (one shared protocol —
         # see util.sharded_checkpoint.RoundCheckpointer); rounds are
         # monotonic across execute_training calls (the facade calls once
@@ -241,11 +255,22 @@ class ParameterAveragingTrainingMaster:
             mesh = self.mesh or make_mesh(
                 n_data=self.num_workers, n_model=1,
                 devices=jax.devices()[:self.num_workers])
-            self._pw = (ParallelWrapper.Builder(net)
-                        .mesh(mesh)
-                        .averaging_frequency(self.averaging_frequency)
-                        .average_updaters(self.average_updaters)
-                        .build())
+            b = (ParallelWrapper.Builder(net)
+                 .mesh(mesh)
+                 .averaging_frequency(self.averaging_frequency)
+                 .average_updaters(self.average_updaters))
+            if self.health_policy is not None:
+                b = b.health_policy(self.health_policy)
+            if self.fault_injector is not None:
+                b = b.fault_injector(self.fault_injector)
+            self._pw = b.build()
+            if self.health_policy is not None:
+                # the watchdog's rollback seam is the MASTER's round
+                # checkpoints (the wrapper has none of its own here);
+                # a restore rewinds the master round counter with it
+                self._pw._ext_rollback = (
+                    self._gate.manager(),
+                    lambda s: setattr(self._gate, "round", int(s)))
         return self._pw
 
     # -- checkpoint / crash-resume (resilience layer) -------------------

@@ -114,6 +114,13 @@ class StatsListener(IterationListener):
             report["memory"] = self._memory_info()
         if c.collect_learning_rates:
             report["learningRates"] = self._learning_rates(model)
+        pol = getattr(model, "_health_policy", None)
+        if pol is not None:
+            # run-health from the training-health watchdog
+            # (common/health.py): skip/spike/rollback/validation-reject
+            # counters + the latest event, so the UI can show a run's
+            # numerical health next to its score curve
+            report["health"] = pol.snapshot()
         if c.collect_mean or c.collect_stdev or c.collect_histograms:
             bins = c.histogram_bins if c.collect_histograms else None
             params = dict(self._param_arrays(model))

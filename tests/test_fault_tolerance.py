@@ -117,10 +117,15 @@ def test_retry_policy_classification():
 
 def test_retry_policy_deadline_and_exhaustion():
     t = {"now": 0.0}
+    sleeps = []
+
+    def fake_sleep(d):
+        sleeps.append(d)
+        t["now"] += d
+
     pol = RetryPolicy(max_retries=100, base_delay=1.0, max_delay=1.0,
                       jitter=0.0, deadline=3.5,
-                      sleep=lambda d: t.__setitem__("now", t["now"] + d),
-                      clock=lambda: t["now"])
+                      sleep=fake_sleep, clock=lambda: t["now"])
     n = {"v": 0}
 
     def always():
@@ -129,8 +134,12 @@ def test_retry_policy_deadline_and_exhaustion():
 
     with pytest.raises(ConnectionError):
         pol.call(always)
-    # attempts at t=0,1,2,3; the next backoff would cross the 3.5s deadline
-    assert n["v"] == 4
+    # attempts at t=0,1,2,3 then a FINAL one at the deadline edge: the
+    # last backoff is capped to the remaining 0.5s instead of either
+    # sleeping past the deadline or forfeiting the remainder
+    assert n["v"] == 5
+    assert sleeps == [1.0, 1.0, 1.0, 0.5]
+    assert t["now"] == 3.5          # never slept past the deadline
 
     pol2 = RetryPolicy(max_retries=2, base_delay=0.0, jitter=0.0,
                        sleep=lambda d: None)
