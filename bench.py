@@ -92,6 +92,27 @@ def _peak_flops():
     return None
 
 
+def _interleaved_median(arms, segments=5):
+    """Interleaved same-process A/B protocol (the ParallelWrapper fix
+    that collapsed a fake 12% inter-process gap to 0.58%, PERF.md r5;
+    now the standard for every dispatch-bound config): run SHORT timed
+    segments of each arm alternating A B A B ... inside ONE process, so
+    tunnel weather / host jitter hits all arms equally, and report the
+    per-arm MEDIAN over segments (robust to a single latency spike where
+    best-of takes the flattering outlier and mean takes the damage).
+
+    arms: {name: zero-arg callable returning one segment's rate}.
+    Returns {name: {"median": rate, "segments": [rates...]}}."""
+    import statistics
+    results = {name: [] for name in arms}
+    for _ in range(segments):
+        for name, fn in arms.items():
+            results[name].append(fn())
+    return {name: {"median": round(statistics.median(v), 1),
+                   "segments": [round(x, 1) for x in v]}
+            for name, v in results.items()}
+
+
 def _bench_net(net, x, y, warmup=2, iters=10, reps=2):
     """Best of `reps` timed segments: transient tunnel-latency spikes on a
     remote-attached chip can halve a dispatch-bound segment; the best rep
@@ -118,8 +139,16 @@ def _bench_net(net, x, y, warmup=2, iters=10, reps=2):
 
 
 def bench_lenet(rng, small=False):
+    """Primary value keeps the historical protocol (staged fit(DataSet)
+    loop, comparable to the r5 record); a fused_steps A/B arm measures
+    the K-batches-per-dispatch fit loop against the single-step loop,
+    interleaved in the same process (both arms iterator-driven so the
+    comparison isolates the dispatch batching)."""
+    import jax
     import numpy as np
 
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
     from deeplearning4j_tpu.models.zoo.lenet import lenet
     batch = 64 if small else 512
     net = lenet(data_type="bfloat16")
@@ -127,8 +156,34 @@ def bench_lenet(rng, small=False):
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
     ips = _bench_net(net, x, y, warmup=1 if small else 3,
                      iters=5 if small else 30, reps=1 if small else 2)
+
+    # fused_steps A/B: K=8 batches per device dispatch vs one-per-dispatch
+    K = 8
+    n_batches = K * (1 if small else 2)
+    ds = DataSet(jax.device_put(x), jax.device_put(y))
+    net1 = lenet(data_type="bfloat16")
+    net8 = lenet(data_type="bfloat16").fused_steps(K)
+
+    def seg(n):
+        def run():
+            t0 = time.perf_counter()
+            n.fit(ListDataSetIterator([ds] * n_batches))
+            float(n._score)
+            return batch * n_batches / (time.perf_counter() - t0)
+        return run
+
+    for n in (net1, net8):
+        seg(n)()                       # compile + warm staging
+    ab = _interleaved_median({"fused1": seg(net1), "fused8": seg(net8)},
+                             segments=3 if small else 5)
     return {"value": round(ips, 1), "unit": "images/sec",
-            "config": f"batch {batch}, bf16",
+            "config": f"batch {batch}, bf16; fused_steps A/B "
+                      f"(interleaved median): fused1 "
+                      f"{ab['fused1']['median']} vs fused8 "
+                      f"{ab['fused8']['median']} img/s",
+            "fused_ab": ab,
+            "fused_speedup": round(ab["fused8"]["median"]
+                                   / max(ab["fused1"]["median"], 1e-9), 3),
             "vs_baseline": round(ips / BASELINE_LENET_IMAGES_PER_SEC, 3)}
 
 
@@ -275,9 +330,52 @@ def _bench_char_rnn_arm(rng, small, scan_unroll):
 
 
 def bench_char_rnn(rng, small=False):
-    cps, B, T = _bench_char_rnn_arm(rng, small, scan_unroll=1)
+    """Interleaved same-process fused_steps A/B (_interleaved_median):
+    fused8 scans up to 8 TBPTT segments (T=200 / tbptt 50 -> the whole
+    4-segment sequence) in ONE dispatch per fit, carries threaded
+    through the scan; fused1 is today's one-dispatch-per-segment loop.
+    Headline `value` stays the single-step number (comparable to the r5
+    record); at T=50 (small/CPU fallback) the sequence is one segment
+    and the arms coincide."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.zoo.char_rnn import char_rnn
+    V, B, T = (77, 8, 50) if small else (77, 64, 200)
+    x = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
+    y = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
+    ds = DataSet(jax.device_put(x), jax.device_put(y))
+    net1 = char_rnn(data_type="bfloat16")
+    net8 = char_rnn(data_type="bfloat16").fused_steps(8)
+    iters = 3 if small else 20
+
+    def seg(n):
+        def run():
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                n.fit(ds)
+            float(n._score)
+            return B * T * iters / (time.perf_counter() - t0)
+        return run
+
+    for n in (net1, net8):       # compile both programs off the clock
+        n.fit(ds)
+        float(n._score)
+    ab = _interleaved_median({"fused1": seg(net1), "fused8": seg(net8)},
+                             segments=3 if small else 5)
+    # headline keeps the HISTORICAL best-of protocol (max over segments,
+    # = r5's best-of-reps) so vs_baseline stays comparable across
+    # captures; the A/B comparison uses the interleaved MEDIANS
+    cps = max(ab["fused1"]["segments"])
     return {"value": round(cps, 0), "unit": "chars/sec",
-            "config": f"2x200 GravesLSTM, batch {B}, seq {T}, tbptt 50, bf16",
+            "config": f"2x200 GravesLSTM, batch {B}, seq {T}, tbptt 50, "
+                      f"bf16; fused_steps A/B (interleaved median): "
+                      f"fused1 {ab['fused1']['median']} vs fused8 "
+                      f"{ab['fused8']['median']} chars/s",
+            "fused_ab": ab,
+            "fused_speedup": round(ab["fused8"]["median"]
+                                   / max(ab["fused1"]["median"], 1e-9), 3),
             "vs_baseline": round(cps / BASELINE_CHARRNN_CHARS_PER_SEC, 3)}
 
 
@@ -294,6 +392,14 @@ def bench_char_rnn_unroll(rng, small=False):
 
 
 def bench_word2vec(rng, small=False):
+    """Interleaved same-process A/B (_interleaved_median) over the
+    dispatch-batching lever itself — batch_pairs 65536 vs 4096 flushes
+    (the AggregateSkipGram-style K-pairs-per-native-call knob): short
+    alternating segments on identical sequence chunks, median-of-k
+    per arm, so tunnel weather can no longer fake a 3x swing between
+    captures. Headline `value` = the 65536 arm's best segment (the
+    historical best-of protocol, comparable across captures); the A/B
+    comparison uses the medians."""
     import jax
     import numpy as np
 
@@ -307,42 +413,65 @@ def bench_word2vec(rng, small=False):
     for i in range(V):
         vocab.add_token(f"w{i}", count=int(rng.zipf(1.5)))
     vocab.finish()
-    table = InMemoryLookupTable(vocab, vector_length=D, seed=1, negative=5,
-                                use_hs=False)
-    table.reset_weights()
 
     from deeplearning4j_tpu.common import native_ops
     # touching the library BEFORE the timed loop: a cold checkout would
     # otherwise pay the one-time `make` inside rep 0's timing window
     native_available = native_ops.available()
 
-    sg = SkipGram(batch_pairs=65536)   # large flushes amortize dispatch
-    sg.configure(vocab, table, window=5, negative=5, use_hs=False, seed=1)
-    n_seqs = 400 if small else 3200
+    def make_arm(batch_pairs):
+        table = InMemoryLookupTable(vocab, vector_length=D, seed=1,
+                                    negative=5, use_hs=False)
+        table.reset_weights()
+        sg = SkipGram(batch_pairs=batch_pairs)
+        sg.configure(vocab, table, window=5, negative=5, use_hs=False,
+                     seed=1)
+        return sg
+
+    arms = {"batch65536": make_arm(65536), "batch4096": make_arm(4096)}
+    segments = 3 if small else 5
+    per_seg = 120 if small else 640
+    n_seqs = 100 + segments * per_seg
     seqs = [rng.integers(0, V, 40).tolist() for _ in range(n_seqs)]
-    for s in seqs[:100]:
-        sg.learn_sequence(s, 0.025)
-    sg._flush(force=True)
-    jax.block_until_ready(sg._syn0)
-    pps = 0.0
-    per_rep = 150 if small else 1500
-    for rep in range(2):   # best-of-2 (see _bench_net)
-        chunk = seqs[100 + per_rep * rep:100 + per_rep * (rep + 1)]
-        base = sg._flushed_pairs
-        t0 = time.perf_counter()
-        # corpus-chunk path: C++ pair generation feeding the batched TPU
-        # kernel (falls back to vectorized numpy without the toolchain) —
-        # the path SequenceVectors.fit drives
-        for i in range(0, len(chunk), 256):
-            sg.learn_sequences_batch(chunk[i:i + 256], 0.025)
+    for sg in arms.values():        # warm: compile both flush programs
+        for s in seqs[:100]:
+            sg.learn_sequence(s, 0.025)
         sg._flush(force=True)
         jax.block_until_ready(sg._syn0)
-        dt = time.perf_counter() - t0
-        pps = max(pps, (sg._flushed_pairs - base) / dt)
+    seg_idx = {name: [0] for name in arms}
+
+    def seg(name, sg):
+        def run():
+            i = seg_idx[name][0]
+            seg_idx[name][0] += 1
+            # both arms consume the SAME chunk per segment (fair A/B)
+            chunk = seqs[100 + per_seg * i:100 + per_seg * (i + 1)]
+            base = sg._flushed_pairs
+            t0 = time.perf_counter()
+            # corpus-chunk path: C++ pair generation feeding the batched
+            # TPU kernel (numpy fallback without the toolchain) — the
+            # path SequenceVectors.fit drives
+            for j in range(0, len(chunk), 256):
+                sg.learn_sequences_batch(chunk[j:j + 256], 0.025)
+            sg._flush(force=True)
+            jax.block_until_ready(sg._syn0)
+            return (sg._flushed_pairs - base) / (time.perf_counter() - t0)
+        return run
+
+    ab = _interleaved_median(
+        {name: seg(name, sg) for name, sg in arms.items()},
+        segments=segments)
+    # headline = best segment of the 65536 arm (the historical best-of
+    # protocol, comparable to the r5 record); medians drive the A/B
+    pps = max(ab["batch65536"]["segments"])
     gen = ("native pairgen" if native_available
            else "numpy pairgen (no native lib)")
     return {"value": round(pps, 0), "unit": "pairs/sec",
-            "config": f"V={V}, dim {D}, neg 5, batch 65536, {gen}",
+            "config": f"V={V}, dim {D}, neg 5, {gen}; flush-batch A/B "
+                      f"(interleaved median): 65536 "
+                      f"{ab['batch65536']['median']} vs 4096 "
+                      f"{ab['batch4096']['median']} pairs/s",
+            "flush_ab": ab,
             "vs_baseline": round(pps / BASELINE_W2V_PAIRS_PER_SEC, 3)}
 
 
@@ -393,7 +522,13 @@ def bench_flash_attention(rng, small=False):
 def bench_decode(rng, small=False):
     """KV-cache incremental decode throughput — the attention-era
     equivalent of the reference's O(1)-per-step streaming inference
-    (MultiLayerNetwork.rnnTimeStep, MultiLayerNetwork.java:2196)."""
+    (MultiLayerNetwork.rnnTimeStep, MultiLayerNetwork.java:2196).
+
+    Interleaved same-process protocol (_interleaved_median): batch-1 and
+    batch-8 segments alternate so a tunnel blip cannot skew one arm, and
+    every generate_batch call's wall time becomes a LATENCY SAMPLE —
+    p50/p99 per-token latency is reported per batch size next to the
+    throughput (a serving SLO is a percentile, not a mean)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -403,23 +538,45 @@ def bench_decode(rng, small=False):
     steps = 16 if small else 128
     lm = TransformerLM(V, d_model=D, n_heads=H, n_layers=L,
                        max_len=max(steps + 16, 64), dtype=jnp.bfloat16)
-    results = {}
-    for batch in (1, 8):
-        prompt = rng.integers(0, V, (batch, 8)).astype(np.int32)
-        # first call compiles the single fused prefill+decode scan program
-        lm.generate_batch(prompt, max_new_tokens=steps)
-        t0 = time.perf_counter()
-        reps = 2 if small else 5
-        for _ in range(reps):
-            lm.generate_batch(prompt, max_new_tokens=steps)
-        dt = time.perf_counter() - t0
-        results[f"batch{batch}"] = round(batch * steps * reps / dt, 1)
-    return {"value": results["batch8"], "unit": "tokens/sec",
-            "config": f"KV-cache decode (one on-device scan program), "
-                      f"TransformerLM L={L} d={D}, {steps} new tokens; "
-                      f"batch1={results['batch1']} tok/s",
-            "vs_baseline": round(results["batch8"]
-                                 / BASELINE_DECODE_TOKENS_PER_SEC, 3)}
+    prompts = {b: rng.integers(0, V, (b, 8)).astype(np.int32)
+               for b in (1, 8)}
+    for p in prompts.values():     # compile both programs off the clock
+        lm.generate_batch(p, max_new_tokens=steps)
+    lat_ms = {b: [] for b in prompts}    # per-CALL per-token latency
+
+    def seg(batch):
+        prompt = prompts[batch]
+        calls = 3 if small else 5
+
+        def run():
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                c0 = time.perf_counter()
+                lm.generate_batch(prompt, max_new_tokens=steps)
+                lat_ms[batch].append(
+                    (time.perf_counter() - c0) * 1e3 / steps)
+            return batch * steps * calls / (time.perf_counter() - t0)
+        return run
+
+    ab = _interleaved_median({"batch1": seg(1), "batch8": seg(8)},
+                             segments=3 if small else 5)
+
+    def pct(samples, q):
+        return round(float(np.percentile(np.asarray(samples), q)), 3)
+
+    rec = {"value": ab["batch8"]["median"], "unit": "tokens/sec",
+           "config": f"KV-cache decode (one on-device scan program), "
+                     f"TransformerLM L={L} d={D}, {steps} new tokens, "
+                     f"interleaved median; batch1="
+                     f"{ab['batch1']['median']} tok/s",
+           "decode_ab": ab,
+           "vs_baseline": round(ab["batch8"]["median"]
+                                / BASELINE_DECODE_TOKENS_PER_SEC, 3)}
+    for b in (1, 8):
+        rec[f"p50_ms_per_token_batch{b}"] = pct(lat_ms[b], 50)
+        rec[f"p99_ms_per_token_batch{b}"] = pct(lat_ms[b], 99)
+        rec[f"latency_samples_batch{b}"] = len(lat_ms[b])
+    return rec
 
 
 def bench_parallel_wrapper(rng, small=False):
@@ -470,10 +627,12 @@ SECONDARY_CONFIGS = {
     # cache (pre-cache values were ~2x these and made the 660 s driver
     # budget skip the last two configs).
     "resnet50_remat": (bench_resnet50_remat, 120),
-    "lenet_mnist": (bench_lenet, 60),
-    "char_rnn_lstm": (bench_char_rnn, 90),
-    "word2vec_skipgram": (bench_word2vec, 60),
-    "decode_tokens_sec": (bench_decode, 75),
+    # estimates below grew with the r6 interleaved A/B protocol (each
+    # config now times two arms x 5 segments in one process)
+    "lenet_mnist": (bench_lenet, 90),
+    "char_rnn_lstm": (bench_char_rnn, 120),
+    "word2vec_skipgram": (bench_word2vec, 90),
+    "decode_tokens_sec": (bench_decode, 100),
     "resnet50_fit_pipeline": (bench_resnet50_pipeline, 150),
     "flash_attention_8k": (bench_flash_attention, 110),
     "parallel_wrapper_resnet50": (bench_parallel_wrapper, 120),

@@ -367,6 +367,64 @@ def finish_step(net, health, score):
     return action
 
 
+def split_stacked(health, n_steps):
+    """Per-step classification over a stacked report: a fused K-step
+    dispatch emits its health scalars as scan ys (leading axis K); this
+    materializes the WHOLE report with one device->host sync and splits
+    it into K per-step dicts for `observe`/`finish_step` — the host-side
+    cost per dispatch is one transfer, not K scalar readbacks."""
+    import jax
+    import numpy as np
+    host = jax.tree.map(np.asarray, health)
+    return [jax.tree.map(lambda a: a[i], host) for i in range(n_steps)]
+
+
+def finish_fused(net, scores, health_stack, n_steps):
+    """The fused-dispatch epilogue shared by MultiLayerNetwork and
+    ComputationGraph (super-batch AND TBPTT fused paths): walk the K
+    inner steps of one dispatch in order, updating the score, counters
+    and listeners per OPTIMIZER STEP (StatsListener sees every step, not
+    every dispatch) and — when armed — classifying each step's health
+    exactly as the sequential loop would.
+
+    Returns the inner index whose classification triggered a ROLLBACK
+    (counters/rng already restored; the caller re-runs the REMAINING
+    staged batches from the restored state so the stream matches K
+    sequential dispatches), or None when every step was consumed. ABORT
+    raises TrainingDivergedError, as in the sequential loop."""
+    import numpy as np
+    if health_stack is None and not net.listeners:
+        # nothing consumes per-step scalars: DON'T materialize the
+        # stacked scores — the np.asarray would block the training
+        # thread on the whole dispatch, serializing host group-staging
+        # with device compute (the sequential loop never syncs). The
+        # score is the super-batch's last step's, read lazily.
+        net._score = scores[n_steps - 1]
+        net.conf.iteration_count += n_steps
+        return None
+    scores_np = np.asarray(scores)
+    healths = (split_stacked(health_stack, n_steps)
+               if health_stack is not None else None)
+    action = OK
+    for i in range(n_steps):
+        if healths is None:
+            net._score = scores_np[i]
+            action = OK
+        else:
+            action = finish_step(net, healths[i], scores_np[i])
+            if action == ROLLBACK:
+                return i
+        net.conf.iteration_count += 1
+        for l in net.listeners:
+            l.iteration_done(net, net.conf.iteration_count - 1)
+    # groups are clipped at checkpoint boundaries (fused.group_size), so
+    # a due save can only land on the LAST inner step — where the net's
+    # in-memory state IS the post-due-step state
+    if healths is not None and action == OK:
+        fit_loop_checkpoint(net)
+    return None
+
+
 def fit_loop_rollback(net):
     """Single-process fit loops' rollback seam: restore the newest health
     checkpoint INTO the net (counters, rng and device loop state

@@ -43,17 +43,19 @@ def next_processed(it):
     return nxt() if callable(nxt) else it.next_batch()
 
 
-def wrap_async_for_fit(it, compute_dtype):
+def wrap_async_for_fit(it, compute_dtype, queue_size=2):
     """fit()'s auto-wrap policy, shared by MultiLayerNetwork and
-    ComputationGraph: async prefetch (queue 2), and for bf16 models a bf16
+    ComputationGraph: async prefetch (queue `queue_size` — the fused
+    multi-step fit loops deepen it to K+1 so a whole super-batch stages
+    while the previous dispatch runs), and for bf16 models a bf16
     FEATURE wire — bit-identical training (the fused step casts features
     to bf16 anyway) with labels/masks kept at full precision."""
     import jax.numpy as jnp
     if isinstance(it, AsyncDataSetIterator):
         return it
     wire = "bfloat16" if compute_dtype == jnp.bfloat16 else None
-    return AsyncDataSetIterator(it, queue_size=2, transfer_dtype=wire,
-                                cast_labels=False)
+    return AsyncDataSetIterator(it, queue_size=max(2, int(queue_size)),
+                                transfer_dtype=wire, cast_labels=False)
 
 
 class BatchValidationError(ValueError):

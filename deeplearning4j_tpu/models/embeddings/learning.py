@@ -288,6 +288,20 @@ class SkipGram(BaseElementsLearning):
 
     name = "skipgram"
 
+    def lower_step(self):
+        """Lower (trace+compile without executing) one batched skip-gram
+        update at the configured batch size — the mesh-cost profiling
+        hook for the model-sharded word2vec mode (syn0/syn1 column-shard
+        over "model"; the collective-budget net pins the psum footprint
+        without hardware). Dummy index/label arrays; shapes and
+        shardings are what the real flush dispatches."""
+        B = self.batch_pairs
+        T = self._max_code_len if self.use_hs else self.negative + 1
+        return _sg_step.lower(
+            self._syn0, self._syn1, np.zeros((B,), np.int32),
+            np.zeros((B, T), np.int32), np.zeros((B, T), np.float32),
+            np.ones((B, T), np.float32), np.float32(0.025))
+
     def learn_sequence(self, ids, lr):
         """ids: list of vocab indices for one sequence."""
         n = len(ids)

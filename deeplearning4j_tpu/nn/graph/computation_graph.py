@@ -450,6 +450,20 @@ class ComputationGraph:
                   keep_checkpoints)
         return self
 
+    def fused_steps(self, k=8):
+        """Fuse K optimizer steps into one device dispatch (see
+        MultiLayerNetwork.fused_steps — identical contract; multi-input
+        feature dicts and multi-output label lists stack per leaf)."""
+        from .. import fused as F
+        return F.install(self, k)
+
+    def _fused_k(self):
+        k = getattr(self, "_fused_steps", 1)
+        if (k <= 1
+                or int(self.conf.global_conf.get("num_iterations", 1)) != 1):
+            return 1
+        return k
+
     def _loop_state(self):
         if self._loop is None:
             self._rng, k = jax.random.split(self._rng)
@@ -488,7 +502,9 @@ class ComputationGraph:
             wrapped_here = not isinstance(data, AsyncDataSetIterator)
             if wrapped_here:
                 data.reset()
-            data = wrap_async_for_fit(data, self.compute_dtype)
+            data = wrap_async_for_fit(
+                data, self.compute_dtype,
+                queue_size=max(2, getattr(self, "_fused_steps", 1) + 1))
         for epoch in range(num_epochs):
             # a fresh async wrapper fit() itself created is already
             # prefetching; resetting it on epoch 0 would drain (and
@@ -505,15 +521,33 @@ class ComputationGraph:
                                   if isinstance(ds, DataSet) else ds)
             else:
                 while data.has_next():
-                    ds = next_processed(data)
-                    self._fit_mds(_dataset_to_mds(ds)
-                                  if isinstance(ds, DataSet) else ds)
+                    k = (self._fused_k()
+                         if self.conf.backprop_type != "tbptt" else 1)
+                    if k <= 1:
+                        ds = next_processed(data)
+                        self._fit_mds(_dataset_to_mds(ds)
+                                      if isinstance(ds, DataSet) else ds)
+                        continue
+                    from .. import fused as F
+                    group = []
+                    g = F.group_size(self, k)
+                    while len(group) < g and data.has_next():
+                        ds = next_processed(data)
+                        group.append(_dataset_to_mds(ds)
+                                     if isinstance(ds, DataSet) else ds)
+                    if len(group) == g and F.uniform_group(group):
+                        self._fit_mds_fused(group)
+                    else:
+                        # ragged tail / mixed shapes: single-step stream
+                        for mds in group:
+                            self._fit_mds(mds)
             self.conf.epoch_count += 1
         return self
 
-    def _fit_mds(self, mds: MultiDataSet):
-        if self._jit_step is None:
-            self._jit_step = self._make_step()
+    def _canon_mds(self, mds):
+        """One MultiDataSet -> the raw-step batch pieces (name-keyed
+        feature dict, label list, mask trees) — the _fit_mds conversion,
+        shared with the fused super-batch path."""
         features = {n: jnp.asarray(f)
                     for n, f in zip(self.conf.network_inputs, mds.features)}
         labels = [jnp.asarray(l) for l in mds.labels]
@@ -526,6 +560,48 @@ class ComputationGraph:
         if mds.labels_masks:
             lmasks = [jnp.asarray(m) if m is not None else None
                       for m in mds.labels_masks]
+        return features, labels, fmasks, lmasks
+
+    def _fit_mds_fused(self, group):
+        """ONE dispatch for len(group) staged MultiDataSets (see
+        MultiLayerNetwork._fit_super_batch — same contract, tree-stacked
+        multi-input/multi-output batch pieces)."""
+        from .. import fused as F
+        emit_health = getattr(self, "_health_policy", None) is not None
+        g = len(group)
+        parts = [self._canon_mds(mds) for mds in group]
+
+        def build():
+            raw = self.make_raw_step(emit_health)
+
+            def prog(params, ustate, state, loop, batch_list):
+                return F.scan_batches(raw, params, ustate, state, loop,
+                                      batch_list)
+
+            return jax.jit(prog, donate_argnums=(0, 1, 2, 3))
+
+        step = F.fused_program(self, ("batch", g), build)
+        batch_list = tuple(
+            {"features": p[0], "labels": p[1], "fmask": p[2],
+             "lmask": p[3]} for p in parts)
+        self._last_batch_size = int(
+            jax.tree.leaves(parts[0][0])[0].shape[0])
+        (self._params, self._updater_state, self._model_state, scores,
+         _, self._loop, *extras) = step(
+             self._params, self._updater_state, self._model_state,
+             self._loop_state(), batch_list)
+        from ...common import health as H
+        rb = H.finish_fused(self, scores,
+                            extras[-1] if emit_health else None, g)
+        if rb is not None:
+            for mds in group[rb + 1:]:  # counters/rng restored; replay
+                self._fit_mds(mds)
+        return self
+
+    def _fit_mds(self, mds: MultiDataSet):
+        if self._jit_step is None:
+            self._jit_step = self._make_step()
+        features, labels, fmasks, lmasks = self._canon_mds(mds)
         self._last_batch_size = int(mds.features[0].shape[0])
         if self.conf.backprop_type == "tbptt":
             return self._fit_tbptt(features, labels, fmasks, lmasks)
@@ -560,8 +636,11 @@ class ComputationGraph:
                 if isinstance(self.conf.vertices[n].conf, BaseRecurrentLayer)]
 
     def _init_carries(self, batch_size):
+        # compute dtype, not param dtype — see MultiLayerNetwork
+        # ._init_carries (cast-on-entry makes values identical; the
+        # returned carry is compute dtype, which the fused scan requires)
         return {n: self.conf.vertices[n].conf.init_carry(batch_size,
-                                                         self.param_dtype)
+                                                         self.compute_dtype)
                 for n in self._recurrent_names()}
 
     def _fit_tbptt(self, features, labels, fmasks, lmasks):
@@ -573,7 +652,19 @@ class ComputationGraph:
         L = self.conf.tbptt_fwd_length
         B = int(next(iter(features.values())).shape[0])
         carries = self._init_carries(B)
-        for t0 in range(0, T, L):
+        t0 = 0
+        while t0 < T:
+            k = self._fused_k()
+            if k > 1:
+                from .. import fused as F
+                g = min(F.group_size(self, k), (T - t0) // L)
+                if g > 1:
+                    carries, t0, done = self._fit_tbptt_fused(
+                        features, labels, fmasks, lmasks, carries, t0, g,
+                        T, L)
+                    if done:        # rollback: abandon this sequence
+                        return self
+                    continue
             def _seg(a):
                 # only sequence-shaped arrays have a time axis to slice;
                 # static inputs/labels/masks pass through whole
@@ -605,7 +696,65 @@ class ComputationGraph:
             if action == "ok" and getattr(self, "_step_emits_health", False):
                 from ...common.health import fit_loop_checkpoint
                 fit_loop_checkpoint(self)
+            t0 += L
         return self
+
+    def _fit_tbptt_fused(self, features, labels, fmasks, lmasks, carries,
+                         t0, g, T, L):
+        """ONE dispatch for g full TBPTT segments (see
+        MultiLayerNetwork._fit_tbptt_fused): the scan body dynamic-slices
+        sequence-shaped arrays (static inputs/labels/masks pass through
+        whole, as in the sequential loop) and threads the RNN carries
+        through the scan carry. Returns (carries', next_t0, rolled_back)."""
+        from .. import fused as F
+        emit_health = getattr(self, "_health_policy", None) is not None
+
+        def build():
+            raw = self.make_raw_step(emit_health)
+
+            def prog(params, ustate, state, loop, features, labels,
+                     fmask, lmask, carries, t0s):
+                def make_batch(s):
+                    def sl(a, min_ndim):
+                        # same slice conditions as the sequential loop's
+                        # _seg (static at trace time): features/labels
+                        # only when sequence-shaped (ndim >= 3), masks
+                        # from ndim >= 2; arrays without a full time
+                        # axis pass through whole
+                        if (a is None or a.ndim < min_ndim
+                                or a.ndim < 2 or a.shape[1] < T):
+                            return a
+                        return jax.lax.dynamic_slice_in_dim(a, s, L, axis=1)
+
+                    return {"features": jax.tree.map(
+                                lambda a: sl(a, 3), features),
+                            "labels": jax.tree.map(
+                                lambda a: sl(a, 3), labels),
+                            "fmask": (jax.tree.map(
+                                lambda a: sl(a, 2), fmask)
+                                if fmask is not None else None),
+                            "lmask": (jax.tree.map(
+                                lambda a: sl(a, 2), lmask)
+                                if lmask is not None else None)}
+
+                return F.scan_steps(raw, params, ustate, state, loop,
+                                    carries, t0s, make_batch)
+
+            return jax.jit(prog, donate_argnums=(0, 1, 2, 3))
+
+        key = ("tbptt", g, T, L,
+               fmasks is not None, lmasks is not None)
+        step = F.fused_program(self, key, build)
+        t0s = jnp.arange(t0, t0 + g * L, L, dtype=jnp.int32)
+        (self._params, self._updater_state, self._model_state, scores,
+         carries, self._loop, *extras) = step(
+             self._params, self._updater_state, self._model_state,
+             self._loop_state(), features, labels, fmasks, lmasks, carries,
+             t0s)
+        from ...common import health as H
+        rb = H.finish_fused(self, scores,
+                            extras[-1] if emit_health else None, g)
+        return carries, t0 + g * L, rb is not None
 
     def rnn_time_step(self, *features):
         """Single/multi-step streaming inference with carried RNN state

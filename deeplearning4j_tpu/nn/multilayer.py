@@ -356,6 +356,39 @@ class MultiLayerNetwork:
                   keep_checkpoints)
         return self
 
+    def fused_steps(self, k=8):
+        """Fuse K optimizer steps into ONE device dispatch: the fit loops
+        stage K batches (the AsyncDataSetIterator prefetch/wire machinery,
+        unchanged), stack them into a [K, B, ...] super-batch, and run a
+        single donated jitted program that `lax.scan`s the SAME raw step
+        over the K batches — one host round-trip per K steps instead of
+        per step (the dispatch-overhead lever for small-step configs;
+        see nn/fused.py for the CPU-backend caveat on compute-bound
+        steps). TBPTT fuses K segments of a sequence per dispatch, with
+        RNN carries threaded through the scan.
+
+        Semantics are pinned: `fused_steps(K)` is bit-identical to K
+        sequential dispatches (params, updater state, rng stream, health
+        counters); `fused_steps(1)` — the default — leaves the
+        single-step program untouched (identical HLO). Ragged tails (K
+        not dividing the epoch, or a short last batch) fall back to
+        single-step dispatches; a health checkpoint seam clips groups at
+        checkpoint boundaries so the save cadence stays counted in
+        optimizer steps. Activation-stats collection
+        (`collect_activation_stats`) and `num_iterations != 1` force the
+        single-step path for the affected batches."""
+        from . import fused as F
+        return F.install(self, k)
+
+    def _fused_k(self):
+        """Effective fused depth for the CURRENT batch: 1 (single-step
+        path) unless armed, act-stats off and num_iterations == 1."""
+        k = getattr(self, "_fused_steps", 1)
+        if (k <= 1 or self._act_stats_cfg is not None
+                or int(self.conf.global_conf.get("num_iterations", 1)) != 1):
+            return 1
+        return k
+
     def _loop_state(self):
         if getattr(self, "_loop", None) is None:
             self._rng, k = jax.random.split(self._rng)
@@ -397,7 +430,11 @@ class MultiLayerNetwork:
         wrapped_here = not isinstance(it, AsyncDataSetIterator)
         if wrapped_here:
             it.reset()
-        async_it = wrap_async_for_fit(it, self.compute_dtype)
+        # fused mode stages a whole super-batch ahead: deepen the prefetch
+        # queue so the staging thread can fill group K+1 while K runs
+        async_it = wrap_async_for_fit(
+            it, self.compute_dtype,
+            queue_size=max(2, getattr(self, "_fused_steps", 1) + 1))
         if self._jit_step is None:
             self._jit_step = self._make_step()
         for epoch in range(num_epochs):
@@ -407,12 +444,65 @@ class MultiLayerNetwork:
                 if hasattr(l, "on_epoch_start"):
                     l.on_epoch_start(self)
             while async_it.has_next():
-                ds = next_processed(async_it)
-                self._fit_batch(ds)
+                k = (self._fused_k()
+                     if self.conf.backprop_type != "tbptt" else 1)
+                if k <= 1:
+                    self._fit_batch(next_processed(async_it))
+                    continue
+                from . import fused as F
+                group = []
+                g = F.group_size(self, k)
+                while len(group) < g and async_it.has_next():
+                    group.append(next_processed(async_it))
+                if len(group) == g and F.uniform_group(group):
+                    self._fit_super_batch(group)
+                else:
+                    # ragged tail (K not dividing the epoch) or mixed
+                    # batch shapes: single-step dispatches, same stream
+                    for ds in group:
+                        self._fit_batch(ds)
             for l in self.listeners:
                 if hasattr(l, "on_epoch_end"):
                     l.on_epoch_end(self)
             self.conf.epoch_count += 1
+        return self
+
+    def _fit_super_batch(self, group):
+        """ONE dispatch for len(group) staged batches: stack on device,
+        scan the raw step, then walk the stacked per-step scores/health
+        on the host (`common.health.finish_fused` — listeners and the
+        watchdog see every optimizer step). On a mid-super-batch
+        rollback the remaining staged batches re-run single-step from
+        the restored state, exactly as the sequential loop would."""
+        from . import fused as F
+        emit_health = getattr(self, "_health_policy", None) is not None
+        g = len(group)
+
+        def build():
+            raw = self.make_raw_step(False, emit_health)
+
+            def prog(params, ustate, state, loop, batch_list):
+                return F.scan_batches(raw, params, ustate, state, loop,
+                                      batch_list)
+
+            return jax.jit(prog, donate_argnums=(0, 1, 2, 3))
+
+        step = F.fused_program(self, ("batch", g), build)
+        batch_list = tuple(
+            {"features": ds.features, "labels": ds.labels,
+             "fmask": ds.features_mask, "lmask": ds.labels_mask}
+            for ds in group)
+        self._last_batch_size = int(np.shape(group[0].features)[0])
+        (self._params, self._updater_state, self._model_state, scores,
+         _, self._loop, *extras) = step(
+             self._params, self._updater_state, self._model_state,
+             self._loop_state(), batch_list)
+        from ..common import health as H
+        rb = H.finish_fused(self, scores,
+                            extras[-1] if emit_health else None, g)
+        if rb is not None:
+            for ds in group[rb + 1:]:   # counters/rng restored; replay
+                self._fit_batch(ds)
         return self
 
     def _fit_batch(self, ds: DataSet):
@@ -457,7 +547,12 @@ class MultiLayerNetwork:
 
     def _init_carries(self, batch_size):
         from .conf.layers.recurrent import BaseRecurrentLayer
-        return [layer.init_carry(batch_size, self.param_dtype)
+        # COMPUTE dtype, not param dtype: forward_with_carry casts the
+        # incoming carry to x.dtype anyway (values identical), and the
+        # returned carry IS compute dtype — a f32 init on a bf16 model
+        # silently retraced the sequential TBPTT step after segment 1 and
+        # breaks the fused scan's carry-dtype invariance
+        return [layer.init_carry(batch_size, self.compute_dtype)
                 if isinstance(layer, BaseRecurrentLayer) else {}
                 for layer in self.layers]
 
@@ -478,7 +573,22 @@ class MultiLayerNetwork:
         lmask = jnp.asarray(ds.labels_mask) if ds.labels_mask is not None else None
         self._last_batch_size = B
         seq_labels = labels.ndim >= 3
-        for t0 in range(0, T, L):
+        t0 = 0
+        while t0 < T:
+            # fused TBPTT: K full segments per dispatch, carries threaded
+            # through the scan; the short tail segment (L not dividing T)
+            # and act-stats-armed runs stay single-step
+            k = self._fused_k()
+            if k > 1:
+                from . import fused as F
+                g = min(F.group_size(self, k), (T - t0) // L)
+                if g > 1:
+                    carries, t0, done = self._fit_tbptt_fused(
+                        features, labels, fmask, lmask, carries, t0, g,
+                        seq_labels, L)
+                    if done:        # rollback: abandon this sequence
+                        return self
+                    continue
             if self._jit_step is None:     # mid-fit arming (see _fit_batch)
                 self._jit_step = self._make_step()
             f_seg = features[:, t0:t0 + L]
@@ -510,7 +620,49 @@ class MultiLayerNetwork:
             if health is not None and action == "ok":
                 from ..common.health import fit_loop_checkpoint
                 fit_loop_checkpoint(self)
+            t0 += L
         return self
+
+    def _fit_tbptt_fused(self, features, labels, fmask, lmask, carries,
+                         t0, g, seq_labels, L):
+        """ONE dispatch for g full TBPTT segments starting at t0: the
+        scan body dynamic-slices each segment out of the full sequence
+        (no host-side restacking — the data crossed the wire once) and
+        threads the RNN carries through the scan carry. Returns
+        (carries', next_t0, rolled_back)."""
+        from . import fused as F
+        emit_health = getattr(self, "_health_policy", None) is not None
+
+        def build():
+            raw = self.make_raw_step(False, emit_health)
+
+            def prog(params, ustate, state, loop, features, labels,
+                     fmask, lmask, carries, t0s):
+                def make_batch(s):
+                    sl = (lambda a: None if a is None else
+                          jax.lax.dynamic_slice_in_dim(a, s, L, axis=1))
+                    return {"features": sl(features),
+                            "labels": sl(labels) if seq_labels else labels,
+                            "fmask": sl(fmask), "lmask": sl(lmask)}
+
+                return F.scan_steps(raw, params, ustate, state, loop,
+                                    carries, t0s, make_batch)
+
+            return jax.jit(prog, donate_argnums=(0, 1, 2, 3))
+
+        key = ("tbptt", g, L, bool(seq_labels),
+               fmask is not None, lmask is not None)
+        step = F.fused_program(self, key, build)
+        t0s = jnp.arange(t0, t0 + g * L, L, dtype=jnp.int32)
+        (self._params, self._updater_state, self._model_state, scores,
+         carries, self._loop, *extras) = step(
+             self._params, self._updater_state, self._model_state,
+             self._loop_state(), features, labels, fmask, lmask, carries,
+             t0s)
+        from ..common import health as H
+        rb = H.finish_fused(self, scores,
+                            extras[-1] if emit_health else None, g)
+        return carries, t0 + g * L, rb is not None
 
     # ------------------------------------------------------------------
     # Layerwise pretraining — reference MultiLayerNetwork.pretrain /

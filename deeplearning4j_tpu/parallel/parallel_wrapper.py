@@ -546,7 +546,12 @@ class ParallelWrapper:
             return jax.jit(fn, donate_argnums=(0, 1, 2))
         return build
 
-    def _run_kstep(self, batches):
+    def _kstep_batches(self, batches, advance_rng=True):
+        """Stack k DataSets into the k-step program's batches_tree
+        (ragged tail rows pad by wrapping; multi-host leaves become
+        global arrays). Shared by `_run_kstep` and `lower_kstep`
+        (which passes advance_rng=False — lowering must not consume
+        the model's rng stream). Returns (batches_tree, B)."""
         net = self.model
         k = len(batches)
         parts = [self._canon_parts(b) for b in batches]
@@ -560,7 +565,10 @@ class ParallelWrapper:
 
         feats = jax.tree.map(stack, *[p[0] for p in parts])  # [k, B, ...]
         labs = jax.tree.map(stack, *[p[1] for p in parts])
-        net._rng, sub = jax.random.split(net._rng)
+        if advance_rng:
+            net._rng, sub = jax.random.split(net._rng)
+        else:
+            sub = jax.random.PRNGKey(0)
         rngs = jax.random.split(sub, k)
         batches_tree = {
             "features": feats,   # [k, B, ...]
@@ -586,6 +594,23 @@ class ParallelWrapper:
                 batches_tree[key] = jax.tree.map(
                     lambda a: put_sharded(a, NamedSharding(self.mesh, sp)),
                     batches_tree[key])
+        return batches_tree, B
+
+    def lower_kstep(self, batches):
+        """Lower (trace+compile without executing) the k-local-steps
+        parameter-averaging program for a list of k DataSets — the
+        mesh-cost profiling hook for averaging_frequency > 1, sibling of
+        `lower_step` (the collective-budget net pins its footprint)."""
+        self._ensure_sharded()
+        batches_tree, _ = self._kstep_batches(batches, advance_rng=False)
+        return self._build_kstep()(batches_tree).lower(
+            self.model._params, self.model._updater_state,
+            self.model._model_state, batches_tree)
+
+    def _run_kstep(self, batches):
+        net = self.model
+        k = len(batches)
+        batches_tree, B = self._kstep_batches(batches)
         h_gen = getattr(net, "_health_gen", 0)
         if self._jit_kstep is not None and \
                 getattr(self, "_kstep_health_gen", 0) != h_gen:

@@ -34,15 +34,8 @@ BUDGET_PATH = os.path.join(os.path.dirname(__file__), "fixtures",
 N = 8
 
 
-def _mode_lowerings():
-    """name -> jax lowering for one step of each parallelism mode, the same
-    constructions dryrun_multichip exercises."""
-    devices = jax.devices()[:N]
-    rng = np.random.default_rng(0)
-    out = {}
-
-    # dp x tp with ZeRO-1 sharded optimizer state
-    conf = (NeuralNetConfiguration.Builder()
+def _conf():
+    return (NeuralNetConfiguration.Builder()
             .seed(7).updater("adam").learning_rate(1e-3).list()
             .layer(0, ConvolutionLayer(n_out=8, kernel_size=(3, 3),
                                        activation="relu"))
@@ -52,13 +45,32 @@ def _mode_lowerings():
                                   loss_function="mcxent"))
             .set_input_type(InputType.convolutional(8, 8, 2))
             .build())
-    net = MultiLayerNetwork(conf).init()
+
+
+def _mode_lowerings():
+    """name -> jax lowering for one step of each parallelism mode, the same
+    constructions dryrun_multichip exercises."""
+    devices = jax.devices()[:N]
+    rng = np.random.default_rng(0)
+    out = {}
+
+    # dp x tp with ZeRO-1 sharded optimizer state
+    net = MultiLayerNetwork(_conf()).init()
     mesh = make_mesh(n_data=N // 2, n_model=2, devices=devices)
     pw = (ParallelWrapper.Builder(net).mesh(mesh).tensor_parallel(True)
           .sharded_updater_state(True).averaging_frequency(1).build())
     x = rng.random((16, 8, 8, 2)).astype(np.float32)
     y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
     out["dp_tp_zero1"] = pw.lower_step(DataSet(x, y))
+
+    # k-local-steps parameter averaging (averaging_frequency=2: lax.scan
+    # of 2 local steps inside shard_map, then pmean over "data")
+    net2 = MultiLayerNetwork(_conf()).init()
+    pw2 = (ParallelWrapper.Builder(net2)
+           .mesh(make_mesh(n_data=N, n_model=1, devices=devices))
+           .averaging_frequency(2).build())
+    out["param_averaging"] = pw2.lower_kstep(
+        [DataSet(x[:8], y[:8]), DataSet(x[8:], y[8:])])
 
     # GPipe pipeline transformer (pipe=4 x data=2)
     from deeplearning4j_tpu.models.zoo.transformer import (embed_fn, init_lm,
@@ -74,6 +86,23 @@ def _mode_lowerings():
                           data_axis="data", learning_rate=0.1)
     xt = rng.integers(0, 11, (8, 8)).astype(np.int32)
     out["gpipe_pp"] = pp.lower_step(xt, (xt + 1) % 11)
+
+    # 3-axis dp x tp x pp: Megatron tensor-parallel blocks inside the
+    # GPipe rotation (pipe=4 x model=2 x data=1 on 8 devices)
+    from deeplearning4j_tpu.models.zoo.transformer import (
+        init_tp_block, make_tp_block_fn, tp_block_specs)
+    mesh3 = make_pipeline_mesh(n_pipe=4, n_data=1, n_model=2,
+                               devices=devices)
+    blocks3 = [init_tp_block(jax.random.fold_in(jax.random.PRNGKey(9), i),
+                             16, 4, 32) for i in range(4)]
+    aux3, _ = init_lm(11, d_model=16, n_heads=4, n_layers=1, max_len=8,
+                      seed=9)
+    pp3 = PipelineParallel(
+        make_tp_block_fn(2, "model"), blocks3, mesh3, loss_fn=lm_loss,
+        aux_params=aux3, pre_fn=embed_fn, n_micro=2, data_axis="data",
+        learning_rate=0.1, param_specs=tp_block_specs("pipe", "model"))
+    x3 = rng.integers(0, 11, (4, 8)).astype(np.int32)
+    out["dp_tp_pp_3axis"] = pp3.lower_step(x3, (x3 + 1) % 11)
 
     # ring-attention sequence parallelism
     from jax.sharding import Mesh
@@ -93,6 +122,33 @@ def _mode_lowerings():
                              ep_mesh)
     xm = jnp.asarray(rng.standard_normal((8 * N, 16)), jnp.float32)
     out["moe_ep"] = jax.jit(moe_mlp_sharded(ep_mesh)).lower(moe_p, xm)
+
+    # dp x ep top-2 MoE: batch over (data, expert) jointly, per-data-slice
+    # all_to_all rings, top-2 combine
+    from jax.sharding import Mesh as _Mesh
+    de_mesh = _Mesh(np.array(devices).reshape(2, N // 2),
+                    ("data", "expert"))
+    moe_p2 = shard_moe_params(init_moe(jax.random.PRNGKey(1), 16, N // 2,
+                                       32), de_mesh)
+    out["dp_ep_moe_top2"] = jax.jit(
+        moe_mlp_sharded(de_mesh, k=2, data_axis="data")).lower(moe_p2, xm)
+
+    # model-sharded word2vec: syn0/syn1 column-shard over "model", the
+    # flush step's logit psum is the only collective
+    from deeplearning4j_tpu.models.embeddings.learning import SkipGram
+    from deeplearning4j_tpu.models.embeddings.lookup_table import \
+        InMemoryLookupTable
+    from deeplearning4j_tpu.models.word2vec.vocab import VocabCache
+    vocab = VocabCache()
+    for i in range(50):
+        vocab.add_token(f"w{i}", count=5)
+    vocab.finish()
+    table = InMemoryLookupTable(vocab, vector_length=8 * N, seed=1,
+                                negative=3, use_hs=False).reset_weights()
+    sg = SkipGram(batch_pairs=256)
+    sg.configure(vocab, table, window=3, negative=3, use_hs=False, seed=1,
+                 mesh=make_mesh(n_data=1, n_model=N, devices=devices))
+    out["w2v_model_sharded"] = sg.lower_step()
     return out
 
 

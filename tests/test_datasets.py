@@ -663,3 +663,116 @@ class TestWirePipeline:
         it = AsyncDataSetIterator(ArraysDataSetIterator((x, y), batch_size=4))
         with pytest.raises(RuntimeError, match="underlying iterator"):
             it.set_pre_processor(lambda ds: ds)
+
+
+class TestAsyncOverlap:
+    """Pipeline overlap proven WITHOUT the tunnel (VERDICT r5 next #4):
+    fit(AsyncDataSetIterator) on the CPU backend with a synthetic
+    per-batch host delay on the feed side and a synthetic per-step delay
+    on the compute side — epoch time must approach max(compute, feed),
+    not their sum — plus the wire-bytes pin for the uint8 path."""
+
+    DELAY = 0.04
+    N_BATCHES = 10
+
+    def _slow_feed(self):
+        import time
+
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import (
+            DataSetIterator)
+        rng = np.random.default_rng(0)
+        x = rng.random((self.N_BATCHES * 8, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[
+            rng.integers(0, 3, self.N_BATCHES * 8)]
+        batches = list(DataSet(x, y).batch_by(8))
+        delay = self.DELAY
+
+        class SlowIterator(DataSetIterator):
+            """Simulates a host-bound source (decode/augment/disk): each
+            next_batch costs `delay` seconds of host time."""
+
+            def __init__(self):
+                self._i = 0
+
+            def has_next(self):
+                return self._i < len(batches)
+
+            def next_batch(self):
+                time.sleep(delay)
+                b = batches[self._i]
+                self._i += 1
+                return b
+
+            def reset(self):
+                self._i = 0
+
+        return SlowIterator(), batches[0]
+
+    def _net(self):
+        from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        conf = (NeuralNetConfiguration.Builder().seed(7)
+                .updater("sgd").learning_rate(0.01).list()
+                .layer(0, DenseLayer(n_out=8, activation="relu"))
+                .layer(1, OutputLayer(n_out=3, activation="softmax",
+                                      loss_function="mcxent"))
+                .set_input_type(InputType.feed_forward(5))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_fit_overlaps_feed_with_compute(self):
+        """With feed = compute = N*d, an overlapped pipeline finishes in
+        ~max(feed, compute) = N*d; a serialized one needs the sum 2*N*d.
+        The prefetch thread must hide the feed delay behind the training
+        thread's per-step work (here a listener-side sleep standing in
+        for step compute)."""
+        import time
+
+        it, warm = self._slow_feed()
+        net = self._net()
+        net.fit(warm)                       # compile off the clock
+        delay = self.DELAY
+
+        class SlowListener:
+            def iteration_done(self, model, iteration):
+                time.sleep(delay)           # synthetic per-step compute
+
+        net.set_listeners(SlowListener())
+        t0 = time.perf_counter()
+        net.fit(it)
+        elapsed = time.perf_counter() - t0
+        feed = compute = self.N_BATCHES * delay
+        serial = feed + compute
+        assert net.conf.iteration_count >= self.N_BATCHES
+        # can't beat the slower side...
+        assert elapsed >= max(feed, compute) * 0.9, elapsed
+        # ...but must clearly beat the serialized sum (75% margin keeps
+        # this robust to a loaded CI host)
+        assert elapsed < 0.75 * serial, (
+            f"epoch took {elapsed:.2f}s vs serialized {serial:.2f}s — "
+            f"feed is not overlapping compute")
+
+    def test_uint8_wire_bytes_staged(self):
+        """The uint8 wire carries 1 byte/element to the device — 4x fewer
+        than the f32 wire the reference-style host transform would ship;
+        the staged array must still BE uint8 (the device transform, when
+        attached, casts on chip, not on the wire)."""
+        from deeplearning4j_tpu.datasets.iterators import (
+            ArraysDataSetIterator, AsyncDataSetIterator)
+        rng = np.random.default_rng(1)
+        x8 = rng.integers(0, 256, (8, 4, 4, 3), dtype=np.uint8)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        it = AsyncDataSetIterator(
+            ArraysDataSetIterator((x8, y), batch_size=8),
+            transfer_dtype="bfloat16")
+        staged = it.next_batch()
+        assert staged.features.dtype == np.uint8
+        assert staged.features.nbytes == x8.size          # 1 byte/elem
+        # 4x fewer wire bytes than the reference-style host f32 transform
+        f32_wire = x8.astype(np.float32).nbytes
+        assert staged.features.nbytes * 4 == f32_wire
+        # and it IS on device (the staging hop happened)
+        assert hasattr(staged.features, "devices")

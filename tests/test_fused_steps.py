@@ -1,0 +1,370 @@
+"""Fused multi-step training (ISSUE 3 acceptance criteria).
+
+The K-batches-per-dispatch fit loops (nn/fused.py, `net.fused_steps(K)`)
+are pinned against the sequential single-step loops:
+
+  (a) fused_steps=K is BIT-IDENTICAL to K sequential dispatches —
+      params, updater state, model state, rng stream, iteration
+      counters, score — for the batch loop, the TBPTT loop (carries
+      threaded through the scan) and the ComputationGraph twins;
+  (b) fused_steps=1 compiles HLO IDENTICAL to today's step (the
+      collect_acts/emit_health pin style) and never builds a scan;
+  (c) a ragged tail — K not dividing the epoch, or mixed batch shapes —
+      falls back to single-step dispatches with an unchanged stream;
+  (d) the training-health watchdog composes: per-inner-step health comes
+      out as scan ys, the on-device gate_update skip works INSIDE the
+      scan (counters aligned with sequential), a rollback landing
+      mid-super-batch restores and replays the remaining staged batches
+      (final state bit-identical to the sequential run), and the
+      checkpoint cadence is counted in OPTIMIZER STEPS (groups clip at
+      checkpoint boundaries, so round checkpoints don't stretch by K);
+  (e) listeners see every optimizer step (per-step scores from the
+      stacked report), not every dispatch.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (ComputationGraph, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.common.health import TrainingHealthPolicy
+from deeplearning4j_tpu.common.resilience import FaultInjector
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (DataSetValidator,
+                                                   ListDataSetIterator,
+                                                   ValidatingDataSetIterator)
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, GravesLSTM,
+                                               OutputLayer, RnnOutputLayer)
+
+
+def _net(seed=7):
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, DenseLayer(n_out=8, activation="relu"))
+            .layer(1, OutputLayer(n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _reg_net(seed=7):
+    """MSE head: a value-poisoned batch deterministically explodes the
+    gradient norm (see test_training_health._reg_net)."""
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, DenseLayer(n_out=8, activation="identity"))
+            .layer(1, OutputLayer(n_out=3, activation="identity",
+                                  loss_function="mse"))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _rnn_net(seed=7):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).data_type("float32")
+            .updater("sgd").learning_rate(0.05).list()
+            .layer(0, GravesLSTM(n_out=12, activation="tanh"))
+            .layer(1, RnnOutputLayer(n_out=4, activation="softmax",
+                                     loss_function="mcxent"))
+            .backprop_type("tbptt").t_bptt_forward_length(4)
+            .set_input_type(InputType.recurrent(6))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _cg(seed=3):
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater("adam").learning_rate(0.01).graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=6, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss_function="mcxent"), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(5))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def _data(n=96, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.random((n, 5)).astype(np.float32)
+    w = r.random((5, 3))
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return DataSet(x, y)
+
+
+def _assert_training_state_equal(a, b, iterations):
+    import jax
+    np.testing.assert_array_equal(a.params(), b.params())
+    for x, y in zip(jax.tree.leaves(a._updater_state),
+                    jax.tree.leaves(b._updater_state)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert a.conf.iteration_count == b.conf.iteration_count == iterations
+    assert (float(a._loop["iteration"]) == float(b._loop["iteration"])
+            == float(iterations))
+    np.testing.assert_array_equal(np.asarray(a._loop["rng"]),
+                                  np.asarray(b._loop["rng"]))
+    assert float(a._score) == float(b._score)
+
+
+def _valit(batches, call, poison):
+    """Iterator that poisons the features of batch `call` via the
+    injector's data.batch site (the PR 2 corruption seam)."""
+    inj = FaultInjector(seed=0)
+    inj.plan("data.batch", on_call=call, corrupt=poison)
+    v = DataSetValidator(policy="count", check_finite=False,
+                         fault_injector=inj)
+    return ValidatingDataSetIterator(ListDataSetIterator(batches), v)
+
+
+# ---------------------------------------------------------------------------
+# (b) fused_steps=1: HLO identical to today's step; K>1 builds a scan
+# ---------------------------------------------------------------------------
+
+def _lower_fit_step(net):
+    import jax
+    step = net._make_step()
+    loop = {"iteration": np.float32(0), "rng": jax.random.PRNGKey(0)}
+    return step.lower(net._params, net._updater_state, net._model_state,
+                      loop, np.zeros((4, 5), np.float32),
+                      np.zeros((4, 3), np.float32), None, None).as_text()
+
+
+def test_fused_steps_1_hlo_identical():
+    base = _lower_fit_step(_net())
+    armed = _lower_fit_step(_net().fused_steps(1))
+    assert armed == base
+    # the K>1 program is a genuine scan (lowers to a while loop) and the
+    # single-step program is not
+    import jax
+    net = _net().fused_steps(4)
+    from deeplearning4j_tpu.nn import fused as F
+    raw = net.make_raw_step()
+
+    def prog(params, ustate, state, loop, batch_list):
+        return F.scan_batches(raw, params, ustate, state, loop, batch_list)
+
+    batch = {"features": np.zeros((4, 5), np.float32),
+             "labels": np.zeros((4, 3), np.float32),
+             "fmask": None, "lmask": None}
+    loop = {"iteration": np.float32(0), "rng": jax.random.PRNGKey(0)}
+    fused_txt = jax.jit(prog).lower(
+        net._params, net._updater_state, net._model_state, loop,
+        (batch,) * 4).as_text()
+    # the scan adds a while loop beyond whatever the single-step program
+    # already carries (the threefry rng split lowers to one)
+    assert (fused_txt.count("stablehlo.while")
+            > base.count("stablehlo.while"))
+
+
+# ---------------------------------------------------------------------------
+# (a) bit-identical to sequential dispatches
+# ---------------------------------------------------------------------------
+
+def test_fused_batch_loop_bit_identical():
+    batches = list(_data(96, seed=1).batch_by(16))     # 6 batches, K=3
+    a = _net(3)
+    a.fit(ListDataSetIterator(batches))
+    b = _net(3).fused_steps(3)
+    b.fit(ListDataSetIterator(batches))
+    _assert_training_state_equal(a, b, 6)
+
+
+def test_fused_multi_epoch_bit_identical():
+    batches = list(_data(96, seed=2).batch_by(16))
+    a = _net(5)
+    a.fit(ListDataSetIterator(batches), num_epochs=2)
+    b = _net(5).fused_steps(3)
+    b.fit(ListDataSetIterator(batches), num_epochs=2)
+    _assert_training_state_equal(a, b, 12)
+
+
+# ---------------------------------------------------------------------------
+# (c) ragged tails fall back to single-step dispatches
+# ---------------------------------------------------------------------------
+
+def test_fused_ragged_tail_falls_back():
+    # 7 batches with K=3 -> two fused groups + 1 single; last batch is
+    # also SHORTER (112 % 16 = 0, so force a short tail by slicing)
+    ds = _data(104, seed=3)                  # 6x16 + one 8-row tail
+    batches = list(ds.batch_by(16))
+    assert batches[-1].num_examples() == 8
+    a = _net(4)
+    a.fit(ListDataSetIterator(batches))
+    b = _net(4).fused_steps(3)
+    b.fit(ListDataSetIterator(batches))
+    _assert_training_state_equal(a, b, 7)
+
+
+def test_fused_k_larger_than_epoch_falls_back():
+    batches = list(_data(32, seed=4).batch_by(16))     # 2 batches, K=8
+    a = _net(6)
+    a.fit(ListDataSetIterator(batches))
+    b = _net(6).fused_steps(8)
+    b.fit(ListDataSetIterator(batches))
+    _assert_training_state_equal(a, b, 2)
+
+
+# ---------------------------------------------------------------------------
+# (a) TBPTT: segments fused per dispatch, carries threaded through scan
+# ---------------------------------------------------------------------------
+
+def test_fused_tbptt_bit_identical_with_ragged_tail():
+    r = np.random.default_rng(0)
+    B, T, F, C = 8, 18, 6, 4       # L=4 -> 4 full segments + short tail
+    x = r.random((B, T, F)).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[r.integers(0, C, (B, T))]
+    ds = DataSet(x, y)
+    a = _rnn_net(3)
+    a.fit(ds)
+    b = _rnn_net(3).fused_steps(3)
+    b.fit(ds)
+    _assert_training_state_equal(a, b, 5)    # 4 full + 1 tail segment
+
+
+# ---------------------------------------------------------------------------
+# ComputationGraph twins
+# ---------------------------------------------------------------------------
+
+def _cg_rnn(seed=5):
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater("adam").learning_rate(0.01)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", GravesLSTM(n_out=8, activation="tanh"), "in")
+            .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                             loss_function="mcxent"), "lstm")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(4))
+            .backprop_type("tbptt").t_bptt_forward_length(5)
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def test_cg_fused_tbptt_bit_identical():
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    r = np.random.default_rng(0)
+    x = r.random((2, 20, 4)).astype(np.float32)     # L=5 -> 4 segments
+    y = np.eye(3, dtype=np.float32)[r.integers(0, 3, (2, 20))]
+    a = _cg_rnn(5)
+    a.fit(MultiDataSet([x], [y]))
+    b = _cg_rnn(5).fused_steps(4)
+    b.fit(MultiDataSet([x], [y]))
+    np.testing.assert_array_equal(a.params(), b.params())
+    assert a.conf.iteration_count == b.conf.iteration_count == 4
+    assert float(a._score) == float(b._score)
+
+
+def test_cg_fused_batch_loop_bit_identical():
+    import jax
+    batches = list(_data(96, seed=5).batch_by(16))
+    a = _cg(3)
+    a.fit(ListDataSetIterator(batches))
+    b = _cg(3).fused_steps(3)
+    b.fit(ListDataSetIterator(batches))
+    np.testing.assert_array_equal(a.params(), b.params())
+    for x, y in zip(jax.tree.leaves(a._updater_state),
+                    jax.tree.leaves(b._updater_state)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert a.conf.iteration_count == b.conf.iteration_count == 6
+    assert float(a._score) == float(b._score)
+
+
+# ---------------------------------------------------------------------------
+# (d) health watchdog composition
+# ---------------------------------------------------------------------------
+
+def test_fused_nan_skip_inside_scan_counters_aligned():
+    batches = list(_data(128, seed=6).batch_by(16))    # 8 batches
+    pol_a = TrainingHealthPolicy(max_consecutive_bad=5)
+    a = _net(11).training_health(pol_a)
+    a.fit(_valit(batches, 2, "nan"))
+    pol_b = TrainingHealthPolicy(max_consecutive_bad=5)
+    b = _net(11).fused_steps(4).training_health(pol_b)
+    b.fit(_valit(batches, 2, "nan"))
+    # the poisoned step was skipped ON DEVICE inside the scan; host
+    # counters classified the stacked report step-by-step
+    assert pol_b.counts["skips"] == 1 and pol_b.counts["ok"] == 7
+    assert pol_a.counts == pol_b.counts
+    np.testing.assert_array_equal(a.params(), b.params())
+    assert b.conf.iteration_count == 8
+    assert float(b._loop["iteration"]) == 8.0
+
+
+def test_fused_rollback_mid_super_batch(tmp_path):
+    batches = list(_data(128, seed=7).batch_by(16))    # 8 batches
+    pol_a = TrainingHealthPolicy(grad_norm_limit=50.0,
+                                 max_consecutive_bad=4)
+    a = _reg_net(4).training_health(pol_a, checkpoint_dir=tmp_path / "a",
+                                    checkpoint_every=2)
+    a.fit(_valit(batches, 4, 500.0))
+    pol_b = TrainingHealthPolicy(grad_norm_limit=50.0,
+                                 max_consecutive_bad=4)
+    b = _reg_net(4).fused_steps(4).training_health(
+        pol_b, checkpoint_dir=tmp_path / "b", checkpoint_every=2)
+    b.fit(_valit(batches, 4, 500.0))
+    # divergence at optimizer step 4 (inner step of a fused group):
+    # restore + replay of the remaining staged batches == sequential
+    assert pol_b.counts["spikes"] == 1
+    assert pol_b.counts["rollbacks"] == 1
+    assert pol_a.counts == pol_b.counts
+    np.testing.assert_array_equal(a.params(), b.params())
+    assert a.conf.iteration_count == b.conf.iteration_count == 7
+
+
+def test_fused_checkpoint_cadence_in_optimizer_steps(tmp_path):
+    """checkpoint_every=2 with fused_steps=8: groups clip at checkpoint
+    boundaries, so the manager holds the SAME step labels as the
+    sequential run — the cadence is counted in optimizer steps and never
+    silently stretches by K."""
+    batches = list(_data(128, seed=8).batch_by(16))    # 8 batches
+    nets = {}
+    for name, k in (("seq", 1), ("fused", 8)):
+        pol = TrainingHealthPolicy(max_consecutive_bad=5)
+        n = _net(9).fused_steps(k).training_health(
+            pol, checkpoint_dir=tmp_path / name, checkpoint_every=2,
+            keep_checkpoints=16)
+        n.fit(ListDataSetIterator(batches))
+        nets[name] = n
+    seq_steps = nets["seq"]._health_ckpt.steps()
+    fused_steps = nets["fused"]._health_ckpt.steps()
+    assert seq_steps == fused_steps == [2, 4, 6, 8]
+    np.testing.assert_array_equal(nets["seq"].params(),
+                                  nets["fused"].params())
+
+
+def test_fused_abort_raises_like_sequential():
+    from deeplearning4j_tpu.common.health import TrainingDivergedError
+    bad = DataSet(np.full((16, 5), np.nan, np.float32),
+                  np.eye(3, dtype=np.float32)[np.zeros(16, int)])
+    pol = TrainingHealthPolicy(max_consecutive_bad=2)
+    net = _net(10).fused_steps(4).training_health(pol)
+    net.fit(_data(32, seed=9))
+    with pytest.raises(TrainingDivergedError, match="offending rounds"):
+        net.fit(ListDataSetIterator([bad] * 4))
+    assert pol.counts["aborts"] == 1
+
+
+# ---------------------------------------------------------------------------
+# (e) listeners see every optimizer step with its own score
+# ---------------------------------------------------------------------------
+
+def test_fused_listeners_see_every_step():
+    batches = list(_data(96, seed=10).batch_by(16))
+
+    class Recorder:
+        def __init__(self):
+            self.iters = []
+            self.scores = []
+
+        def iteration_done(self, model, iteration):
+            self.iters.append(iteration)
+            self.scores.append(float(model.score()))
+
+    rec_a, rec_b = Recorder(), Recorder()
+    a = _net(12).set_listeners(rec_a)
+    a.fit(ListDataSetIterator(batches))
+    b = _net(12).fused_steps(3).set_listeners(rec_b)
+    b.fit(ListDataSetIterator(batches))
+    assert rec_a.iters == rec_b.iters == list(range(6))
+    assert rec_a.scores == rec_b.scores   # per-step, from the stacked ys
