@@ -18,6 +18,10 @@ plus beyond-reference extras (budget permitting, skipped first):
   7. flash_attention_8k Pallas flash kernel vs XLA softmax at T=8192
                         (vs_baseline = measured speedup over XLA)
   8. decode_tokens_sec  TransformerLM KV-cache decode tokens/s (batch 1 / 8)
+  9. served_throughput  end-to-end serving: ContinuousDecodeServer
+                        (iteration-level batching) vs static gang batching
+                        over mixed-length requests, tokens/s + request
+                        p50/p99 (the SLO view; serving/ subsystem)
 
 Output protocol (round-4 restructure — the r2 record died to a driver
 timeout with output buffered (rc=124) and the r3 record died to an
@@ -579,6 +583,84 @@ def bench_decode(rng, small=False):
     return rec
 
 
+def bench_served(rng, small=False):
+    """End-to-end SERVING throughput: the ContinuousDecodeServer
+    (iteration-level batching, serving/decode.py) against the same
+    machinery in static gang-batching mode, over a mixed-length request
+    stream — the workload shape where continuous batching earns its keep.
+    Interleaved same-process protocol; request-level p50/p99 come from
+    the servers' own ServingMetrics (a serving SLO is a percentile).
+    CPU-backend numbers + protocol in PERF.md; tools/serve_ab.py is the
+    richer standalone version of this config."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+    from deeplearning4j_tpu.serving import ContinuousDecodeServer
+
+    V, L, D, H = (96, 2, 32, 2) if small else (512, 4, 256, 8)
+    max_len = 64 if small else 160
+    slots = 4 if small else 8
+    # the backlog must stay several waves deep or both schedulers converge
+    # (continuous earns its margin REFILLING slots from a queue)
+    n_req = 16 if small else 24
+    lm = TransformerLM(V, d_model=D, n_heads=H, n_layers=L,
+                       max_len=max_len, dtype=jnp.float32)
+    servers = {
+        "continuous": ContinuousDecodeServer(
+            lm, slots=slots, prompt_buckets=(8, 16),
+            max_queue=4 * n_req).start(),
+        "static": ContinuousDecodeServer(
+            lm, slots=slots, prompt_buckets=(8, 16), max_queue=4 * n_req,
+            static_batching=True).start(),
+    }
+
+    def workload(seed, n):
+        r = np.random.default_rng(seed)
+        return [(r.integers(1, V, int(r.integers(3, 16))).tolist(),
+                 int(r.integers(4, max_len - 16 - 4)))
+                for _ in range(n)]
+
+    for srv in servers.values():       # compile off the clock
+        for p, n in workload(0, 4):
+            srv.generate(p, n, timeout=300)
+
+    seg_idx = {name: [0] for name in servers}
+
+    def seg(name):
+        srv = servers[name]
+
+        def run():
+            work = workload(100 + seg_idx[name][0], n_req)
+            seg_idx[name][0] += 1
+            toks = sum(n for _, n in work)
+            t0 = time.perf_counter()
+            for f in [srv.submit(p, n) for p, n in work]:
+                f.result(600)
+            return toks / (time.perf_counter() - t0)
+        return run
+
+    ab = _interleaved_median({n: seg(n) for n in servers},
+                             segments=3 if small else 5)
+    snaps = {n: servers[n].metrics.snapshot() for n in servers}
+    for srv in servers.values():
+        srv.stop()
+    rec = {"value": ab["continuous"]["median"], "unit": "tokens/sec",
+           "config": f"ContinuousDecodeServer L={L} d={D} slots={slots}, "
+                     f"mixed prompts/decode lengths, {n_req} reqs/seg, "
+                     f"interleaved median vs static gang batching",
+           "serving_ab": ab,
+           "continuous_over_static": round(
+               ab["continuous"]["median"] / ab["static"]["median"], 3),
+           "vs_baseline": round(ab["continuous"]["median"]
+                                / BASELINE_DECODE_TOKENS_PER_SEC, 3)}
+    for n, s in snaps.items():
+        rec[f"p50_request_ms_{n}"] = round(s["latency_ms_p50"], 3)
+        rec[f"p99_request_ms_{n}"] = round(s["latency_ms_p99"], 3)
+        rec[f"occupancy_{n}"] = round(s["batch_occupancy_mean"], 3)
+    return rec
+
+
 def bench_parallel_wrapper(rng, small=False):
     import jax
     import numpy as np
@@ -633,6 +715,7 @@ SECONDARY_CONFIGS = {
     "char_rnn_lstm": (bench_char_rnn, 120),
     "word2vec_skipgram": (bench_word2vec, 90),
     "decode_tokens_sec": (bench_decode, 100),
+    "served_throughput": (bench_served, 110),
     "resnet50_fit_pipeline": (bench_resnet50_pipeline, 150),
     "flash_attention_8k": (bench_flash_attention, 110),
     "parallel_wrapper_resnet50": (bench_parallel_wrapper, 120),

@@ -100,6 +100,33 @@ def _leaves(tree):
     return jax.tree.leaves(tree)
 
 
+def rowwise_finite(tree, batch_axis=0):
+    """Per-example finiteness of an inference OUTPUT pytree: bool [B]
+    numpy vector, True where every leaf's row `b` is all-finite. The
+    serving layer's optional output screen (`InferenceServer(
+    screen_outputs=True)`) uses it to fail ONLY the poisoned requests in
+    a micro-batch instead of the whole dispatch — the inference-side
+    analog of the training watchdog's NaN/Inf skip. Host-side numpy on
+    results that are already being shipped to callers, so it adds no
+    device round-trip."""
+    import numpy as np
+    ok = None
+    for leaf in _leaves(tree):
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.integer) or a.dtype == np.bool_:
+            continue                  # ints/bools can't be non-finite
+        if a.dtype.kind not in "fc":
+            # ml_dtypes bfloat16/f8 (kind 'V'): no native isfinite — the
+            # f32 cast is exact for them. Native f16/f32/f64/complex are
+            # checked in their OWN precision (casting f64 to f32 would
+            # flag finite values beyond f32 range as inf).
+            a = a.astype(np.float32)
+        axes = tuple(i for i in range(a.ndim) if i != batch_axis)
+        row_ok = np.isfinite(a).all(axis=axes)
+        ok = row_ok if ok is None else (ok & row_ok)
+    return ok
+
+
 def gate_update(ok, new_tree, old_tree):
     """Conditionally apply an update inside the compiled step: every leaf
     becomes `jnp.where(ok, new, old)`, so a step whose health predicate is

@@ -272,6 +272,129 @@ def make_decode_block_fn(n_heads):
     return block_decode
 
 
+def make_slot_decode_block_fn(n_heads):
+    """`make_decode_block_fn` generalized to a FIXED-SLOT serving batch:
+    per-slot cache positions and an active mask, the decode unit of the
+    continuous-batching scheduler (`serving/decode.py`).
+
+    block_decode(p, x [S, D], cache {k,v: [S, L, H, hd]}, pos [S],
+                 active [S] bool) -> (y [S, D], updated cache)
+
+    Every slot's row is computed unconditionally (shapes stay static — ONE
+    compiled program no matter which slots are occupied), but the cache
+    write is GATED: an inactive slot writes back the rows it already held,
+    so its cache stays bit-identical while neighbours decode. Each row
+    depends only on its own x/cache/pos rows, which is what makes a
+    request's token stream independent of who shares the batch (the
+    continuous-decode determinism pin)."""
+
+    def block_decode(p, x, cache, pos, active):
+        S, D = x.shape
+        H = n_heads
+        hd = D // H
+        h = _layer_norm(x, p["ln1"]["g"], p["ln1"]["b"])
+        qkv = h @ p["attn"]["wqkv"]                     # [S, 3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        rows = jnp.arange(S)
+        gate = active[:, None, None]
+        old_k = cache["k"][rows, pos]                   # [S, H, hd]
+        old_v = cache["v"][rows, pos]
+        k_cache = cache["k"].at[rows, pos].set(
+            jnp.where(gate, k.reshape(S, H, hd), old_k))
+        v_cache = cache["v"].at[rows, pos].set(
+            jnp.where(gate, v.reshape(S, H, hd), old_v))
+        qh = q.reshape(S, H, hd)
+        scores = jnp.einsum("shd,slhd->shl", qh,
+                            k_cache) / math.sqrt(hd)    # [S, H, L]
+        L = k_cache.shape[1]
+        mask = jnp.arange(L)[None, None, :] <= pos[:, None, None]
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        att = jax.nn.softmax(scores.astype(jnp.float32),
+                             -1).astype(x.dtype)
+        out = jnp.einsum("shl,slhd->shd", att, v_cache).reshape(S, D)
+        x = x + out @ p["attn"]["wo"]
+        h = _layer_norm(x, p["ln2"]["g"], p["ln2"]["b"])
+        m = jax.nn.gelu(h @ p["mlp"]["w1"] + p["mlp"]["b1"])
+        y = x + m @ p["mlp"]["w2"] + p["mlp"]["b2"]
+        return y, {"k": k_cache, "v": v_cache}
+
+    return block_decode
+
+
+def make_slot_decode_fn(n_heads):
+    """One ITERATION of continuous-batching decode, the whole model:
+
+    step(aux, blocks, cache, pos [S], tok [S], active [S])
+      -> (next_tok [S] i32, logits [S, V] f32, new cache, new pos)
+
+    Greedy on-device argmax (f32 logits — tie-break parity with
+    `generate_batch`); inactive slots compute but change nothing (gated
+    cache writes, pos advances by `active`). The scheduler jits this ONCE
+    per slot count and calls it every token iteration, swapping requests
+    in and out of slots between calls — Orca-style iteration-level
+    scheduling."""
+    block_decode = make_slot_decode_block_fn(n_heads)
+
+    def step(aux, blocks, cache, pos, tok, active):
+        x = aux["tok"][tok] + aux["pos"][pos]           # [S, D]
+        new_cache = []
+        for p, c in zip(blocks, cache):
+            x, c = block_decode(p, x, c, pos, active)
+            new_cache.append(c)
+        logits = logits_fn(aux, x).astype(jnp.float32)  # [S, V]
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        new_pos = pos + active.astype(pos.dtype)
+        return nxt, logits, new_cache, new_pos
+
+    return step
+
+
+def prefill_forward(aux, blocks, tokens, n_heads, cache_len):
+    """One causal forward over `tokens` [B, P] through the SHARED
+    attention core (`causal_attention(return_kv=True)`), filling rows
+    [0, P) of a length-`cache_len` KV cache per layer. Returns
+    (h [B, P, D], cache). The ONE prefill implementation: `generate_batch`
+    and the serving prefill programs both call it, so serving can never
+    drift from the pinned generation numerics."""
+    B, P = tokens.shape
+    h = embed_fn(aux, tokens)
+    cache = []
+    for p in blocks:
+        hn = _layer_norm(h, p["ln1"]["g"], p["ln1"]["b"])
+        att, kp, vp = causal_attention(
+            hn, p["attn"]["wqkv"], p["attn"]["wo"], n_heads,
+            return_kv=True)
+        h = h + att
+        hn = _layer_norm(h, p["ln2"]["g"], p["ln2"]["b"])
+        m = jax.nn.gelu(hn @ p["mlp"]["w1"] + p["mlp"]["b1"])
+        h = h + m @ p["mlp"]["w2"] + p["mlp"]["b2"]
+        z = jnp.zeros((B, cache_len, n_heads, kp.shape[-1]), kp.dtype)
+        cache.append({"k": z.at[:, :P].set(kp),
+                      "v": z.at[:, :P].set(vp)})
+    return h, cache
+
+
+def make_prefill_fn(n_heads, cache_len):
+    """Serving prefill program for ONE request, prompt right-padded to a
+    length bucket:
+
+    prefill(aux, blocks, prompt [1, Pb], length scalar)
+      -> (logits [1, V] f32 at the last REAL token, cache rows)
+
+    Causal masking makes positions < length independent of the padding
+    tail; the tail's garbage k/v rows are installed too but are
+    OVERWRITTEN by decode steps before any query can attend to them
+    (decode writes position `pos` before attending through it), so
+    bucket-padded prefill is exact, not approximate."""
+
+    def prefill(aux, blocks, prompt, length):
+        h, cache = prefill_forward(aux, blocks, prompt, n_heads, cache_len)
+        logits = logits_fn(aux, h[:, length - 1]).astype(jnp.float32)
+        return logits, cache
+
+    return prefill
+
+
 def init_kv_cache(n_layers, batch, max_len, d_model, n_heads,
                   dtype=jnp.float32):
     hd = d_model // n_heads
@@ -490,29 +613,11 @@ class TransformerLM:
                 # numpy pick()
                 return logits_fn(aux, x).astype(jnp.float32), new_cache
 
-            def prefill_block(p, h):
-                """make_block_fn's forward, via the SHARED attention core
-                (return_kv=True), whole prompt in parallel."""
-                hn = _layer_norm(h, p["ln1"]["g"], p["ln1"]["b"])
-                att, kp, vp = causal_attention(
-                    hn, p["attn"]["wqkv"], p["attn"]["wo"], n_heads,
-                    return_kv=True)
-                h = h + att
-                hn = _layer_norm(h, p["ln2"]["g"], p["ln2"]["b"])
-                m = jax.nn.gelu(hn @ p["mlp"]["w1"] + p["mlp"]["b1"])
-                h = h + m @ p["mlp"]["w2"] + p["mlp"]["b2"]
-                return h, kp, vp
-
             def gen(aux, blocks, prompts, temp, rng):
-                # parallel prefill: one causal pass fills the caches
-                h = embed_fn(aux, prompts)                 # [B, P, D]
-                cache = []
-                for p in blocks:
-                    h, kp, vp = prefill_block(p, h)
-                    z = jnp.zeros((B, max_len, n_heads,
-                                   kp.shape[-1]), kp.dtype)
-                    cache.append({"k": z.at[:, :P].set(kp),
-                                  "v": z.at[:, :P].set(vp)})
+                # parallel prefill: one causal pass fills the caches (the
+                # SHARED implementation serving's prefill programs use)
+                h, cache = prefill_forward(aux, blocks, prompts, n_heads,
+                                           max_len)
                 logit = logits_fn(aux, h[:, -1]).astype(jnp.float32)
                 pos = jnp.asarray(P, jnp.int32)
 

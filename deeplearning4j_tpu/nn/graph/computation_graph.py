@@ -840,6 +840,26 @@ class ComputationGraph:
 
     feedForward = feed_forward
 
+    def make_inference_fn(self):
+        """PURE inference step `(params, state, x) -> [outputs]` — the
+        MultiLayerNetwork.make_inference_fn twin for the serving layer.
+        `x` is a single array (single-input graphs — the serving batcher
+        coalesces one request tensor) or a dict name->array for
+        multi-input graphs. train=False + constant rng: pure in
+        (params, state, x), so serving determinism pins hold; params are
+        arguments, so hot swap needs no recompile."""
+        self._ensure_init()
+        in_names = list(self.conf.network_inputs)
+
+        def infer(params, state, x):
+            inputs = x if isinstance(x, dict) else {in_names[0]: x}
+            rng = jax.random.PRNGKey(0)
+            acts, _, _, _ = self._apply_graph(params, state, inputs,
+                                              train=False, rng=rng)
+            return [acts[n] for n in self.conf.network_outputs]
+
+        return infer
+
     # ------------------------------------------------------------------
     # Score / gradients (gradient-check compatible API)
     # ------------------------------------------------------------------

@@ -739,6 +739,26 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # Inference — reference output(:1521)/feedForward(:657)
     # ------------------------------------------------------------------
+    def _forward_out(self, params, state, x, *, train, rng, fmask=None):
+        """Pure forward to the OUTPUT layer's activation — the ONE
+        implementation behind `output()` and `make_inference_fn()` (a fix
+        in one must reach the other or the serving layer's bit-identity
+        pin against `output()` silently breaks)."""
+        h, _, _, _ = self._output_layer_input(params, state, x,
+                                              train=train, rng=rng,
+                                              fmask=fmask)
+        out_layer = self.layers[-1]
+        i = len(self.layers) - 1
+        p = jax.tree.map(lambda a: a.astype(self.compute_dtype)
+                         if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                         params[i])
+        lrng = jax.random.fold_in(rng, i)
+        if out_layer.has_state():
+            out, _ = out_layer.forward_with_state(
+                p, h, state[i], train=train, rng=lrng)
+            return out
+        return out_layer.forward(p, h, train=train, rng=lrng)
+
     def output(self, x, train=False, features_mask=None):
         """Forward pass to the output layer. `features_mask` carries
         variable-length sequence masks through recurrent layers, matching the
@@ -749,20 +769,8 @@ class MultiLayerNetwork:
         key = ("output", bool(train), fmask is not None)
         if key not in self._jit_forward:
             def fwd(params, state, x, fmask, rng):
-                h, _, _, _ = self._output_layer_input(params, state, x,
-                                                   train=train, rng=rng,
-                                                   fmask=fmask)
-                out_layer = self.layers[-1]
-                i = len(self.layers) - 1
-                p = jax.tree.map(lambda a: a.astype(self.compute_dtype)
-                                 if jnp.issubdtype(a.dtype, jnp.floating) else a,
-                                 params[i])
-                lrng = jax.random.fold_in(rng, i)
-                if out_layer.has_state():
-                    out, _ = out_layer.forward_with_state(
-                        p, h, state[i], train=train, rng=lrng)
-                    return out
-                return out_layer.forward(p, h, train=train, rng=lrng)
+                return self._forward_out(params, state, x, train=train,
+                                         rng=rng, fmask=fmask)
             self._jit_forward[key] = jax.jit(fwd)
         self._rng, rng = jax.random.split(self._rng)
         return self._jit_forward[key](self._params, self._model_state, x,
@@ -778,6 +786,24 @@ class MultiLayerNetwork:
         return [x] + acts
 
     feedForward = feed_forward
+
+    def make_inference_fn(self):
+        """PURE inference step `(params, state, x) -> out` — the compilation
+        unit the serving layer (`serving/InferenceServer`) jits per padding
+        bucket. train=False with a CONSTANT rng key: dropout is inactive at
+        inference, so the rng never reaches the math and the program is a
+        pure function of (params, state, x) — two calls with the same
+        arguments return bit-identical outputs, which is what lets the
+        server pin micro-batched results against a batch-1 call. Params and
+        model state are ARGUMENTS (not captured), so a hot model swap is a
+        new argument, not a recompile."""
+        self._ensure_init()
+
+        def infer(params, state, x):
+            return self._forward_out(params, state, x, train=False,
+                                     rng=jax.random.PRNGKey(0))
+
+        return infer
 
     # ------------------------------------------------------------------
     # Streaming RNN inference — reference rnnTimeStep(:2196): O(1) per step,

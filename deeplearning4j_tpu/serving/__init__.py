@@ -1,0 +1,34 @@
+"""Serving layer: the request path from concurrent clients to compiled
+inference programs.
+
+The reference DL4J shipped inference as bare `output()`/`predict()` calls
+on the training container; a system that "serves heavy traffic from
+millions of users" (ROADMAP north star) needs the three mechanisms modern
+serving systems converge on, built here over the existing containers:
+
+  * `InferenceServer` — dynamic micro-batching with latency deadlines
+    (Clipper): coalesce concurrent requests, pad to a FIXED set of bucket
+    shapes so the compile cache is small and pinned, shed load explicitly.
+  * `ContinuousDecodeServer` — iteration-level batching for autoregressive
+    KV-cache decode (Orca): requests join/leave a fixed-slot decode
+    program at token granularity, prefill separated per prompt bucket.
+  * Hot model swap on both: new checkpoints route new work while in-flight
+    work drains — zero dropped requests, zero recompiles.
+
+`ServingMetrics` (p50/p99, queue depth, occupancy, shed/swap counts)
+feeds the existing UI via `ui.stats.ServingStatsReporter`; deadlines,
+backpressure, `RetryPolicy` and `FaultInjector` sites reuse
+`common/resilience.py`; NaN/Inf output screening reuses
+`common/health.py`.
+"""
+from .metrics import ServingMetrics
+from .server import (DeadlineExceededError, InferenceServer,
+                     ServerClosedError, ServerOverloadedError,
+                     ServingError, UnhealthyOutputError)
+from .decode import ContinuousDecodeServer
+
+__all__ = [
+    "InferenceServer", "ContinuousDecodeServer", "ServingMetrics",
+    "ServingError", "ServerOverloadedError", "DeadlineExceededError",
+    "UnhealthyOutputError", "ServerClosedError",
+]

@@ -1,0 +1,386 @@
+"""Continuous-batching KV-cache decode scheduler (Orca, OSDI'22).
+
+Static request batching decodes a gang of requests until the LAST one
+finishes: a 5-token reply waits for the 200-token reply it shares a batch
+with, and its slot emits padding the whole time. Iteration-level
+("continuous") batching reschedules at TOKEN granularity instead — a
+fixed-slot decode program (`models.zoo.transformer.make_slot_decode_fn`)
+runs one token for every occupied slot per dispatch, and requests join or
+leave slots BETWEEN dispatches. Prefill and decode are separated: a
+joining request's prompt runs through a per-prompt-length-bucket prefill
+program (`make_prefill_fn`) whose cache rows are scattered into the free
+slot, then the request rides the shared decode program.
+
+Determinism pin (tests/test_serving.py): a request's token stream is
+bit-identical whether it decodes alone or joins a running batch — every
+slot's row math touches only its own cache/pos/token rows, and inactive
+slots' cache writes are gated. So continuous batching is a pure
+throughput lever, not an accuracy trade.
+
+Hot swap keeps MULTIPLE param versions live while draining (one per
+undrained swap, typically two): slots keep the version they started with
+(a compiled program takes params as arguments, so versions share ONE
+executable), each iteration dispatches once per live version with the
+active mask restricted to that version's slots, and new requests route
+to the newest version immediately — zero admission stall, zero dropped
+in-flight requests. Drained versions are released on request completion
+AND on idle iterations, so repeated swaps never accumulate dead params.
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+import logging
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .server import (DeadlineExceededError, ServerClosedError,
+                     _RequestLoop)
+
+log = logging.getLogger(__name__)
+
+
+class _DecodeRequest:
+    __slots__ = ("prompt", "max_new", "future", "deadline", "t_submit",
+                 "generated", "slot", "version")
+
+    def __init__(self, prompt, max_new, deadline):
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.future = cf.Future()
+        self.deadline = deadline
+        self.t_submit = time.monotonic()
+        self.generated = []
+        self.slot = None
+        self.version = None
+
+
+class ContinuousDecodeServer(_RequestLoop):
+    """Token-granularity serving endpoint over a TransformerLM.
+
+    `submit(prompt, max_new_tokens)` returns a Future resolving to the
+    full token list (prompt + generated, greedy decode — the
+    `generate_batch` contract). `static_batching=True` degrades scheduling
+    to gang admission (a new batch only forms when every slot is free) —
+    the A/B baseline `tools/serve_ab.py` measures against, through the
+    exact same machinery.
+    """
+
+    _thread_name = "continuous-decode"
+    _default_stop_timeout = 60.0
+
+    def __init__(self, lm, slots=4, prompt_buckets=(8, 16, 32),
+                 max_queue=64, fault_injector=None, retry_policy=None,
+                 metrics=None, stats_reporter=None, report_every=64,
+                 static_batching=False):
+        from ..models.zoo.transformer import (make_prefill_fn,
+                                              make_slot_decode_fn)
+        import jax
+
+        self.lm = lm
+        self.slots = int(slots)
+        self.max_len = int(lm.aux["pos"].shape[0])
+        self.prompt_buckets = tuple(sorted(int(b) for b in prompt_buckets))
+        if self.prompt_buckets[-1] > self.max_len:
+            raise ValueError(f"largest prompt bucket "
+                             f"{self.prompt_buckets[-1]} > model max_len "
+                             f"{self.max_len}")
+        self._injector = fault_injector
+        self._retry = retry_policy
+        from .metrics import ServingMetrics
+        self.metrics = metrics or ServingMetrics()
+        self._reporter = stats_reporter
+        self._report_every = max(1, int(report_every))
+        self._static = bool(static_batching)
+
+        n_heads = lm.n_heads
+        self._n_heads = n_heads
+        self._d_model = int(lm.aux["tok"].shape[1])
+        self._cache_dtype = lm.aux["tok"].dtype
+        self._n_layers = len(lm.blocks)
+        self._versions = [(lm.aux, lm.blocks)]   # index = param version
+        self._reset_device_state()
+        # ONE decode program for the life of the server (fixed slot count;
+        # params are arguments, so hot swap reuses it). Cache and pos are
+        # donated — they are THE device state, rebound every iteration.
+        self._step = jax.jit(make_slot_decode_fn(n_heads),
+                             donate_argnums=(2, 3))
+        self._prefills = {}                      # bucket -> jitted program
+        self._make_prefill = lambda: jax.jit(make_prefill_fn(
+            n_heads, self.max_len))
+
+        def install(cache, rows, s):
+            return [{"k": c["k"].at[s].set(r["k"][0]),
+                     "v": c["v"].at[s].set(r["v"][0])}
+                    for c, r in zip(cache, rows)]
+        # only the cache is donated: its buffers alias the output exactly,
+        # while the [1, L, H, hd] prefill rows never could
+        self._install = jax.jit(install, donate_argnums=(0,))
+
+        self._swap_lock = threading.Lock()
+        self._init_loop(max_queue)
+
+    # -- client API ----------------------------------------------------
+    def submit(self, prompt, max_new_tokens, deadline_ms=None):
+        """Enqueue one decode request; Future resolves to the full token
+        list (prompt + `max_new_tokens` greedy continuations)."""
+        if not self._running:
+            raise ServerClosedError("server is not running")
+        prompt = [int(t) for t in np.asarray(prompt).ravel()]
+        if not prompt:
+            raise ValueError("prompt must contain at least one token")
+        if int(max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if len(prompt) > self.prompt_buckets[-1]:
+            raise ValueError(f"prompt length {len(prompt)} exceeds the "
+                             f"largest bucket {self.prompt_buckets[-1]}")
+        if len(prompt) + int(max_new_tokens) > self.max_len:
+            raise ValueError(
+                f"prompt+new tokens ({len(prompt)}+{max_new_tokens}) "
+                f"exceed max_len {self.max_len}")
+        if self._injector is not None:
+            self._injector.fire("serve.request")
+        self.metrics.count("received")
+        dl = (time.monotonic() + deadline_ms / 1e3
+              if deadline_ms is not None else None)
+        return self._enqueue(_DecodeRequest(prompt, max_new_tokens, dl))
+
+    def generate(self, prompt, max_new_tokens, deadline_ms=None,
+                 timeout=None):
+        """Blocking convenience wrapper over submit()."""
+        return self.submit(prompt, max_new_tokens,
+                           deadline_ms=deadline_ms).result(timeout)
+
+    # -- hot swap ------------------------------------------------------
+    def swap(self, new_lm):
+        """Route NEW requests to `new_lm`'s params while slots already
+        decoding drain on the version they started with (dual-version
+        dispatch — module docstring). Structure/shape mismatch raises."""
+        import jax
+        with self._swap_lock:
+            if self._injector is not None:
+                self._injector.fire("serve.swap")
+            new = (new_lm.aux, new_lm.blocks)
+            old_l, old_t = jax.tree_util.tree_flatten(self._versions[-1])
+            new_l, new_t = jax.tree_util.tree_flatten(new)
+            if old_t != new_t:
+                raise ValueError("swap rejected: param tree structure "
+                                 "differs from the serving model")
+            for o, n in zip(old_l, new_l):
+                if o.shape != n.shape or o.dtype != n.dtype:
+                    raise ValueError(f"swap rejected: leaf mismatch "
+                                     f"{n.shape}/{n.dtype} vs serving "
+                                     f"{o.shape}/{o.dtype}")
+            self._versions.append(new)
+            self.metrics.count("swaps")
+
+    # -- scheduler internals -------------------------------------------
+    def _reset_device_state(self):
+        """Fresh slot state: the KV cache, per-slot positions/tokens, and
+        host-side occupancy. Called at construction and after a decode
+        dispatch fails terminally (the donated cache/pos buffers may have
+        been consumed by the failed call — they cannot be trusted)."""
+        import jax.numpy as jnp
+
+        from ..models.zoo.transformer import init_kv_cache
+        self._cache = init_kv_cache(self._n_layers, self.slots,
+                                    self.max_len, self._d_model,
+                                    self._n_heads, self._cache_dtype)
+        self._pos = jnp.zeros((self.slots,), jnp.int32)
+        self._tok = jnp.zeros((self.slots,), jnp.int32)
+        self._slot_req = [None] * self.slots     # host-side occupancy
+
+    @property
+    def prefill_programs(self):
+        """bucket -> compiled prefill program (compile-cache pin)."""
+        return dict(self._prefills)
+
+    def _prompt_bucket(self, n):
+        for b in self.prompt_buckets:
+            if b >= n:
+                return b
+        return self.prompt_buckets[-1]
+
+    def _admit(self, req, slot):
+        """Prefill `req`'s prompt and install it into `slot`."""
+        import jax.numpy as jnp
+        bucket = self._prompt_bucket(len(req.prompt))
+        prog = self._prefills.get(bucket)
+        if prog is None:
+            prog = self._prefills[bucket] = self._make_prefill()
+            log.info("compiled prefill program for prompt bucket %d",
+                     bucket)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(req.prompt)] = req.prompt
+        with self._swap_lock:       # version index + params read atomically
+            vidx = len(self._versions) - 1
+            aux, blocks = self._versions[vidx]
+
+        def dispatch():
+            if self._injector is not None:
+                self._injector.fire("serve.batch")
+            return prog(aux, blocks, jnp.asarray(padded),
+                        jnp.asarray(len(req.prompt), jnp.int32))
+
+        if self._retry is not None:
+            logits, rows = self._retry.call(
+                dispatch,
+                on_retry=lambda a, e, d: self.metrics.count("retries"))
+        else:
+            logits, rows = dispatch()
+        first = int(np.argmax(np.asarray(logits)[0]))
+        req.generated.append(first)
+        if len(req.generated) >= req.max_new:
+            # one-token request: done at prefill, never occupies a slot
+            req.future.set_result(list(req.prompt) + req.generated)
+            self.metrics.record_request(
+                (time.monotonic() - req.t_submit) * 1e3)
+            return
+        self._cache = self._install(self._cache, rows, slot)
+        self._pos = self._pos.at[slot].set(len(req.prompt))
+        self._tok = self._tok.at[slot].set(first)
+        req.slot = slot
+        req.version = vidx
+        self._slot_req[slot] = req
+
+    def _admit_pending(self, timeout=0.0):
+        """Fill free slots from the queue. `timeout` blocks on the FIRST
+        get only — the idle loop's way of waiting for work on the queue
+        itself instead of busy-polling at the 1 ms decode tick."""
+        if not self._running and not self._drain_on_stop:
+            return      # fail-fast stop: queued requests must NOT be
+            #             admitted into freed slots — the loop's final
+            #             drain fails them once the busy slots finish
+        free = [s for s in range(self.slots) if self._slot_req[s] is None]
+        if self._static and len(free) < self.slots:
+            return      # gang scheduling: wait for the whole batch
+        wait = float(timeout)
+        for s in free:
+            req = None
+            while req is None:
+                try:
+                    req = (self._q.get(timeout=wait) if wait
+                           else self._q.get_nowait())
+                except queue.Empty:
+                    return
+                wait = 0.0
+                if req.future.done():   # failed by a raced submit/stop
+                    req = None
+                elif req.deadline is not None and \
+                        time.monotonic() > req.deadline:
+                    req.future.set_exception(DeadlineExceededError(
+                        "deadline expired before prefill"))
+                    self.metrics.count("shed_deadline")
+                    req = None
+            try:
+                self._admit(req, s)
+            except BaseException as e:  # noqa: BLE001 — fail THIS request
+                req.future.set_exception(e)
+                self.metrics.count("failed")
+
+    def _decode_iteration(self):
+        """One token for every occupied slot: one dispatch per live param
+        version, active mask restricted to that version's slots."""
+        import jax.numpy as jnp
+        live = [(s, r) for s, r in enumerate(self._slot_req)
+                if r is not None]
+        if not live:
+            return False
+        self.metrics.record_occupancy(len(live), self.slots)
+        versions = sorted({r.version for _, r in live})
+        new_tok = {}
+        for v in versions:
+            active = np.zeros((self.slots,), bool)
+            for s, r in live:
+                if r.version == v:
+                    active[s] = True
+            aux, blocks = self._versions[v]
+
+            def dispatch():
+                if self._injector is not None:
+                    self._injector.fire("serve.batch")
+                return self._step(aux, blocks, self._cache, self._pos,
+                                  self._tok, jnp.asarray(active))
+
+            # NOTE on retry composition: cache/pos are donated, so a
+            # failure INSIDE the compiled call is not retryable at this
+            # level (the buffers are gone) — the injector site sits before
+            # the call, which is exactly the transient class (tunnel
+            # hiccup before dispatch) retries exist for.
+            if self._retry is not None:
+                nxt, _, self._cache, self._pos = self._retry.call(
+                    dispatch,
+                    on_retry=lambda a, e, d: self.metrics.count("retries"))
+            else:
+                nxt, _, self._cache, self._pos = dispatch()
+            nxt = np.asarray(nxt)
+            for s, r in live:
+                if r.version == v:
+                    new_tok[s] = int(nxt[s])
+        self._tok = jnp.asarray(
+            [new_tok.get(s, 0) for s in range(self.slots)], jnp.int32)
+        done_any = False
+        t_now = time.monotonic()
+        for s, r in live:
+            r.generated.append(new_tok[s])
+            if len(r.generated) >= r.max_new:
+                # the final token needs no decode step (generate() makes
+                # the same point): resolve and free the slot
+                r.generated = r.generated[:r.max_new]
+                r.future.set_result(list(r.prompt) + r.generated)
+                self.metrics.record_request((t_now - r.t_submit) * 1e3)
+                self._slot_req[s] = None
+                done_any = True
+        if done_any:
+            self._gc_versions()
+        self.metrics.count("batches")       # decode iterations
+        if self._reporter is not None and \
+                self.metrics.count_value("batches") % self._report_every \
+                == 0:
+            self._reporter.report(self.metrics.snapshot())
+        return True
+
+    def _gc_versions(self):
+        """Drop drained old param versions (keep indices stable: only a
+        fully-drained PREFIX below the newest can be released)."""
+        with self._swap_lock:
+            in_use = {r.version for r in self._slot_req if r is not None}
+            newest = len(self._versions) - 1
+            for v in range(newest):
+                if v not in in_use and self._versions[v] is not None:
+                    self._versions[v] = None
+
+    def _busy(self):
+        return any(r is not None for r in self._slot_req)
+
+    def _loop_once(self):
+        # idle (no slot occupied): block on the queue up to 50 ms instead
+        # of spinning at the decode tick; busy: drain the queue non-blocking
+        self._admit_pending(timeout=0.0 if self._busy() else 0.05)
+        try:
+            busy = self._decode_iteration()
+        except BaseException as e:  # noqa: BLE001 — fail slots, survive
+            # a decode dispatch failed terminally (non-retryable, or
+            # retries exhausted). The donated cache/pos buffers cannot be
+            # trusted after a failed call, so every occupied request
+            # fails LOUDLY and the slot state resets — the server keeps
+            # serving instead of stranding all future requests on a dead
+            # thread.
+            n_failed = 0
+            for r in self._slot_req:
+                if r is not None and not r.future.done():
+                    r.future.set_exception(e)
+                    n_failed += 1
+            if n_failed:
+                self.metrics.count("failed", n_failed)
+            self._reset_device_state()
+            self._gc_versions()
+            return
+        if not busy:
+            # idle: still GC param versions (repeated swaps on an idle
+            # server must not accumulate dead params); the next loop's
+            # blocking admit is the idle wait, no sleep needed
+            self._gc_versions()

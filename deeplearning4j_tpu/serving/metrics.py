@@ -1,0 +1,97 @@
+"""Serving metrics: request-level latency percentiles + operational gauges.
+
+A serving SLO is a percentile, not a mean (bench.py's decode config makes
+the same point for token latency) — so the core structure here is a
+bounded latency reservoir per phase (queue wait, dispatch, total) with
+p50/p99 read out in `snapshot()`. Everything is host-side, lock-guarded,
+and O(1) per request: metrics must never add a device round-trip or a
+blocking call to the serving hot path.
+
+`snapshot()` is the ONE export surface — the same dict feeds
+`ui.stats.ServingStatsReporter` (the existing UI storage path), the
+`served_throughput` bench entry, and `tools/serve_ab.py`.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+
+
+def _pct(sorted_vals, q):
+    """Nearest-rank percentile of an already-sorted list (no numpy: the
+    metrics path must stay importable and cheap everywhere the stdlib-only
+    resilience layer is)."""
+    if not sorted_vals:
+        return None
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+class ServingMetrics:
+    """Thread-safe counters + latency reservoirs for one serving endpoint.
+
+    Counters: received / completed / failed / shed_deadline /
+    shed_queue_full / retries / swaps / unhealthy_outputs. Gauges: queue
+    depth (sampled at batch formation), batch occupancy (real requests /
+    bucket slots — the padding waste measure), decode slot occupancy.
+    Reservoirs keep the most recent `window` samples (deque) so a long-
+    running server reports RECENT percentiles, not all-time ones.
+    """
+
+    def __init__(self, window=2048):
+        self._lock = threading.Lock()
+        self._window = int(window)
+        self._counts = collections.Counter()
+        self._lat_ms = collections.deque(maxlen=self._window)
+        self._queue_wait_ms = collections.deque(maxlen=self._window)
+        self._queue_depth = collections.deque(maxlen=self._window)
+        self._occupancy = collections.deque(maxlen=self._window)
+        self._batch_sizes = collections.deque(maxlen=self._window)
+
+    # -- hot-path recorders -------------------------------------------
+    def count(self, key, n=1):
+        with self._lock:
+            self._counts[key] += n
+
+    def record_request(self, total_ms, queue_wait_ms=None):
+        with self._lock:
+            self._counts["completed"] += 1
+            self._lat_ms.append(float(total_ms))
+            if queue_wait_ms is not None:
+                self._queue_wait_ms.append(float(queue_wait_ms))
+
+    def record_batch(self, n_real, bucket, queue_depth):
+        with self._lock:
+            self._counts["batches"] += 1
+            self._batch_sizes.append(int(n_real))
+            self._occupancy.append(n_real / float(bucket) if bucket else 0.0)
+            self._queue_depth.append(int(queue_depth))
+
+    def record_occupancy(self, active, slots):
+        """Decode-scheduler slot occupancy for one token iteration."""
+        with self._lock:
+            self._occupancy.append(active / float(slots) if slots else 0.0)
+
+    # -- read-out ------------------------------------------------------
+    def count_value(self, key):
+        with self._lock:
+            return self._counts.get(key, 0)
+
+    def snapshot(self):
+        with self._lock:
+            lat = sorted(self._lat_ms)
+            qw = sorted(self._queue_wait_ms)
+            occ = list(self._occupancy)
+            depth = list(self._queue_depth)
+            sizes = list(self._batch_sizes)
+            out = dict(self._counts)
+        out["latency_ms_p50"] = _pct(lat, 50)
+        out["latency_ms_p99"] = _pct(lat, 99)
+        out["queue_wait_ms_p50"] = _pct(qw, 50)
+        out["queue_wait_ms_p99"] = _pct(qw, 99)
+        out["queue_depth_last"] = depth[-1] if depth else 0
+        out["queue_depth_max"] = max(depth) if depth else 0
+        out["batch_occupancy_mean"] = (sum(occ) / len(occ)) if occ else None
+        out["batch_size_mean"] = (sum(sizes) / len(sizes)) if sizes else None
+        return out

@@ -1,0 +1,499 @@
+"""InferenceServer — adaptive micro-batching over donated jitted programs.
+
+The request path (Clipper, NSDI'17 adaptive batching; the queueing
+discipline every TPU serving stack converges on):
+
+  submit(x) -> bounded queue -> batcher thread coalesces up to
+  `max_batch` requests or `max_wait_ms`, whichever first -> the batch is
+  right-padded to the nearest PADDING BUCKET -> one compiled program per
+  bucket runs the dispatch -> per-request rows are sliced out and the
+  futures resolved.
+
+Design pins (tests/test_serving.py):
+
+  * Determinism. A request's result is bit-identical no matter how it was
+    batched: alone, co-batched with 7 strangers, or bucket-padded. Two
+    facts make this true on a deterministic backend: (1) row results of
+    the forward are independent of other rows at FIXED batch shape, and
+    (2) per-row bits are identical across gemm batch shapes — measured on
+    XLA:CPU for every M in {2,3,4,6,8,16}, while M=1 takes a gemv path
+    with a different accumulation order. Hence the DEFAULT bucket floor is
+    2: a solo request pads to [2, ...], never [1, ...]. (Pass explicit
+    `buckets` containing 1 only if you do not need the cross-bucket pin.)
+  * Bounded compile cache. Programs are AOT-compiled per (bucket, example
+    structure) key and PINNED — a mixed-size request stream compiles at
+    most len(buckets) programs per input structure, and the set never
+    grows with traffic (contrast GEN_JIT_CACHE_SIZE's LRU: serving pads
+    INTO the fixed set instead of evicting).
+  * Hot swap. Params/model-state live in ONE reference the batcher reads
+    once per dispatch; `swap()` validates the new tree's structure+shapes
+    (same compiled programs stay valid — a swap is a new argument, not a
+    recompile) and replaces the reference atomically. In-flight batches
+    drain on the old params; queued and future requests route to the new.
+
+Operational hardening reuses the existing subsystems: per-request
+deadlines + queue backpressure shed load explicitly (`DeadlineExceeded` /
+`ServerOverloaded` futures, never silent drops), transient dispatch
+failures go through `common.resilience.RetryPolicy`, `FaultInjector`
+sites (`serve.request`, `serve.batch`, `serve.swap`) drive the fault
+tests through the real code path, and `screen_outputs=True` fails just
+the NaN/Inf rows via `common.health.rowwise_finite`.
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+import logging
+import queue
+import threading
+import time
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+class ServingError(RuntimeError):
+    """Base class for request-level serving failures."""
+
+
+class ServerOverloadedError(ServingError):
+    """Queue-full backpressure: the request was shed at admission."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline expired before dispatch."""
+
+
+class UnhealthyOutputError(ServingError):
+    """Output screening found NaN/Inf in this request's rows."""
+
+
+class ServerClosedError(ServingError):
+    """The server was stopped before the request could run."""
+
+
+class _Request:
+    __slots__ = ("x", "future", "deadline", "t_submit")
+
+    def __init__(self, x, deadline):
+        self.x = x
+        self.future = cf.Future()
+        self.deadline = deadline
+        self.t_submit = time.monotonic()
+
+
+def _default_buckets(max_batch):
+    """Powers of two from 2 up to and including max_batch. The floor is 2
+    even for max_batch=1 (a queue-only config): dispatching M=1 would take
+    the gemv path whose accumulation order differs from gemm (module
+    docstring), silently breaking the determinism pin."""
+    out = []
+    b = 2
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max(int(max_batch), 2))
+    return tuple(sorted(set(out)))
+
+
+class _RequestLoop:
+    """Shared lifecycle for the serving loops (InferenceServer and
+    ContinuousDecodeServer): bounded request queue, batcher-thread
+    start/stop with drain-vs-fail-fast semantics, and the subtle
+    threading guards — the submit/stop race re-check (a request enqueued
+    after the loop's final drain must be failed, never silently lost),
+    the join-timeout path (a loop still draining keeps `_thread` set so
+    `start()` refuses a second thread), and the queued-work failure
+    drain. ONE implementation so a fix here cannot drift between the two
+    servers. Subclasses set `_thread_name` / `_default_stop_timeout`,
+    implement `_loop_once()` (one scheduling iteration), and may
+    override `_busy()` (work in progress that must finish before a
+    draining stop may exit)."""
+
+    _thread_name = "serving-loop"
+    _default_stop_timeout = 30.0
+
+    def _init_loop(self, max_queue):
+        self._q = queue.Queue(maxsize=int(max_queue))
+        self._running = False
+        self._drain_on_stop = True
+        self._thread = None
+
+    # -- hooks ---------------------------------------------------------
+    def _busy(self):
+        return False
+
+    def _loop_once(self):
+        raise NotImplementedError
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self):
+        if self._running:
+            return self
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("previous serve loop has not exited yet")
+        self._running = True
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        name=self._thread_name,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True, timeout=None):
+        """Stop the loop. drain=True serves everything already queued
+        first; drain=False fails queued requests with ServerClosedError."""
+        if not self._running:
+            return
+        timeout = (self._default_stop_timeout if timeout is None
+                   else float(timeout))
+        self._drain_on_stop = bool(drain)
+        self._running = False
+        t = self._thread
+        t.join(timeout)
+        if t.is_alive():
+            # leave _thread set: start() must refuse until the loop exits
+            # (and _drain_on_stop keeps the value the loop is acting on)
+            log.warning("serve loop still draining after %.1fs", timeout)
+            return
+        self._thread = None
+        self._drain_on_stop = True
+        self._fail_queued(ServerClosedError("server stopped"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- queue machinery -----------------------------------------------
+    def _enqueue(self, req):
+        """Admit `req` (has .future) or shed loudly; returns the future."""
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            self.metrics.count("shed_queue_full")
+            raise ServerOverloadedError(
+                f"queue full ({self._q.maxsize} pending)") from None
+        if not self._running:
+            # raced stop(): the loop's final drain may already have run,
+            # leaving this request in a dead queue — fail it HERE so no
+            # caller ever blocks on a future nobody will resolve
+            if not req.future.done():
+                req.future.set_exception(
+                    ServerClosedError("server stopped during submit"))
+            raise ServerClosedError("server stopped during submit")
+        return req.future
+
+    def _fail_queued(self, exc):
+        """Fail everything still queued (late submits that raced stop())."""
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if not r.future.done():
+                r.future.set_exception(exc)
+                self.metrics.count("failed")
+
+    def _serve_loop(self):
+        while True:
+            if not self._running and not self._busy() and (
+                    not self._drain_on_stop or self._q.empty()):
+                break
+            self._loop_once()
+        self._fail_queued(ServerClosedError("server stopped"))
+
+
+class InferenceServer(_RequestLoop):
+    """Micro-batching inference endpoint over one network container.
+
+    `net` is anything with `make_inference_fn()` + `_params` /
+    `_model_state` (MultiLayerNetwork, ComputationGraph). Requests are
+    SINGLE examples (no batch axis; dict-of-arrays for multi-input
+    graphs); results are the per-example output rows as numpy.
+    """
+
+    _thread_name = "inference-server"
+    _default_stop_timeout = 30.0
+
+    def __init__(self, net, max_batch=8, max_wait_ms=2.0, buckets=None,
+                 max_queue=64, default_deadline_ms=None, retry_policy=None,
+                 fault_injector=None, screen_outputs=False, metrics=None,
+                 stats_reporter=None, report_every=16):
+        from .metrics import ServingMetrics
+        net._ensure_init()
+        self._infer = net.make_inference_fn()
+        self._params_ref = (net._params, net._model_state)
+        self.max_batch = int(max_batch)
+        self.max_wait = float(max_wait_ms) / 1e3
+        self.buckets = (tuple(sorted(int(b) for b in buckets)) if buckets
+                        else _default_buckets(self.max_batch))
+        if self.buckets[-1] < self.max_batch:
+            raise ValueError(f"largest bucket {self.buckets[-1]} < "
+                             f"max_batch {self.max_batch}")
+        self.default_deadline = (None if default_deadline_ms is None
+                                 else float(default_deadline_ms) / 1e3)
+        self._retry = retry_policy
+        self._injector = fault_injector
+        self._screen = bool(screen_outputs)
+        self.metrics = metrics or ServingMetrics()
+        self._reporter = stats_reporter
+        self._report_every = max(1, int(report_every))
+        self._programs = {}
+        self._swap_lock = threading.Lock()
+        self._since_report = 0
+        self._init_loop(max_queue)
+
+    # -- client API ----------------------------------------------------
+    def submit(self, x, deadline_ms=None):
+        """Enqueue one example; returns a concurrent.futures.Future whose
+        result is this example's output rows. Raises ServerOverloadedError
+        immediately when the queue is full (explicit backpressure — the
+        caller decides whether to retry, not a hidden buffer)."""
+        if not self._running:
+            raise ServerClosedError("server is not running")
+        if self._injector is not None:
+            x = self._injector.fire("serve.request", payload=x)
+        self.metrics.count("received")
+        dl = (time.monotonic() + deadline_ms / 1e3 if deadline_ms is not None
+              else (time.monotonic() + self.default_deadline
+                    if self.default_deadline is not None else None))
+        return self._enqueue(_Request(x, dl))
+
+    def predict(self, x, deadline_ms=None, timeout=None):
+        """Blocking single-request convenience wrapper over submit()."""
+        return self.submit(x, deadline_ms=deadline_ms).result(timeout)
+
+    # -- hot swap ------------------------------------------------------
+    def swap(self, new_net):
+        """Install a new model's params/state without dropping in-flight
+        requests: the in-flight dispatch holds its own reference and
+        drains; every batch formed after this call reads the new one. The
+        new tree must match the serving tree's structure and leaf shapes
+        (the compiled bucket programs are reused — mismatch raises, it
+        does not silently recompile into a different architecture)."""
+        import jax
+        with self._swap_lock:
+            if self._injector is not None:
+                self._injector.fire("serve.swap")
+            new_net._ensure_init()
+            new = (new_net._params, new_net._model_state)
+            old_l, old_t = jax.tree_util.tree_flatten(self._params_ref)
+            new_l, new_t = jax.tree_util.tree_flatten(new)
+            if old_t != new_t:
+                raise ValueError("swap rejected: param tree structure "
+                                 f"differs ({new_t} vs serving {old_t})")
+            for o, n in zip(old_l, new_l):
+                if getattr(o, "shape", None) != getattr(n, "shape", None) \
+                        or getattr(o, "dtype", None) != getattr(n, "dtype",
+                                                                None):
+                    raise ValueError(
+                        "swap rejected: leaf mismatch "
+                        f"{getattr(n, 'shape', None)}/"
+                        f"{getattr(n, 'dtype', None)} vs serving "
+                        f"{o.shape}/{o.dtype}")
+            self._params_ref = new
+            self.metrics.count("swaps")
+        log.info("hot swap installed (%d swaps total)",
+                 self.metrics.snapshot().get("swaps", 0))
+
+    def swap_from_path(self, path):
+        """Hot swap from a ModelSerializer zip checkpoint
+        (`util/model_serializer.py`) — the architecture in the zip must
+        match the serving architecture."""
+        from ..util import model_serializer
+        self.swap(model_serializer.restore_model(path, load_updater=False))
+
+    def swap_from_checkpoint(self, directory, net_factory, step=None):
+        """Hot swap from a ShardedCheckpointManager directory: build a
+        fresh container via `net_factory()`, restore `step` (default:
+        latest) into it, and swap."""
+        from ..util.sharded_checkpoint import ShardedCheckpointManager
+        mgr = ShardedCheckpointManager(directory)
+        net = net_factory()
+        net._ensure_init()
+        mgr.restore(net, step if step is not None else mgr.latest_step())
+        self.swap(net)
+
+    # -- batcher internals ---------------------------------------------
+    @property
+    def compiled_programs(self):
+        """Snapshot of the padding-bucket compile cache keys (the
+        compile-cache pin counts these)."""
+        return dict(self._programs)
+
+    def _bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _leaves(self, x):
+        # SORTED key order for dicts: jax's pytree flattening sorts dict
+        # keys, so two requests differing only in insertion order are the
+        # same program — the cache key must agree or the compile-cache
+        # pin breaks on key-order permutations
+        return ([x[k] for k in sorted(x)] if isinstance(x, dict) else [x])
+
+    def _struct_key(self, x):
+        """Structure signature of one example: the batching/compile-cache
+        unit (dict key set + per-leaf shape/dtype, key-order-insensitive)."""
+        if isinstance(x, dict):
+            names = tuple(sorted(x))
+        else:
+            names = None
+        return (names, tuple((tuple(np.shape(l)), str(np.asarray(l).dtype))
+                             for l in self._leaves(x)))
+
+    def _program(self, bucket, example):
+        import jax
+        key = (bucket, self._struct_key(example))
+        prog = self._programs.get(key)
+        if prog is None:
+            params, state = self._params_ref
+            if isinstance(example, dict):
+                xs = {k: jax.ShapeDtypeStruct(
+                    (bucket,) + tuple(np.shape(v)),
+                    np.asarray(v).dtype) for k, v in example.items()}
+            else:
+                xs = jax.ShapeDtypeStruct(
+                    (bucket,) + tuple(np.shape(example)),
+                    np.asarray(example).dtype)
+            # AOT per bucket: lower+compile ONCE, pinned forever. The
+            # request tensor is NOT donated — its shape can never alias
+            # the output's, so XLA could not reuse the buffer anyway
+            # (the decode path donates its KV cache, where aliasing is
+            # total); params stay undonated because every batch reuses
+            # them.
+            prog = jax.jit(self._infer).lower(params, state, xs).compile()
+            self._programs[key] = prog
+            log.info("compiled serving program bucket=%d (%d cached)",
+                     bucket, len(self._programs))
+        return prog
+
+    def _stack_pad(self, reqs, bucket):
+        """[n_real examples] -> bucket-padded batch (zero rows pad; row
+        independence makes pad content irrelevant to real rows)."""
+        def stack(*rows):
+            a = np.stack([np.asarray(r) for r in rows])
+            if a.shape[0] < bucket:
+                pad = np.zeros((bucket - a.shape[0],) + a.shape[1:],
+                               a.dtype)
+                a = np.concatenate([a, pad])
+            return a
+        first = reqs[0].x
+        if isinstance(first, dict):
+            return {k: stack(*[r.x[k] for r in reqs]) for k in first}
+        return stack(*[r.x for r in reqs])
+
+    def _collect(self):
+        """Coalesce one micro-batch: block for the first request, then
+        fill until max_batch or max_wait — capped by the earliest deadline
+        so a tight-deadline request is not queued past its budget."""
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        t_close = time.monotonic() + self.max_wait
+        while len(batch) < self.max_batch:
+            now = time.monotonic()
+            close = t_close
+            for r in batch:
+                if r.deadline is not None:
+                    close = min(close, r.deadline)
+            if now >= close:
+                break
+            try:
+                batch.append(self._q.get(timeout=close - now))
+            except queue.Empty:
+                break
+        return batch
+
+    def _loop_once(self):
+        batch = self._collect()
+        if not batch:
+            return
+        try:
+            self._run_batch(batch)
+        except BaseException as e:  # noqa: BLE001 — fail futures
+            n_failed = 0
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+                    n_failed += 1
+            if n_failed:
+                self.metrics.count("failed", n_failed)
+
+    def _run_batch(self, batch):
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.future.done():       # failed by a raced submit/stop
+                continue
+            if r.deadline is not None and now > r.deadline:
+                r.future.set_exception(DeadlineExceededError(
+                    f"deadline missed by {(now - r.deadline) * 1e3:.1f}ms "
+                    "before dispatch"))
+                self.metrics.count("shed_deadline")
+            else:
+                live.append(r)
+        if not live:
+            return
+        # heterogeneous traffic: requests with different input structures
+        # cannot share a dispatch — partition by the SAME key the compile
+        # cache uses, so one odd-shaped request can never fail its
+        # co-batched neighbours
+        groups = {}
+        for r in live:
+            groups.setdefault(self._struct_key(r.x), []).append(r)
+        for group in groups.values():
+            self._dispatch_group(group, now)
+        # cadence by batches-SINCE-LAST-REPORT, not a modulo on the shared
+        # counter: multi-group dispatches advance the counter by >1 and
+        # would make a modulo land arbitrarily rarely
+        self._since_report += len(groups)
+        if self._reporter is not None and \
+                self._since_report >= self._report_every:
+            self._since_report = 0
+            self._reporter.report(self.metrics.snapshot())
+
+    def _dispatch_group(self, live, now):
+        bucket = self._bucket_for(len(live))
+        self.metrics.record_batch(len(live), bucket, self._q.qsize())
+        prog = self._program(bucket, live[0].x)
+        params, state = self._params_ref     # ONE read: swap-atomic
+        x = self._stack_pad(live, bucket)
+
+        def dispatch():
+            if self._injector is not None:
+                self._injector.fire("serve.batch")
+            return prog(params, state, x)
+
+        if self._retry is not None:
+            out = self._retry.call(
+                dispatch,
+                on_retry=lambda a, e, d: self.metrics.count("retries"))
+        else:
+            out = dispatch()
+        rows = [np.asarray(l) for l in
+                (out if isinstance(out, (list, tuple)) else [out])]
+        single = not isinstance(out, (list, tuple))
+        ok = None
+        if self._screen:
+            from ..common.health import rowwise_finite
+            ok = rowwise_finite(rows)
+        t_done = time.monotonic()
+        for i, r in enumerate(live):
+            if r.future.done():
+                continue
+            if ok is not None and not ok[i]:
+                r.future.set_exception(UnhealthyOutputError(
+                    "non-finite values in request output"))
+                self.metrics.count("unhealthy_outputs")
+                continue
+            res = [a[i] for a in rows]
+            r.future.set_result(res[0] if single else res)
+            self.metrics.record_request(
+                (t_done - r.t_submit) * 1e3,
+                (now - r.t_submit) * 1e3)

@@ -290,3 +290,37 @@ class StatsListener(IterationListener):
             for i, p in enumerate(model._params):
                 for k, v in p.items():
                     yield f"{i}_{k}", np.asarray(v)
+
+
+class ServingStatsReporter:
+    """Route serving-layer metrics through the SAME storage path training
+    stats use (StatsStorageRouter / ui/storage.py), so the existing UI
+    server sees a serving session next to training sessions with zero new
+    plumbing. One static-info record names the served model; each
+    `report()` appends a timestamped update whose `serving` key carries the
+    ServingMetrics snapshot (p50/p99 latency, queue depth, batch occupancy,
+    shed/swap counts). The serving loops call `report()` on a cadence the
+    server owns (`InferenceServer(stats_reporter=..., report_every=N)`) —
+    metrics must never add a per-request host hop."""
+
+    def __init__(self, router_or_storage, session_id=None,
+                 worker_id="server_0", model_info=None):
+        self.router = router_or_storage
+        self.session_id = session_id or f"serving_{int(time.time() * 1000)}"
+        self.worker_id = worker_id
+        self._model_info = model_info or {}
+        self._init_sent = False
+
+    def report(self, snapshot):
+        """Append one serving-metrics update (a ServingMetrics.snapshot()
+        dict, but any JSON-able mapping works)."""
+        if not self._init_sent:
+            self.router.put_static_info({
+                "sessionId": self.session_id, "workerId": self.worker_id,
+                "startTime": int(time.time() * 1000),
+                "serving": dict(self._model_info)})
+            self._init_sent = True
+        self.router.put_update({
+            "sessionId": self.session_id, "workerId": self.worker_id,
+            "timestamp": int(time.time() * 1000),
+            "serving": dict(snapshot)})

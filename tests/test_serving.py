@@ -1,0 +1,496 @@
+"""Serving layer pins (ISSUE 4 acceptance criteria).
+
+  (a) Determinism: a request's result is BIT-IDENTICAL whether it is
+      served alone, co-batched with strangers, or bucket-padded — and
+      matches the raw container forward on the same rows. (The bucket
+      floor of 2 exists because XLA:CPU's M=1 gemv path accumulates in a
+      different order than gemm; serving never dispatches M=1.)
+  (b) Compile cache: a mixed-size request stream compiles at most
+      len(buckets) programs per input structure — the set is pinned, not
+      an LRU that churns under traffic.
+  (c) Continuous decode: a request that JOINS a running fixed-slot batch
+      emits the same token stream as a solo decode, and equal-arrival
+      continuous decode matches `generate_batch` bit-for-bit.
+  (d) Hot swap completes under concurrent load with zero dropped or
+      failed in-flight requests, on both the micro-batch and the
+      dual-version continuous-decode paths.
+  (e) FaultInjector-driven deadline/shed/retry/screening paths through
+      the REAL serving code (sites serve.request / serve.batch /
+      serve.swap), and serving metrics ride the existing UI storage path.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (ComputationGraph, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.common.resilience import (FaultInjected,
+                                                  FaultInjector,
+                                                  RetryPolicy)
+from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                        DeadlineExceededError,
+                                        InferenceServer, ServingMetrics,
+                                        ServerOverloadedError,
+                                        UnhealthyOutputError)
+
+
+def _mln(seed=7, n_in=6, n_out=4):
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, DenseLayer(n_out=16, activation="relu"))
+            .layer(1, OutputLayer(n_out=n_out, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _cg(seed=7):
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater("sgd").learning_rate(0.1).graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss_function="mcxent"), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(5))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def _lm(seed=3):
+    return TransformerLM(64, d_model=32, n_heads=2, n_layers=2,
+                         max_len=64, seed=seed)
+
+
+def _bits_equal(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return a.dtype == b.dtype and a.shape == b.shape and \
+        np.array_equal(a.view(np.uint8), b.view(np.uint8))
+
+
+# ---------------------------------------------------------------------------
+# (a) determinism pins
+# ---------------------------------------------------------------------------
+class TestMicroBatchDeterminism:
+    def test_cobatched_bit_identical_to_batch1(self):
+        """The SAME request served solo and co-batched with 7 strangers
+        returns bit-identical results."""
+        net = _mln()
+        rng = np.random.default_rng(0)
+        xs = rng.standard_normal((8, 6)).astype(np.float32)
+        with InferenceServer(net, max_batch=8, max_wait_ms=20.0) as srv:
+            futs = [srv.submit(x) for x in xs]       # coalesce into one batch
+            batched = [f.result(30) for f in futs]
+            solo = srv.predict(xs[0], timeout=30)    # batch-1 call
+        assert _bits_equal(solo, batched[0])
+
+    def test_bucket_padded_bit_identical_to_unpadded(self):
+        """3 requests pad to bucket 4; rows must match the raw unpadded
+        batch-3 forward bit-for-bit (and the batch-16 one)."""
+        net = _mln()
+        rng = np.random.default_rng(1)
+        xs = rng.standard_normal((16, 6)).astype(np.float32)
+        with InferenceServer(net, max_batch=4, max_wait_ms=20.0,
+                             buckets=(2, 4)) as srv:
+            futs = [srv.submit(x) for x in xs[:3]]
+            rows = [np.asarray(f.result(30)) for f in futs]
+        direct3 = np.asarray(net.output(xs[:3]))
+        direct16 = np.asarray(net.output(xs))
+        for i in range(3):
+            assert _bits_equal(rows[i], direct3[i])
+            assert _bits_equal(rows[i], direct16[i])
+
+    def test_computation_graph_served(self):
+        """The CG twin serves through the same machinery (multi-output
+        list results)."""
+        cg = _cg()
+        rng = np.random.default_rng(2)
+        xs = rng.standard_normal((4, 5)).astype(np.float32)
+        with InferenceServer(cg, max_batch=4, max_wait_ms=20.0) as srv:
+            futs = [srv.submit(x) for x in xs]
+            rows = [f.result(30) for f in futs]
+        direct = np.asarray(cg.output(xs)[0])
+        for i in range(4):
+            assert isinstance(rows[i], list) and len(rows[i]) == 1
+            assert _bits_equal(rows[i][0], direct[i])
+
+
+# ---------------------------------------------------------------------------
+# (b) compile-cache pin
+# ---------------------------------------------------------------------------
+class TestCompileCache:
+    def test_mixed_sizes_compile_at_most_num_buckets(self):
+        net = _mln()
+        rng = np.random.default_rng(3)
+        xs = rng.standard_normal((64, 6)).astype(np.float32)
+        with InferenceServer(net, max_batch=8, max_wait_ms=1.0,
+                             buckets=(2, 4, 8), max_queue=128) as srv:
+            futs = []
+            # mixed arrival pattern: bursts of 1..8 with pauses, so the
+            # batcher forms micro-batches of many different real sizes
+            i = 0
+            for burst in (1, 3, 8, 2, 5, 7, 4, 6, 1, 8, 3, 2):
+                for _ in range(burst):
+                    futs.append(srv.submit(xs[i % 64]))
+                    i += 1
+                time.sleep(0.01)
+            rows = [np.asarray(f.result(30)) for f in futs]
+        assert len(srv.compiled_programs) <= 3
+        direct = np.asarray(net.output(xs[:len(rows)]))
+        for i, r in enumerate(rows):
+            assert _bits_equal(r, direct[i % 64])
+
+    def test_heterogeneous_structures_partition_not_fail(self):
+        """Requests with DIFFERENT input widths landing in one coalescing
+        window are partitioned by structure, not crashed together: each
+        width gets its own dispatch and correct results."""
+        net4 = _mln(7, n_in=6)
+        rng = np.random.default_rng(18)
+        xa = rng.standard_normal((3, 6)).astype(np.float32)
+        xb = rng.standard_normal((3, 6)).astype(np.float64)  # other dtype
+        with InferenceServer(net4, max_batch=8, max_wait_ms=30.0) as srv:
+            futs = [srv.submit(x) for x in xa] + [srv.submit(x) for x in xb]
+            rows = [np.asarray(f.result(30)) for f in futs]
+        da = np.asarray(net4.output(xa))
+        db = np.asarray(net4.output(xb))
+        for i in range(3):
+            assert _bits_equal(rows[i], da[i])
+            # f64 requests are a separate program (separate struct key);
+            # value-compare against the container run on the f64 batch
+            np.testing.assert_array_equal(rows[3 + i], db[i])
+        assert srv.metrics.snapshot().get("failed", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# (c) continuous decode
+# ---------------------------------------------------------------------------
+class TestContinuousDecode:
+    def test_join_running_batch_equals_solo(self):
+        """A request joining a batch mid-decode emits the same tokens as
+        the same request decoding alone."""
+        lm = _lm()
+        rng = np.random.default_rng(4)
+        pa = rng.integers(1, 64, 5).tolist()
+        pb = rng.integers(1, 64, 8).tolist()
+        pc = rng.integers(1, 64, 3).tolist()
+        with ContinuousDecodeServer(lm, slots=4,
+                                    prompt_buckets=(4, 8)) as srv:
+            solo = srv.generate(pa, 10, timeout=60)
+            flong = srv.submit(pb, 30)       # running batch
+            time.sleep(0.05)                 # let pb decode a few tokens
+            fa = srv.submit(pa, 10)          # joins mid-flight
+            fc = srv.submit(pc, 6)
+            joined = fa.result(60)
+            flong.result(60)
+            fc.result(60)
+        assert joined == solo
+
+    def test_equal_arrival_matches_generate_batch(self):
+        """4 equal-length requests admitted together == generate_batch
+        greedy rows, token-for-token."""
+        lm = _lm()
+        rng = np.random.default_rng(5)
+        prompts = rng.integers(1, 64, (4, 4)).astype(np.int32)
+        expect = lm.generate_batch(prompts, max_new_tokens=8)
+        with ContinuousDecodeServer(lm, slots=4,
+                                    prompt_buckets=(4,)) as srv:
+            futs = [srv.submit(prompts[i], 8) for i in range(4)]
+            rows = [f.result(60) for f in futs]
+        for i in range(4):
+            assert rows[i] == expect[i].tolist()
+
+    def test_matches_generate_use_cache(self):
+        """The serving path agrees with the pinned single-request
+        generate(use_cache=True) reference."""
+        lm = _lm()
+        rng = np.random.default_rng(6)
+        p = rng.integers(1, 64, 4).tolist()
+        expect = lm.generate(p, max_new_tokens=9)
+        with ContinuousDecodeServer(lm, slots=2,
+                                    prompt_buckets=(4,)) as srv:
+            got = srv.generate(p, 9, timeout=60)
+        assert got == expect
+
+    def test_one_token_request_resolves_at_prefill(self):
+        lm = _lm()
+        p = [5, 9, 2]
+        expect = lm.generate(p, max_new_tokens=1)
+        with ContinuousDecodeServer(lm, slots=2,
+                                    prompt_buckets=(4,)) as srv:
+            got = srv.generate(p, 1, timeout=60)
+            with pytest.raises(ValueError, match="max_new_tokens"):
+                srv.submit(p, 0)
+        assert got == expect
+
+    def test_prefill_compile_cache_bounded(self):
+        lm = _lm()
+        rng = np.random.default_rng(7)
+        with ContinuousDecodeServer(lm, slots=2,
+                                    prompt_buckets=(4, 8)) as srv:
+            for n in (2, 3, 4, 5, 7, 8, 6, 1):
+                srv.generate(rng.integers(1, 64, n).tolist(), 2,
+                             timeout=60)
+            assert len(srv.prefill_programs) <= 2
+
+
+# ---------------------------------------------------------------------------
+# (d) hot swap under load
+# ---------------------------------------------------------------------------
+class TestHotSwap:
+    def test_microbatch_swap_zero_dropped(self):
+        """Concurrent clients submit across a swap; every future resolves
+        (zero dropped/failed), and post-swap results match the new net."""
+        net1, net2 = _mln(7), _mln(99)
+        rng = np.random.default_rng(8)
+        xs = rng.standard_normal((32, 6)).astype(np.float32)
+        srv = InferenceServer(net1, max_batch=4, max_wait_ms=1.0,
+                              max_queue=512).start()
+        futs = []
+
+        def client():
+            for i in range(150):
+                futs.append(srv.submit(xs[i % 32]))
+                time.sleep(0.0004)
+
+        t = threading.Thread(target=client)
+        t.start()
+        time.sleep(0.02)
+        srv.swap(net2)
+        t.join()
+        results = [f.result(60) for f in futs]   # raises on any failure
+        assert len(results) == 150
+        assert srv.metrics.snapshot().get("failed", 0) == 0
+        after = srv.predict(xs[0], timeout=30)
+        srv.stop()
+        assert _bits_equal(after, np.asarray(net2.output(xs[:2]))[0])
+
+    def test_swap_rejects_architecture_mismatch(self):
+        net1 = _mln(7)
+        other = _mln(7, n_in=6, n_out=7)      # different output width
+        srv = InferenceServer(net1).start()
+        try:
+            with pytest.raises(ValueError, match="swap rejected"):
+                srv.swap(other)
+        finally:
+            srv.stop()
+
+    def test_swap_from_serializer_path(self, tmp_path):
+        from deeplearning4j_tpu.util import model_serializer
+        net1, net2 = _mln(7), _mln(99)
+        path = str(tmp_path / "model.zip")
+        model_serializer.write_model(net2, path)
+        rng = np.random.default_rng(9)
+        x = rng.standard_normal((6,)).astype(np.float32)
+        with InferenceServer(net1, max_wait_ms=1.0) as srv:
+            srv.swap_from_path(path)
+            got = srv.predict(x, timeout=30)
+        assert srv.metrics.snapshot().get("swaps") == 1
+        assert _bits_equal(got, np.asarray(
+            net2.output(np.stack([x, x])))[0])
+
+    def test_decode_dual_version_drain(self):
+        """In-flight decode requests finish on pre-swap params (token
+        streams identical to a pre-swap solo run) while a post-swap
+        request gets the new params — dual-version dispatch."""
+        lm1, lm2 = _lm(3), _lm(11)
+        rng = np.random.default_rng(10)
+        pa = rng.integers(1, 64, 4).tolist()
+        pb = rng.integers(1, 64, 4).tolist()
+        with ContinuousDecodeServer(lm1, slots=2,
+                                    prompt_buckets=(4,)) as srv:
+            solo_old = srv.generate(pa, 14, timeout=60)
+            fa = srv.submit(pa, 14)
+            time.sleep(0.03)                  # pa decoding on v0
+            srv.swap(lm2)
+            fb = srv.submit(pb, 5)            # admitted on v1
+            ra, rb = fa.result(60), fb.result(60)
+        assert ra == solo_old                 # drained on old params
+        expect_new = lm2.generate_batch(np.asarray([pb], np.int32),
+                                        max_new_tokens=5)
+        assert rb == expect_new[0].tolist()   # routed to new params
+        assert srv.metrics.snapshot().get("failed", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# (e) faults, deadlines, backpressure, screening, metrics/UI
+# ---------------------------------------------------------------------------
+class TestOperationalHardening:
+    def test_retry_recovers_transient_batch_fault(self):
+        net = _mln()
+        inj = FaultInjector(seed=1).plan("serve.batch", on_call=0,
+                                         exc=FaultInjected)
+        rp = RetryPolicy(max_retries=3, base_delay=0.001,
+                         retryable=(ConnectionError,))
+        rng = np.random.default_rng(11)
+        x = rng.standard_normal((6,)).astype(np.float32)
+        with InferenceServer(net, max_wait_ms=1.0, fault_injector=inj,
+                             retry_policy=rp) as srv:
+            got = srv.predict(x, timeout=30)
+        snap = srv.metrics.snapshot()
+        assert snap.get("retries") == 1 and snap.get("failed", 0) == 0
+        assert inj.fired("serve.batch")
+        assert _bits_equal(got, np.asarray(net.output(np.stack([x, x])))[0])
+
+    def test_unretryable_batch_fault_fails_requests_loudly(self):
+        net = _mln()
+        inj = FaultInjector(seed=2).plan("serve.batch", on_call=0,
+                                         exc=FaultInjected)
+        rng = np.random.default_rng(12)
+        x = rng.standard_normal((6,)).astype(np.float32)
+        with InferenceServer(net, max_wait_ms=1.0,
+                             fault_injector=inj) as srv:   # no retry policy
+            f = srv.submit(x)
+            with pytest.raises(FaultInjected):
+                f.result(30)
+            # the server survives: next request serves fine
+            assert srv.predict(x, timeout=30) is not None
+        assert srv.metrics.snapshot().get("failed") == 1
+
+    def test_deadline_shed_before_dispatch(self):
+        net = _mln()
+        rng = np.random.default_rng(13)
+        x = rng.standard_normal((6,)).astype(np.float32)
+        with InferenceServer(net, max_batch=2, max_wait_ms=50.0) as srv:
+            f = srv.submit(x, deadline_ms=0.001)
+            with pytest.raises(DeadlineExceededError):
+                f.result(30)
+        assert srv.metrics.snapshot().get("shed_deadline") == 1
+
+    def test_queue_full_backpressure(self):
+        net = _mln()
+        rng = np.random.default_rng(14)
+        xs = rng.standard_normal((32, 6)).astype(np.float32)
+        srv = InferenceServer(net, max_batch=2, max_wait_ms=100.0,
+                              max_queue=2).start()
+        try:
+            with pytest.raises(ServerOverloadedError):
+                for i in range(16):
+                    srv.submit(xs[i])
+            assert srv.metrics.snapshot().get("shed_queue_full", 0) >= 1
+        finally:
+            srv.stop()
+
+    def test_corrupt_request_screened_not_fatal(self):
+        """A NaN-poisoned request (FaultInjector corrupt at serve.request)
+        fails ONLY that request; co-batched neighbours are unaffected."""
+        net = _mln()
+        inj = FaultInjector(seed=3).plan("serve.request", on_call=0,
+                                         corrupt="nan")
+        rng = np.random.default_rng(15)
+        xs = rng.standard_normal((3, 6)).astype(np.float32)
+        with InferenceServer(net, max_batch=4, max_wait_ms=20.0,
+                             fault_injector=inj,
+                             screen_outputs=True) as srv:
+            f_bad = srv.submit(xs[0])        # poisoned
+            f_ok = [srv.submit(x) for x in xs[1:]]
+            with pytest.raises(UnhealthyOutputError):
+                f_bad.result(30)
+            rows = [np.asarray(f.result(30)) for f in f_ok]
+        assert srv.metrics.snapshot().get("unhealthy_outputs") == 1
+        direct = np.asarray(net.output(xs))
+        for i, r in enumerate(rows):
+            assert _bits_equal(r, direct[i + 1])
+
+    def test_decode_thread_survives_terminal_dispatch_fault(self):
+        """A non-retryable fault during a decode iteration fails the
+        occupied requests LOUDLY, resets the slot state, and keeps the
+        server serving — no dead thread stranding future requests."""
+        lm = _lm()
+        inj = FaultInjector(seed=5).plan("serve.batch", on_call=1,
+                                         exc=FaultInjected)  # 0 = prefill
+        rng = np.random.default_rng(19)
+        p = rng.integers(1, 64, 4).tolist()
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(4,),
+                                    fault_injector=inj) as srv:
+            f = srv.submit(p, 6)
+            with pytest.raises(FaultInjected):
+                f.result(60)
+            # the server recovers: same request serves fine afterwards
+            got = srv.generate(p, 6, timeout=60)
+        assert got == lm.generate(p, max_new_tokens=6)
+        assert srv.metrics.snapshot().get("failed") == 1
+
+    def test_decode_stop_no_drain_fails_queued_fast(self):
+        """stop(drain=False) must FAIL queued requests, not admit them
+        into slots freed by the draining ones."""
+        lm = _lm()
+        rng = np.random.default_rng(20)
+        with ContinuousDecodeServer(lm, slots=1,
+                                    prompt_buckets=(4,)) as srv:
+            busy = srv.submit(rng.integers(1, 64, 4).tolist(), 24)
+            time.sleep(0.02)          # occupies the only slot
+            queued = [srv.submit(rng.integers(1, 64, 4).tolist(), 24)
+                      for _ in range(3)]
+            srv.stop(drain=False)
+            assert busy.result(60)    # in-flight work still completes
+            for f in queued:
+                with pytest.raises(Exception):
+                    f.result(60)      # queued work failed, not served
+
+    def test_max_batch_one_keeps_bucket_floor(self):
+        """max_batch=1 must still pad to bucket 2 — never an M=1 gemv
+        dispatch (the determinism-pin floor)."""
+        net = _mln()
+        rng = np.random.default_rng(21)
+        x = rng.standard_normal((6,)).astype(np.float32)
+        with InferenceServer(net, max_batch=1, max_wait_ms=1.0) as srv:
+            assert srv.buckets == (2,)
+            got = srv.predict(x, timeout=30)
+        assert _bits_equal(got, np.asarray(net.output(np.stack([x, x])))[0])
+
+    def test_decode_deadline_shed_and_swap_site(self):
+        lm = _lm()
+        inj = FaultInjector(seed=4)
+        rng = np.random.default_rng(16)
+        p = rng.integers(1, 64, 4).tolist()
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(4,),
+                                    fault_injector=inj) as srv:
+            f = srv.submit(p, 4, deadline_ms=0.0)
+            with pytest.raises(DeadlineExceededError):
+                f.result(60)
+            srv.swap(_lm(12))
+        assert srv.metrics.snapshot().get("shed_deadline") == 1
+        assert inj.calls("serve.swap") == 1
+        assert inj.calls("serve.request") == 1
+
+    def test_serving_metrics_reach_ui_storage(self):
+        """ServingStatsReporter rides the ui/storage.py path: the same
+        InMemoryStatsStorage the training UI reads sees serving updates."""
+        from deeplearning4j_tpu.ui.stats import ServingStatsReporter
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        net = _mln()
+        storage = InMemoryStatsStorage()
+        rep = ServingStatsReporter(storage, session_id="serve_test",
+                                   model_info={"model": "mln"})
+        rng = np.random.default_rng(17)
+        xs = rng.standard_normal((8, 6)).astype(np.float32)
+        with InferenceServer(net, max_batch=4, max_wait_ms=1.0,
+                             stats_reporter=rep, report_every=1) as srv:
+            for x in xs:
+                srv.predict(x, timeout=30)
+        assert "serve_test" in storage.list_session_ids()
+        latest = storage.get_latest_update("serve_test")
+        serving = latest["serving"]
+        assert serving["completed"] == 8
+        assert serving["latency_ms_p50"] is not None
+        assert serving["latency_ms_p99"] is not None
+        assert 0.0 < serving["batch_occupancy_mean"] <= 1.0
+        static = storage.get_static_info("serve_test")
+        assert static["serving"]["model"] == "mln"
+
+    def test_metrics_snapshot_shape(self):
+        m = ServingMetrics(window=8)
+        for i in range(20):
+            m.record_request(float(i))
+        m.record_batch(3, 4, 2)
+        snap = m.snapshot()
+        assert snap["completed"] == 20
+        # bounded reservoir: percentiles over the LAST 8 samples
+        assert snap["latency_ms_p50"] >= 12.0
+        assert snap["queue_depth_max"] == 2
+        assert snap["batch_occupancy_mean"] == 0.75

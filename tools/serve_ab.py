@@ -1,0 +1,191 @@
+"""Serving-layer A/B on the CPU backend (no chip needed).
+
+Two questions the serving subsystem (`deeplearning4j_tpu/serving/`)
+exists to answer, measured through the REAL servers with the interleaved
+same-process protocol (bench.py `_interleaved_median`: alternating short
+segments, median per arm — tunnel weather / host jitter hits both arms
+equally):
+
+  * decode_continuous_vs_static — the SAME fixed-slot decode machinery
+    with iteration-level scheduling (requests join/leave at token
+    granularity, Orca) vs gang admission (a new batch only forms when
+    every slot is free — classic static request batching). Mixed decode
+    lengths are the point: under static batching a 4-token reply's slot
+    idles while a 28-token reply finishes; continuous refills it.
+  * microbatch_vs_per_request — InferenceServer's adaptive micro-batching
+    (Clipper) vs the bare per-request `output()` loop the reference
+    shipped. Dispatch-overhead-dominated small models are exactly the
+    serving regime: N/8 batched dispatches beat N solo dispatches.
+
+Run:  JAX_PLATFORMS=cpu python tools/serve_ab.py [--segments N]
+Numbers recorded in PERF.md ("serving layer"); on-chip re-measure armed
+in ROADMAP (remote-attached dispatch makes batching wins larger).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the ONE protocol implementation (see tools/fused_ab.py)
+from bench import _interleaved_median as _interleaved  # noqa: E402
+
+
+def _lm():
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+    return TransformerLM(96, d_model=32, n_heads=2, n_layers=2,
+                         max_len=64, seed=5, dtype=jnp.float32)
+
+
+def _mlp():
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.Builder().seed(7)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, DenseLayer(n_out=64, activation="relu"))
+            .layer(1, OutputLayer(n_out=10, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(32))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _decode_workload(rng, n):
+    """Mixed sequence lengths — prompts spanning two buckets, decode
+    lengths 4..43 (the spread static batching pays for)."""
+    out = []
+    for _ in range(n):
+        p_len = int(rng.integers(3, 16))
+        n_new = int(rng.integers(4, 44))
+        out.append((rng.integers(1, 96, p_len).tolist(), n_new))
+    return out
+
+
+def bench_decode_ab(segments, reqs_per_seg=16):
+    """continuous vs static decode batching: same model params, same slot
+    program, same per-segment workload — only the SCHEDULER differs."""
+    import numpy as np
+
+    from deeplearning4j_tpu.serving import ContinuousDecodeServer
+
+    lm = _lm()
+    servers = {
+        "continuous": ContinuousDecodeServer(
+            lm, slots=4, prompt_buckets=(8, 16), max_queue=256).start(),
+        "static": ContinuousDecodeServer(
+            lm, slots=4, prompt_buckets=(8, 16), max_queue=256,
+            static_batching=True).start(),
+    }
+    warm = _decode_workload(np.random.default_rng(0), 6)
+    for srv in servers.values():        # compile off the clock
+        for p, n in warm:
+            srv.generate(p, n, timeout=120)
+
+    seg_idx = {"continuous": [0], "static": [0]}
+
+    def seg(name):
+        srv = servers[name]
+
+        def run():
+            # identical per-segment workload for both arms, fresh per
+            # segment index so neither arm replays a cached rng stream
+            rng = np.random.default_rng(100 + seg_idx[name][0])
+            seg_idx[name][0] += 1
+            work = _decode_workload(rng, reqs_per_seg)
+            toks = sum(n for _, n in work)
+            t0 = time.perf_counter()
+            futs = [srv.submit(p, n) for p, n in work]
+            for f in futs:
+                f.result(300)
+            return toks / (time.perf_counter() - t0)
+        return run
+
+    ab = _interleaved({n: seg(n) for n in servers}, segments=segments)
+    lat = {n: servers[n].metrics.snapshot() for n in servers}
+    for srv in servers.values():
+        srv.stop()
+    return {
+        "config": "TransformerLM L=2 d=32 slots=4, mixed prompts 3-15 / "
+                  "decode 4-43 tokens, 16 reqs/segment, greedy",
+        "unit": "generated tokens/sec",
+        "ab": ab,
+        "speedup_continuous_over_static": round(
+            ab["continuous"]["median"] / ab["static"]["median"], 3),
+        "request_latency_ms": {
+            n: {"p50": lat[n]["latency_ms_p50"],
+                "p99": lat[n]["latency_ms_p99"]} for n in lat},
+        "slot_occupancy_mean": {
+            n: round(lat[n]["batch_occupancy_mean"], 3) for n in lat},
+    }
+
+
+def bench_microbatch_ab(segments, reqs_per_seg=96):
+    """InferenceServer micro-batching vs a bare per-request output()
+    loop over the same request stream."""
+    import numpy as np
+
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    net = _mlp()
+    rng = np.random.default_rng(1)
+    xs = rng.standard_normal((reqs_per_seg, 32)).astype(np.float32)
+    srv = InferenceServer(net, max_batch=8, max_wait_ms=2.0,
+                          max_queue=2 * reqs_per_seg).start()
+    # compile EVERY bucket program + the per-request jit off the clock
+    for burst in (1, 4, 8):
+        for f in [srv.submit(x) for x in xs[:burst]]:
+            f.result(60)
+    net.output(xs[:1])
+
+    def seg_server():
+        t0 = time.perf_counter()
+        futs = [srv.submit(x) for x in xs]
+        for f in futs:
+            f.result(120)
+        return reqs_per_seg / (time.perf_counter() - t0)
+
+    def seg_per_request():
+        t0 = time.perf_counter()
+        for x in xs:
+            np.asarray(net.output(x[None]))
+        return reqs_per_seg / (time.perf_counter() - t0)
+
+    ab = _interleaved({"microbatch": seg_server,
+                       "per_request": seg_per_request},
+                      segments=segments)
+    snap = srv.metrics.snapshot()
+    srv.stop()
+    return {
+        "config": "MLP 32->64->10, 96 requests/segment, max_batch=8 "
+                  "max_wait=2ms buckets(2,4,8)",
+        "unit": "requests/sec",
+        "ab": ab,
+        "speedup_microbatch_over_per_request": round(
+            ab["microbatch"]["median"] / ab["per_request"]["median"], 3),
+        "request_latency_ms": {"p50": snap["latency_ms_p50"],
+                               "p99": snap["latency_ms_p99"]},
+        "batch_size_mean": round(snap["batch_size_mean"], 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--segments", type=int, default=5)
+    args = ap.parse_args()
+    for name, fn in (("decode_continuous_vs_static", bench_decode_ab),
+                     ("microbatch_vs_per_request", bench_microbatch_ab)):
+        rec = {"name": name}
+        rec.update(fn(args.segments))
+        print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
