@@ -349,6 +349,120 @@ def make_slot_decode_fn(n_heads):
     return step
 
 
+def make_slot_verify_block_fn(n_heads):
+    """`make_slot_decode_block_fn` widened to K query positions per slot:
+    the per-block unit of SPECULATIVE decoding's verify dispatch
+    (`serving/speculate.py`).
+
+    block_verify(p, x [S, K, D], cache {k,v: [S, L, H, hd]}, pos [S],
+                 active [S] bool) -> (y [S, K, D], updated cache)
+
+    Slot s's K inputs land at cache rows pos[s]..pos[s]+K-1 (all K k/v
+    rows are written BEFORE attention, exactly as `prefill_forward` fills
+    its window), and query i attends causally to rows <= pos[s]+i. The
+    same two gates as the 1-token block keep the serving pins intact:
+    inactive slots write back the rows they already held (bit-identical
+    cache while neighbours decode), and rows beyond the cache length are
+    dropped (`mode="drop"` scatter) — a verify dispatch near the end of
+    the cache writes only the rows that exist, and the host never
+    consumes tokens whose row would not fit (the submit() length guard).
+    Masked-out score positions contribute EXACT zeros after softmax
+    (exp underflows to 0.0), so widening the attended row set from the
+    decode block's to the verify block's changes no accepted row's bits."""
+
+    def block_verify(p, x, cache, pos, active):
+        S, K, D = x.shape
+        H = n_heads
+        hd = D // H
+        h = _layer_norm(x, p["ln1"]["g"], p["ln1"]["b"])
+        qkv = h @ p["attn"]["wqkv"]                     # [S, K, 3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        L = cache["k"].shape[1]
+        rows = jnp.arange(S)[:, None]                   # [S, 1]
+        pcols = pos[:, None] + jnp.arange(K)[None, :]   # [S, K]
+        gate = active[:, None, None, None]
+        # gather clips an out-of-range row to L-1 (value unused: its
+        # write is dropped); scatter DROPS out-of-range rows outright,
+        # so the duplicate-index clobber a clipped write would risk
+        # cannot happen
+        old_k = cache["k"].at[rows, pcols].get(mode="clip")
+        old_v = cache["v"].at[rows, pcols].get(mode="clip")
+        k_cache = cache["k"].at[rows, pcols].set(
+            jnp.where(gate, k.reshape(S, K, H, hd), old_k), mode="drop")
+        v_cache = cache["v"].at[rows, pcols].set(
+            jnp.where(gate, v.reshape(S, K, H, hd), old_v), mode="drop")
+        qh = q.reshape(S, K, H, hd)
+        scores = jnp.einsum("skhd,slhd->shkl", qh,
+                            k_cache) / math.sqrt(hd)    # [S, H, K, L]
+        mask = (jnp.arange(L)[None, None, None, :]
+                <= pcols[:, None, :, None])             # [S, 1, K, L]
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        att = jax.nn.softmax(scores.astype(jnp.float32),
+                             -1).astype(x.dtype)
+        out = jnp.einsum("shkl,slhd->skhd", att, v_cache).reshape(S, K, D)
+        x = x + out @ p["attn"]["wo"]
+        h = _layer_norm(x, p["ln2"]["g"], p["ln2"]["b"])
+        m = jax.nn.gelu(h @ p["mlp"]["w1"] + p["mlp"]["b1"])
+        y = x + m @ p["mlp"]["w2"] + p["mlp"]["b2"]
+        return y, {"k": k_cache, "v": v_cache}
+
+    return block_verify
+
+
+def make_slot_verify_fn(n_heads, k):
+    """One SPECULATIVE iteration of continuous-batching decode — up to K
+    tokens per device dispatch, the whole model:
+
+    verify(aux, blocks, cache, pos [S], toks [S, K], active [S])
+      -> (nxt [S, K] i32, n_acc [S] i32, logits [S, K, V] f32,
+          new cache, new pos)
+
+    toks[s, 0] is slot s's LAST ACCEPTED token and toks[s, 1:] are K-1
+    draft tokens (any values — a garbage draft costs acceptance, never
+    correctness). The K-wide causal forward writes their k/v at rows
+    pos[s]..pos[s]+K-1 and emits greedy argmax at every position;
+    nxt[s, i] is what plain greedy decode WOULD emit after stream prefix
+    ..toks[s, :i+1], so acceptance-by-exact-match is computed on device:
+    n_acc[s] = length of the longest prefix with nxt[s, i] == toks[s, i+1].
+    The scheduler consumes nxt[s, :n_acc[s]+1] — the matched drafts plus
+    one bonus token (the model's own choice at the first divergence) —
+    so BY CONSTRUCTION the emitted stream is this program's own greedy
+    argmax chain: a draft can only change the dispatch count, never the
+    tokens. Identity with the 1-wide decode program's stream additionally
+    rests on argmax parity across dispatch widths — the same measured
+    cross-shape property the prefill/decode pin already relies on (gemm
+    rows bit-stable across M on the tested backends; near-tie logits are
+    the theoretical exposure) — and is pinned by test across K, draft
+    sources, and batch compositions. pos advances by n_acc+1 per slot;
+    rejected-suffix rows are dead cache rows the pointer never passed,
+    overwritten by the next dispatch's writes before any query can attend
+    to them (the bucket-prefill argument). k=1 degenerates to exactly one
+    token per dispatch (no drafts, bonus only) — plain decode through the
+    verify program."""
+    block_verify = make_slot_verify_block_fn(n_heads)
+    k = int(k)
+    if k < 1:
+        raise ValueError(f"speculative width k must be >= 1, got {k}")
+
+    def verify(aux, blocks, cache, pos, toks, active):
+        max_len = aux["pos"].shape[0]
+        pcols = jnp.clip(pos[:, None] + jnp.arange(k)[None, :],
+                         0, max_len - 1)
+        x = aux["tok"][toks] + aux["pos"][pcols]        # [S, K, D]
+        new_cache = []
+        for p, c in zip(blocks, cache):
+            x, c = block_verify(p, x, c, pos, active)
+            new_cache.append(c)
+        logits = logits_fn(aux, x).astype(jnp.float32)  # [S, K, V]
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)  # [S, K]
+        match = (nxt[:, :k - 1] == toks[:, 1:]).astype(jnp.int32)
+        n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [S], 0..K-1
+        new_pos = pos + jnp.where(active, n_acc + 1, 0).astype(pos.dtype)
+        return nxt, n_acc.astype(jnp.int32), logits, new_cache, new_pos
+
+    return verify
+
+
 def prefill_forward(aux, blocks, tokens, n_heads, cache_len):
     """One causal forward over `tokens` [B, P] through the SHARED
     attention core (`causal_attention(return_kv=True)`), filling rows
@@ -494,8 +608,48 @@ class TransformerLM:
             h = self.block_fn(p, h)
         return logits_fn(self.aux, h)
 
+    def _decode_step(self):
+        """The ONE jitted single-token KV-cache decode step (lazy): shared
+        by generate(use_cache=True) and the speculative path's prefill so
+        the two can never drift."""
+        if self._jit_decode is None:
+            block_decode = make_decode_block_fn(self.n_heads)
+
+            def step(aux, blocks, cache, pos, token):
+                x = aux["tok"][token] + aux["pos"][pos]      # [1, D]
+                new_cache = []
+                for p, c in zip(blocks, cache):
+                    x, c = block_decode(p, x, c, pos)
+                    new_cache.append(c)
+                return logits_fn(aux, x)[0], new_cache
+
+            self._jit_decode = jax.jit(step, donate_argnums=(2,))
+        return self._jit_decode
+
+    def _spec_verify(self, k):
+        """Jitted K-wide verify program per speculative width (cache and
+        pos donated — they are the decode state, rebound every call). One
+        program per k; batch size retraces inside the same jit."""
+        progs = getattr(self, "_spec_verify_cache", None)
+        if progs is None:
+            progs = self._spec_verify_cache = {}
+        prog = progs.get(int(k))
+        if prog is None:
+            prog = progs[int(k)] = jax.jit(
+                make_slot_verify_fn(self.n_heads, k),
+                donate_argnums=(2, 3))
+        return prog
+
+    @staticmethod
+    def _unwrap_draft(draft, k):
+        """Accept a bare DraftSource or a serving.speculate.Speculator
+        bundle (duck-typed: has .draft and .k) for the `draft=` kwarg."""
+        if hasattr(draft, "draft") and hasattr(draft, "k"):
+            return draft.draft, int(draft.k)
+        return draft, int(k)
+
     def generate(self, prompt, max_new_tokens=32, temperature=0.0, seed=0,
-                 use_cache=False):
+                 use_cache=False, draft=None, speculate_k=4):
         """Autoregressive continuation of `prompt` (list/array of token
         ids). temperature 0 = greedy argmax; >0 = sampled.
 
@@ -504,10 +658,20 @@ class TransformerLM:
         step with a device-resident KV cache (`make_decode_block_fn`) —
         O(T) per token, the serving path. Both produce identical greedy
         outputs (pinned by test); generation is capped at max_len with a
-        cache (no sliding window)."""
+        cache (no sliding window).
+
+        draft=<DraftSource or Speculator> (serving/speculate.py) turns on
+        SPECULATIVE decoding: `speculate_k`-wide verify dispatches accept
+        up to K tokens each (greedy-only; the token stream is pinned
+        bit-identical to the non-speculative paths — acceptance is by
+        exact argmax match, so a bad draft costs throughput, never
+        correctness)."""
         toks = list(np.asarray(prompt).ravel().astype(int))
         if not toks:
             raise ValueError("prompt must contain at least one token")
+        if draft is not None:
+            return self._spec_generate(toks, int(max_new_tokens), draft,
+                                       speculate_k, temperature)
         rng = np.random.default_rng(seed)
         max_len = self.aux["pos"].shape[0]
 
@@ -529,18 +693,7 @@ class TransformerLM:
             raise ValueError(
                 f"prompt+new tokens ({len(toks)}+{max_new_tokens}) exceed "
                 f"max_len {max_len} (the KV cache has no sliding window)")
-        if self._jit_decode is None:
-            block_decode = make_decode_block_fn(self.n_heads)
-
-            def step(aux, blocks, cache, pos, token):
-                x = aux["tok"][token] + aux["pos"][pos]      # [1, D]
-                new_cache = []
-                for p, c in zip(blocks, cache):
-                    x, c = block_decode(p, x, c, pos)
-                    new_cache.append(c)
-                return logits_fn(aux, x)[0], new_cache
-
-            self._jit_decode = jax.jit(step, donate_argnums=(2,))
+        step = self._decode_step()
         cache = init_kv_cache(len(self.blocks), 1, max_len,
                               self.aux["tok"].shape[1], self.n_heads,
                               self.aux["tok"].dtype)
@@ -548,21 +701,145 @@ class TransformerLM:
         # compiled step (simple; a batched prefill is the known next step)
         logit = None
         for pos, t in enumerate(toks):
-            logit, cache = self._jit_decode(
+            logit, cache = step(
                 self.aux, self.blocks, cache, jnp.asarray(pos, jnp.int32),
                 jnp.asarray([t], jnp.int32))
         n_new = int(max_new_tokens)
         for i in range(n_new):
             toks.append(pick(logit))
             if i < n_new - 1:    # no decode needed after the last token
-                logit, cache = self._jit_decode(
+                logit, cache = step(
                     self.aux, self.blocks, cache,
                     jnp.asarray(len(toks) - 1, jnp.int32),
                     jnp.asarray([toks[-1]], jnp.int32))
         return toks
 
+    def _spec_generate(self, toks, n_new, draft, k, temperature):
+        """generate(draft=...): single-request speculative greedy decode.
+        Prefill rides the SAME sequential single-token step as
+        generate(use_cache=True) (first emitted token trivially
+        bit-identical); then each `verify` dispatch accepts 1..K tokens."""
+        if float(temperature) > 0.0:
+            raise ValueError("speculative decoding is greedy-only "
+                             "(acceptance is by exact argmax match); got "
+                             f"temperature={temperature}")
+        draft, k = self._unwrap_draft(draft, k)
+        if n_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {n_new}")
+        max_len = self.aux["pos"].shape[0]
+        if len(toks) + n_new > max_len:
+            raise ValueError(
+                f"prompt+new tokens ({len(toks)}+{n_new}) exceed "
+                f"max_len {max_len} (the KV cache has no sliding window)")
+        step = self._decode_step()
+        cache = init_kv_cache(len(self.blocks), 1, max_len,
+                              self.aux["tok"].shape[1], self.n_heads,
+                              self.aux["tok"].dtype)
+        logit = None
+        for pos, t in enumerate(toks):
+            logit, cache = step(
+                self.aux, self.blocks, cache, jnp.asarray(pos, jnp.int32),
+                jnp.asarray([t], jnp.int32))
+        out = list(toks)
+        out.append(int(np.asarray(logit, np.float32).argmax()))
+        if n_new == 1:
+            return out
+        verify = self._spec_verify(k)
+        key = object()                      # per-call draft stream
+        draft.start(key, out)               # prompt + first accepted token
+        pos_arr = jnp.asarray([len(toks)], jnp.int32)
+        active = jnp.ones((1,), bool)
+        n_out = 1
+        try:
+            while n_out < n_new:
+                # never draft past the remaining budget (a ModelDraft
+                # would pay dispatches for tokens that can't be taken)
+                dr = list(draft.propose(
+                    key, min(k - 1, n_new - n_out - 1)))[:k - 1]
+                row = [out[-1]] + dr + [0] * (k - 1 - len(dr))
+                nxt, n_acc, _, cache, pos_arr = verify(
+                    self.aux, self.blocks, cache, pos_arr,
+                    jnp.asarray([row], jnp.int32), active)
+                take = min(int(np.asarray(n_acc)[0]) + 1, n_new - n_out)
+                acc = [int(t) for t in np.asarray(nxt)[0, :take]]
+                out.extend(acc)
+                n_out += take
+                if n_out < n_new:
+                    draft.observe(key, acc)
+        finally:
+            draft.stop(key)
+        return out
+
+    def _spec_generate_batch(self, prompts, n_new, draft, k, temperature):
+        """generate_batch(draft=...): batched speculative greedy decode.
+        One parallel prefill (the SHARED `prefill_forward`), then K-wide
+        verify dispatches over all rows; rows advance 1..K tokens per
+        dispatch independently (per-row positions) and finished rows go
+        inactive until every row has its n_new tokens."""
+        if float(temperature) > 0.0:
+            raise ValueError("speculative decoding is greedy-only "
+                             "(acceptance is by exact argmax match); got "
+                             f"temperature={temperature}")
+        draft, k = self._unwrap_draft(draft, k)
+        prompts = jnp.asarray(np.asarray(prompts), jnp.int32)
+        B, P = prompts.shape
+        if n_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {n_new}")
+        max_len = self.aux["pos"].shape[0]
+        if P + n_new > max_len:
+            raise ValueError(
+                f"prompt+new tokens ({P}+{n_new}) exceed max_len "
+                f"{max_len} (the KV cache has no sliding window)")
+        prog = getattr(self, "_spec_prefill", None)
+        if prog is None:
+            n_heads = self.n_heads
+
+            def pre(aux, blocks, prompts):
+                h, cache = prefill_forward(aux, blocks, prompts, n_heads,
+                                           aux["pos"].shape[0])
+                return logits_fn(aux, h[:, -1]).astype(jnp.float32), cache
+
+            prog = self._spec_prefill = jax.jit(pre)
+        logit, cache = prog(self.aux, self.blocks, prompts)
+        first = np.argmax(np.asarray(logit), -1)
+        prompts_np = np.asarray(prompts)
+        gens = [[int(first[i])] for i in range(B)]
+        keys = [object() for _ in range(B)]
+        for i in range(B):
+            draft.start(keys[i], prompts_np[i].tolist() + gens[i])
+        verify = self._spec_verify(k)
+        pos = jnp.full((B,), P, jnp.int32)
+        try:
+            while any(len(g) < n_new for g in gens):
+                toks_np = np.zeros((B, k), np.int32)
+                active_np = np.zeros((B,), bool)
+                for i, g in enumerate(gens):
+                    if len(g) >= n_new:
+                        continue
+                    active_np[i] = True
+                    dr = list(draft.propose(
+                        keys[i], min(k - 1, n_new - len(g) - 1)))[:k - 1]
+                    toks_np[i, :1 + len(dr)] = [g[-1]] + dr
+                nxt, n_acc, _, cache, pos = verify(
+                    self.aux, self.blocks, cache, pos,
+                    jnp.asarray(toks_np), jnp.asarray(active_np))
+                nxt_np, nacc_np = np.asarray(nxt), np.asarray(n_acc)
+                for i, g in enumerate(gens):
+                    if not active_np[i]:
+                        continue
+                    take = min(int(nacc_np[i]) + 1, n_new - len(g))
+                    acc = [int(t) for t in nxt_np[i, :take]]
+                    g.extend(acc)
+                    if len(g) < n_new:
+                        draft.observe(keys[i], acc)
+        finally:
+            for key in keys:
+                draft.stop(key)
+        return np.concatenate(
+            [prompts_np, np.asarray(gens, np.int32)], 1)
+
     def generate_batch(self, prompts, max_new_tokens, temperature=0.0,
-                       seed=0):
+                       seed=0, draft=None, speculate_k=4):
         """Batched KV-cache decode, entire generation in ONE jitted
         program: a PARALLEL prefill (one causal forward over the whole
         prompt fills every layer's cache — MXU-shaped, not P sequential
@@ -581,7 +858,16 @@ class TransformerLM:
         batcher pads/buckets upstream). Returns [B, P + max_new_tokens].
         reference parity: MultiLayerNetwork.rnnTimeStep
         (MultiLayerNetwork.java:2196) — O(1)-state streaming inference,
-        attention era."""
+        attention era.
+
+        draft=<DraftSource or Speculator> switches to SPECULATIVE greedy
+        decode (`_spec_generate_batch`): up to `speculate_k` tokens per
+        verify dispatch per row, token streams pinned bit-identical to
+        this path's greedy rows."""
+        if draft is not None:
+            return self._spec_generate_batch(prompts, int(max_new_tokens),
+                                             draft, speculate_k,
+                                             temperature)
         prompts = jnp.asarray(np.asarray(prompts), jnp.int32)
         B, P = prompts.shape
         n_new = int(max_new_tokens)

@@ -14,6 +14,11 @@ serving systems converge on, built here over the existing containers:
     program at token granularity, prefill separated per prompt bucket.
   * Hot model swap on both: new checkpoints route new work while in-flight
     work drains — zero dropped requests, zero recompiles.
+  * Speculative decoding (`speculate.py`): a cheap draft (`NGramDraft`
+    prompt-lookup or `ModelDraft` small-model) proposes K-1 tokens and
+    ONE K-wide verify dispatch accepts 1..K of them — greedy streams
+    pinned bit-identical to plain decode (acceptance-by-exact-argmax-
+    match), so speculation is a pure dispatch-amortization lever.
 
 `ServingMetrics` (p50/p99, queue depth, occupancy, shed/swap counts)
 feeds the existing UI via `ui.stats.ServingStatsReporter`; deadlines,
@@ -26,9 +31,11 @@ from .server import (DeadlineExceededError, InferenceServer,
                      ServerClosedError, ServerOverloadedError,
                      ServingError, UnhealthyOutputError)
 from .decode import ContinuousDecodeServer
+from .speculate import DraftSource, ModelDraft, NGramDraft, Speculator
 
 __all__ = [
     "InferenceServer", "ContinuousDecodeServer", "ServingMetrics",
     "ServingError", "ServerOverloadedError", "DeadlineExceededError",
     "UnhealthyOutputError", "ServerClosedError",
+    "Speculator", "DraftSource", "NGramDraft", "ModelDraft",
 ]

@@ -25,6 +25,23 @@ active mask restricted to that version's slots, and new requests route
 to the newest version immediately — zero admission stall, zero dropped
 in-flight requests. Drained versions are released on request completion
 AND on idle iterations, so repeated swaps never accumulate dead params.
+
+Speculative decoding (`speculate=`, serving/speculate.py): the 1-token
+step is replaced by a K-wide verify program (`make_slot_verify_fn`) —
+each iteration drafts K-1 tokens per slot (host-side n-gram lookup or a
+small draft model) and ONE dispatch accepts 1..K of them per slot.
+Slots advance VARIABLE token counts per iteration (the per-slot
+positions already support ragged advance), streams stay bit-identical
+to plain greedy decode (the accepted tokens are the verify program's
+own argmax chain by construction; cross-width argmax parity is pinned
+by test — see speculate.py), and speculation composes with the
+dual-version swap drain (verify runs under the slot's pinned version;
+the draft needs no pinning — it can only cost acceptance).
+
+Deadlines are enforced mid-decode, not just at admission: a slot whose
+request outlives its latency budget is evicted between iterations
+(future fails with DeadlineExceededError, shed counted, slot refilled
+the same iteration).
 """
 from __future__ import annotations
 
@@ -40,6 +57,32 @@ from .server import (DeadlineExceededError, ServerClosedError,
                      _RequestLoop)
 
 log = logging.getLogger(__name__)
+
+
+def _fail_future(fut, exc):
+    """set_exception unless the caller already resolved/cancelled it.
+    The done() pre-check alone races a concurrent cancel() — and several
+    call sites run OUTSIDE _loop_once's try, where an InvalidStateError
+    would kill the serve thread permanently. Returns True when the
+    exception was delivered (callers count metrics only then)."""
+    try:
+        if not fut.done():
+            fut.set_exception(exc)
+            return True
+    except cf.InvalidStateError:
+        pass
+    return False
+
+
+def _resolve_future(fut, result):
+    """set_result, tolerating a concurrently cancel()ed future."""
+    try:
+        if not fut.done():
+            fut.set_result(result)
+            return True
+    except cf.InvalidStateError:
+        pass
+    return False
 
 
 class _DecodeRequest:
@@ -74,9 +117,10 @@ class ContinuousDecodeServer(_RequestLoop):
     def __init__(self, lm, slots=4, prompt_buckets=(8, 16, 32),
                  max_queue=64, fault_injector=None, retry_policy=None,
                  metrics=None, stats_reporter=None, report_every=64,
-                 static_batching=False):
+                 static_batching=False, speculate=None):
         from ..models.zoo.transformer import (make_prefill_fn,
                                               make_slot_decode_fn)
+        from .speculate import as_speculator
         import jax
 
         self.lm = lm
@@ -107,6 +151,15 @@ class ContinuousDecodeServer(_RequestLoop):
         # donated — they are THE device state, rebound every iteration.
         self._step = jax.jit(make_slot_decode_fn(n_heads),
                              donate_argnums=(2, 3))
+        # speculative decoding (serving/speculate.py): ONE K-wide verify
+        # program replaces the 1-token step for every iteration — drafts
+        # in, 1..K accepted tokens out per slot per dispatch, token
+        # streams pinned bit-identical to the plain step. The program is
+        # the model's OWN cached verify jit (`_spec_verify`), shared with
+        # generate(draft=...) so the same (model, K) never compiles twice.
+        self._spec = as_speculator(speculate)
+        self._verify = (None if self._spec is None else
+                        lm._spec_verify(self._spec.k))
         self._prefills = {}                      # bucket -> jitted program
         self._make_prefill = lambda: jax.jit(make_prefill_fn(
             n_heads, self.max_len))
@@ -192,6 +245,10 @@ class ContinuousDecodeServer(_RequestLoop):
         self._pos = jnp.zeros((self.slots,), jnp.int32)
         self._tok = jnp.zeros((self.slots,), jnp.int32)
         self._slot_req = [None] * self.slots     # host-side occupancy
+        spec = getattr(self, "_spec", None)      # unset on first call
+        if spec is not None:
+            for s in range(self.slots):          # idempotent stops
+                spec.draft.stop(s)
 
     @property
     def prefill_programs(self):
@@ -235,9 +292,9 @@ class ContinuousDecodeServer(_RequestLoop):
         req.generated.append(first)
         if len(req.generated) >= req.max_new:
             # one-token request: done at prefill, never occupies a slot
-            req.future.set_result(list(req.prompt) + req.generated)
-            self.metrics.record_request(
-                (time.monotonic() - req.t_submit) * 1e3)
+            if _resolve_future(req.future, list(req.prompt) + req.generated):
+                self.metrics.record_request(
+                    (time.monotonic() - req.t_submit) * 1e3)
             return
         self._cache = self._install(self._cache, rows, slot)
         self._pos = self._pos.at[slot].set(len(req.prompt))
@@ -245,6 +302,10 @@ class ContinuousDecodeServer(_RequestLoop):
         req.slot = slot
         req.version = vidx
         self._slot_req[slot] = req
+        if self._spec is not None:
+            # draft stream keyed by slot: full context so far (slot reuse
+            # is safe — start() resets the key, _free_slot stops it)
+            self._spec.draft.start(slot, list(req.prompt) + req.generated)
 
     def _admit_pending(self, timeout=0.0):
         """Fill free slots from the queue. `timeout` blocks on the FIRST
@@ -271,24 +332,62 @@ class ContinuousDecodeServer(_RequestLoop):
                     req = None
                 elif req.deadline is not None and \
                         time.monotonic() > req.deadline:
-                    req.future.set_exception(DeadlineExceededError(
-                        "deadline expired before prefill"))
-                    self.metrics.count("shed_deadline")
+                    if _fail_future(req.future, DeadlineExceededError(
+                            "deadline expired before prefill")):
+                        self.metrics.count("shed_deadline")
                     req = None
             try:
                 self._admit(req, s)
             except BaseException as e:  # noqa: BLE001 — fail THIS request
-                req.future.set_exception(e)
+                _fail_future(req.future, e)
                 self.metrics.count("failed")
 
+    def _free_slot(self, slot):
+        """Release `slot`'s host-side occupancy (and its draft stream).
+        Device rows/pos are left stale on purpose: the next admission
+        resets pos and decode overwrites rows before attending (the
+        dead-row contract)."""
+        self._slot_req[slot] = None
+        if self._spec is not None:
+            self._spec.draft.stop(slot)
+
+    def _evict_expired(self):
+        """Mid-decode deadline enforcement: a slot whose request deadline
+        has passed is evicted BETWEEN iterations — future fails with
+        DeadlineExceededError, the shed is counted, and the slot frees
+        THIS iteration (the following `_admit_pending` can refill it).
+        Admission-time shedding (`_admit_pending`) only protects requests
+        that expire in the queue; this protects the slots themselves from
+        requests whose token budget outlives their latency budget."""
+        now = time.monotonic()
+        evicted = False
+        for s, r in enumerate(self._slot_req):
+            if r is None or r.deadline is None or now <= r.deadline:
+                continue
+            if _fail_future(r.future, DeadlineExceededError(
+                    f"deadline expired mid-decode after "
+                    f"{len(r.generated)} tokens")):
+                self.metrics.count("shed_deadline")
+                self.metrics.count("evicted_mid_decode")
+            self._free_slot(s)
+            evicted = True
+        if evicted:
+            self._gc_versions()
+
     def _decode_iteration(self):
-        """One token for every occupied slot: one dispatch per live param
-        version, active mask restricted to that version's slots."""
+        """One scheduling iteration for every occupied slot: one dispatch
+        per live param version, active mask restricted to that version's
+        slots. Plain mode advances every slot exactly one token;
+        speculative mode (`speculate=`) advances each slot 1..K tokens
+        per dispatch (per-slot positions already support ragged
+        advance)."""
         import jax.numpy as jnp
         live = [(s, r) for s, r in enumerate(self._slot_req)
                 if r is not None]
         if not live:
             return False
+        if self._spec is not None:
+            return self._spec_iteration(live)
         self.metrics.record_occupancy(len(live), self.slots)
         versions = sorted({r.version for _, r in live})
         new_tok = {}
@@ -316,12 +415,14 @@ class ContinuousDecodeServer(_RequestLoop):
                     on_retry=lambda a, e, d: self.metrics.count("retries"))
             else:
                 nxt, _, self._cache, self._pos = dispatch()
+            self.metrics.count("dispatches")
             nxt = np.asarray(nxt)
             for s, r in live:
                 if r.version == v:
                     new_tok[s] = int(nxt[s])
         self._tok = jnp.asarray(
             [new_tok.get(s, 0) for s in range(self.slots)], jnp.int32)
+        self.metrics.count("tokens_out", len(live))
         done_any = False
         t_now = time.monotonic()
         for s, r in live:
@@ -330,18 +431,111 @@ class ContinuousDecodeServer(_RequestLoop):
                 # the final token needs no decode step (generate() makes
                 # the same point): resolve and free the slot
                 r.generated = r.generated[:r.max_new]
-                r.future.set_result(list(r.prompt) + r.generated)
-                self.metrics.record_request((t_now - r.t_submit) * 1e3)
-                self._slot_req[s] = None
+                if _resolve_future(r.future,
+                                   list(r.prompt) + r.generated):
+                    self.metrics.record_request(
+                        (t_now - r.t_submit) * 1e3)
+                self._free_slot(s)
                 done_any = True
         if done_any:
             self._gc_versions()
+        self._after_iteration()
+        return True
+
+    def _spec_iteration(self, live):
+        """One SPECULATIVE iteration: per live version, gather each
+        slot's draft (K-1 tokens, zero-padded — padding costs acceptance,
+        never correctness), run ONE K-wide verify dispatch, and advance
+        each slot by its accepted count (matched prefix + bonus). The
+        emitted stream is the verify program's own greedy argmax chain —
+        acceptance only decides the dispatch count; bit-identity with
+        the plain step's stream is pinned by test (cross-width argmax
+        parity, speculate.py). Draft and verify are both evaluated
+        under the slot's pinned param version (`r.version`); the draft
+        source itself needs no pinning because a mismatched draft cannot
+        alter accepted tokens."""
+        import jax.numpy as jnp
+        self.metrics.record_occupancy(len(live), self.slots)
+        K = self._spec.k
+        draft = self._spec.draft
+        d0 = getattr(draft, "dispatch_count", 0)   # ModelDraft device cost
+        versions = sorted({r.version for _, r in live})
+        done_any = False
+        for v in versions:
+            live_v = [(s, r) for s, r in live if r.version == v]
+            active = np.zeros((self.slots,), bool)
+            toks = np.zeros((self.slots, K), np.int32)
+            n_dr = {}
+            for s, r in live_v:
+                active[s] = True
+                # never request drafts past the request's remaining token
+                # budget: a ModelDraft would pay real dispatches for
+                # tokens that can never be accepted, and the acceptance
+                # reservoir would log them as misses
+                n_want = r.max_new - len(r.generated)
+                dr = list(draft.propose(
+                    s, min(K - 1, n_want - 1)))[:K - 1]
+                n_dr[s] = len(dr)
+                toks[s, :1 + len(dr)] = [r.generated[-1]] + dr
+            aux, blocks = self._versions[v]
+
+            def dispatch():
+                if self._injector is not None:
+                    self._injector.fire("serve.batch")
+                return self._verify(aux, blocks, self._cache, self._pos,
+                                    jnp.asarray(toks), jnp.asarray(active))
+
+            # same donated-buffer retry contract as the plain step: the
+            # injector site sits BEFORE the compiled call (the transient
+            # tunnel-hiccup class); a failure inside it is terminal here
+            if self._retry is not None:
+                nxt, n_acc, _, self._cache, self._pos = self._retry.call(
+                    dispatch,
+                    on_retry=lambda a, e, d: self.metrics.count("retries"))
+            else:
+                nxt, n_acc, _, self._cache, self._pos = dispatch()
+            self.metrics.count("dispatches")
+            nxt = np.asarray(nxt)
+            n_acc = np.asarray(n_acc)
+            t_now = time.monotonic()
+            for s, r in live_v:
+                want = r.max_new - len(r.generated)
+                take = min(int(n_acc[s]) + 1, want)
+                acc = [int(t) for t in nxt[s, :take]]
+                r.generated.extend(acc)
+                self.metrics.count("tokens_out", take)
+                # drafted = REAL draft tokens (zero-padding is not a
+                # draft); matched likewise capped — a pad that happens to
+                # equal the argmax is accepted (it IS the argmax) but
+                # credits luck, not the draft
+                self.metrics.record_speculation(
+                    take, n_dr[s], min(int(n_acc[s]), take, n_dr[s]))
+                if len(r.generated) >= r.max_new:
+                    if _resolve_future(r.future,
+                                       list(r.prompt) + r.generated):
+                        self.metrics.record_request(
+                            (t_now - r.t_submit) * 1e3)
+                    self._free_slot(s)
+                    done_any = True
+                else:
+                    draft.observe(s, acc)
+        dd = getattr(draft, "dispatch_count", 0) - d0
+        if dd:
+            # a ModelDraft pays real device dispatches for its proposals;
+            # count them so dispatch amortization stays honest (NGramDraft
+            # never moves this — host-only)
+            self.metrics.count("draft_dispatches", dd)
+        if done_any:
+            self._gc_versions()
+        self._after_iteration()
+        return True
+
+    def _after_iteration(self):
         self.metrics.count("batches")       # decode iterations
         if self._reporter is not None and \
                 self.metrics.count_value("batches") % self._report_every \
                 == 0:
             self._reporter.report(self.metrics.snapshot())
-        return True
 
     def _gc_versions(self):
         """Drop drained old param versions (keep indices stable: only a
@@ -357,6 +551,9 @@ class ContinuousDecodeServer(_RequestLoop):
         return any(r is not None for r in self._slot_req)
 
     def _loop_once(self):
+        # evict deadline-expired slots FIRST so the admit below can refill
+        # them in the same iteration
+        self._evict_expired()
         # idle (no slot occupied): block on the queue up to 50 ms instead
         # of spinning at the decode tick; busy: drain the queue non-blocking
         self._admit_pending(timeout=0.0 if self._busy() else 0.05)
@@ -371,8 +568,7 @@ class ContinuousDecodeServer(_RequestLoop):
             # thread.
             n_failed = 0
             for r in self._slot_req:
-                if r is not None and not r.future.done():
-                    r.future.set_exception(e)
+                if r is not None and _fail_future(r.future, e):
                     n_failed += 1
             if n_failed:
                 self.metrics.count("failed", n_failed)

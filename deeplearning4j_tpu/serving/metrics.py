@@ -48,6 +48,10 @@ class ServingMetrics:
         self._queue_depth = collections.deque(maxlen=self._window)
         self._occupancy = collections.deque(maxlen=self._window)
         self._batch_sizes = collections.deque(maxlen=self._window)
+        # speculative decode reservoirs (serving/speculate.py): accepted
+        # tokens per slot-dispatch and draft acceptance rate
+        self._spec_accepted = collections.deque(maxlen=self._window)
+        self._spec_accept_rate = collections.deque(maxlen=self._window)
 
     # -- hot-path recorders -------------------------------------------
     def count(self, key, n=1):
@@ -73,6 +77,18 @@ class ServingMetrics:
         with self._lock:
             self._occupancy.append(active / float(slots) if slots else 0.0)
 
+    def record_speculation(self, accepted, drafted, matched):
+        """One slot's share of one speculative verify dispatch: `accepted`
+        tokens emitted (matched prefix + bonus), `matched` of the
+        `drafted` draft tokens confirmed by the verify argmax."""
+        with self._lock:
+            self._counts["spec_tokens"] += int(accepted)
+            self._counts["spec_drafted"] += int(drafted)
+            self._counts["spec_matched"] += int(matched)
+            self._spec_accepted.append(int(accepted))
+            if drafted:
+                self._spec_accept_rate.append(matched / float(drafted))
+
     # -- read-out ------------------------------------------------------
     def count_value(self, key):
         with self._lock:
@@ -85,6 +101,8 @@ class ServingMetrics:
             occ = list(self._occupancy)
             depth = list(self._queue_depth)
             sizes = list(self._batch_sizes)
+            spec_acc = list(self._spec_accepted)
+            spec_rate = list(self._spec_accept_rate)
             out = dict(self._counts)
         out["latency_ms_p50"] = _pct(lat, 50)
         out["latency_ms_p99"] = _pct(lat, 99)
@@ -94,4 +112,21 @@ class ServingMetrics:
         out["queue_depth_max"] = max(depth) if depth else 0
         out["batch_occupancy_mean"] = (sum(occ) / len(occ)) if occ else None
         out["batch_size_mean"] = (sum(sizes) / len(sizes)) if sizes else None
+        # speculative-decode view: recent accepted-tokens-per-dispatch and
+        # draft acceptance rate (reservoirs), plus the all-time dispatch
+        # amortization the whole feature exists to improve
+        out["spec_accepted_per_dispatch_mean"] = (
+            sum(spec_acc) / len(spec_acc)) if spec_acc else None
+        out["spec_acceptance_rate_mean"] = (
+            sum(spec_rate) / len(spec_rate)) if spec_rate else None
+        # dispatches_per_token = TARGET-model dispatches (decode/verify)
+        # per emitted token — the tunnel-amortization headline for a
+        # host-side draft; device_dispatches_per_token folds in the draft
+        # model's own dispatches (ModelDraft pays ~K-1 per round;
+        # NGramDraft pays zero) so a small-model draft cannot
+        # misread as a round-trip win it does not deliver
+        d, t = out.get("dispatches", 0), out.get("tokens_out", 0)
+        out["dispatches_per_token"] = (d / t) if t else None
+        out["device_dispatches_per_token"] = (
+            (d + out.get("draft_dispatches", 0)) / t) if t else None
         return out

@@ -443,6 +443,51 @@ class TestOperationalHardening:
             got = srv.predict(x, timeout=30)
         assert _bits_equal(got, np.asarray(net.output(np.stack([x, x])))[0])
 
+    def test_decode_deadline_evicted_mid_decode(self):
+        """A request whose deadline expires WHILE it occupies a slot is
+        evicted between iterations: its future fails with
+        DeadlineExceededError, the shed is counted, and the slot frees
+        the same iteration (a queued request takes it over immediately —
+        the server never rides a dead request to max_new)."""
+        lm = _lm()
+        rng = np.random.default_rng(22)
+        p = rng.integers(1, 64, 4).tolist()
+        # delay-only faults slow every decode iteration deterministically
+        # so the deadline reliably lands mid-decode, not at admission
+        inj = FaultInjector(seed=6).plan(
+            "serve.batch", on_calls=range(1, 60), times=60,
+            delay=0.02, exc=None)
+        with ContinuousDecodeServer(lm, slots=1, prompt_buckets=(4,),
+                                    fault_injector=inj) as srv:
+            doomed = srv.submit(p, 40, deadline_ms=100)
+            queued = srv.submit(p, 4)        # waits for the only slot
+            with pytest.raises(DeadlineExceededError,
+                               match="mid-decode"):
+                doomed.result(60)
+            assert queued.result(60) == lm.generate(p, max_new_tokens=4)
+        snap = srv.metrics.snapshot()
+        assert snap.get("evicted_mid_decode") == 1
+        assert snap.get("shed_deadline") == 1
+
+    def test_decode_cancelled_future_expiring_keeps_thread_alive(self):
+        """A caller-cancel()ed future whose deadline then expires must not
+        kill the serve thread (set_exception on a cancelled future raises
+        InvalidStateError): the slot is released silently and the server
+        keeps serving."""
+        lm = _lm()
+        p = [3, 9, 11, 4]
+        inj = FaultInjector(seed=7).plan(
+            "serve.batch", on_calls=range(1, 60), times=60,
+            delay=0.02, exc=None)
+        with ContinuousDecodeServer(lm, slots=1, prompt_buckets=(4,),
+                                    fault_injector=inj) as srv:
+            f = srv.submit(p, 40, deadline_ms=150)
+            time.sleep(0.05)
+            assert f.cancel() or f.done()
+            time.sleep(0.4)           # deadline passes on the dead future
+            got = srv.generate(p, 4, timeout=60)
+        assert got == lm.generate(p, max_new_tokens=4)
+
     def test_decode_deadline_shed_and_swap_site(self):
         lm = _lm()
         inj = FaultInjector(seed=4)

@@ -1,0 +1,333 @@
+"""Speculative decoding pins (ISSUE 5 acceptance criteria).
+
+  (a) Bit-identity: the speculative greedy token stream is IDENTICAL to
+      plain greedy decode — solo generate(), batched generate_batch(),
+      solo and co-batched through ContinuousDecodeServer, for K in
+      {2, 4, 8}, for BOTH draft sources (NGramDraft prompt-lookup and
+      ModelDraft small-model), and across a mid-stream hot swap.
+      Acceptance-by-exact-argmax-match makes the stream the verify
+      program's own argmax chain by construction — a draft only changes
+      the dispatch count — and these pins hold it to the plain decode
+      programs' chains across dispatch widths.
+  (b) Amortization: a perfectly-aligned draft (the target model drafting
+      for itself) accepts K tokens per dispatch — dispatches/token
+      = 1/K; a garbage draft still advances >= 1 token per dispatch.
+  (c) Speculation x faults: FaultInjector at `serve.batch` during a
+      verify dispatch — a retried transient keeps the stream
+      bit-identical; a terminal fault fails the slot LOUDLY and resets
+      state (the PR 4 plain-decode pin, re-proven under speculation).
+  (d) Speculation metrics (acceptance rate, tokens/dispatch) ride the
+      existing ServingMetrics -> ui/stats storage path.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.resilience import (FaultInjected,
+                                                  FaultInjector,
+                                                  RetryPolicy)
+from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+from deeplearning4j_tpu.serving import (ContinuousDecodeServer, ModelDraft,
+                                        NGramDraft, Speculator)
+
+
+def _lm(seed=3, max_len=64):
+    return TransformerLM(64, d_model=32, n_heads=2, n_layers=2,
+                         max_len=max_len, seed=seed)
+
+
+def _draft_lm(seed=21):
+    """A genuinely SMALLER draft model (the Leviathan setting); max_len
+    covers the target's plus the speculative overhang."""
+    return TransformerLM(64, d_model=16, n_heads=2, n_layers=1,
+                         max_len=80, seed=seed)
+
+
+def _prompt(seed=4, n=5):
+    return np.random.default_rng(seed).integers(1, 64, n).tolist()
+
+
+# ---------------------------------------------------------------------------
+# draft sources (host-side behavior)
+# ---------------------------------------------------------------------------
+class TestNGramDraft:
+    def test_prompt_lookup_proposes_continuation(self):
+        d = NGramDraft(n=3)
+        d.start("r", [1, 2, 3, 4, 5, 1, 2, 3])
+        # suffix [1,2,3] occurred at the start; continuation is [4,5,...]
+        assert d.propose("r", 3) == [4, 5, 1]
+        d.stop("r")
+
+    def test_most_recent_match_wins(self):
+        d = NGramDraft(n=2)
+        d.start("r", [7, 8, 1, 7, 8, 2, 7, 8])
+        assert d.propose("r", 1) == [2]     # recency, not first occurrence
+
+    def test_no_match_returns_empty(self):
+        d = NGramDraft(n=3, min_match=2)
+        d.start("r", [1, 2, 3, 4])
+        assert d.propose("r", 4) == []
+
+    def test_observe_extends_history(self):
+        d = NGramDraft(n=2)
+        d.start("r", [5, 6])
+        d.observe("r", [9, 5, 6])
+        assert d.propose("r", 1) == [9]
+
+    def test_stop_is_idempotent(self):
+        d = NGramDraft()
+        d.start("r", [1])
+        d.stop("r")
+        d.stop("r")                          # no KeyError
+
+
+# ---------------------------------------------------------------------------
+# (a) bit-identity: generate / generate_batch
+# ---------------------------------------------------------------------------
+class TestGenerateSpeculative:
+    def test_ngram_bit_identical_across_k(self):
+        lm = _lm()
+        p = _prompt()
+        plain = lm.generate(p, 20, use_cache=True)
+        for k in (2, 4, 8):
+            assert lm.generate(p, 20, draft=NGramDraft(),
+                               speculate_k=k) == plain
+
+    def test_k1_degenerates_to_plain_decode(self):
+        lm = _lm()
+        p = _prompt()
+        assert lm.generate(p, 12, draft=NGramDraft(),
+                           speculate_k=1) == lm.generate(p, 12,
+                                                         use_cache=True)
+
+    def test_model_draft_bit_identical(self):
+        lm = _lm()
+        p = _prompt()
+        plain = lm.generate(p, 16, use_cache=True)
+        assert lm.generate(p, 16, draft=ModelDraft(_draft_lm()),
+                           speculate_k=4) == plain
+
+    def test_speculator_bundle_accepted(self):
+        lm = _lm()
+        p = _prompt()
+        spec = Speculator(NGramDraft(), k=4)
+        assert lm.generate(p, 10, draft=spec) == lm.generate(
+            p, 10, use_cache=True)
+
+    def test_generate_batch_both_sources(self):
+        lm = _lm()
+        prompts = np.random.default_rng(5).integers(
+            1, 64, (3, 4)).astype(np.int32)
+        plain = lm.generate_batch(prompts, max_new_tokens=12)
+        for draft in (NGramDraft(), ModelDraft(_draft_lm())):
+            got = lm.generate_batch(prompts, max_new_tokens=12,
+                                    draft=draft, speculate_k=4)
+            assert np.array_equal(got, plain)
+
+    def test_greedy_only(self):
+        lm = _lm()
+        with pytest.raises(ValueError, match="greedy-only"):
+            lm.generate(_prompt(), 8, temperature=0.7, draft=NGramDraft())
+        with pytest.raises(ValueError, match="greedy-only"):
+            lm.generate_batch(np.asarray([[1, 2]], np.int32), 8,
+                              temperature=0.7, draft=NGramDraft())
+
+    def test_max_len_guard(self):
+        lm = _lm()
+        with pytest.raises(ValueError, match="max_len"):
+            lm.generate([1] * 10, 60, draft=NGramDraft())
+
+
+# ---------------------------------------------------------------------------
+# (a)+(b) serving: solo, co-batched, swap, amortization
+# ---------------------------------------------------------------------------
+class TestServerSpeculative:
+    def test_solo_and_join_bit_identical_across_k(self):
+        """For K in {2,4,8}: a speculative solo stream matches plain
+        decode, and a request JOINING a running speculative batch matches
+        its own solo stream (the continuous-decode pin, under ragged
+        multi-token slot advance)."""
+        lm = _lm()
+        rng = np.random.default_rng(4)
+        pa = rng.integers(1, 64, 5).tolist()
+        pb = rng.integers(1, 64, 8).tolist()
+        plain = lm.generate(pa, 10, use_cache=True)
+        for k in (2, 4, 8):
+            with ContinuousDecodeServer(
+                    lm, slots=4, prompt_buckets=(4, 8),
+                    speculate=Speculator(NGramDraft(), k=k)) as srv:
+                solo = srv.generate(pa, 10, timeout=60)
+                flong = srv.submit(pb, 24)      # running batch
+                time.sleep(0.05)
+                fa = srv.submit(pa, 10)         # joins mid-flight
+                joined = fa.result(60)
+                flong.result(60)
+            assert solo == plain
+            assert joined == solo
+
+    def test_model_draft_server_bit_identical(self):
+        lm = _lm()
+        p = _prompt()
+        with ContinuousDecodeServer(
+                lm, slots=2, prompt_buckets=(8,),
+                speculate=Speculator(ModelDraft(_draft_lm()), k=4)) as srv:
+            got = srv.generate(p, 14, timeout=60)
+        assert got == lm.generate(p, 14, use_cache=True)
+
+    def test_equal_arrival_matches_generate_batch(self):
+        lm = _lm()
+        prompts = np.random.default_rng(5).integers(
+            1, 64, (4, 4)).astype(np.int32)
+        expect = lm.generate_batch(prompts, max_new_tokens=8)
+        with ContinuousDecodeServer(
+                lm, slots=4, prompt_buckets=(4,),
+                speculate=Speculator(NGramDraft(), k=4)) as srv:
+            futs = [srv.submit(prompts[i], 8) for i in range(4)]
+            rows = [f.result(60) for f in futs]
+        for i in range(4):
+            assert rows[i] == expect[i].tolist()
+
+    def test_self_draft_accepts_k_per_dispatch(self):
+        """The target drafting for itself = every draft matches: exactly
+        K accepted tokens per dispatch, dispatches/token == 1/K — the
+        amortization ceiling the dispatch-cost model predicts."""
+        lm = _lm()
+        k = 4
+        with ContinuousDecodeServer(
+                lm, slots=2, prompt_buckets=(8,),
+                speculate=Speculator(ModelDraft(lm), k=k)) as srv:
+            got = srv.generate(_prompt(), 21, timeout=60)
+        assert got == lm.generate(_prompt(), 21, use_cache=True)
+        snap = srv.metrics.snapshot()
+        # 21 tokens: 1 at prefill, then 20 = 5 full-acceptance dispatches
+        assert snap["spec_accepted_per_dispatch_mean"] == pytest.approx(k)
+        assert snap["dispatches_per_token"] == pytest.approx(1.0 / k)
+        assert snap["spec_acceptance_rate_mean"] == pytest.approx(1.0)
+        # honesty: a MODEL draft pays its own device dispatches (~K-1 per
+        # round + context ingestion) — the folded-in metric must show the
+        # round-trip cost a host-side draft would not pay
+        assert snap["draft_dispatches"] > 0
+        assert snap["device_dispatches_per_token"] > \
+            3 * snap["dispatches_per_token"]
+
+    def test_garbage_draft_still_advances(self):
+        """A draft that never matches still advances one (bonus) token
+        per dispatch — speculation can degrade to plain-decode cost but
+        never stall or corrupt."""
+
+        class WorstDraft(NGramDraft):
+            def propose(self, key, k):
+                hist = self._hist[key]
+                return [(hist[-1] + 1) % 3 for _ in range(k)]
+
+        lm = _lm()
+        p = _prompt()
+        with ContinuousDecodeServer(
+                lm, slots=2, prompt_buckets=(8,),
+                speculate=Speculator(WorstDraft(), k=4)) as srv:
+            got = srv.generate(p, 10, timeout=60)
+        assert got == lm.generate(p, 10, use_cache=True)
+        snap = srv.metrics.snapshot()
+        assert snap["spec_accepted_per_dispatch_mean"] < 2.0
+        assert snap["dispatches_per_token"] <= 1.0
+
+    def test_swap_drain_speculative(self):
+        """Dual-version drain under speculation: the in-flight stream
+        finishes on pre-swap params bit-identical to a pre-swap solo run
+        while a post-swap request gets the new params — draft + verify
+        both evaluated under the slot's pinned version."""
+        lm1, lm2 = _lm(3), _lm(11)
+        rng = np.random.default_rng(10)
+        pa = rng.integers(1, 64, 4).tolist()
+        pb = rng.integers(1, 64, 4).tolist()
+        with ContinuousDecodeServer(
+                lm1, slots=2, prompt_buckets=(4,),
+                speculate=Speculator(NGramDraft(), k=4)) as srv:
+            solo_old = srv.generate(pa, 14, timeout=60)
+            fa = srv.submit(pa, 14)
+            time.sleep(0.03)                  # pa decoding on v0
+            srv.swap(lm2)
+            fb = srv.submit(pb, 5)            # admitted on v1
+            ra, rb = fa.result(60), fb.result(60)
+        assert ra == solo_old
+        expect_new = lm2.generate_batch(np.asarray([pb], np.int32),
+                                        max_new_tokens=5)
+        assert rb == expect_new[0].tolist()
+        assert srv.metrics.snapshot().get("failed", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# (c) speculation x faults
+# ---------------------------------------------------------------------------
+class TestSpeculationFaults:
+    def test_retry_keeps_stream_bit_identical(self):
+        """Transient fault at serve.batch on the FIRST verify dispatch
+        (call 0 is the admission prefill): the retry re-runs the verify
+        and the stream is unchanged."""
+        lm = _lm()
+        p = _prompt()
+        inj = FaultInjector(seed=1).plan("serve.batch", on_call=1,
+                                         exc=FaultInjected)
+        rp = RetryPolicy(max_retries=3, base_delay=0.001,
+                         retryable=(ConnectionError,))
+        with ContinuousDecodeServer(
+                lm, slots=2, prompt_buckets=(8,), fault_injector=inj,
+                retry_policy=rp,
+                speculate=Speculator(NGramDraft(), k=4)) as srv:
+            got = srv.generate(p, 10, timeout=60)
+        snap = srv.metrics.snapshot()
+        assert got == lm.generate(p, 10, use_cache=True)
+        assert snap.get("retries") == 1 and snap.get("failed", 0) == 0
+
+    def test_terminal_fault_fails_loudly_and_recovers(self):
+        lm = _lm()
+        p = _prompt()
+        inj = FaultInjector(seed=2).plan("serve.batch", on_call=1,
+                                         exc=FaultInjected)
+        with ContinuousDecodeServer(
+                lm, slots=2, prompt_buckets=(8,), fault_injector=inj,
+                speculate=Speculator(NGramDraft(), k=4)) as srv:
+            f = srv.submit(p, 6)
+            with pytest.raises(FaultInjected):
+                f.result(60)
+            # slot state reset (incl. the draft stream): serves again
+            got = srv.generate(p, 6, timeout=60)
+        assert got == lm.generate(p, 6, use_cache=True)
+        assert srv.metrics.snapshot().get("failed") == 1
+
+
+# ---------------------------------------------------------------------------
+# (d) metrics through the UI storage path
+# ---------------------------------------------------------------------------
+class TestSpeculationMetrics:
+    def test_spec_metrics_reach_ui_storage(self):
+        from deeplearning4j_tpu.ui.stats import ServingStatsReporter
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        lm = _lm()
+        storage = InMemoryStatsStorage()
+        rep = ServingStatsReporter(storage, session_id="spec_serve",
+                                   model_info={"model": "lm-spec"})
+        with ContinuousDecodeServer(
+                lm, slots=2, prompt_buckets=(8,), stats_reporter=rep,
+                report_every=1,
+                speculate=Speculator(NGramDraft(), k=4)) as srv:
+            srv.generate(_prompt(), 12, timeout=60)
+        serving = storage.get_latest_update("spec_serve")["serving"]
+        assert serving["spec_accepted_per_dispatch_mean"] >= 1.0
+        assert 0.0 <= serving["spec_acceptance_rate_mean"] <= 1.0
+        assert 0.0 < serving["dispatches_per_token"] <= 1.0
+        assert serving["spec_tokens"] == serving["tokens_out"]
+
+    def test_metrics_record_speculation_shape(self):
+        from deeplearning4j_tpu.serving import ServingMetrics
+        m = ServingMetrics(window=8)
+        m.count("dispatches", 2)
+        m.count("tokens_out", 6)
+        m.record_speculation(4, 3, 3)
+        m.record_speculation(2, 3, 1)
+        snap = m.snapshot()
+        assert snap["spec_accepted_per_dispatch_mean"] == 3.0
+        assert snap["spec_acceptance_rate_mean"] == pytest.approx(2 / 3)
+        assert snap["dispatches_per_token"] == pytest.approx(1 / 3)
+        assert snap["spec_tokens"] == 6 and snap["spec_matched"] == 4
