@@ -186,6 +186,15 @@ class TrainingHealthPolicy:
         # counters/events so concurrent rejects don't lose increments
         self._lock = threading.Lock()
 
+    def _count(self, key, n=1):
+        """Increment a health counter AND mirror it onto the process-wide
+        metrics registry (`train.health.<key>`), so run-health shows up
+        on the ui/server.py `/metrics` Prometheus route next to serving
+        and transport counters — the one named surface."""
+        self.counts[key] += n
+        from ..obs.registry import default_registry
+        default_registry().counter("train.health." + key).inc(n)
+
     # -- classification -------------------------------------------------
     def observe(self, health, round_index=None):
         """Classify one step. Returns OK / SKIP / SPIKE / ROLLBACK /
@@ -206,7 +215,7 @@ class TrainingHealthPolicy:
                 # checkpoint cadence. (The round's pmax grad-norm is
                 # contaminated by the skipped step, so spike checks are
                 # meaningless here and deliberately not applied.)
-                self.counts["skips"] += bad
+                self._count("skips", bad)
                 self.consecutive_bad = 0
                 self._event("skip", round_index,
                             reason=f"{bad}/{steps} local steps non-finite "
@@ -232,7 +241,7 @@ class TrainingHealthPolicy:
             want = ROLLBACK if self.rollback_on_spike else SPIKE
             return self._unhealthy(want, reason, round_index, score,
                                    grad_norm)
-        self.counts["ok"] += 1
+        self._count("ok")
         self.consecutive_bad = 0
         self._ingest(score)
         return OK
@@ -256,21 +265,21 @@ class TrainingHealthPolicy:
 
     def _unhealthy(self, want, reason, round_index, score, grad_norm):
         kind = "skip" if want == SKIP else "spike"
-        self.counts[kind + "s"] += 1
+        self._count(kind + "s")
         self.consecutive_bad += 1
         self._event(kind, round_index, reason=reason, score=score,
                     gradNorm=grad_norm)
         log.warning("training-health %s at round %s: %s", kind,
                     round_index, reason)
         if self.consecutive_bad >= self.max_consecutive_bad:
-            self.counts["aborts"] += 1
+            self._count("aborts")
             self._event("abort", round_index, reason=reason)
             return ABORT
         return want
 
     # -- bookkeeping hooks ----------------------------------------------
     def record_rollback(self, round_index, restored_round):
-        self.counts["rollbacks"] += 1
+        self._count("rollbacks")
         self._event("rollback", round_index,
                     restoredRound=int(restored_round))
         log.warning("training-health rollback: round %s restored from "
@@ -278,7 +287,7 @@ class TrainingHealthPolicy:
 
     def record_validation_reject(self, reason, batch_index=None):
         with self._lock:
-            self.counts["validation_rejects"] += 1
+            self._count("validation_rejects")
         self._event("validation_reject", batch_index, reason=str(reason))
 
     def _event(self, kind, round_index, **meta):

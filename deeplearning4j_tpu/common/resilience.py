@@ -24,7 +24,12 @@ semantics made explicit. This module is the one place they are defined:
     through the REAL code path, not a mock.
 
 Everything here is stdlib-only (no jax/numpy): the PS worker side is
-numpy-only by design and must stay importable without jax.
+numpy-only by design and must stay importable without jax. Retries and
+injected faults additionally publish to the process-wide
+`obs.registry.default_registry()` (also stdlib-only) — `resilience.
+retries[.<metric>]` / `resilience.faults_injected[.<site>]` — so the
+`/metrics` Prometheus route on ui/server.py shows transport health next
+to serving and training counters.
 """
 from __future__ import annotations
 
@@ -32,6 +37,8 @@ import logging
 import random
 import threading
 import time
+
+from ..obs.registry import default_registry
 
 log = logging.getLogger(__name__)
 
@@ -63,9 +70,13 @@ class RetryPolicy:
     def __init__(self, max_retries=5, base_delay=0.05, max_delay=2.0,
                  multiplier=2.0, jitter=0.25, deadline=None,
                  retryable=(ConnectionError, TimeoutError, OSError),
-                 seed=0, sleep=None, clock=None):
+                 seed=0, sleep=None, clock=None, metric=None):
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
+        # `metric`: optional name suffix for the registry counter, so a
+        # PS client's reconnect retries and a serving dispatch's retries
+        # are distinguishable on the /metrics route
+        self.metric = metric
         self.max_retries = int(max_retries)
         self.base_delay = float(base_delay)
         self.max_delay = float(max_delay)
@@ -116,6 +127,11 @@ class RetryPolicy:
                     # remainder on one last attempt instead of forfeiting
                     # it by raising early
                     d = min(d, remaining)
+                reg = default_registry()
+                reg.counter("resilience.retries").inc()
+                if self.metric:
+                    reg.counter(
+                        f"resilience.retries.{self.metric}").inc()
                 if on_retry is not None:
                     on_retry(attempt, e, d)
                 self._sleep(d)
@@ -235,6 +251,9 @@ class FaultInjector:
                     break
         if hit is None:
             return payload
+        reg = default_registry()
+        reg.counter("resilience.faults_injected").inc()
+        reg.counter(f"resilience.faults_injected.{site}").inc()
         log.warning("fault injected at %s (call #%d): delay=%.3fs sever=%s"
                     " corrupt=%s", site, n, hit.delay, hit.sever,
                     hit.corrupt)

@@ -764,6 +764,24 @@ class AsyncDataSetIterator(DataSetIterator):
             raise StopIteration("iterator exhausted")
         self._consumed_any = True
         self._next = self._q.get()
+        # staging-queue depth AFTER the take: the pipeline-health gauge
+        # (0 here while the fit loop is fast means the loop is
+        # DATA-starved; full means compute-bound — the two regimes the
+        # async-overlap test distinguishes). Published on the shared
+        # registry so /metrics and obs_report show it next to dispatch
+        # spans. AsyncMultiDataSetIterator inherits this path. The
+        # gauge/counter resolve ONCE (first batch) — per-batch cost is
+        # an attribute load + the counter's own lock, never the
+        # registry lock.
+        obs = getattr(self, "_obs_metrics", None)
+        if obs is None:
+            from ..obs.registry import default_registry
+            reg = default_registry()
+            obs = self._obs_metrics = (
+                reg.gauge("data.async_iterator.queue_depth"),
+                reg.counter("data.async_iterator.batches"))
+        obs[0].set(self._q.qsize())
+        obs[1].inc()
         return b
 
     def next(self):

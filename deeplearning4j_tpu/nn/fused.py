@@ -149,6 +149,7 @@ def fused_program(net, key, builder):
     """Per-net cache of compiled fused programs, invalidated when the
     health watchdog or activation-stats mode toggles (the same
     generation counters ParallelWrapper watches)."""
+    from .. import obs
     gen = (getattr(net, "_health_gen", 0),
            getattr(net, "_act_stats_gen", 0))
     cache = getattr(net, "_fused_cache", None)
@@ -156,5 +157,10 @@ def fused_program(net, key, builder):
         cache = {"gen": gen}
         net._fused_cache = cache
     if key not in cache:
-        cache[key] = builder()
+        # a fused-program (re)build is the expensive, rare event a trace
+        # must show: an unexpected span here mid-run means something is
+        # thrashing the program cache (health/act-stats toggles)
+        with obs.TRACER.span("train.compile", cat="train",
+                             key=repr(key)):
+            cache[key] = builder()
     return cache[key]

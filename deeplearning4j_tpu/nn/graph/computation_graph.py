@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ... import obs
 from ...datasets.dataset import DataSet, MultiDataSet
 from ...datasets.iterators import next_processed
 from ..conf.computation_graph_configuration import ComputationGraphConfiguration
@@ -531,10 +532,11 @@ class ComputationGraph:
                     from .. import fused as F
                     group = []
                     g = F.group_size(self, k)
-                    while len(group) < g and data.has_next():
-                        ds = next_processed(data)
-                        group.append(_dataset_to_mds(ds)
-                                     if isinstance(ds, DataSet) else ds)
+                    with obs.TRACER.span("train.stage", cat="train", k=g):
+                        while len(group) < g and data.has_next():
+                            ds = next_processed(data)
+                            group.append(_dataset_to_mds(ds)
+                                         if isinstance(ds, DataSet) else ds)
                     if len(group) == g and F.uniform_group(group):
                         self._fit_mds_fused(group)
                     else:
@@ -586,13 +588,16 @@ class ComputationGraph:
              "lmask": p[3]} for p in parts)
         self._last_batch_size = int(
             jax.tree.leaves(parts[0][0])[0].shape[0])
-        (self._params, self._updater_state, self._model_state, scores,
-         _, self._loop, *extras) = step(
-             self._params, self._updater_state, self._model_state,
-             self._loop_state(), batch_list)
-        from ...common import health as H
-        rb = H.finish_fused(self, scores,
-                            extras[-1] if emit_health else None, g)
+        with obs.TRACER.span("train.fused_group", cat="train", k=g):
+            with obs.TRACER.span("train.dispatch", cat="train", k=g):
+                (self._params, self._updater_state, self._model_state,
+                 scores, _, self._loop, *extras) = step(
+                     self._params, self._updater_state, self._model_state,
+                     self._loop_state(), batch_list)
+            from ...common import health as H
+            with obs.TRACER.span("train.health", cat="train", k=g):
+                rb = H.finish_fused(self, scores,
+                                    extras[-1] if emit_health else None, g)
         if rb is not None:
             for mds in group[rb + 1:]:  # counters/rng restored; replay
                 self._fit_mds(mds)
@@ -607,16 +612,18 @@ class ComputationGraph:
             return self._fit_tbptt(features, labels, fmasks, lmasks)
         num_iterations = int(self.conf.global_conf.get("num_iterations", 1))
         for _ in range(num_iterations):
-            (self._params, self._updater_state, self._model_state,
-             score, _, self._loop, *extras) = self._jit_step(
-                 self._params, self._updater_state, self._model_state,
-                 self._loop_state(), features, labels, fmasks, lmasks)
+            with obs.TRACER.span("train.dispatch", cat="train"):
+                (self._params, self._updater_state, self._model_state,
+                 score, _, self._loop, *extras) = self._jit_step(
+                     self._params, self._updater_state, self._model_state,
+                     self._loop_state(), features, labels, fmasks, lmasks)
             action = "ok"
             if not getattr(self, "_step_emits_health", False):
                 self._score = score
             else:
                 from ...common import health as H
-                action = H.finish_step(self, extras[-1], score)
+                with obs.TRACER.span("train.health", cat="train"):
+                    action = H.finish_step(self, extras[-1], score)
                 if action == "rollback":
                     break           # counters/rng restored; next batch
             self.conf.iteration_count += 1
@@ -624,7 +631,8 @@ class ComputationGraph:
                 l.iteration_done(self, self.conf.iteration_count - 1)
             if action == "ok" and getattr(self, "_step_emits_health", False):
                 from ...common.health import fit_loop_checkpoint
-                fit_loop_checkpoint(self)
+                with obs.TRACER.span("train.checkpoint", cat="train"):
+                    fit_loop_checkpoint(self)
         return self
 
     # ------------------------------------------------------------------
@@ -678,10 +686,13 @@ class ComputationGraph:
             fm_seg = ({n: _seg(m) for n, m in fmasks.items()}
                       if fmasks else None)
             lm_seg = ([_seg(m) for m in lmasks] if lmasks else None)
-            (self._params, self._updater_state, self._model_state, score,
-             carries, self._loop, *extras) = self._jit_step(
-                 self._params, self._updater_state, self._model_state,
-                 self._loop_state(), f_seg, l_seg, fm_seg, lm_seg, carries)
+            with obs.TRACER.span("train.dispatch", cat="train",
+                                 tbptt=True):
+                (self._params, self._updater_state, self._model_state,
+                 score, carries, self._loop, *extras) = self._jit_step(
+                     self._params, self._updater_state, self._model_state,
+                     self._loop_state(), f_seg, l_seg, fm_seg, lm_seg,
+                     carries)
             action = "ok"
             if not getattr(self, "_step_emits_health", False):
                 self._score = score
@@ -695,7 +706,8 @@ class ComputationGraph:
                 l.iteration_done(self, self.conf.iteration_count - 1)
             if action == "ok" and getattr(self, "_step_emits_health", False):
                 from ...common.health import fit_loop_checkpoint
-                fit_loop_checkpoint(self)
+                with obs.TRACER.span("train.checkpoint", cat="train"):
+                    fit_loop_checkpoint(self)
             t0 += L
         return self
 
@@ -746,14 +758,19 @@ class ComputationGraph:
                fmasks is not None, lmasks is not None)
         step = F.fused_program(self, key, build)
         t0s = jnp.arange(t0, t0 + g * L, L, dtype=jnp.int32)
-        (self._params, self._updater_state, self._model_state, scores,
-         carries, self._loop, *extras) = step(
-             self._params, self._updater_state, self._model_state,
-             self._loop_state(), features, labels, fmasks, lmasks, carries,
-             t0s)
-        from ...common import health as H
-        rb = H.finish_fused(self, scores,
-                            extras[-1] if emit_health else None, g)
+        with obs.TRACER.span("train.fused_group", cat="train", k=g,
+                             tbptt=True):
+            with obs.TRACER.span("train.dispatch", cat="train", k=g,
+                                 tbptt=True):
+                (self._params, self._updater_state, self._model_state,
+                 scores, carries, self._loop, *extras) = step(
+                     self._params, self._updater_state, self._model_state,
+                     self._loop_state(), features, labels, fmasks, lmasks,
+                     carries, t0s)
+            from ...common import health as H
+            with obs.TRACER.span("train.health", cat="train", k=g):
+                rb = H.finish_fused(self, scores,
+                                    extras[-1] if emit_health else None, g)
         return carries, t0 + g * L, rb is not None
 
     def rnn_time_step(self, *features):

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..datasets.dataset import DataSet
 from ..datasets.iterators import (AsyncDataSetIterator, DataSetIterator,
                                   ListDataSetIterator, next_processed)
@@ -452,8 +453,9 @@ class MultiLayerNetwork:
                 from . import fused as F
                 group = []
                 g = F.group_size(self, k)
-                while len(group) < g and async_it.has_next():
-                    group.append(next_processed(async_it))
+                with obs.TRACER.span("train.stage", cat="train", k=g):
+                    while len(group) < g and async_it.has_next():
+                        group.append(next_processed(async_it))
                 if len(group) == g and F.uniform_group(group):
                     self._fit_super_batch(group)
                 else:
@@ -493,13 +495,16 @@ class MultiLayerNetwork:
              "fmask": ds.features_mask, "lmask": ds.labels_mask}
             for ds in group)
         self._last_batch_size = int(np.shape(group[0].features)[0])
-        (self._params, self._updater_state, self._model_state, scores,
-         _, self._loop, *extras) = step(
-             self._params, self._updater_state, self._model_state,
-             self._loop_state(), batch_list)
-        from ..common import health as H
-        rb = H.finish_fused(self, scores,
-                            extras[-1] if emit_health else None, g)
+        with obs.TRACER.span("train.fused_group", cat="train", k=g):
+            with obs.TRACER.span("train.dispatch", cat="train", k=g):
+                (self._params, self._updater_state, self._model_state,
+                 scores, _, self._loop, *extras) = step(
+                     self._params, self._updater_state, self._model_state,
+                     self._loop_state(), batch_list)
+            from ..common import health as H
+            with obs.TRACER.span("train.health", cat="train", k=g):
+                rb = H.finish_fused(self, scores,
+                                    extras[-1] if emit_health else None, g)
         if rb is not None:
             for ds in group[rb + 1:]:   # counters/rng restored; replay
                 self._fit_batch(ds)
@@ -520,10 +525,11 @@ class MultiLayerNetwork:
                 # iteration_done MID-fit (invalidating the step); rebuild
                 # rather than crash on the next iteration
                 self._jit_step = self._make_step()
-            (self._params, self._updater_state, self._model_state,
-             score, _, self._loop, *extras) = self._jit_step(
-                 self._params, self._updater_state, self._model_state,
-                 self._loop_state(), features, labels, fmask, lmask)
+            with obs.TRACER.span("train.dispatch", cat="train"):
+                (self._params, self._updater_state, self._model_state,
+                 score, _, self._loop, *extras) = self._jit_step(
+                     self._params, self._updater_state, self._model_state,
+                     self._loop_state(), features, labels, fmask, lmask)
             health = (extras.pop() if getattr(self, "_step_emits_health",
                                               False) else None)
             if extras:
@@ -534,7 +540,8 @@ class MultiLayerNetwork:
                 self._score = score
             else:
                 from ..common import health as H
-                action = H.finish_step(self, health, score)
+                with obs.TRACER.span("train.health", cat="train"):
+                    action = H.finish_step(self, health, score)
                 if action == "rollback":
                     break           # counters/rng restored; next batch
             self.conf.iteration_count += 1
@@ -542,7 +549,8 @@ class MultiLayerNetwork:
                 l.iteration_done(self, self.conf.iteration_count - 1)
             if health is not None and action == "ok":
                 from ..common.health import fit_loop_checkpoint
-                fit_loop_checkpoint(self)
+                with obs.TRACER.span("train.checkpoint", cat="train"):
+                    fit_loop_checkpoint(self)
         return self
 
     def _init_carries(self, batch_size):
@@ -595,10 +603,13 @@ class MultiLayerNetwork:
             l_seg = labels[:, t0:t0 + L] if seq_labels else labels
             fm_seg = fmask[:, t0:t0 + L] if fmask is not None else None
             lm_seg = lmask[:, t0:t0 + L] if lmask is not None else None
-            (self._params, self._updater_state, self._model_state, score,
-             carries, self._loop, *extras) = self._jit_step(
-                 self._params, self._updater_state, self._model_state,
-                 self._loop_state(), f_seg, l_seg, fm_seg, lm_seg, carries)
+            with obs.TRACER.span("train.dispatch", cat="train",
+                                 tbptt=True):
+                (self._params, self._updater_state, self._model_state,
+                 score, carries, self._loop, *extras) = self._jit_step(
+                     self._params, self._updater_state, self._model_state,
+                     self._loop_state(), f_seg, l_seg, fm_seg, lm_seg,
+                     carries)
             health = (extras.pop() if getattr(self, "_step_emits_health",
                                               False) else None)
             if extras:
@@ -611,7 +622,8 @@ class MultiLayerNetwork:
                 self._score = score
             else:
                 from ..common import health as H
-                action = H.finish_step(self, health, score)
+                with obs.TRACER.span("train.health", cat="train"):
+                    action = H.finish_step(self, health, score)
                 if action == "rollback":
                     break       # abandon the rest of this sequence
             self.conf.iteration_count += 1
@@ -619,7 +631,8 @@ class MultiLayerNetwork:
                 l.iteration_done(self, self.conf.iteration_count - 1)
             if health is not None and action == "ok":
                 from ..common.health import fit_loop_checkpoint
-                fit_loop_checkpoint(self)
+                with obs.TRACER.span("train.checkpoint", cat="train"):
+                    fit_loop_checkpoint(self)
             t0 += L
         return self
 
@@ -654,14 +667,19 @@ class MultiLayerNetwork:
                fmask is not None, lmask is not None)
         step = F.fused_program(self, key, build)
         t0s = jnp.arange(t0, t0 + g * L, L, dtype=jnp.int32)
-        (self._params, self._updater_state, self._model_state, scores,
-         carries, self._loop, *extras) = step(
-             self._params, self._updater_state, self._model_state,
-             self._loop_state(), features, labels, fmask, lmask, carries,
-             t0s)
-        from ..common import health as H
-        rb = H.finish_fused(self, scores,
-                            extras[-1] if emit_health else None, g)
+        with obs.TRACER.span("train.fused_group", cat="train", k=g,
+                             tbptt=True):
+            with obs.TRACER.span("train.dispatch", cat="train", k=g,
+                                 tbptt=True):
+                (self._params, self._updater_state, self._model_state,
+                 scores, carries, self._loop, *extras) = step(
+                     self._params, self._updater_state, self._model_state,
+                     self._loop_state(), features, labels, fmask, lmask,
+                     carries, t0s)
+            from ..common import health as H
+            with obs.TRACER.span("train.health", cat="train", k=g):
+                rb = H.finish_fused(self, scores,
+                                    extras[-1] if emit_health else None, g)
         return carries, t0 + g * L, rb is not None
 
     # ------------------------------------------------------------------

@@ -1,0 +1,58 @@
+"""Unified observability layer: span tracing + metrics registry.
+
+The repo's telemetry before this package was `ServingMetrics` snapshots
+and ad-hoc prints; the ROADMAP's traffic-harness and canary-fleet items
+both presuppose per-request timelines and SLO attainment counters. This
+package is that substrate:
+
+  * `trace.Tracer` — monotonic-clock spans in a lock-light bounded ring,
+    exported as Chrome trace-event JSON (Perfetto / chrome://tracing).
+    Threaded through both servers (enqueue -> queue wait -> batch
+    formation -> dispatch -> complete, one span per decode iteration)
+    and the training fit loops (staging, dispatch, health, checkpoint).
+  * `registry.MetricsRegistry` — the named counter/gauge/reservoir
+    surface everything publishes through (serving metrics, PS-transport
+    retries, async-iterator queue depth, training-health counters),
+    exported as a Prometheus text route on `ui/server.py` (`/metrics`).
+  * `trace.FlightRecorder` — arm the tracer when rolling p99 crosses a
+    threshold, so SLO violations self-document.
+
+Hard constraints: stdlib-only (importing or using obs can never pull in
+jax or add a device dispatch — pinned by test), and the disabled tracer
+costs nanoseconds per call site (pinned by test). `TRACER` is the
+process-wide default tracer (disabled until `enable_tracing()`);
+`registry.default_registry()` is the process-wide metrics surface.
+"""
+from __future__ import annotations
+
+from . import registry
+from .registry import MetricsRegistry, default_registry, fmt
+from .trace import FlightRecorder, Span, Tracer
+
+TRACER = Tracer(enabled=False)
+
+
+def get_tracer():
+    """The process-wide tracer (servers and fit loops default to it)."""
+    return TRACER
+
+
+def span(name, **kw):
+    """Record a span on the global tracer (no-op while disabled)."""
+    return TRACER.span(name, **kw)
+
+
+def enable_tracing():
+    """Turn the global tracer on; returns it (for .save()/.spans())."""
+    return TRACER.enable()
+
+
+def disable_tracing():
+    return TRACER.disable()
+
+
+__all__ = [
+    "Tracer", "Span", "FlightRecorder", "MetricsRegistry",
+    "default_registry", "fmt", "registry",
+    "TRACER", "get_tracer", "span", "enable_tracing", "disable_tracing",
+]

@@ -1,0 +1,257 @@
+"""MetricsRegistry — the one named surface every subsystem publishes
+through.
+
+Before this module each subsystem grew its own ad-hoc counters:
+`ServingMetrics` kept a Counter + deques, the training-health policy a
+dict, the PS transport logged retries, the async iterator exposed
+nothing. The registry generalizes the counter/gauge/reservoir machinery
+ServingMetrics proved out into one shared, named, thread-safe store:
+
+  * `Counter`  — monotonically increasing int (requests, retries, sheds,
+    dispatches, health skips).
+  * `Gauge`    — last-written value (queue depth, slot occupancy).
+  * `Reservoir`— bounded deque of recent samples with nearest-rank
+    percentiles (latency p50/p99) — RECENT percentiles, not all-time,
+    exactly the ServingMetrics window semantics.
+
+Export surfaces:
+  * `snapshot()`        — flat JSON-able dict (the UI-storage shape).
+  * `prometheus_text()` — Prometheus text exposition format, served by
+    `ui/server.py`'s `/metrics` route (counters as `counter`, gauges as
+    `gauge`, reservoirs as `summary` with quantile labels).
+
+Constraints (pinned by tests/test_obs.py):
+  * stdlib-only — no jax, no numpy. Publishing a metric can NEVER add a
+    device dispatch, and the module stays importable everywhere the
+    stdlib-only resilience layer is (numpy-free PS workers).
+  * O(1), lock-light hot path: one small lock per metric object, none on
+    reads of counters (int read is atomic under the GIL).
+"""
+from __future__ import annotations
+
+import collections
+import re
+import threading
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name):
+    """Map an internal dotted metric name onto the Prometheus grammar
+    ([a-zA-Z_:][a-zA-Z0-9_:]*): dots/dashes/spaces become underscores."""
+    out = _NAME_RE.sub("_", str(name))
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def fmt(v, nd=3):
+    """None-safe rounding for metric read-outs: empty reservoirs report
+    their percentiles/means as None (no data is not 0.0), and every
+    consumer that prints or JSON-encodes a snapshot (tools/serve_ab.py,
+    bench.py, tools/obs_report.py) must not crash on the idle case.
+    ONE shared helper so the guard cannot drift per call site."""
+    if v is None:
+        return None
+    try:
+        return round(float(v), nd)
+    except (TypeError, ValueError):
+        return v
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return None
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+class Counter:
+    """Monotonic counter. `inc` is the only writer."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    """Last-written value (None until first set)."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def set(self, v):
+        self._value = v
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Reservoir:
+    """Bounded sample window with percentile read-out.
+
+    Keeps the most recent `window` samples (deque) so a long-running
+    process reports RECENT percentiles; `total` counts every sample ever
+    recorded (the Prometheus `_count`)."""
+
+    __slots__ = ("name", "_buf", "_lock", "total")
+
+    def __init__(self, name, window=2048):
+        self.name = name
+        self._buf = collections.deque(maxlen=int(window))
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def record(self, v):
+        with self._lock:
+            self._buf.append(float(v))
+            self.total += 1
+
+    def values(self):
+        with self._lock:
+            return list(self._buf)
+
+    def percentile(self, q):
+        return percentile(sorted(self.values()), q)
+
+    def mean(self):
+        vals = self.values()
+        return (sum(vals) / len(vals)) if vals else None
+
+    def last(self):
+        with self._lock:
+            return self._buf[-1] if self._buf else None
+
+    def max(self):
+        vals = self.values()
+        return max(vals) if vals else None
+
+
+class MetricsRegistry:
+    """Named store of counters/gauges/reservoirs.
+
+    get-or-create accessors (`counter(name)`, `gauge(name)`,
+    `reservoir(name, window)`) so publishers never coordinate creation;
+    a name registered as one kind and requested as another raises — a
+    rename/typo fails loudly instead of splitting a metric in two."""
+
+    def __init__(self):
+        self._metrics = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name, cls, *args):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, *args)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name):
+        return self._get(name, Counter)
+
+    def gauge(self, name):
+        return self._get(name, Gauge)
+
+    def reservoir(self, name, window=2048):
+        return self._get(name, Reservoir, window)
+
+    def names(self, prefix=""):
+        with self._lock:
+            return sorted(n for n in self._metrics if n.startswith(prefix))
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    # -- export surfaces ----------------------------------------------
+    def snapshot(self, prefix=""):
+        """Flat JSON-able dict: counters/gauges by name, reservoirs as
+        `<name>_p50` / `<name>_p99` / `<name>_mean` / `<name>_count`."""
+        with self._lock:
+            items = [(n, m) for n, m in sorted(self._metrics.items())
+                     if n.startswith(prefix)]
+        out = {}
+        for name, m in items:
+            key = name[len(prefix):] if prefix else name
+            if isinstance(m, Counter):
+                out[key] = m.value
+            elif isinstance(m, Gauge):
+                out[key] = m.value
+            else:
+                vals = sorted(m.values())
+                out[key + "_p50"] = percentile(vals, 50)
+                out[key + "_p99"] = percentile(vals, 99)
+                out[key + "_mean"] = (sum(vals) / len(vals)) if vals \
+                    else None
+                out[key + "_count"] = m.total
+        return out
+
+    def prometheus_text(self, namespace=""):
+        """Prometheus text exposition format (version 0.0.4): counters,
+        gauges (skipped while unset), reservoirs as summaries with
+        quantile labels. Served by ui/server.py's `/metrics` route."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        ns = sanitize(namespace) + "_" if namespace else ""
+        lines = []
+        for name, m in items:
+            pname = ns + sanitize(name)
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {pname} counter")
+                lines.append(f"{pname} {m.value}")
+            elif isinstance(m, Gauge):
+                if m.value is None:
+                    continue
+                lines.append(f"# TYPE {pname} gauge")
+                lines.append(f"{pname} {float(m.value)}")
+            else:
+                vals = sorted(m.values())
+                lines.append(f"# TYPE {pname} summary")
+                for q, label in ((50, "0.5"), (90, "0.9"), (99, "0.99")):
+                    v = percentile(vals, q)
+                    if v is not None:
+                        lines.append(
+                            f'{pname}{{quantile="{label}"}} {v}')
+                lines.append(f"{pname}_count {m.total}")
+        return "\n".join(lines) + "\n"
+
+
+_default = MetricsRegistry()
+_default_lock = threading.Lock()
+
+
+def default_registry():
+    """The process-wide registry: PS-transport retries, async-iterator
+    queue depth, training-health counters, and any ServingMetrics built
+    without an explicit registry all publish here, and ui/server.py's
+    `/metrics` route serves it by default."""
+    return _default
+
+
+def reset_default_registry():
+    """Swap in a fresh default registry (tests: isolate counters)."""
+    global _default
+    with _default_lock:
+        _default = MetricsRegistry()
+    return _default

@@ -367,6 +367,9 @@ class PSServer:
                         (hb_wid,) = struct.unpack_from("<Q", payload, 0)
                         wid = int(hb_wid)
                         self._touch(wid)
+                        from ..obs.registry import default_registry
+                        default_registry().counter(
+                            "ps.server.heartbeats").inc()
                         _send_msg(conn, OP_HEARTBEAT, _ACK)
                     elif op == OP_PULL:
                         params, mstate, version = self._acc.snapshot_params()
@@ -710,6 +713,8 @@ class PSClient:
                 op_, _ack = _recv_msg(sock)
                 if op_ != OP_HEARTBEAT:
                     raise ProtocolError("bad HEARTBEAT reply")
+                from ..obs.registry import default_registry
+                default_registry().counter("ps.client.heartbeats").inc()
             except OSError:
                 # heartbeats are best-effort: drop the socket and re-dial
                 # on the next tick; the server only reaps after a full

@@ -53,6 +53,7 @@ import time
 
 import numpy as np
 
+from .. import obs
 from .server import (DeadlineExceededError, ServerClosedError,
                      _RequestLoop)
 
@@ -87,7 +88,7 @@ def _resolve_future(fut, result):
 
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "future", "deadline", "t_submit",
-                 "generated", "slot", "version")
+                 "generated", "slot", "version", "req_id")
 
     def __init__(self, prompt, max_new, deadline):
         self.prompt = prompt
@@ -98,6 +99,7 @@ class _DecodeRequest:
         self.generated = []
         self.slot = None
         self.version = None
+        self.req_id = None      # assigned at submit (the trace/request id)
 
 
 class ContinuousDecodeServer(_RequestLoop):
@@ -117,12 +119,15 @@ class ContinuousDecodeServer(_RequestLoop):
     def __init__(self, lm, slots=4, prompt_buckets=(8, 16, 32),
                  max_queue=64, fault_injector=None, retry_policy=None,
                  metrics=None, stats_reporter=None, report_every=64,
-                 static_batching=False, speculate=None):
+                 static_batching=False, speculate=None, tracer=None,
+                 flight_recorder=None):
         from ..models.zoo.transformer import (make_prefill_fn,
                                               make_slot_decode_fn)
         from .speculate import as_speculator
         import jax
 
+        self._tracer = tracer if tracer is not None else obs.TRACER
+        self._flight = flight_recorder
         self.lm = lm
         self.slots = int(slots)
         self.max_len = int(lm.aux["pos"].shape[0])
@@ -231,6 +236,29 @@ class ContinuousDecodeServer(_RequestLoop):
             self.metrics.count("swaps")
 
     # -- scheduler internals -------------------------------------------
+    def _complete(self, req, t_now):
+        """Resolve one finished request: future, latency + SLO metrics,
+        the request-timeline span, and the flight-recorder feed. ONE
+        implementation for the three completion sites (prefill-only,
+        plain iteration, speculative iteration) so SLO accounting cannot
+        drift between them."""
+        if not _resolve_future(req.future,
+                               list(req.prompt) + req.generated):
+            return
+        total_ms = (t_now - req.t_submit) * 1e3
+        self.metrics.record_request(
+            total_ms, tokens=len(req.generated),
+            deadline_met=(None if req.deadline is None
+                          else t_now <= req.deadline))
+        tr = self._tracer
+        if tr.enabled:
+            t0 = int(req.t_submit * 1e9)
+            tr.emit("serve.request", t0, int(total_ms * 1e6), cat="serve",
+                    track=f"req-{req.req_id}", trace_id=req.req_id,
+                    args={"tokens": len(req.generated)})
+        if self._flight is not None:
+            self._flight.observe(total_ms)
+
     def _reset_device_state(self):
         """Fresh slot state: the KV cache, per-slot positions/tokens, and
         host-side occupancy. Called at construction and after a decode
@@ -264,6 +292,14 @@ class ContinuousDecodeServer(_RequestLoop):
     def _admit(self, req, slot):
         """Prefill `req`'s prompt and install it into `slot`."""
         import jax.numpy as jnp
+        tr = self._tracer
+        if tr.enabled:
+            # queue wait ends at ADMISSION here (a decode request's
+            # "batch formation" is winning a slot)
+            t0 = int(req.t_submit * 1e9)
+            tr.emit("serve.queue_wait", t0, time.monotonic_ns() - t0,
+                    cat="serve", track=f"req-{req.req_id}",
+                    trace_id=req.req_id)
         bucket = self._prompt_bucket(len(req.prompt))
         prog = self._prefills.get(bucket)
         if prog is None:
@@ -282,19 +318,20 @@ class ContinuousDecodeServer(_RequestLoop):
             return prog(aux, blocks, jnp.asarray(padded),
                         jnp.asarray(len(req.prompt), jnp.int32))
 
-        if self._retry is not None:
-            logits, rows = self._retry.call(
-                dispatch,
-                on_retry=lambda a, e, d: self.metrics.count("retries"))
-        else:
-            logits, rows = dispatch()
+        with self._tracer.span("decode.prefill", cat="serve",
+                               track="server", trace_id=req.req_id,
+                               bucket=bucket, slot=slot):
+            if self._retry is not None:
+                logits, rows = self._retry.call(
+                    dispatch,
+                    on_retry=lambda a, e, d: self.metrics.count("retries"))
+            else:
+                logits, rows = dispatch()
         first = int(np.argmax(np.asarray(logits)[0]))
         req.generated.append(first)
         if len(req.generated) >= req.max_new:
             # one-token request: done at prefill, never occupies a slot
-            if _resolve_future(req.future, list(req.prompt) + req.generated):
-                self.metrics.record_request(
-                    (time.monotonic() - req.t_submit) * 1e3)
+            self._complete(req, time.monotonic())
             return
         self._cache = self._install(self._cache, rows, slot)
         self._pos = self._pos.at[slot].set(len(req.prompt))
@@ -335,6 +372,7 @@ class ContinuousDecodeServer(_RequestLoop):
                     if _fail_future(req.future, DeadlineExceededError(
                             "deadline expired before prefill")):
                         self.metrics.count("shed_deadline")
+                        self.metrics.record_slo_miss()
                     req = None
             try:
                 self._admit(req, s)
@@ -369,6 +407,7 @@ class ContinuousDecodeServer(_RequestLoop):
                     f"{len(r.generated)} tokens")):
                 self.metrics.count("shed_deadline")
                 self.metrics.count("evicted_mid_decode")
+                self.metrics.record_slo_miss()
             self._free_slot(s)
             evicted = True
         if evicted:
@@ -388,6 +427,8 @@ class ContinuousDecodeServer(_RequestLoop):
             return False
         if self._spec is not None:
             return self._spec_iteration(live)
+        tr = self._tracer
+        t_iter0 = time.monotonic_ns() if tr.enabled else None
         self.metrics.record_occupancy(len(live), self.slots)
         versions = sorted({r.version for _, r in live})
         new_tok = {}
@@ -409,12 +450,15 @@ class ContinuousDecodeServer(_RequestLoop):
             # level (the buffers are gone) — the injector site sits before
             # the call, which is exactly the transient class (tunnel
             # hiccup before dispatch) retries exist for.
-            if self._retry is not None:
-                nxt, _, self._cache, self._pos = self._retry.call(
-                    dispatch,
-                    on_retry=lambda a, e, d: self.metrics.count("retries"))
-            else:
-                nxt, _, self._cache, self._pos = dispatch()
+            with tr.span("decode.dispatch", cat="serve", track="server",
+                         version=v):
+                if self._retry is not None:
+                    nxt, _, self._cache, self._pos = self._retry.call(
+                        dispatch,
+                        on_retry=lambda a, e, d: self.metrics.count(
+                            "retries"))
+                else:
+                    nxt, _, self._cache, self._pos = dispatch()
             self.metrics.count("dispatches")
             nxt = np.asarray(nxt)
             for s, r in live:
@@ -431,12 +475,18 @@ class ContinuousDecodeServer(_RequestLoop):
                 # the final token needs no decode step (generate() makes
                 # the same point): resolve and free the slot
                 r.generated = r.generated[:r.max_new]
-                if _resolve_future(r.future,
-                                   list(r.prompt) + r.generated):
-                    self.metrics.record_request(
-                        (t_now - r.t_submit) * 1e3)
+                self._complete(r, t_now)
                 self._free_slot(s)
                 done_any = True
+        if t_iter0 is not None:
+            # one span per scheduling iteration, tagged with the two
+            # numbers head-of-line surgery needs: how full the machine
+            # was and how many tokens the iteration produced
+            tr.emit("decode.iteration", t_iter0,
+                    time.monotonic_ns() - t_iter0, cat="serve",
+                    track="server",
+                    args={"slot_occupancy": len(live) / self.slots,
+                          "accepted": len(live)})
         if done_any:
             self._gc_versions()
         self._after_iteration()
@@ -455,6 +505,9 @@ class ContinuousDecodeServer(_RequestLoop):
         source itself needs no pinning because a mismatched draft cannot
         alter accepted tokens."""
         import jax.numpy as jnp
+        tr = self._tracer
+        t_iter0 = time.monotonic_ns() if tr.enabled else None
+        n_accepted = 0
         self.metrics.record_occupancy(len(live), self.slots)
         K = self._spec.k
         draft = self._spec.draft
@@ -488,12 +541,16 @@ class ContinuousDecodeServer(_RequestLoop):
             # same donated-buffer retry contract as the plain step: the
             # injector site sits BEFORE the compiled call (the transient
             # tunnel-hiccup class); a failure inside it is terminal here
-            if self._retry is not None:
-                nxt, n_acc, _, self._cache, self._pos = self._retry.call(
-                    dispatch,
-                    on_retry=lambda a, e, d: self.metrics.count("retries"))
-            else:
-                nxt, n_acc, _, self._cache, self._pos = dispatch()
+            with tr.span("decode.verify", cat="serve", track="server",
+                         version=v, k=K):
+                if self._retry is not None:
+                    nxt, n_acc, _, self._cache, self._pos = \
+                        self._retry.call(
+                            dispatch,
+                            on_retry=lambda a, e, d: self.metrics.count(
+                                "retries"))
+                else:
+                    nxt, n_acc, _, self._cache, self._pos = dispatch()
             self.metrics.count("dispatches")
             nxt = np.asarray(nxt)
             n_acc = np.asarray(n_acc)
@@ -503,6 +560,7 @@ class ContinuousDecodeServer(_RequestLoop):
                 take = min(int(n_acc[s]) + 1, want)
                 acc = [int(t) for t in nxt[s, :take]]
                 r.generated.extend(acc)
+                n_accepted += take
                 self.metrics.count("tokens_out", take)
                 # drafted = REAL draft tokens (zero-padding is not a
                 # draft); matched likewise capped — a pad that happens to
@@ -511,10 +569,7 @@ class ContinuousDecodeServer(_RequestLoop):
                 self.metrics.record_speculation(
                     take, n_dr[s], min(int(n_acc[s]), take, n_dr[s]))
                 if len(r.generated) >= r.max_new:
-                    if _resolve_future(r.future,
-                                       list(r.prompt) + r.generated):
-                        self.metrics.record_request(
-                            (t_now - r.t_submit) * 1e3)
+                    self._complete(r, t_now)
                     self._free_slot(s)
                     done_any = True
                 else:
@@ -525,6 +580,13 @@ class ContinuousDecodeServer(_RequestLoop):
             # count them so dispatch amortization stays honest (NGramDraft
             # never moves this — host-only)
             self.metrics.count("draft_dispatches", dd)
+        if t_iter0 is not None:
+            tr.emit("decode.iteration", t_iter0,
+                    time.monotonic_ns() - t_iter0, cat="serve",
+                    track="server",
+                    args={"slot_occupancy": len(live) / self.slots,
+                          "accepted": n_accepted,
+                          "draft_dispatches": dd})
         if done_any:
             self._gc_versions()
         self._after_iteration()

@@ -1,117 +1,217 @@
-"""Serving metrics: request-level latency percentiles + operational gauges.
+"""Serving metrics: request-level latency percentiles + operational
+gauges + SLO attainment counters.
 
 A serving SLO is a percentile, not a mean (bench.py's decode config makes
 the same point for token latency) — so the core structure here is a
 bounded latency reservoir per phase (queue wait, dispatch, total) with
-p50/p99 read out in `snapshot()`. Everything is host-side, lock-guarded,
-and O(1) per request: metrics must never add a device round-trip or a
-blocking call to the serving hot path.
+p50/p99 read out in `snapshot()`. Everything is host-side and O(1) per
+request: metrics must never add a device round-trip or a blocking call
+to the serving hot path.
 
-`snapshot()` is the ONE export surface — the same dict feeds
-`ui.stats.ServingStatsReporter` (the existing UI storage path), the
-`served_throughput` bench entry, and `tools/serve_ab.py`.
+Since PR 6 the counter/gauge/reservoir machinery lives in
+`obs.registry.MetricsRegistry` — this class is a named view over a
+registry (its own private one by default, or a shared/default registry
+so the `/metrics` Prometheus route on ui/server.py exports serving
+counters next to training-health and transport counters). The
+`snapshot()` dict is unchanged and remains the ONE export surface — the
+same dict feeds `ui.stats.ServingStatsReporter` (the existing UI storage
+path), the `served_throughput` bench entry, and `tools/serve_ab.py`.
+
+Queue-depth staleness fix (PR 6): depth used to be sampled ONLY at batch
+formation, so an idle-then-bursty server reported the depth of the last
+batch formed minutes ago. The serving loops now also record depth at
+enqueue and shed time (`record_queue_depth`), so `queue_depth_last`
+reflects admission pressure even before a batch forms.
+
+SLO counters (PR 6): pass `slo_target_ms` (or have the server report
+explicit per-request deadlines) and `snapshot()` carries
+`slo_total` / `slo_met` / `slo_tokens_met` / `slo_attainment` — the
+deadline-attainment and goodput-under-SLO numerators the ROADMAP's
+production-traffic harness starts from. Shed/evicted deadline-carrying
+requests count as misses: attainment is over requests ADMITTED to an
+SLO, not just the ones that survived to completion.
 """
 from __future__ import annotations
 
-import collections
-import threading
+import itertools
+
+from ..obs.registry import MetricsRegistry, fmt, percentile as _pct
+
+__all__ = ["ServingMetrics", "fmt", "slo_view"]
+
+_ANON = itertools.count()
 
 
-def _pct(sorted_vals, q):
-    """Nearest-rank percentile of an already-sorted list (no numpy: the
-    metrics path must stay importable and cheap everywhere the stdlib-only
-    resilience layer is)."""
-    if not sorted_vals:
-        return None
-    k = max(0, min(len(sorted_vals) - 1,
-                   int(round(q / 100.0 * (len(sorted_vals) - 1)))))
-    return sorted_vals[k]
+def slo_view(snap, throughput=None, base=None):
+    """Deadline-attainment + goodput-under-SLO from one snapshot() dict:
+    goodput = raw rate x fraction of output that landed within the SLO
+    (tokens for decode servers, requests for batch endpoints). `base` is
+    a snapshot taken AFTER any compile-off-the-clock warm-up — the
+    counters are all-time, and first-compile requests are guaranteed SLO
+    misses that would permanently deflate attainment. The ONE
+    implementation behind tools/serve_ab.py and bench.py's serving
+    records, so the attainment/goodput definition cannot drift between
+    reports."""
+    def delta(key):
+        return snap.get(key, 0) - (base.get(key, 0) if base else 0)
+
+    total, met = delta("slo_total"), delta("slo_met")
+    out = {"slo_total": total, "slo_met": met,
+           "attainment": fmt(met / total if total else None, 4)}
+    produced = delta("tokens_out")
+    if produced:
+        frac = min(1.0, delta("slo_tokens_met") / produced)
+        out["goodput_fraction"] = fmt(frac, 4)
+        if throughput is not None:
+            out["goodput_tokens_per_sec"] = fmt(throughput * frac, 1)
+    elif total and throughput is not None:
+        frac = met / total
+        out["goodput_fraction"] = fmt(frac, 4)
+        out["goodput_requests_per_sec"] = fmt(throughput * frac, 1)
+    return out
 
 
 class ServingMetrics:
     """Thread-safe counters + latency reservoirs for one serving endpoint.
 
     Counters: received / completed / failed / shed_deadline /
-    shed_queue_full / retries / swaps / unhealthy_outputs. Gauges: queue
-    depth (sampled at batch formation), batch occupancy (real requests /
-    bucket slots — the padding waste measure), decode slot occupancy.
-    Reservoirs keep the most recent `window` samples (deque) so a long-
-    running server reports RECENT percentiles, not all-time ones.
+    shed_queue_full / retries / swaps / unhealthy_outputs + the SLO
+    family. Gauges: queue depth (sampled at enqueue, shed, AND batch
+    formation), batch occupancy (real requests / bucket slots — the
+    padding waste measure), decode slot occupancy. Reservoirs keep the
+    most recent `window` samples so a long-running server reports RECENT
+    percentiles, not all-time ones.
+
+    `registry` / `name`: where the metrics live. Default is a private
+    `MetricsRegistry` (two servers never collide); pass
+    `obs.default_registry()` (and a distinct `name`) to export this
+    endpoint on the process-wide `/metrics` Prometheus route.
     """
 
-    def __init__(self, window=2048):
-        self._lock = threading.Lock()
+    def __init__(self, window=2048, registry=None, name=None,
+                 slo_target_ms=None):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        if name is None:
+            name = f"srv{next(_ANON)}" if registry is not None else "srv"
+        self.name = name
+        self._prefix = f"serving.{name}."
         self._window = int(window)
-        self._counts = collections.Counter()
-        self._lat_ms = collections.deque(maxlen=self._window)
-        self._queue_wait_ms = collections.deque(maxlen=self._window)
-        self._queue_depth = collections.deque(maxlen=self._window)
-        self._occupancy = collections.deque(maxlen=self._window)
-        self._batch_sizes = collections.deque(maxlen=self._window)
+        self.slo_target_ms = (None if slo_target_ms is None
+                              else float(slo_target_ms))
+        res = self.registry.reservoir
+        p = self._prefix
+        self._lat_ms = res(p + "latency_ms", self._window)
+        self._queue_wait_ms = res(p + "queue_wait_ms", self._window)
+        self._queue_depth = res(p + "queue_depth", self._window)
+        self._occupancy = res(p + "occupancy", self._window)
+        self._batch_sizes = res(p + "batch_size", self._window)
         # speculative decode reservoirs (serving/speculate.py): accepted
         # tokens per slot-dispatch and draft acceptance rate
-        self._spec_accepted = collections.deque(maxlen=self._window)
-        self._spec_accept_rate = collections.deque(maxlen=self._window)
+        self._spec_accepted = res(p + "spec_accepted", self._window)
+        self._spec_accept_rate = res(p + "spec_accept_rate", self._window)
+        self._counters = {}     # key -> Counter, resolved once per key
 
     # -- hot-path recorders -------------------------------------------
     def count(self, key, n=1):
-        with self._lock:
-            self._counts[key] += n
+        # memoized per key: the hot path pays one dict hit + the
+        # counter's own lock, never the registry lock or a string concat
+        # (the module contract: O(1), lock-light per request)
+        c = self._counters.get(key)
+        if c is None:
+            c = self._counters[key] = self.registry.counter(
+                self._prefix + key)
+        c.inc(n)
 
-    def record_request(self, total_ms, queue_wait_ms=None):
-        with self._lock:
-            self._counts["completed"] += 1
-            self._lat_ms.append(float(total_ms))
-            if queue_wait_ms is not None:
-                self._queue_wait_ms.append(float(queue_wait_ms))
+    def record_request(self, total_ms, queue_wait_ms=None, tokens=None,
+                       deadline_met=None):
+        """One completed request. `tokens` (generated tokens, or None
+        for non-generative endpoints) and `deadline_met` (True/False for
+        an explicit per-request deadline, None for none) feed the SLO
+        counters; without an explicit deadline, `slo_target_ms` decides
+        attainment from the total latency."""
+        self.count("completed")
+        self._lat_ms.record(float(total_ms))
+        if queue_wait_ms is not None:
+            self._queue_wait_ms.record(float(queue_wait_ms))
+        met = deadline_met
+        if met is None and self.slo_target_ms is not None:
+            met = float(total_ms) <= self.slo_target_ms
+        if met is not None:
+            self.count("slo_total")
+            if met:
+                self.count("slo_met")
+                if tokens:
+                    self.count("slo_tokens_met", int(tokens))
+
+    def record_slo_miss(self):
+        """A deadline-carrying request that never completed (shed at
+        admission or evicted mid-decode): attainment's denominator must
+        include it — goodput under load is exactly about the requests
+        the server gave up on."""
+        self.count("slo_total")
+
+    def record_queue_depth(self, depth):
+        """Depth sample OUTSIDE batch formation (enqueue / shed time) —
+        the staleness fix: an idle-then-bursty server reports admission
+        pressure, not the depth of the last batch formed minutes ago."""
+        self._queue_depth.record(int(depth))
 
     def record_batch(self, n_real, bucket, queue_depth):
-        with self._lock:
-            self._counts["batches"] += 1
-            self._batch_sizes.append(int(n_real))
-            self._occupancy.append(n_real / float(bucket) if bucket else 0.0)
-            self._queue_depth.append(int(queue_depth))
+        self.count("batches")
+        self._batch_sizes.record(int(n_real))
+        self._occupancy.record(n_real / float(bucket) if bucket else 0.0)
+        self._queue_depth.record(int(queue_depth))
 
     def record_occupancy(self, active, slots):
         """Decode-scheduler slot occupancy for one token iteration."""
-        with self._lock:
-            self._occupancy.append(active / float(slots) if slots else 0.0)
+        self._occupancy.record(active / float(slots) if slots else 0.0)
 
     def record_speculation(self, accepted, drafted, matched):
         """One slot's share of one speculative verify dispatch: `accepted`
         tokens emitted (matched prefix + bonus), `matched` of the
         `drafted` draft tokens confirmed by the verify argmax."""
-        with self._lock:
-            self._counts["spec_tokens"] += int(accepted)
-            self._counts["spec_drafted"] += int(drafted)
-            self._counts["spec_matched"] += int(matched)
-            self._spec_accepted.append(int(accepted))
-            if drafted:
-                self._spec_accept_rate.append(matched / float(drafted))
+        self.count("spec_tokens", int(accepted))
+        self.count("spec_drafted", int(drafted))
+        self.count("spec_matched", int(matched))
+        self._spec_accepted.record(int(accepted))
+        if drafted:
+            self._spec_accept_rate.record(matched / float(drafted))
 
     # -- read-out ------------------------------------------------------
     def count_value(self, key):
-        with self._lock:
-            return self._counts.get(key, 0)
+        from ..obs.registry import Counter
+        m = self.registry.get(self._prefix + key)
+        # non-counter names (a reservoir like "latency_ms", an unset
+        # gauge) report 0, matching the old Counter-dict .get(key, 0)
+        return m.value if isinstance(m, Counter) else 0
 
     def snapshot(self):
-        with self._lock:
-            lat = sorted(self._lat_ms)
-            qw = sorted(self._queue_wait_ms)
-            occ = list(self._occupancy)
-            depth = list(self._queue_depth)
-            sizes = list(self._batch_sizes)
-            spec_acc = list(self._spec_accepted)
-            spec_rate = list(self._spec_accept_rate)
-            out = dict(self._counts)
+        from ..obs.registry import Counter
+        out = {}
+        for n in self.registry.names(self._prefix):
+            m = self.registry.get(n)
+            if isinstance(m, Counter):
+                out[n[len(self._prefix):]] = m.value
+        lat = sorted(self._lat_ms.values())
+        qw = sorted(self._queue_wait_ms.values())
+        occ = self._occupancy.values()
+        sizes = self._batch_sizes.values()
+        spec_acc = self._spec_accepted.values()
+        spec_rate = self._spec_accept_rate.values()
         out["latency_ms_p50"] = _pct(lat, 50)
         out["latency_ms_p99"] = _pct(lat, 99)
         out["queue_wait_ms_p50"] = _pct(qw, 50)
         out["queue_wait_ms_p99"] = _pct(qw, 99)
-        out["queue_depth_last"] = depth[-1] if depth else 0
-        out["queue_depth_max"] = max(depth) if depth else 0
-        out["batch_occupancy_mean"] = (sum(occ) / len(occ)) if occ else None
-        out["batch_size_mean"] = (sum(sizes) / len(sizes)) if sizes else None
+        depth_last = self._queue_depth.last()
+        depth_max = self._queue_depth.max()
+        out["queue_depth_last"] = 0 if depth_last is None \
+            else int(depth_last)
+        out["queue_depth_max"] = 0 if depth_max is None else int(depth_max)
+        out["batch_occupancy_mean"] = (sum(occ) / len(occ)) if occ \
+            else None
+        out["batch_size_mean"] = (sum(sizes) / len(sizes)) if sizes \
+            else None
         # speculative-decode view: recent accepted-tokens-per-dispatch and
         # draft acceptance rate (reservoirs), plus the all-time dispatch
         # amortization the whole feature exists to improve
@@ -129,4 +229,11 @@ class ServingMetrics:
         out["dispatches_per_token"] = (d / t) if t else None
         out["device_dispatches_per_token"] = (
             (d + out.get("draft_dispatches", 0)) / t) if t else None
+        # SLO attainment: met / (met + missed-or-shed). Always present so
+        # the traffic-harness round starts from pinned keys.
+        out.setdefault("slo_total", 0)
+        out.setdefault("slo_met", 0)
+        out.setdefault("slo_tokens_met", 0)
+        out["slo_attainment"] = (out["slo_met"] / out["slo_total"]
+                                 if out["slo_total"] else None)
         return out

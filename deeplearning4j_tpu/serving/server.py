@@ -42,12 +42,15 @@ the NaN/Inf rows via `common.health.rowwise_finite`.
 from __future__ import annotations
 
 import concurrent.futures as cf
+import itertools
 import logging
 import queue
 import threading
 import time
 
 import numpy as np
+
+from .. import obs
 
 log = logging.getLogger(__name__)
 
@@ -73,13 +76,14 @@ class ServerClosedError(ServingError):
 
 
 class _Request:
-    __slots__ = ("x", "future", "deadline", "t_submit")
+    __slots__ = ("x", "future", "deadline", "t_submit", "req_id")
 
     def __init__(self, x, deadline):
         self.x = x
         self.future = cf.Future()
         self.deadline = deadline
         self.t_submit = time.monotonic()
+        self.req_id = None      # assigned at submit (the trace/request id)
 
 
 def _default_buckets(max_batch):
@@ -118,6 +122,11 @@ class _RequestLoop:
         self._running = False
         self._drain_on_stop = True
         self._thread = None
+        self._req_ids = itertools.count()
+        if not hasattr(self, "_tracer"):    # subclasses normally set it
+            self._tracer = obs.TRACER
+        if not hasattr(self, "_flight"):
+            self._flight = None
 
     # -- hooks ---------------------------------------------------------
     def _busy(self):
@@ -168,12 +177,24 @@ class _RequestLoop:
     # -- queue machinery -----------------------------------------------
     def _enqueue(self, req):
         """Admit `req` (has .future) or shed loudly; returns the future."""
+        if req.req_id is None:
+            req.req_id = next(self._req_ids)
         try:
             self._q.put_nowait(req)
         except queue.Full:
             self.metrics.count("shed_queue_full")
+            # queue-depth staleness fix: a shed IS a depth observation (a
+            # full queue), even when no batch forms for a while
+            self.metrics.record_queue_depth(self._q.maxsize)
             raise ServerOverloadedError(
                 f"queue full ({self._q.maxsize} pending)") from None
+        # depth sampled at ENQUEUE, not only at batch formation: an
+        # idle-then-bursty server must report admission pressure
+        self.metrics.record_queue_depth(self._q.qsize())
+        tr = self._tracer
+        if tr.enabled:
+            tr.instant("serve.enqueue", cat="serve",
+                       track=f"req-{req.req_id}", trace_id=req.req_id)
         if not self._running:
             # raced stop(): the loop's final drain may already have run,
             # leaving this request in a dead queue — fail it HERE so no
@@ -219,8 +240,11 @@ class InferenceServer(_RequestLoop):
     def __init__(self, net, max_batch=8, max_wait_ms=2.0, buckets=None,
                  max_queue=64, default_deadline_ms=None, retry_policy=None,
                  fault_injector=None, screen_outputs=False, metrics=None,
-                 stats_reporter=None, report_every=16):
+                 stats_reporter=None, report_every=16, tracer=None,
+                 flight_recorder=None):
         from .metrics import ServingMetrics
+        self._tracer = tracer if tracer is not None else obs.TRACER
+        self._flight = flight_recorder
         net._ensure_init()
         self._infer = net.make_inference_fn()
         self._params_ref = (net._params, net._model_state)
@@ -436,6 +460,7 @@ class InferenceServer(_RequestLoop):
                     f"deadline missed by {(now - r.deadline) * 1e3:.1f}ms "
                     "before dispatch"))
                 self.metrics.count("shed_deadline")
+                self.metrics.record_slo_miss()
             else:
                 live.append(r)
         if not live:
@@ -459,41 +484,64 @@ class InferenceServer(_RequestLoop):
             self._reporter.report(self.metrics.snapshot())
 
     def _dispatch_group(self, live, now):
+        tr = self._tracer
         bucket = self._bucket_for(len(live))
         self.metrics.record_batch(len(live), bucket, self._q.qsize())
-        prog = self._program(bucket, live[0].x)
-        params, state = self._params_ref     # ONE read: swap-atomic
-        x = self._stack_pad(live, bucket)
+        if tr.enabled:
+            # close each request's queue-wait span now that its batch
+            # exists (t_submit shares monotonic_ns's clock base)
+            now_ns = time.monotonic_ns()
+            for r in live:
+                t0 = int(r.t_submit * 1e9)
+                tr.emit("serve.queue_wait", t0, now_ns - t0, cat="serve",
+                        track=f"req-{r.req_id}", trace_id=r.req_id)
+        with tr.span("serve.batch", cat="serve", track="server",
+                     bucket=bucket, n_real=len(live)):
+            prog = self._program(bucket, live[0].x)
+            params, state = self._params_ref     # ONE read: swap-atomic
+            x = self._stack_pad(live, bucket)
 
-        def dispatch():
-            if self._injector is not None:
-                self._injector.fire("serve.batch")
-            return prog(params, state, x)
+            def dispatch():
+                if self._injector is not None:
+                    self._injector.fire("serve.batch")
+                return prog(params, state, x)
 
-        if self._retry is not None:
-            out = self._retry.call(
-                dispatch,
-                on_retry=lambda a, e, d: self.metrics.count("retries"))
-        else:
-            out = dispatch()
-        rows = [np.asarray(l) for l in
-                (out if isinstance(out, (list, tuple)) else [out])]
-        single = not isinstance(out, (list, tuple))
-        ok = None
-        if self._screen:
-            from ..common.health import rowwise_finite
-            ok = rowwise_finite(rows)
-        t_done = time.monotonic()
-        for i, r in enumerate(live):
-            if r.future.done():
-                continue
-            if ok is not None and not ok[i]:
-                r.future.set_exception(UnhealthyOutputError(
-                    "non-finite values in request output"))
-                self.metrics.count("unhealthy_outputs")
-                continue
-            res = [a[i] for a in rows]
-            r.future.set_result(res[0] if single else res)
-            self.metrics.record_request(
-                (t_done - r.t_submit) * 1e3,
-                (now - r.t_submit) * 1e3)
+            with tr.span("serve.dispatch", cat="serve", track="server",
+                         bucket=bucket):
+                if self._retry is not None:
+                    out = self._retry.call(
+                        dispatch,
+                        on_retry=lambda a, e, d: self.metrics.count(
+                            "retries"))
+                else:
+                    out = dispatch()
+            rows = [np.asarray(l) for l in
+                    (out if isinstance(out, (list, tuple)) else [out])]
+            single = not isinstance(out, (list, tuple))
+            ok = None
+            if self._screen:
+                from ..common.health import rowwise_finite
+                ok = rowwise_finite(rows)
+            t_done = time.monotonic()
+            for i, r in enumerate(live):
+                if r.future.done():
+                    continue
+                if ok is not None and not ok[i]:
+                    r.future.set_exception(UnhealthyOutputError(
+                        "non-finite values in request output"))
+                    self.metrics.count("unhealthy_outputs")
+                    continue
+                res = [a[i] for a in rows]
+                r.future.set_result(res[0] if single else res)
+                total_ms = (t_done - r.t_submit) * 1e3
+                self.metrics.record_request(
+                    total_ms, (now - r.t_submit) * 1e3,
+                    deadline_met=(None if r.deadline is None
+                                  else t_done <= r.deadline))
+                if tr.enabled:
+                    t0 = int(r.t_submit * 1e9)
+                    tr.emit("serve.request", t0,
+                            int((t_done - r.t_submit) * 1e9), cat="serve",
+                            track=f"req-{r.req_id}", trace_id=r.req_id)
+                if self._flight is not None:
+                    self._flight.observe(total_ms)

@@ -49,6 +49,8 @@ from __future__ import annotations
 
 import logging
 
+from .. import obs
+
 log = logging.getLogger(__name__)
 
 
@@ -189,25 +191,30 @@ class ModelDraft(DraftSource):
 
     def propose(self, key, k):
         import numpy as np
-        st = self._state[key]
-        logit = None
-        while st["pending"] and st["pos"] < self._max_len:
-            logit = self._feed(st, st["pending"].pop(0))
-        st["base"] = st["pos"]
-        st["fed"] = []
-        if logit is None or k < 1:
-            # nothing newly ingested to seed from (or cache exhausted)
-            return []
-        out = []
-        for i in range(int(k)):
-            nt = int(np.asarray(logit).argmax())
-            out.append(nt)
-            if i < int(k) - 1:
-                if st["pos"] >= self._max_len:
-                    break               # draft cache edge: truncate
-                logit = self._feed(st, nt)
-                st["fed"].append(nt)
-        return out
+        # one span per proposal round: a ModelDraft's K-1 dispatches are
+        # real device work the timeline must show next to the verify
+        # dispatch they amortize (an NGramDraft never appears here)
+        with obs.TRACER.span("draft.propose", cat="serve", track="server",
+                             k=int(k)):
+            st = self._state[key]
+            logit = None
+            while st["pending"] and st["pos"] < self._max_len:
+                logit = self._feed(st, st["pending"].pop(0))
+            st["base"] = st["pos"]
+            st["fed"] = []
+            if logit is None or k < 1:
+                # nothing newly ingested to seed from (or cache exhausted)
+                return []
+            out = []
+            for i in range(int(k)):
+                nt = int(np.asarray(logit).argmax())
+                out.append(nt)
+                if i < int(k) - 1:
+                    if st["pos"] >= self._max_len:
+                        break           # draft cache edge: truncate
+                    logit = self._feed(st, nt)
+                    st["fed"].append(nt)
+            return out
 
     def stop(self, key):
         self._state.pop(key, None)

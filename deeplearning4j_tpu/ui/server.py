@@ -17,7 +17,15 @@ deeplearning4j-play module/ equivalents):
   /tsne            t-SNE scatter of uploaded coords (TsneModule)
 
 plus a remote-receiver endpoint accepting POSTed reports from
-RemoteUIStatsStorageRouter (reference module/remote/RemoteReceiverModule).
+RemoteUIStatsStorageRouter (reference module/remote/RemoteReceiverModule),
+and a Prometheus text-format route:
+
+  GET /metrics             obs.registry counters/gauges/summaries
+                           (serving metrics, PS-transport retries/
+                           heartbeats, training-health counters,
+                           async-iterator queue depth). Serves the
+                           process-wide `obs.default_registry()` unless
+                           `attach_metrics(registry)` bound another.
 
 All remote-supplied values are rendered via textContent/createElement (never
 innerHTML interpolation) so a process POSTing to /remoteReceive cannot
@@ -482,6 +490,7 @@ refresh(); setInterval(refresh, 3000);""")
 class _Handler(BaseHTTPRequestHandler):
     storage = None
     tsne = None  # session_id -> {"coords": ..., "labels": ...}
+    metrics_registry = None  # None -> obs.default_registry() per request
 
     def log_message(self, *a):   # silence request logging
         pass
@@ -490,6 +499,15 @@ class _Handler(BaseHTTPRequestHandler):
         body = json.dumps(obj).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _text(self, text, code=200):
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -518,6 +536,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._html(_SYSTEM)
         elif self.path == "/tsne":
             self._html(_TSNE)
+        elif self.path == "/metrics":
+            # Prometheus text exposition: the default registry is looked
+            # up PER REQUEST (not bound at server start) so counters
+            # registered after the UI came up — a serving endpoint built
+            # later, the first health event — appear without re-attach
+            reg = self.metrics_registry
+            if reg is None:
+                from ..obs.registry import default_registry
+                reg = default_registry()
+            self._text(reg.prometheus_text(namespace="dl4j_tpu"))
         elif self.path == "/api/sessions":
             self._json(s.list_session_ids() if s else [])
         elif self.path.startswith("/api/static/"):
@@ -574,6 +602,7 @@ class UIServer:
         self._httpd = None
         self._thread = None
         self.storage = None
+        self.metrics_registry = None
 
     @classmethod
     def get_instance(cls, port=9000):
@@ -583,10 +612,19 @@ class UIServer:
 
     getInstance = get_instance
 
+    def attach_metrics(self, registry):
+        """Bind a specific MetricsRegistry to the `/metrics` route
+        (default: the process-wide obs.default_registry())."""
+        self.metrics_registry = registry
+        if self._httpd is not None:
+            self._httpd.RequestHandlerClass.metrics_registry = registry
+        return self
+
     def attach(self, storage):
         self.storage = storage
         handler = type("BoundHandler", (_Handler,),
-                       {"storage": storage, "tsne": {}})
+                       {"storage": storage, "tsne": {},
+                        "metrics_registry": self.metrics_registry})
         if self._httpd is None:
             self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
                                               handler)
@@ -603,7 +641,8 @@ class UIServer:
         POSTs to /remoteReceive return 503 until attach() is called."""
         if self._httpd is None:
             handler = type("BoundHandler", (_Handler,),
-                           {"storage": None, "tsne": {}})
+                           {"storage": None, "tsne": {},
+                            "metrics_registry": self.metrics_registry})
             self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
                                               handler)
             self.port = self._httpd.server_address[1]

@@ -22,6 +22,20 @@ equally):
     (Clipper) vs the bare per-request `output()` loop the reference
     shipped. Dispatch-overhead-dominated small models are exactly the
     serving regime: N/8 batched dispatches beat N solo dispatches.
+  * tracing_on_vs_off — the SAME continuous-decode scheduler with the
+    obs tracer enabled vs disabled (the shipping default). Disabled is a
+    few attribute checks per iteration (nanosecond-scale, pinned by
+    tests/test_obs.py) — this arm bounds even the ENABLED cost, and pins
+    that tracing adds zero device dispatches (dispatch counters must
+    match across arms for the same workload).
+
+Every arm reports deadline attainment and goodput-under-SLO
+(`--slo-ms`, default 100 ms request SLO) next to raw tokens/s — the
+pinned starting metric for the ROADMAP traffic-harness round. Metrics
+read-outs are None-guarded through the shared `obs.registry.fmt` helper
+(empty reservoirs report None, not a crash). `--report PATH` writes the
+combined tools/obs_report.py view (host-span timeline + metrics
+snapshots, plus the tracing arm's Chrome trace alongside).
 
 Run:  JAX_PLATFORMS=cpu python tools/serve_ab.py [--segments N]
 Numbers recorded in PERF.md ("serving layer"); on-chip re-measure armed
@@ -40,6 +54,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 # the ONE protocol implementation (see tools/fused_ab.py)
 from bench import _interleaved_median as _interleaved  # noqa: E402
+from deeplearning4j_tpu.obs.registry import fmt  # noqa: E402
+# the ONE attainment/goodput implementation (shared with bench.py)
+from deeplearning4j_tpu.serving.metrics import \
+    slo_view as _slo_view  # noqa: E402
 
 
 def _lm():
@@ -75,25 +93,30 @@ def _decode_workload(rng, n):
     return out
 
 
-def bench_decode_ab(segments, reqs_per_seg=16):
+def bench_decode_ab(segments, reqs_per_seg=16, slo_ms=100.0):
     """continuous vs static decode batching: same model params, same slot
     program, same per-segment workload — only the SCHEDULER differs."""
     import numpy as np
 
-    from deeplearning4j_tpu.serving import ContinuousDecodeServer
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            ServingMetrics)
 
     lm = _lm()
     servers = {
         "continuous": ContinuousDecodeServer(
-            lm, slots=4, prompt_buckets=(8, 16), max_queue=256).start(),
+            lm, slots=4, prompt_buckets=(8, 16), max_queue=256,
+            metrics=ServingMetrics(slo_target_ms=slo_ms)).start(),
         "static": ContinuousDecodeServer(
             lm, slots=4, prompt_buckets=(8, 16), max_queue=256,
-            static_batching=True).start(),
+            static_batching=True,
+            metrics=ServingMetrics(slo_target_ms=slo_ms)).start(),
     }
     warm = _decode_workload(np.random.default_rng(0), 6)
     for srv in servers.values():        # compile off the clock
         for p, n in warm:
             srv.generate(p, n, timeout=120)
+    # SLO baseline after warm-up: compile-latency misses stay off the books
+    base = {n: servers[n].metrics.snapshot() for n in servers}
 
     seg_idx = {"continuous": [0], "static": [0]}
 
@@ -126,14 +149,17 @@ def bench_decode_ab(segments, reqs_per_seg=16):
         "speedup_continuous_over_static": round(
             ab["continuous"]["median"] / ab["static"]["median"], 3),
         "request_latency_ms": {
-            n: {"p50": lat[n]["latency_ms_p50"],
-                "p99": lat[n]["latency_ms_p99"]} for n in lat},
+            n: {"p50": fmt(lat[n]["latency_ms_p50"]),
+                "p99": fmt(lat[n]["latency_ms_p99"])} for n in lat},
         "slot_occupancy_mean": {
-            n: round(lat[n]["batch_occupancy_mean"], 3) for n in lat},
-    }
+            n: fmt(lat[n]["batch_occupancy_mean"]) for n in lat},
+        "slo_ms": slo_ms,
+        "slo": {n: _slo_view(lat[n], ab[n]["median"], base[n])
+                for n in lat},
+    }, lat, None
 
 
-def bench_speculative_ab(segments, reqs_per_seg=16):
+def bench_speculative_ab(segments, reqs_per_seg=16, slo_ms=100.0):
     """speculative vs plain greedy decode through the continuous-batching
     server: same model, same slot machinery, same per-segment workload —
     only the spec arm drafts (K=4 n-gram prompt-lookup) and verifies K
@@ -146,7 +172,8 @@ def bench_speculative_ab(segments, reqs_per_seg=16):
 
     from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
     from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
-                                            NGramDraft, Speculator)
+                                            NGramDraft, ServingMetrics,
+                                            Speculator)
 
     V, max_len = 96, 96
     lm = TransformerLM(V, d_model=32, n_heads=2, n_layers=2,
@@ -172,14 +199,18 @@ def bench_speculative_ab(segments, reqs_per_seg=16):
     servers = {
         "speculative": ContinuousDecodeServer(
             lm, slots=4, prompt_buckets=(8, 16), max_queue=256,
-            speculate=Speculator(NGramDraft(n=3), k=4)).start(),
+            speculate=Speculator(NGramDraft(n=3), k=4),
+            metrics=ServingMetrics(slo_target_ms=slo_ms)).start(),
         "plain": ContinuousDecodeServer(
-            lm, slots=4, prompt_buckets=(8, 16), max_queue=256).start(),
+            lm, slots=4, prompt_buckets=(8, 16), max_queue=256,
+            metrics=ServingMetrics(slo_target_ms=slo_ms)).start(),
     }
     warm = workload(np.random.default_rng(0), 6)
     for srv in servers.values():        # compile off the clock
         for p, n in warm:
             srv.generate(p, n, timeout=120)
+    # SLO baseline after warm-up: compile-latency misses stay off the books
+    base = {n: servers[n].metrics.snapshot() for n in servers}
 
     seg_idx = {name: [0] for name in servers}
 
@@ -212,33 +243,40 @@ def bench_speculative_ab(segments, reqs_per_seg=16):
         "speedup_spec_over_plain": round(
             ab["speculative"]["median"] / ab["plain"]["median"], 3),
         "dispatches_per_token": {
-            n: round(snaps[n]["dispatches_per_token"], 4) for n in snaps},
-        "acceptance_rate": round(s["spec_acceptance_rate_mean"], 4),
-        "accepted_per_dispatch": round(
+            n: fmt(snaps[n]["dispatches_per_token"], 4) for n in snaps},
+        "acceptance_rate": fmt(s["spec_acceptance_rate_mean"], 4),
+        "accepted_per_dispatch": fmt(
             s["spec_accepted_per_dispatch_mean"], 3),
         "request_latency_ms": {
-            n: {"p50": snaps[n]["latency_ms_p50"],
-                "p99": snaps[n]["latency_ms_p99"]} for n in snaps},
-    }
+            n: {"p50": fmt(snaps[n]["latency_ms_p50"]),
+                "p99": fmt(snaps[n]["latency_ms_p99"])} for n in snaps},
+        "slo_ms": slo_ms,
+        "slo": {n: _slo_view(snaps[n], ab[n]["median"], base[n])
+                for n in snaps},
+    }, snaps, None
 
 
-def bench_microbatch_ab(segments, reqs_per_seg=96):
+def bench_microbatch_ab(segments, reqs_per_seg=96, slo_ms=100.0):
     """InferenceServer micro-batching vs a bare per-request output()
     loop over the same request stream."""
     import numpy as np
 
-    from deeplearning4j_tpu.serving import InferenceServer
+    from deeplearning4j_tpu.serving import InferenceServer, ServingMetrics
 
     net = _mlp()
     rng = np.random.default_rng(1)
     xs = rng.standard_normal((reqs_per_seg, 32)).astype(np.float32)
     srv = InferenceServer(net, max_batch=8, max_wait_ms=2.0,
-                          max_queue=2 * reqs_per_seg).start()
+                          max_queue=2 * reqs_per_seg,
+                          metrics=ServingMetrics(
+                              slo_target_ms=slo_ms)).start()
     # compile EVERY bucket program + the per-request jit off the clock
     for burst in (1, 4, 8):
         for f in [srv.submit(x) for x in xs[:burst]]:
             f.result(60)
     net.output(xs[:1])
+    # SLO baseline after warm-up: compile-latency misses stay off the books
+    base = srv.metrics.snapshot()
 
     def seg_server():
         t0 = time.perf_counter()
@@ -265,22 +303,135 @@ def bench_microbatch_ab(segments, reqs_per_seg=96):
         "ab": ab,
         "speedup_microbatch_over_per_request": round(
             ab["microbatch"]["median"] / ab["per_request"]["median"], 3),
-        "request_latency_ms": {"p50": snap["latency_ms_p50"],
-                               "p99": snap["latency_ms_p99"]},
-        "batch_size_mean": round(snap["batch_size_mean"], 2),
+        "request_latency_ms": {"p50": fmt(snap["latency_ms_p50"]),
+                               "p99": fmt(snap["latency_ms_p99"])},
+        "batch_size_mean": fmt(snap["batch_size_mean"], 2),
+        "slo_ms": slo_ms,
+        "slo": {"microbatch": _slo_view(snap, ab["microbatch"]["median"],
+                                        base)},
+    }, {"microbatch": snap}, None
+
+
+def bench_tracing_ab(segments, reqs_per_seg=16, slo_ms=100.0):
+    """Tracing-enabled vs tracing-disabled through the SAME continuous
+    decode scheduler: the disabled arm is the shipping default (a few
+    attribute checks per call site — the claim "tracing off adds ~zero
+    over the pre-obs serve path" rests on the nanosecond-scale disabled
+    span pin in tests/test_obs.py); this A/B bounds the ENABLED cost and
+    pins that spans add ZERO device dispatches (the two arms' dispatch
+    counters must agree for the same workload). Returns the enabled
+    arm's tracer so main() can write a real Chrome trace."""
+    import numpy as np
+
+    from deeplearning4j_tpu.obs import Tracer
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            ServingMetrics)
+
+    lm = _lm()
+    tracer_on = Tracer(capacity=1 << 16, enabled=True)
+    tracer_off = Tracer(enabled=False)
+    servers = {
+        "tracing_off": ContinuousDecodeServer(
+            lm, slots=4, prompt_buckets=(8, 16), max_queue=256,
+            tracer=tracer_off,
+            metrics=ServingMetrics(slo_target_ms=slo_ms)).start(),
+        "tracing_on": ContinuousDecodeServer(
+            lm, slots=4, prompt_buckets=(8, 16), max_queue=256,
+            tracer=tracer_on,
+            metrics=ServingMetrics(slo_target_ms=slo_ms)).start(),
     }
+    warm = _decode_workload(np.random.default_rng(0), 6)
+    for srv in servers.values():        # compile off the clock
+        for p, n in warm:
+            srv.generate(p, n, timeout=120)
+    # baseline after warm-up: both the dispatch-equality pin and the SLO
+    # read-outs cover only the measured workload
+    base = {n: s.metrics.snapshot() for n, s in servers.items()}
+
+    seg_idx = {n: [0] for n in servers}
+
+    def seg(name):
+        srv = servers[name]
+
+        def run():
+            rng = np.random.default_rng(100 + seg_idx[name][0])
+            seg_idx[name][0] += 1
+            work = _decode_workload(rng, reqs_per_seg)
+            toks = sum(n for _, n in work)
+            t0 = time.perf_counter()
+            futs = [srv.submit(p, n) for p, n in work]
+            for f in futs:
+                f.result(300)
+            return toks / (time.perf_counter() - t0)
+        return run
+
+    ab = _interleaved({n: seg(n) for n in servers}, segments=segments)
+    snaps = {n: servers[n].metrics.snapshot() for n in servers}
+    disp = {n: snaps[n]["dispatches"] - base[n]["dispatches"]
+            for n in snaps}
+    for srv in servers.values():
+        srv.stop()
+    return {
+        "config": "TransformerLM L=2 d=32 slots=4, same mixed workload "
+                  "as decode A/B; obs tracer on vs off (off = shipping "
+                  "default)",
+        "unit": "generated tokens/sec",
+        "ab": ab,
+        "tracing_on_over_off": round(
+            ab["tracing_on"]["median"] / ab["tracing_off"]["median"], 3),
+        # span recording must never change WHAT runs on the device:
+        # identical workload -> identical dispatch count
+        "measured_dispatches": disp,
+        "zero_extra_dispatches": disp["tracing_on"] == disp[
+            "tracing_off"],
+        "spans_recorded": len(tracer_on),
+        "slo_ms": slo_ms,
+        "slo": {n: _slo_view(snaps[n], ab[n]["median"], base[n])
+                for n in snaps},
+    }, snaps, tracer_on
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--segments", type=int, default=5)
+    ap.add_argument("--slo-ms", type=float, default=100.0,
+                    help="request SLO for attainment/goodput read-outs")
+    ap.add_argument("--report", default=None,
+                    help="write the combined obs report (text + JSON + "
+                         "Chrome trace) under this path prefix")
     args = ap.parse_args()
-    for name, fn in (("decode_continuous_vs_static", bench_decode_ab),
-                     ("speculative_vs_plain", bench_speculative_ab),
-                     ("microbatch_vs_per_request", bench_microbatch_ab)):
+    all_snaps = {}
+    tracer = None
+    benches = (("decode_continuous_vs_static", bench_decode_ab),
+               ("speculative_vs_plain", bench_speculative_ab),
+               ("microbatch_vs_per_request", bench_microbatch_ab),
+               ("tracing_on_vs_off", bench_tracing_ab))
+    for name, fn in benches:
         rec = {"name": name}
-        rec.update(fn(args.segments))
+        # uniform contract: every bench returns (body, snaps, tracer-or-
+        # None); only the tracing A/B carries a tracer for the report
+        body, snaps, tracer_arm = fn(args.segments, slo_ms=args.slo_ms)
+        if tracer_arm is not None:
+            tracer = tracer_arm
+        rec.update(body)
+        for arm, snap in snaps.items():
+            all_snaps[f"{name}.{arm}"] = snap
         print(json.dumps(rec))
+    if args.report:
+        # the combined tools/obs_report.py view replaces the old
+        # print-only summaries: host spans (from the tracing arm) +
+        # every arm's metrics snapshot, one text + one JSON + the raw
+        # Chrome trace for Perfetto
+        from obs_report import build_report, format_report
+        report = build_report(spans=tracer, metrics=all_snaps)
+        with open(args.report + ".json", "w") as fh:
+            json.dump(report, fh)
+        with open(args.report + ".txt", "w") as fh:
+            fh.write(format_report(report) + "\n")
+        if tracer is not None:
+            tracer.save(args.report + ".trace.json")
+        print(json.dumps({"report": args.report + ".{json,txt}",
+                          "trace": args.report + ".trace.json"}))
 
 
 if __name__ == "__main__":
