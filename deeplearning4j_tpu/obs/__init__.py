@@ -10,12 +10,18 @@ package is that substrate:
     Threaded through both servers (enqueue -> queue wait -> batch
     formation -> dispatch -> complete, one span per decode iteration)
     and the training fit loops (staging, dispatch, health, checkpoint).
-  * `registry.MetricsRegistry` — the named counter/gauge/reservoir
-    surface everything publishes through (serving metrics, PS-transport
-    retries, async-iterator queue depth, training-health counters),
-    exported as a Prometheus text route on `ui/server.py` (`/metrics`).
+  * `registry.MetricsRegistry` — the named counter/gauge/reservoir/
+    histogram surface everything publishes through (serving metrics,
+    PS-transport retries, async-iterator queue depth, training-health
+    counters), exported as a Prometheus text route on `ui/server.py`
+    (`/metrics`). `Histogram` is the fixed-bucket cumulative kind the
+    serving SLO metrics (TTFT, inter-token) scrape as.
   * `trace.FlightRecorder` — arm the tracer when rolling p99 crosses a
     threshold, so SLO violations self-document.
+  * `decompose.decompose` — post-hoc span-derived latency
+    decomposition: each served request's total attributed to
+    queue-wait / prefill / decode / scheduling-gap phases (the
+    traffic-harness analyzer; rendered by `tools/obs_report.py`).
 
 Hard constraints: stdlib-only (importing or using obs can never pull in
 jax or add a device dispatch — pinned by test), and the disabled tracer
@@ -26,7 +32,8 @@ process-wide default tracer (disabled until `enable_tracing()`);
 from __future__ import annotations
 
 from . import registry
-from .registry import MetricsRegistry, default_registry, fmt
+from .decompose import decompose, decompose_requests
+from .registry import Histogram, MetricsRegistry, default_registry, fmt
 from .trace import FlightRecorder, Span, Tracer
 
 TRACER = Tracer(enabled=False)
@@ -52,7 +59,8 @@ def disable_tracing():
 
 
 __all__ = [
-    "Tracer", "Span", "FlightRecorder", "MetricsRegistry",
+    "Tracer", "Span", "FlightRecorder", "MetricsRegistry", "Histogram",
     "default_registry", "fmt", "registry",
+    "decompose", "decompose_requests",
     "TRACER", "get_tracer", "span", "enable_tracing", "disable_tracing",
 ]

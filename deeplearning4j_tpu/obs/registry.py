@@ -13,6 +13,14 @@ ServingMetrics proved out into one shared, named, thread-safe store:
   * `Reservoir`— bounded deque of recent samples with nearest-rank
     percentiles (latency p50/p99) — RECENT percentiles, not all-time,
     exactly the ServingMetrics window semantics.
+  * `Histogram` — FIXED-BUCKET cumulative distribution (Prometheus
+    `histogram` kind: `_bucket{le=...}` / `_sum` / `_count`). Unlike a
+    reservoir, bucket counts are all-time, mergeable across scrapes /
+    processes, and scrape as a real distribution; `quantile()` is the
+    classic interpolate-within-bucket estimate — resolution bounded by
+    the bucket grid, which is the price of aggregability. The serving
+    SLO metrics (TTFT, inter-token latency, the load-sweep read-outs)
+    use this kind.
 
 Export surfaces:
   * `snapshot()`        — flat JSON-able dict (the UI-storage shape).
@@ -29,6 +37,7 @@ Constraints (pinned by tests/test_obs.py):
 """
 from __future__ import annotations
 
+import bisect
 import collections
 import re
 import threading
@@ -66,6 +75,29 @@ def percentile(sorted_vals, q):
     k = max(0, min(len(sorted_vals) - 1,
                    int(round(q / 100.0 * (len(sorted_vals) - 1)))))
     return sorted_vals[k]
+
+
+def bucket_quantile(bounds, counts, q):
+    """Interpolated quantile from fixed-bucket counts: `bounds` are the
+    finite upper bounds, `counts` the per-bucket counts (an extra final
+    entry, the +Inf overflow, is allowed; overflow mass clamps to the
+    largest finite bound). Shared by `Histogram.quantile` and the
+    loadgen's per-run DELTA quantiles (bucket counts are cumulative and
+    subtractable — the property reservoirs lack)."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = (q / 100.0) * total
+    cum, lo = 0, 0.0
+    for i, ub in enumerate(bounds):
+        c = counts[i] if i < len(counts) else 0
+        if cum + c >= target:
+            if c == 0:
+                return lo
+            return lo + (target - cum) / c * (ub - lo)
+        cum += c
+        lo = ub
+    return bounds[-1]
 
 
 class Counter:
@@ -144,8 +176,74 @@ class Reservoir:
         return max(vals) if vals else None
 
 
+class Histogram:
+    """Fixed-bucket cumulative histogram (the Prometheus `histogram`
+    kind).
+
+    `buckets` are the FINITE upper bounds (le semantics: a sample lands
+    in the first bucket whose bound >= value); everything above the
+    largest bound goes to the implicit +Inf bucket. Counts are all-time
+    cumulative — two scrapes (or two processes' exposition) can be
+    summed bucket-by-bucket, which a Reservoir's sample window can't.
+
+    `quantile(q)` interpolates linearly inside the bucket holding the
+    q-th sample (what PromQL's `histogram_quantile()` computes
+    server-side): an ESTIMATE whose error is bounded by bucket width.
+    Samples past the largest finite bound clamp to that bound."""
+
+    __slots__ = ("name", "buckets", "_counts", "_sum", "total", "_lock")
+
+    # default grid tuned for millisecond latencies: sub-ms inter-token
+    # gaps up through multi-second tail requests
+    DEFAULT_BUCKETS = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100,
+                       250, 500, 1000, 2500, 5000, 10000)
+
+    def __init__(self, name, buckets=None):
+        self.name = name
+        bs = tuple(sorted(float(b) for b in
+                          (buckets or self.DEFAULT_BUCKETS)))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets = bs
+        self._counts = [0] * (len(bs) + 1)      # last = +Inf overflow
+        self._sum = 0.0
+        self.total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v):
+        v = float(v)
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self.total += 1
+
+    def _state(self):
+        """Atomic (per-bucket counts incl. overflow, sum, total) — the
+        exposition must be self-consistent (cumulative counts that sum
+        to `_count`), so all three are read under one lock."""
+        with self._lock:
+            return list(self._counts), self._sum, self.total
+
+    def counts(self):
+        return self._state()[0]
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def quantile(self, q):
+        """Estimated q-th percentile (None while empty)."""
+        counts, _, _ = self._state()
+        return bucket_quantile(self.buckets, counts, q)
+
+    def mean(self):
+        _, s, total = self._state()
+        return (s / total) if total else None
+
+
 class MetricsRegistry:
-    """Named store of counters/gauges/reservoirs.
+    """Named store of counters/gauges/reservoirs/histograms.
 
     get-or-create accessors (`counter(name)`, `gauge(name)`,
     `reservoir(name, window)`) so publishers never coordinate creation;
@@ -176,6 +274,12 @@ class MetricsRegistry:
     def reservoir(self, name, window=2048):
         return self._get(name, Reservoir, window)
 
+    def histogram(self, name, buckets=None):
+        """Get-or-create; like `reservoir`'s window, `buckets` only
+        applies on first registration (a later caller with a different
+        grid gets the existing metric — one name, one grid)."""
+        return self._get(name, Histogram, buckets)
+
     def names(self, prefix=""):
         with self._lock:
             return sorted(n for n in self._metrics if n.startswith(prefix))
@@ -198,6 +302,15 @@ class MetricsRegistry:
                 out[key] = m.value
             elif isinstance(m, Gauge):
                 out[key] = m.value
+            elif isinstance(m, Histogram):
+                # ONE atomic state read feeds every derived value, like
+                # the exposition path: p50/p99/mean/count must describe
+                # the same instant even while another thread observes
+                counts, s, total = m._state()
+                out[key + "_p50"] = bucket_quantile(m.buckets, counts, 50)
+                out[key + "_p99"] = bucket_quantile(m.buckets, counts, 99)
+                out[key + "_mean"] = (s / total) if total else None
+                out[key + "_count"] = total
             else:
                 vals = sorted(m.values())
                 out[key + "_p50"] = percentile(vals, 50)
@@ -225,6 +338,19 @@ class MetricsRegistry:
                     continue
                 lines.append(f"# TYPE {pname} gauge")
                 lines.append(f"{pname} {float(m.value)}")
+            elif isinstance(m, Histogram):
+                counts, total_sum, _ = m._state()
+                lines.append(f"# TYPE {pname} histogram")
+                cum = 0
+                for b, c in zip(m.buckets, counts):
+                    cum += c
+                    lines.append(f'{pname}_bucket{{le="{b:g}"}} {cum}')
+                # +Inf closes over the SAME atomic state read, so the
+                # exposition is always internally consistent
+                lines.append(
+                    f'{pname}_bucket{{le="+Inf"}} {sum(counts)}')
+                lines.append(f"{pname}_sum {total_sum}")
+                lines.append(f"{pname}_count {sum(counts)}")
             else:
                 vals = sorted(m.values())
                 lines.append(f"# TYPE {pname} summary")

@@ -33,6 +33,7 @@ trace through the injected seam), storing the capture for post-mortem.
 from __future__ import annotations
 
 import collections
+import datetime
 import json
 import threading
 import time
@@ -90,6 +91,12 @@ class Tracer:
         self._enabled = bool(enabled)
         self._auto = None        # [remaining, restore_enabled, callback]
         self._lock = threading.Lock()    # export/clear only, never emit
+        # wallclock anchor: ONE (wall_ns, monotonic_ns) pair captured at
+        # construction. Span timestamps stay monotonic (immune to NTP
+        # steps); the anchor lets chrome_trace() emit a `clock_sync`
+        # metadata event so two saved traces — different runs, different
+        # processes — can be aligned on the wall clock in Perfetto.
+        self._wall_anchor = (time.time_ns(), monotonic_ns())
 
     @property
     def enabled(self):
@@ -179,14 +186,30 @@ class Tracer:
         """Chrome trace-event JSON (loads in Perfetto / chrome://tracing):
         one complete ("ph":"X") event per span, ts/dur in microseconds
         rebased to the earliest span, tracks mapped to tids with
-        thread_name metadata so lanes are labeled."""
+        thread_name metadata so lanes are labeled.
+
+        A `clock_sync` metadata event anchors ts=0 to the wall clock
+        (`wallclock_ns_at_ts0`): spans are timed on the bare monotonic
+        clock, whose zero is arbitrary per boot/process, so WITHOUT the
+        anchor two saved traces cannot be aligned. To overlay trace B on
+        trace A in Perfetto, shift B's events by
+        (B.wallclock_ns_at_ts0 - A.wallclock_ns_at_ts0) / 1e3 us."""
         spans = self.spans()
-        base = min((s.t0_ns for s in spans), default=0)
+        wall_ns, mono_ns = self._wall_anchor
+        base = min((s.t0_ns for s in spans), default=mono_ns)
         tracks = {}
         for s in spans:
             tracks.setdefault(s.track or "main", len(tracks))
+        wall_at_base = wall_ns + (base - mono_ns)
         events = [{"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
-                   "args": {"name": process_name}}]
+                   "args": {"name": process_name}},
+                  {"ph": "M", "pid": 0, "tid": 0, "name": "clock_sync",
+                   "args": {
+                       "wallclock_ns_at_ts0": wall_at_base,
+                       "monotonic_ns_at_ts0": base,
+                       "wallclock_iso": datetime.datetime.fromtimestamp(
+                           wall_at_base / 1e9,
+                           datetime.timezone.utc).isoformat()}}]
         for track, tid in tracks.items():
             events.append({"ph": "M", "pid": 0, "tid": tid,
                            "name": "thread_name",

@@ -20,17 +20,27 @@ serving systems converge on, built here over the existing containers:
     pinned bit-identical to plain decode (acceptance-by-exact-argmax-
     match), so speculation is a pure dispatch-amortization lever.
 
-`ServingMetrics` (p50/p99, queue depth, occupancy, shed/swap counts)
-feeds the existing UI via `ui.stats.ServingStatsReporter`; deadlines,
-backpressure, `RetryPolicy` and `FaultInjector` sites reuse
-`common/resilience.py`; NaN/Inf output screening reuses
-`common/health.py`.
+`ServingMetrics` (p50/p99, TTFT/inter-token histograms, queue depth,
+occupancy, shed/swap counts) feeds the existing UI via
+`ui.stats.ServingStatsReporter`; deadlines, backpressure, `RetryPolicy`
+and `FaultInjector` sites reuse `common/resilience.py`; NaN/Inf output
+screening reuses `common/health.py`.
+
+The production-traffic harness (`loadgen.py`) drives both servers with
+seeded, deterministic arrival processes (open-loop Poisson, bursty
+on/off, closed-loop fixed concurrency) and request-size mixes — the
+offered-load side of the ROADMAP's "handles heavy traffic" claim;
+`tools/load_sweep.py` sweeps offered rate into a throughput–latency
+curve with goodput-under-SLO and the saturation knee.
 """
 from .metrics import ServingMetrics
 from .server import (DeadlineExceededError, InferenceServer,
                      ServerClosedError, ServerOverloadedError,
                      ServingError, UnhealthyOutputError)
 from .decode import ContinuousDecodeServer
+from .loadgen import (ClosedLoop, DecodeSizeMix, InferenceSizeMix,
+                      OnOffProcess, PoissonProcess, Schedule,
+                      build_schedule, run_load)
 from .speculate import DraftSource, ModelDraft, NGramDraft, Speculator
 
 __all__ = [
@@ -38,4 +48,7 @@ __all__ = [
     "ServingError", "ServerOverloadedError", "DeadlineExceededError",
     "UnhealthyOutputError", "ServerClosedError",
     "Speculator", "DraftSource", "NGramDraft", "ModelDraft",
+    "PoissonProcess", "OnOffProcess", "ClosedLoop",
+    "DecodeSizeMix", "InferenceSizeMix", "Schedule",
+    "build_schedule", "run_load",
 ]

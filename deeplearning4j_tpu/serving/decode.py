@@ -88,7 +88,7 @@ def _resolve_future(fut, result):
 
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "future", "deadline", "t_submit",
-                 "generated", "slot", "version", "req_id")
+                 "generated", "slot", "version", "req_id", "t_last_tok")
 
     def __init__(self, prompt, max_new, deadline):
         self.prompt = prompt
@@ -100,6 +100,7 @@ class _DecodeRequest:
         self.slot = None
         self.version = None
         self.req_id = None      # assigned at submit (the trace/request id)
+        self.t_last_tok = None  # when this request's last token landed
 
 
 class ContinuousDecodeServer(_RequestLoop):
@@ -329,6 +330,10 @@ class ContinuousDecodeServer(_RequestLoop):
                 logits, rows = dispatch()
         first = int(np.argmax(np.asarray(logits)[0]))
         req.generated.append(first)
+        # TTFT closes HERE: prefill's argmax IS the first generated
+        # token, whether or not the request goes on to occupy a slot
+        req.t_last_tok = time.monotonic()
+        self.metrics.record_ttft((req.t_last_tok - req.t_submit) * 1e3)
         if len(req.generated) >= req.max_new:
             # one-token request: done at prefill, never occupies a slot
             self._complete(req, time.monotonic())
@@ -471,6 +476,11 @@ class ContinuousDecodeServer(_RequestLoop):
         t_now = time.monotonic()
         for s, r in live:
             r.generated.append(new_tok[s])
+            # one inter-token sample per decode iteration per slot
+            if r.t_last_tok is not None:
+                self.metrics.record_inter_token(
+                    (t_now - r.t_last_tok) * 1e3)
+            r.t_last_tok = t_now
             if len(r.generated) >= r.max_new:
                 # the final token needs no decode step (generate() makes
                 # the same point): resolve and free the slot
@@ -560,6 +570,13 @@ class ContinuousDecodeServer(_RequestLoop):
                 take = min(int(n_acc[s]) + 1, want)
                 acc = [int(t) for t in nxt[s, :take]]
                 r.generated.extend(acc)
+                # a speculative iteration lands `take` tokens at once:
+                # record the PER-TOKEN stream rate (delta / take), one
+                # sample per iteration per slot like the plain step
+                if take and r.t_last_tok is not None:
+                    self.metrics.record_inter_token(
+                        (t_now - r.t_last_tok) * 1e3 / take)
+                r.t_last_tok = t_now
                 n_accepted += take
                 self.metrics.count("tokens_out", take)
                 # drafted = REAL draft tokens (zero-padding is not a

@@ -23,6 +23,16 @@ batch formed minutes ago. The serving loops now also record depth at
 enqueue and shed time (`record_queue_depth`), so `queue_depth_last`
 reflects admission pressure even before a batch forms.
 
+TTFT + inter-token latency (PR 7): the decode server records
+time-to-first-token (submit -> the slot's FIRST generated token, closed
+at prefill where token 1 is produced) and an inter-token sample per
+decode iteration per slot. Both are `obs.registry.Histogram`s — fixed
+cumulative buckets, so they scrape as real distributions on the
+Prometheus route and aggregate across endpoints, unlike the recent-
+window reservoirs. These are the serving SLO metrics the fixed-backlog
+A/B never needed: under ARRIVING traffic, TTFT is what queueing does to
+users and inter-token is what co-residency does to streams.
+
 SLO counters (PR 6): pass `slo_target_ms` (or have the server report
 explicit per-request deadlines) and `snapshot()` carries
 `slo_total` / `slo_met` / `slo_tokens_met` / `slo_attainment` — the
@@ -35,7 +45,8 @@ from __future__ import annotations
 
 import itertools
 
-from ..obs.registry import MetricsRegistry, fmt, percentile as _pct
+from ..obs.registry import (MetricsRegistry, bucket_quantile, fmt,
+                            percentile as _pct)
 
 __all__ = ["ServingMetrics", "fmt", "slo_view"]
 
@@ -110,6 +121,12 @@ class ServingMetrics:
         # tokens per slot-dispatch and draft acceptance rate
         self._spec_accepted = res(p + "spec_accepted", self._window)
         self._spec_accept_rate = res(p + "spec_accept_rate", self._window)
+        # decode-server SLO histograms (fixed cumulative buckets — the
+        # Prometheus `histogram` kind, scrapeable/aggregatable where a
+        # reservoir is not); recorded by ContinuousDecodeServer
+        hist = self.registry.histogram
+        self._ttft_ms = hist(p + "ttft_ms")
+        self._inter_token_ms = hist(p + "inter_token_ms")
         self._counters = {}     # key -> Counter, resolved once per key
 
     # -- hot-path recorders -------------------------------------------
@@ -151,6 +168,18 @@ class ServingMetrics:
         the server gave up on."""
         self.count("slo_total")
 
+    def record_ttft(self, ms):
+        """Time-to-first-token for one request: submit -> the first
+        generated token landing (the decode server closes this at
+        prefill, whose argmax IS token 1)."""
+        self._ttft_ms.observe(float(ms))
+
+    def record_inter_token(self, ms):
+        """One inter-token latency sample per decode iteration per slot
+        (speculative iterations record delta/accepted — the per-token
+        stream rate the user sees, not the per-dispatch stall)."""
+        self._inter_token_ms.observe(float(ms))
+
     def record_queue_depth(self, depth):
         """Depth sample OUTSIDE batch formation (enqueue / shed time) —
         the staleness fix: an idle-then-bursty server reports admission
@@ -179,6 +208,14 @@ class ServingMetrics:
             self._spec_accept_rate.record(matched / float(drafted))
 
     # -- read-out ------------------------------------------------------
+    def latency_histograms(self):
+        """The per-token SLO histograms by snapshot key — the PUBLIC
+        handle `serving.loadgen.run_load` uses for per-run bucket-count
+        deltas (reaching for the private attributes would degrade
+        silently on a rename)."""
+        return {"ttft_ms": self._ttft_ms,
+                "inter_token_ms": self._inter_token_ms}
+
     def count_value(self, key):
         from ..obs.registry import Counter
         m = self.registry.get(self._prefix + key)
@@ -219,6 +256,16 @@ class ServingMetrics:
             sum(spec_acc) / len(spec_acc)) if spec_acc else None
         out["spec_acceptance_rate_mean"] = (
             sum(spec_rate) / len(spec_rate)) if spec_rate else None
+        # TTFT / inter-token histograms (quantiles are interpolated
+        # estimates bounded by the bucket grid; None while empty). One
+        # atomic state read per histogram so p50/p99/mean/count describe
+        # the same instant while the serve thread keeps observing.
+        for key, h in self.latency_histograms().items():
+            counts, s, total = h._state()
+            out[key + "_p50"] = bucket_quantile(h.buckets, counts, 50)
+            out[key + "_p99"] = bucket_quantile(h.buckets, counts, 99)
+            out[key + "_mean"] = (s / total) if total else None
+            out[key + "_count"] = total
         # dispatches_per_token = TARGET-model dispatches (decode/verify)
         # per emitted token — the tunnel-amortization headline for a
         # host-side draft; device_dispatches_per_token folds in the draft

@@ -1,0 +1,354 @@
+"""Production-traffic harness pins (ISSUE 7 acceptance criteria).
+
+  (a) Determinism: same seed => byte-identical arrival schedule
+      (arrivals + payloads + sha256 digest) for all three arrival
+      processes, and identical admitted/shed/SLO accounting across two
+      fault-free replays of the same schedule on a real server.
+  (b) No coordinated omission: open-loop arrivals are honored by
+      SUBMISSION time, never completion time — pinned against a fake
+      server that stalls every completion (a coordinated generator
+      would crawl; ours keeps to the schedule).
+  (c) Zero extra device dispatches: driving a server through the
+      loadgen with tracing + histograms + decomposition enabled
+      dispatches exactly what the tracing-off arm and a bare sequential
+      generate() loop dispatch (the PR 6 dispatch-counter A/B
+      protocol).
+  (d) TTFT + inter-token histograms: recorded by the decode server
+      (TTFT closed at prefill, one inter-token sample per decode
+      iteration per slot), exposed in snapshot() and the Prometheus
+      text exposition as cumulative `_bucket`/`_sum`/`_count`.
+  (e) Smoke sweep: a fast tools/load_sweep.py run producing the
+      combined obs_report (sweep curve + knee + latency decomposition)
+      — tier1.yml uploads its JSON as a CI artifact.
+"""
+import concurrent.futures as cf
+import importlib
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+from deeplearning4j_tpu.obs import MetricsRegistry, Tracer, decompose
+from deeplearning4j_tpu.serving import (ClosedLoop, ContinuousDecodeServer,
+                                        DecodeSizeMix, OnOffProcess,
+                                        PoissonProcess, ServingMetrics,
+                                        build_schedule, run_load)
+
+
+def _lm(seed=3):
+    return TransformerLM(64, d_model=16, n_heads=2, n_layers=1,
+                         max_len=48, seed=seed)
+
+
+def _mix():
+    return DecodeSizeMix(((0.7, (2, 6), (3, 8)),
+                          (0.3, (4, 8), (6, 12))), vocab=64)
+
+
+_PROCESSES = {
+    "poisson": lambda: PoissonProcess(80.0),
+    "onoff": lambda: OnOffProcess(160.0, on_s=0.25, off_s=0.25),
+    "closed": lambda: ClosedLoop(4),
+}
+
+
+# ---------------------------------------------------------------------------
+# (a) schedule determinism
+# ---------------------------------------------------------------------------
+class TestScheduleDeterminism:
+    @pytest.mark.parametrize("name", sorted(_PROCESSES))
+    def test_same_seed_byte_identical(self, name):
+        make = _PROCESSES[name]
+        s1 = build_schedule(make(), _mix(), 32, seed=11)
+        s2 = build_schedule(make(), _mix(), 32, seed=11)
+        # byte-identical, not approximately equal: repr of the full
+        # float arrival tuple and every payload tuple must match
+        assert repr(s1.arrivals) == repr(s2.arrivals)
+        assert repr(s1.items) == repr(s2.items)
+        assert s1.digest() == s2.digest()
+        assert s1.digest() != build_schedule(make(), _mix(), 32,
+                                             seed=12).digest()
+
+    def test_open_loop_arrivals_sorted(self):
+        for name in ("poisson", "onoff"):
+            s = build_schedule(_PROCESSES[name](), _mix(), 64, seed=1)
+            assert list(s.arrivals) == sorted(s.arrivals)
+            assert all(t >= 0 for t in s.arrivals)
+
+    def test_onoff_has_silence_gaps(self):
+        """Bursty means bursty: with bursts much shorter than the
+        request budget, consecutive arrivals must straddle at least one
+        full off period."""
+        s = build_schedule(OnOffProcess(200.0, on_s=0.1, off_s=0.4),
+                           _mix(), 64, seed=2)
+        gaps = [b - a for a, b in zip(s.arrivals, s.arrivals[1:])]
+        assert any(g >= 0.4 for g in gaps)
+
+    def test_arrival_and_size_streams_independent(self):
+        """Changing the mix must not perturb the arrival pattern."""
+        other = DecodeSizeMix(((1.0, (10, 14), (20, 30)),), vocab=64)
+        s1 = build_schedule(PoissonProcess(50.0), _mix(), 16, seed=7)
+        s2 = build_schedule(PoissonProcess(50.0), other, 16, seed=7)
+        assert s1.arrivals == s2.arrivals
+        assert s1.items != s2.items
+
+
+# ---------------------------------------------------------------------------
+# (b) open loop honors submission time (no coordinated omission)
+# ---------------------------------------------------------------------------
+class _StallSink:
+    """Fake server that completes every request `delay_s` AFTER submit —
+    slow enough that a completion-coordinated generator would crawl."""
+
+    metrics = None
+
+    def __init__(self, delay_s):
+        self.delay_s = float(delay_s)
+        self.t_submit = []
+
+    def submit(self, prompt, max_new):
+        self.t_submit.append(time.monotonic())
+        f = cf.Future()
+        t = threading.Timer(self.delay_s, f.set_result, args=([0],))
+        t.daemon = True
+        t.start()
+        return f
+
+
+class TestOpenLoopNoCoordination:
+    def test_submissions_track_schedule_not_completions(self):
+        """12 arrivals over ~0.15s against a server that takes 0.4s per
+        request: a closed/coordinated generator would need ~4.8s of
+        submission time; the open loop must keep submit lateness tiny
+        and finish submissions before the FIRST completion lands."""
+        sched = build_schedule(PoissonProcess(100.0), _mix(), 12, seed=0)
+        sink = _StallSink(delay_s=0.4)
+        out = run_load(sink, sched, result_timeout=30.0)
+        assert out["admitted"] == 12 and out["completed"] == 12
+        assert out["submit_lateness_ms_max"] < 250.0
+        # every submission happened before the first completion could
+        # have landed — the structural no-coordination pin
+        span = sink.t_submit[-1] - sink.t_submit[0]
+        assert span < sink.delay_s
+
+    def test_closed_loop_respects_concurrency(self):
+        class _CountingSink:
+            metrics = None
+
+            def __init__(self, delay_s):
+                self.delay_s = delay_s
+                self.outstanding = 0
+                self.max_outstanding = 0
+                self.lock = threading.Lock()
+
+            def submit(self, prompt, max_new):
+                with self.lock:
+                    self.outstanding += 1
+                    self.max_outstanding = max(self.max_outstanding,
+                                               self.outstanding)
+                f = cf.Future()
+
+                def done():
+                    with self.lock:
+                        self.outstanding -= 1
+                    f.set_result([0])
+                t = threading.Timer(self.delay_s, done)
+                t.daemon = True
+                t.start()
+                return f
+
+        sched = build_schedule(ClosedLoop(3), _mix(), 12, seed=4)
+        sink = _CountingSink(delay_s=0.02)
+        out = run_load(sink, sched, result_timeout=30.0)
+        assert out["completed"] == 12
+        assert sink.max_outstanding <= 3
+
+
+# ---------------------------------------------------------------------------
+# (a cont.) identical accounting across replays on a real server
+# ---------------------------------------------------------------------------
+class TestAccountingDeterminism:
+    @pytest.mark.parametrize("name", sorted(_PROCESSES))
+    def test_same_seed_same_accounting(self, name):
+        """Fault-free, under-capacity replay of one schedule twice on
+        the SAME server: admitted/shed/completed/failed/tokens and the
+        SLO deltas must be identical (the generous SLO keeps wall-clock
+        jitter out of attainment)."""
+        lm = _lm()
+        metrics = ServingMetrics(slo_target_ms=60_000)
+        sched = build_schedule(_PROCESSES[name](), _mix(), 10, seed=5)
+        keys = ("submitted", "admitted", "shed_at_submit", "completed",
+                "failed", "tokens_out", "ttft_ms_count")
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                    max_queue=64,
+                                    metrics=metrics) as srv:
+            srv.generate([1, 2, 3], 3, timeout=120)     # warm compile
+            r1 = run_load(srv, sched)
+            r2 = run_load(srv, sched)
+        assert r1["schedule"]["digest"] == r2["schedule"]["digest"]
+        for k in keys:
+            assert r1[k] == r2[k], f"{k}: {r1[k]} != {r2[k]}"
+        assert r1["shed_at_submit"] == 0 and r1["failed"] == 0
+        assert r1["completed"] == 10
+        # per-run SLO deltas: all 10 admitted, all met, both runs
+        for r in (r1, r2):
+            assert r["slo"]["slo_total"] == 10
+            assert r["slo"]["slo_met"] == 10
+            assert r["slo"]["attainment"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# (c) zero extra device dispatches (PR 6 dispatch-counter A/B protocol)
+# ---------------------------------------------------------------------------
+class TestZeroExtraDispatches:
+    def test_loadgen_histograms_decomposition_add_zero_dispatches(self):
+        """The SAME closed-loop(1) schedule — deterministic co-residency,
+        so dispatch counts are exactly comparable — through three arms:
+        loadgen with tracing ON (+ decomposition computed over the
+        spans), loadgen with tracing OFF, and a bare sequential
+        generate() loop (the pre-harness protocol). The decode dispatch
+        and token counters must be IDENTICAL: load generation, histogram
+        recording, and span analysis are host-side observers, never
+        schedulers."""
+        sched = build_schedule(ClosedLoop(1),
+                               DecodeSizeMix(((1.0, (2, 6), (3, 7)),),
+                                             vocab=64), 6, seed=9)
+        counts = {}
+        for name, tracer in (("on", Tracer(enabled=True)),
+                             ("off", Tracer(enabled=False))):
+            metrics = ServingMetrics(slo_target_ms=60_000)
+            with ContinuousDecodeServer(_lm(), slots=2,
+                                        prompt_buckets=(8,),
+                                        tracer=tracer,
+                                        metrics=metrics) as srv:
+                srv.generate([1, 2, 3], 2, timeout=120)   # warm compile
+                base = metrics.snapshot()
+                out = run_load(srv, sched)
+                snap = metrics.snapshot()
+            assert out["completed"] == 6
+            counts[name] = (snap["dispatches"] - base["dispatches"],
+                            snap["tokens_out"] - base["tokens_out"])
+            if name == "on":
+                # the analyzer consumes what the run recorded (6 loadgen
+                # requests + the traced warm-up request)
+                dec = decompose(tracer)
+                assert dec["n_requests"] == 7
+        metrics = ServingMetrics()
+        with ContinuousDecodeServer(_lm(), slots=2, prompt_buckets=(8,),
+                                    metrics=metrics) as srv:
+            srv.generate([1, 2, 3], 2, timeout=120)       # warm compile
+            base = metrics.snapshot()
+            for item in sched.items:
+                srv.generate(list(item["prompt"]), item["max_new"],
+                             timeout=120)
+            snap = metrics.snapshot()
+        counts["direct"] = (snap["dispatches"] - base["dispatches"],
+                            snap["tokens_out"] - base["tokens_out"])
+        assert counts["on"] == counts["off"] == counts["direct"]
+
+
+# ---------------------------------------------------------------------------
+# (d) TTFT + inter-token histograms through the real decode server
+# ---------------------------------------------------------------------------
+class TestTTFTInterToken:
+    def test_recorded_and_exposed(self):
+        reg = MetricsRegistry()
+        metrics = ServingMetrics(registry=reg, name="t1")
+        with ContinuousDecodeServer(_lm(), slots=2, prompt_buckets=(8,),
+                                    metrics=metrics) as srv:
+            srv.generate([1, 2, 3], 6, timeout=120)
+            snap_mid = metrics.snapshot()
+            # a one-token request closes TTFT at prefill and never
+            # records an inter-token sample (no decode iteration)
+            srv.generate([4, 5, 6], 1, timeout=120)
+        snap = metrics.snapshot()
+        assert snap_mid["ttft_ms_count"] == 1
+        # 6 tokens: 1 from prefill + 5 decode iterations
+        assert snap_mid["inter_token_ms_count"] == 5
+        assert snap_mid["ttft_ms_p50"] is not None
+        assert snap_mid["inter_token_ms_p99"] is not None
+        assert snap["ttft_ms_count"] == 2
+        assert snap["inter_token_ms_count"] == 5
+        text = reg.prometheus_text()
+        assert "# TYPE serving_t1_ttft_ms histogram" in text
+        assert 'serving_t1_ttft_ms_bucket{le="+Inf"} 2' in text
+        assert "serving_t1_inter_token_ms_count 5" in text
+        assert "serving_t1_inter_token_ms_sum" in text
+
+
+# ---------------------------------------------------------------------------
+# decomposition over a real traced run
+# ---------------------------------------------------------------------------
+class TestDecomposition:
+    def test_phases_partition_request_latency(self):
+        tracer = Tracer(enabled=True)
+        with ContinuousDecodeServer(_lm(), slots=2, prompt_buckets=(8,),
+                                    tracer=tracer) as srv:
+            srv.generate([1, 2, 3], 4, timeout=120)       # warm compile
+            futs = [srv.submit([2 + i, 3, 4], 6) for i in range(3)]
+            for f in futs:
+                f.result(120)
+        dec = decompose(tracer)
+        assert dec["n_requests"] == 4
+        for row in dec["requests"]:
+            for ph in ("queue_wait_ms", "prefill_ms", "decode_ms",
+                       "sched_gap_ms"):
+                assert row[ph] >= 0.0
+            # the server lane is single-threaded, every term is clipped
+            # to the request window: the four phases PARTITION the total
+            parts = (row["queue_wait_ms"] + row["prefill_ms"]
+                     + row["decode_ms"] + row["sched_gap_ms"])
+            assert parts == pytest.approx(row["total_ms"], abs=1e-6)
+        assert sum(dec["fractions"].values()) == pytest.approx(1.0,
+                                                               abs=0.01)
+        # a decode request spends real time in prefill and decode
+        assert dec["phases"]["prefill_ms"]["total_ms"] > 0
+        assert dec["phases"]["decode_ms"]["total_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
+# (e) smoke sweep: the tier-1 artifact CI uploads
+# ---------------------------------------------------------------------------
+class TestSmokeSweep:
+    def test_smoke_sweep_writes_report(self):
+        """Fast (<10s) end-to-end tools/load_sweep.py run: 2-rate curve
+        over the real decode server, knee identified, combined
+        obs_report written. tier1.yml uploads the JSON next to the
+        junit/log artifacts, so every CI run ships a machine-readable
+        throughput-latency record."""
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        mod = importlib.import_module("load_sweep")
+        # tier1.yml sets SMOKE_REPORT_DIR so its artifact-upload paths
+        # and this test agree even on runners with a custom TMPDIR
+        out = os.path.join(
+            os.environ.get("SMOKE_REPORT_DIR") or tempfile.gettempdir(),
+            "load_sweep_smoke")
+        res = mod.run_sweep(server="decode", rates=(40.0, 400.0),
+                            n_req=8, slo_ms=250.0, seed=0, trace=True,
+                            report_path=out)
+        (decode,) = res
+        assert decode["server"] == "decode"
+        assert len(decode["curve"]) == 2
+        for pt in decode["curve"]:
+            assert pt["completed"] == 8
+            assert pt["tokens_per_sec"] > 0
+            assert pt["latency_ms"]["p99"] is not None
+            assert pt["ttft_ms_p99"] is not None
+            assert "sustained_ratio" in pt
+        assert decode["knee"]["criterion"].startswith("achieved >=")
+        with open(out + ".json") as fh:
+            rep = json.load(fh)
+        assert rep["sweep"][0]["server"] == "decode"
+        assert rep["decomposition"]["n_requests"] >= 16
+        assert set(rep["decomposition"]["fractions"]) == {
+            "queue_wait_ms", "prefill_ms", "decode_ms", "sched_gap_ms"}
+        assert os.path.exists(out + ".txt")
+        assert os.path.exists(out + ".trace.json")

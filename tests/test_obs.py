@@ -151,6 +151,73 @@ class TestTraceSchema:
         reg.counter("x")
         with pytest.raises(TypeError):
             reg.gauge("x")
+        reg.histogram("h")
+        with pytest.raises(TypeError):
+            reg.reservoir("h")
+
+    def test_histogram_buckets_and_quantiles(self):
+        """The fixed-bucket histogram kind (ISSUE 7): cumulative
+        `_bucket{le=}`/`_sum`/`_count` exposition, interpolated
+        quantile estimates, overflow clamped to the largest bound."""
+        from deeplearning4j_tpu.obs import Histogram
+        h = Histogram("lat", buckets=(1, 2, 5, 10))
+        assert h.quantile(50) is None           # empty: no data
+        for v in (0.5, 1.5, 3.0, 4.0, 7.0, 50.0):
+            h.observe(v)
+        assert h.counts() == [1, 1, 2, 1, 1]    # last = +Inf overflow
+        assert h.total == 6 and h.sum == 66.0
+        # interpolated within the (2, 5] bucket holding the median
+        assert 2.0 < h.quantile(50) <= 5.0
+        assert h.quantile(99) == 10.0           # overflow clamps
+        assert h.mean() == pytest.approx(11.0)
+
+    def test_histogram_prometheus_exposition(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("req.ttft_ms", buckets=(1, 10, 100))
+        h.observe(5.0)
+        h.observe(500.0)
+        text = reg.prometheus_text(namespace="dl4j_tpu")
+        assert "# TYPE dl4j_tpu_req_ttft_ms histogram" in text
+        assert 'dl4j_tpu_req_ttft_ms_bucket{le="1"} 0' in text
+        assert 'dl4j_tpu_req_ttft_ms_bucket{le="10"} 1' in text
+        assert 'dl4j_tpu_req_ttft_ms_bucket{le="100"} 1' in text
+        assert 'dl4j_tpu_req_ttft_ms_bucket{le="+Inf"} 2' in text
+        assert "dl4j_tpu_req_ttft_ms_sum 505.0" in text
+        assert "dl4j_tpu_req_ttft_ms_count 2" in text
+        snap = reg.snapshot()
+        assert snap["req.ttft_ms_count"] == 2
+        assert snap["req.ttft_ms_p50"] is not None
+
+    def test_clock_sync_anchors_traces_for_alignment(self):
+        """Trace-alignment fix (ISSUE 7): spans are timed on the bare
+        monotonic clock, so two saved traces were un-alignable. Every
+        chrome_trace() now carries a `clock_sync` metadata event whose
+        `wallclock_ns_at_ts0` anchors ts=0 to the wall clock; two
+        traces align by shifting one by the anchor difference."""
+        t1 = Tracer(enabled=True)
+        with t1.span("a"):
+            pass
+        time.sleep(0.05)
+        t2 = Tracer(enabled=True)
+        with t2.span("b"):
+            pass
+
+        def anchor(t):
+            (cs,) = [e for e in t.chrome_trace()["traceEvents"]
+                     if e.get("name") == "clock_sync"]
+            assert cs["ph"] == "M"
+            assert "wallclock_iso" in cs["args"]
+            return (cs["args"]["wallclock_ns_at_ts0"],
+                    cs["args"]["monotonic_ns_at_ts0"])
+        w1, m1 = anchor(t1)
+        w2, m2 = anchor(t2)
+        # the anchors agree with the real elapsed time: wall-clock
+        # difference == monotonic difference (same process, so the two
+        # clocks tick together; 10ms slack for clock-read jitter)
+        assert w2 > w1 and m2 > m1
+        assert abs((w2 - w1) - (m2 - m1)) < 10e6
+        # and the anchor is an actual recent wallclock time
+        assert abs(time.time_ns() - w2) < 60e9
 
     def test_sanitize_and_fmt(self):
         assert sanitize("a.b-c d") == "a_b_c_d"
@@ -346,6 +413,9 @@ class TestMetricsPins:
         "spec_accepted_per_dispatch_mean", "spec_acceptance_rate_mean",
         "dispatches_per_token", "device_dispatches_per_token",
         "slo_total", "slo_met", "slo_tokens_met", "slo_attainment",
+        "ttft_ms_p50", "ttft_ms_p99", "ttft_ms_mean", "ttft_ms_count",
+        "inter_token_ms_p50", "inter_token_ms_p99",
+        "inter_token_ms_mean", "inter_token_ms_count",
     )
 
     def test_registry_storage_keys_via_stats_reporter(self):

@@ -1,0 +1,282 @@
+"""Throughput–latency sweep: offered rate -> what the servers deliver.
+
+The traffic-harness headline tool: drive a REAL server
+(`ContinuousDecodeServer` and/or `InferenceServer`) with seeded arrival
+schedules (`serving/loadgen.py`) at a ladder of offered rates, and emit
+the curve every serving claim should be judged on:
+
+  offered rate -> achieved tokens/s (requests/s for the micro-batch
+  server), request p50/p99, TTFT p99, inter-token p99, SLO attainment,
+  goodput-under-SLO, shed counts, submit-lateness (open-loop fidelity)
+
+plus the SATURATION KNEE — the highest offered rate the server still
+sustains (achieved >= 90% of offered). Below the knee latency is flat;
+past it the queue grows without bound and p99/sheds are the story. The
+combined `tools/obs_report.py` view (host spans + span-derived latency
+decomposition + per-rate metrics) is written with `--report`.
+
+Run (CPU backend, no chip needed):
+
+    JAX_PLATFORMS=cpu python tools/load_sweep.py \
+        [--server both] [--rates 50,100,200,400,800] \
+        [--process poisson|onoff|closed] [--requests 64] \
+        [--slo-ms 150] [--seed 0] [--report /tmp/sweep] [--no-trace]
+
+`--process onoff` keeps the same MEAN rate but bursts at 2x with a 50%
+duty cycle (the p99 stressor); `--process closed` reinterprets each
+"rate" as a fixed concurrency (the coordinated-omission contrast).
+`bench.py`'s `load_sweep` config pins one sweep point per record;
+tests/test_loadgen.py runs the smoke version in tier-1 and CI uploads
+its report JSON.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.obs.registry import fmt  # noqa: E402
+
+KNEE_THRESH = 0.9
+
+
+def _lm():
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+    return TransformerLM(96, d_model=32, n_heads=2, n_layers=2,
+                         max_len=64, seed=5, dtype=jnp.float32)
+
+
+def _mlp():
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.Builder().seed(7)
+            .updater("adam").learning_rate(0.01).list()
+            .layer(0, DenseLayer(n_out=64, activation="relu"))
+            .layer(1, OutputLayer(n_out=10, activation="softmax",
+                                  loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(32))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _process_for(process, rate):
+    """Map one sweep 'rate' onto an arrival process. onoff keeps the
+    same MEAN rate but bursts at 2x with a 50% duty cycle; closed
+    reinterprets rate as a concurrency level."""
+    from deeplearning4j_tpu.serving import (ClosedLoop, OnOffProcess,
+                                            PoissonProcess)
+    if process == "poisson":
+        return PoissonProcess(rate)
+    if process == "onoff":
+        return OnOffProcess(2.0 * rate, on_s=0.5, off_s=0.5)
+    if process == "closed":
+        return ClosedLoop(max(1, int(rate)))
+    raise ValueError(f"unknown process {process!r}")
+
+
+def _knee(curve):
+    """Saturation knee over annotated points (each carries `_offered` /
+    `_achieved`): the last point before the first unsustained one."""
+    knee = first_bad = None
+    for pt in curve:
+        off, ach = pt.pop("_offered", None), pt.pop("_achieved", None)
+        if not off or ach is None:
+            continue
+        pt["sustained_ratio"] = round(ach / off, 3)
+        if first_bad is None:
+            if ach / off >= KNEE_THRESH:
+                knee = pt
+            else:
+                first_bad = pt
+    return {
+        "criterion": f"achieved >= {KNEE_THRESH:g} x offered",
+        "knee_offered_rate": knee and knee["offered_rate_target"],
+        "knee_achieved": knee and (knee.get("tokens_per_sec")
+                                   or knee.get("requests_per_sec")),
+        "first_unsustained_rate": (
+            first_bad and first_bad["offered_rate_target"]),
+    }
+
+
+def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
+                 process="poisson", tracer=None, lm=None, slots=4):
+    """Rate ladder over the ContinuousDecodeServer. One server serves
+    every rate (compile once); per-point accounting is delta-based
+    (loadgen baselines at entry), so points never contaminate each
+    other. Offered/achieved compare in TOKENS/s — the decode server's
+    capacity is token throughput, not request admission."""
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            DecodeSizeMix,
+                                            ServingMetrics,
+                                            build_schedule, run_load)
+    lm = lm if lm is not None else _lm()
+    metrics = ServingMetrics(slo_target_ms=slo_ms)
+    srv = ContinuousDecodeServer(
+        lm, slots=slots, prompt_buckets=(8, 16), max_queue=1024,
+        metrics=metrics, tracer=tracer).start()
+    # mostly short chat turns + a tail of long generations — the mixed-
+    # length shape continuous batching exists for
+    mix = DecodeSizeMix(((0.8, (3, 12), (4, 24)),
+                         (0.2, (8, 16), (24, 44))), vocab=96)
+    try:
+        # compile both prompt buckets + the decode step off the clock
+        for p in ([1, 2, 3, 4], list(range(1, 13))):
+            srv.generate(p, 4, timeout=300)
+        curve = []
+        for i, rate in enumerate(rates):
+            sched = build_schedule(_process_for(process, rate), mix,
+                                   n_req, seed=seed + i)
+            pt = run_load(srv, sched)
+            pt["offered_rate_target"] = rate
+            pt["_offered"] = pt["schedule"]["offered_tokens_per_sec"]
+            pt["_achieved"] = pt["tokens_per_sec"]
+            curve.append(pt)
+        snap = metrics.snapshot()
+    finally:
+        srv.stop(timeout=120)
+    # describe the model actually measured (bench.py passes bigger ones)
+    d_model = int(lm.aux["tok"].shape[1])
+    return {"server": "decode", "process": process,
+            "config": f"TransformerLM L={len(lm.blocks)} d={d_model} "
+                      f"slots={slots}, mix 80% short(p3-11/n4-23) + "
+                      f"20% long(p8-15/n24-43), {n_req} reqs/rate, "
+                      f"slo={slo_ms:g}ms",
+            "unit": "generated tokens/sec",
+            "curve": curve, "knee": _knee(curve)}, snap
+
+
+def sweep_microbatch(rates, n_req=96, slo_ms=50.0, seed=0,
+                     process="poisson", tracer=None):
+    """Rate ladder over the InferenceServer (requests/s domain)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.serving import (InferenceServer,
+                                            InferenceSizeMix,
+                                            ServingMetrics,
+                                            build_schedule, run_load)
+    net = _mlp()
+    metrics = ServingMetrics(slo_target_ms=slo_ms)
+    srv = InferenceServer(net, max_batch=8, max_wait_ms=2.0,
+                          max_queue=1024, metrics=metrics,
+                          tracer=tracer).start()
+    mix = InferenceSizeMix(32)
+    try:
+        # compile every bucket program off the clock
+        rng = np.random.default_rng(1)
+        xs = rng.standard_normal((8, 32)).astype(np.float32)
+        for burst in (1, 4, 8):
+            for f in [srv.submit(x) for x in xs[:burst]]:
+                f.result(120)
+        curve = []
+        for i, rate in enumerate(rates):
+            sched = build_schedule(_process_for(process, rate), mix,
+                                   n_req, seed=seed + i)
+            pt = run_load(srv, sched)
+            pt["offered_rate_target"] = rate
+            pt["_offered"] = pt["schedule"]["offered_rps"]
+            pt["_achieved"] = pt["requests_per_sec"]
+            curve.append(pt)
+        snap = metrics.snapshot()
+    finally:
+        srv.stop(timeout=120)
+    return {"server": "microbatch", "process": process,
+            "config": "MLP 32->64->10, max_batch=8 max_wait=2ms, "
+                      f"{n_req} reqs/rate, slo={slo_ms:g}ms",
+            "unit": "requests/sec",
+            "curve": curve, "knee": _knee(curve)}, snap
+
+
+def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
+              process="poisson", n_req=64, slo_ms=150.0, seed=0,
+              trace=True, report_path=None):
+    """Drive the sweep(s) and (optionally) write the combined
+    obs_report (JSON + text + Chrome trace). Returns the results list.
+    The tier-1 smoke test calls this with tiny parameters."""
+    from deeplearning4j_tpu.obs import Tracer
+    tracer = Tracer(capacity=1 << 16, enabled=True) if trace else None
+    results, snaps = [], {}
+    if server in ("decode", "both"):
+        body, snap = sweep_decode(rates, n_req=n_req, slo_ms=slo_ms,
+                                  seed=seed, process=process,
+                                  tracer=tracer)
+        results.append(body)
+        snaps["decode"] = snap
+    if server in ("microbatch", "both"):
+        # the micro-batch rates ride the same ladder; its own tracer
+        # would collide with the decode server's req-<id> lanes, so the
+        # shared tracer is decode-only and decomposition covers decode
+        mb_rates = tuple(max(20, r // 2) for r in rates)
+        body, snap = sweep_microbatch(mb_rates, n_req=n_req,
+                                      slo_ms=min(slo_ms, 50.0),
+                                      seed=seed, process=process)
+        results.append(body)
+        snaps["microbatch"] = snap
+    if report_path:
+        # obs_report lives next to this file, not under the repo-root
+        # entry this module inserts — `python -m tools.load_sweep` or an
+        # importing test must not lose a finished sweep at report time
+        tools_dir = os.path.dirname(os.path.abspath(__file__))
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        from obs_report import build_report, format_report
+        report = build_report(spans=tracer, metrics=snaps)
+        report["sweep"] = results
+        with open(report_path + ".json", "w") as fh:
+            json.dump(report, fh)
+        with open(report_path + ".txt", "w") as fh:
+            fh.write(format_report(report) + "\n")
+            for r in results:
+                fh.write(f"\n== sweep: {r['server']} ({r['process']}) "
+                         f"==\n")
+                for pt in r["curve"]:
+                    fh.write(json.dumps(pt) + "\n")
+                fh.write(json.dumps(r["knee"]) + "\n")
+        if tracer is not None:
+            tracer.save(report_path + ".trace.json")
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--server", default="both",
+                    choices=("decode", "microbatch", "both"))
+    ap.add_argument("--rates", default="50,100,200,400,800",
+                    help="comma-separated offered rates (requests/sec; "
+                         "concurrency levels for --process closed)")
+    ap.add_argument("--process", default="poisson",
+                    choices=("poisson", "onoff", "closed"))
+    ap.add_argument("--requests", type=int, default=64,
+                    help="requests per sweep point")
+    ap.add_argument("--slo-ms", type=float, default=150.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--report", default=None,
+                    help="write obs_report JSON/text/trace under this "
+                         "path prefix")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="disable span tracing (no decomposition in "
+                         "the report)")
+    args = ap.parse_args()
+    rates = tuple(float(r) for r in args.rates.split(","))
+    t0 = time.perf_counter()
+    results = run_sweep(server=args.server, rates=rates,
+                        process=args.process, n_req=args.requests,
+                        slo_ms=args.slo_ms, seed=args.seed,
+                        trace=not args.no_trace,
+                        report_path=args.report)
+    for r in results:
+        print(json.dumps(r))
+    print(json.dumps({"elapsed_s": fmt(time.perf_counter() - t0, 1),
+                      "report": args.report and args.report
+                      + ".{json,txt,trace.json}"}))
+
+
+if __name__ == "__main__":
+    main()

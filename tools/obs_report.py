@@ -4,6 +4,10 @@ One place that joins the three telemetry surfaces PR 6 standardized:
 
   * host span timeline (obs.trace.Tracer / a saved Chrome trace JSON) —
     aggregated per span name: count, total/mean/p99 ms;
+  * span-derived latency decomposition (`obs.decompose`): each served
+    request's total attributed to queue-wait / prefill / decode /
+    scheduling-gap phases, aggregated per phase — included automatically
+    whenever the spans contain `serve.request` lanes;
   * the device-op table from `optimize.profiler.summarize_trace` (an
     xplane/trace capture directory, when one exists);
   * one or more metrics snapshots (`ServingMetrics.snapshot()` dicts or
@@ -71,7 +75,12 @@ def build_report(spans=None, profile_logdir=None, metrics=None):
     (missing/unparsable traces degrade to None, never raise — the host
     report must survive a profile that was never captured)."""
     report = {"spans": span_summary(spans) if spans is not None else None,
-              "device_ops": None, "metrics": None}
+              "device_ops": None, "metrics": None, "decomposition": None}
+    if spans is not None:
+        from deeplearning4j_tpu.obs.decompose import decompose
+        dec = decompose(spans)
+        if dec["n_requests"]:
+            report["decomposition"] = dec
     if profile_logdir is not None:
         try:
             from deeplearning4j_tpu.optimize.profiler import \
@@ -112,6 +121,15 @@ def format_report(report, top=20):
         lines += _table(report["spans"],
                         ["name", "count", "total_ms", "mean_ms",
                          "p99_ms"], "host spans", limit=top)
+    if report.get("decomposition"):
+        dec = report["decomposition"]
+        rows = [{"phase": ph, **stats,
+                 "fraction": dec["fractions"].get(ph)}
+                for ph, stats in dec["phases"].items()]
+        lines += _table(rows, ["phase", "total_ms", "mean_ms", "p50_ms",
+                               "p99_ms", "fraction"],
+                        f"latency decomposition "
+                        f"({dec['n_requests']} requests)")
     if report.get("device_ops") is not None:
         lines += _table(report["device_ops"],
                         ["name", "total_ms", "count", "pct"],
