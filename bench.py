@@ -27,7 +27,12 @@ plus beyond-reference extras (budget permitting, skipped first):
                         greedy decode on repetitive text — tokens/s,
                         acceptance rate, dispatches/token (streams
                         pinned bit-identical)
- 11. load_sweep         production-traffic harness (serving/loadgen.py):
+ 11. paged_decode       paged block-table KV cache (serving/kvpool.py,
+                        vLLM-style) vs the fixed-slot cache at EQUAL
+                        ARENA BYTES, mixed lengths behind a shared
+                        system prefix — max concurrent streams, prefix
+                        hit rate, tokens/s (streams pinned bit-identical)
+ 12. load_sweep         production-traffic harness (serving/loadgen.py):
                         seeded Poisson arrivals at a 3-rate ladder
                         through the ContinuousDecodeServer — achieved
                         tokens/s, request p99, TTFT p99, goodput-under-
@@ -808,6 +813,117 @@ def bench_speculative(rng, small=False):
     return rec
 
 
+def bench_paged_decode(rng, small=False):
+    """Paged block-table KV cache vs the fixed-slot cache through the
+    REAL ContinuousDecodeServer at EQUAL ARENA BYTES (serving/kvpool.py
+    + the zoo's paged programs; tools/serve_ab.py `paged_vs_fixed` is
+    the richer standalone). Fixed mode reserves slots x max_len rows up
+    front, so its concurrency IS its slot count; paged mode holds the
+    same rows as free-listed blocks, slots become a scheduling width,
+    and admission gates on blocks actually reserved. The workload —
+    mixed lengths behind one shared system prefix, stored once by the
+    prefix cache — is the shape real traffic has. Streams are pinned
+    bit-identical and paging adds zero decode dispatches per token
+    (tests/test_paged.py), so the A/B isolates CONCURRENCY at fixed
+    memory: max live streams is the headline next to tokens/s."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            ServingMetrics)
+
+    V, L, D, H = (96, 2, 32, 2) if small else (512, 4, 256, 8)
+    max_len = 64 if small else 160
+    fixed_slots = 4 if small else 8
+    bs = 8 if small else 16
+    n_blocks = fixed_slots * max_len // bs      # EQUAL arena rows
+    paged_slots = 4 * fixed_slots
+    n_req = 16 if small else 32
+    n_prefix = 16
+    bucket = 24 if small else 32
+    dec_hi = 28 if small else 60
+    lm = TransformerLM(V, d_model=D, n_heads=H, n_layers=L,
+                       max_len=max_len, dtype=jnp.float32)
+    sys_prefix = np.random.default_rng(7).integers(
+        1, V, n_prefix).tolist()
+
+    def workload(seed, n):
+        r = np.random.default_rng(seed)
+        return [(sys_prefix
+                 + r.integers(1, V, int(r.integers(1, 8))).tolist(),
+                 int(r.integers(4, dec_hi))) for _ in range(n)]
+
+    slo_ms = 100.0
+    servers = {
+        "paged": ContinuousDecodeServer(
+            lm, slots=paged_slots, prompt_buckets=(bucket,),
+            max_queue=4 * n_req, paged=True, block_size=bs,
+            n_blocks=n_blocks,
+            metrics=ServingMetrics(slo_target_ms=slo_ms)).start(),
+        "fixed": ContinuousDecodeServer(
+            lm, slots=fixed_slots, prompt_buckets=(bucket,),
+            max_queue=4 * n_req,
+            metrics=ServingMetrics(slo_target_ms=slo_ms)).start(),
+    }
+    for srv in servers.values():       # compile off the clock
+        for p, n in workload(0, 4):
+            srv.generate(p, n, timeout=300)
+    base = {n: servers[n].metrics.snapshot() for n in servers}
+
+    seg_idx = {name: [0] for name in servers}
+
+    def seg(name):
+        srv = servers[name]
+
+        def run():
+            work = workload(100 + seg_idx[name][0], n_req)
+            seg_idx[name][0] += 1
+            toks = sum(n for _, n in work)
+            t0 = time.perf_counter()
+            for f in [srv.submit(p, n) for p, n in work]:
+                f.result(600)
+            return toks / (time.perf_counter() - t0)
+        return run
+
+    ab = _interleaved_median({n: seg(n) for n in servers},
+                             segments=3 if small else 5)
+    snaps = {n: servers[n].metrics.snapshot() for n in servers}
+    for srv in servers.values():
+        srv.stop()
+    streams = {n: snaps[n]["live_streams_max"] for n in snaps}
+    p = snaps["paged"]
+    rec = {"value": ab["paged"]["median"], "unit": "tokens/sec",
+           "config": f"ContinuousDecodeServer L={L} d={D}, equal arena "
+                     f"{n_blocks * bs} KV rows: fixed {fixed_slots} "
+                     f"slots x {max_len} vs paged {n_blocks} blocks x "
+                     f"{bs} (slots={paged_slots} scheduling width), "
+                     f"{n_prefix}-token shared prefix, {n_req} reqs/seg",
+           "paged_ab": ab,
+           "paged_over_fixed": round(
+               ab["paged"]["median"] / ab["fixed"]["median"], 3),
+           "max_concurrent_streams": streams,
+           "streams_paged_over_fixed": round(
+               streams["paged"] / max(1, streams["fixed"]), 2),
+           "blocks_in_use_max": p["blocks_in_use_max"],
+           "pool_blocks": p["pool_blocks"],
+           "blocked_on_memory": p["blocked_on_memory"],
+           "vs_baseline": round(ab["paged"]["median"]
+                                / BASELINE_DECODE_TOKENS_PER_SEC, 3)}
+    from deeplearning4j_tpu.obs.registry import fmt
+    from deeplearning4j_tpu.serving.metrics import slo_view
+    rec["prefix_hit_rate"] = fmt(p["prefix_hit_rate"], 4)
+    rec["dispatches_per_token"] = {
+        n: fmt(snaps[n]["dispatches_per_token"], 4) for n in snaps}
+    for n, s in snaps.items():
+        view = slo_view(s, ab[n]["median"], base[n])
+        rec[f"slo_attainment_{n}"] = view["attainment"]
+        rec[f"goodput_tokens_per_sec_{n}"] = view.get(
+            "goodput_tokens_per_sec")
+    rec["slo_ms"] = slo_ms
+    return rec
+
+
 def bench_load_sweep(rng, small=False):
     """One pinned traffic-harness sweep point (the ISSUE 7 acceptance
     metric): seeded open-loop Poisson arrivals through the REAL
@@ -923,6 +1039,9 @@ SECONDARY_CONFIGS = {
     "decode_tokens_sec": (bench_decode, 100),
     "served_throughput": (bench_served, 110),
     "speculative_decode": (bench_speculative, 120),
+    # paged KV cache (ISSUE 8): concurrency at equal arena bytes —
+    # max live streams + tokens/s, paged vs fixed-slot cache
+    "paged_decode": (bench_paged_decode, 110),
     # the traffic-harness pinned sweep point (ISSUE 7): arrivals +
     # queueing, not backlog replay — knee + goodput-under-SLO per record
     "load_sweep": (bench_load_sweep, 100),

@@ -272,6 +272,40 @@ def make_decode_block_fn(n_heads):
     return block_decode
 
 
+def gated_cache_rows(cache, idx, k_new, v_new, gate=None):
+    """The ONE clip-gather / drop-scatter KV-cache row update, shared by
+    the fixed-slot decode block (1 position/slot), the K-wide verify
+    block, and the paged block-table programs — so the subtle part of
+    serving cache writes lives in exactly one place.
+
+    cache: {"k": ..., "v": ...}; idx: index tuple for `.at[idx]`
+    addressing whole [..., H, hd] rows; k_new/v_new: replacement rows,
+    shaped like the indexed selection.
+
+    gate (broadcastable bool) selects per row between the new value and
+    the row's CURRENT content: an inactive slot writes back the rows it
+    already held, so its cache stays bit-identical while neighbours
+    decode. The gather clips an out-of-range row to the last one (value
+    unused: its write is dropped); the scatter DROPS out-of-range rows
+    outright, so the duplicate-index clobber a clipped write would risk
+    cannot happen.
+
+    gate=None means the INDICES already encode gating (callers send
+    suppressed rows out of range, where the drop-mode scatter discards
+    them). The paged programs need this form: a free slot's stale block
+    table may alias a live slot's physical block, and a stale write-back
+    would race the live slot's new row inside one scatter — index
+    gating writes nothing at all instead."""
+    out = {}
+    for name, new in (("k", k_new), ("v", v_new)):
+        buf = cache[name]
+        if gate is not None:
+            old = buf.at[idx].get(mode="clip")
+            new = jnp.where(gate, new, old)
+        out[name] = buf.at[idx].set(new, mode="drop")
+    return out
+
+
 def make_slot_decode_block_fn(n_heads):
     """`make_decode_block_fn` generalized to a FIXED-SLOT serving batch:
     per-slot cache positions and an active mask, the decode unit of the
@@ -297,12 +331,9 @@ def make_slot_decode_block_fn(n_heads):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         rows = jnp.arange(S)
         gate = active[:, None, None]
-        old_k = cache["k"][rows, pos]                   # [S, H, hd]
-        old_v = cache["v"][rows, pos]
-        k_cache = cache["k"].at[rows, pos].set(
-            jnp.where(gate, k.reshape(S, H, hd), old_k))
-        v_cache = cache["v"].at[rows, pos].set(
-            jnp.where(gate, v.reshape(S, H, hd), old_v))
+        cache = gated_cache_rows(cache, (rows, pos), k.reshape(S, H, hd),
+                                 v.reshape(S, H, hd), gate)
+        k_cache, v_cache = cache["k"], cache["v"]
         qh = q.reshape(S, H, hd)
         scores = jnp.einsum("shd,slhd->shl", qh,
                             k_cache) / math.sqrt(hd)    # [S, H, L]
@@ -381,16 +412,10 @@ def make_slot_verify_block_fn(n_heads):
         rows = jnp.arange(S)[:, None]                   # [S, 1]
         pcols = pos[:, None] + jnp.arange(K)[None, :]   # [S, K]
         gate = active[:, None, None, None]
-        # gather clips an out-of-range row to L-1 (value unused: its
-        # write is dropped); scatter DROPS out-of-range rows outright,
-        # so the duplicate-index clobber a clipped write would risk
-        # cannot happen
-        old_k = cache["k"].at[rows, pcols].get(mode="clip")
-        old_v = cache["v"].at[rows, pcols].get(mode="clip")
-        k_cache = cache["k"].at[rows, pcols].set(
-            jnp.where(gate, k.reshape(S, K, H, hd), old_k), mode="drop")
-        v_cache = cache["v"].at[rows, pcols].set(
-            jnp.where(gate, v.reshape(S, K, H, hd), old_v), mode="drop")
+        cache = gated_cache_rows(cache, (rows, pcols),
+                                 k.reshape(S, K, H, hd),
+                                 v.reshape(S, K, H, hd), gate)
+        k_cache, v_cache = cache["k"], cache["v"]
         qh = q.reshape(S, K, H, hd)
         scores = jnp.einsum("skhd,slhd->shkl", qh,
                             k_cache) / math.sqrt(hd)    # [S, H, K, L]
@@ -463,16 +488,18 @@ def make_slot_verify_fn(n_heads, k):
     return verify
 
 
-def prefill_forward(aux, blocks, tokens, n_heads, cache_len):
-    """One causal forward over `tokens` [B, P] through the SHARED
-    attention core (`causal_attention(return_kv=True)`), filling rows
-    [0, P) of a length-`cache_len` KV cache per layer. Returns
-    (h [B, P, D], cache). The ONE prefill implementation: `generate_batch`
-    and the serving prefill programs both call it, so serving can never
-    drift from the pinned generation numerics."""
-    B, P = tokens.shape
+def prefill_panels(aux, blocks, tokens, n_heads):
+    """The ONE causal prompt forward: embed `tokens` [B, P], run every
+    block through the SHARED attention core
+    (`causal_attention(return_kv=True)`), and return
+    (h [B, P, D], [(kp, vp)] per layer, each [B, P, H, hd]).
+
+    Both cache layouts install from these panels — `prefill_forward`
+    scatters them into fixed-slot cache rows, `make_paged_prefill_fn`
+    into block-table rows — so neither layout can drift from the
+    training/forward block numerics."""
     h = embed_fn(aux, tokens)
-    cache = []
+    panels = []
     for p in blocks:
         hn = _layer_norm(h, p["ln1"]["g"], p["ln1"]["b"])
         att, kp, vp = causal_attention(
@@ -482,6 +509,20 @@ def prefill_forward(aux, blocks, tokens, n_heads, cache_len):
         hn = _layer_norm(h, p["ln2"]["g"], p["ln2"]["b"])
         m = jax.nn.gelu(hn @ p["mlp"]["w1"] + p["mlp"]["b1"])
         h = h + m @ p["mlp"]["w2"] + p["mlp"]["b2"]
+        panels.append((kp, vp))
+    return h, panels
+
+
+def prefill_forward(aux, blocks, tokens, n_heads, cache_len):
+    """One causal forward over `tokens` [B, P] filling rows [0, P) of a
+    length-`cache_len` fixed-layout KV cache per layer. Returns
+    (h [B, P, D], cache). `generate_batch` and the serving prefill
+    programs both call it (via the shared `prefill_panels` core), so
+    serving can never drift from the pinned generation numerics."""
+    B, P = tokens.shape
+    h, panels = prefill_panels(aux, blocks, tokens, n_heads)
+    cache = []
+    for kp, vp in panels:
         z = jnp.zeros((B, cache_len, n_heads, kp.shape[-1]), kp.dtype)
         cache.append({"k": z.at[:, :P].set(kp),
                       "v": z.at[:, :P].set(vp)})
@@ -514,6 +555,192 @@ def init_kv_cache(n_layers, batch, max_len, d_model, n_heads,
     hd = d_model // n_heads
     z = lambda: jnp.zeros((batch, max_len, n_heads, hd), dtype)
     return [{"k": z(), "v": z()} for _ in range(n_layers)]
+
+
+def init_paged_kv_cache(n_layers, n_blocks, block_size, d_model, n_heads,
+                        dtype=jnp.float32):
+    """PAGED KV arena: per layer {k, v: [n_blocks * block_size, H, hd]},
+    flat row-major so physical row = block_id * block_size + offset.
+    One preallocated arena shared by EVERY stream — which streams own
+    which blocks is host state (`serving.kvpool.BlockPool` + per-slot
+    block tables), not device state."""
+    hd = d_model // n_heads
+    rows = int(n_blocks) * int(block_size)
+    z = lambda: jnp.zeros((rows, n_heads, hd), dtype)
+    return [{"k": z(), "v": z()} for _ in range(n_layers)]
+
+
+def make_paged_decode_block_fn(n_heads, block_size):
+    """`make_slot_decode_block_fn` with the cache indirected through a
+    BLOCK TABLE (vLLM PagedAttention, Kwon et al. SOSP'23): the per-slot
+    unit of paged continuous-batching decode.
+
+    block_decode(p, x [S, D], cache {k,v: [n_rows, H, hd]}, btab [S, NB],
+                 pos [S], active [S] bool) -> (y [S, D], updated cache)
+
+    `cache` is the SHARED flat arena; `btab[s, b]` maps slot s's logical
+    block b to a physical block, so logical row l lives at physical row
+    `btab[s, l // bs] * bs + l % bs`. The write lands at slot s's
+    frontier row; gating is by INDEX, not write-back (`gated_cache_rows`
+    gate=None): a free slot's stale table may alias a live slot's
+    physical block, and a stale write-back would race the live slot's
+    new row inside one scatter — inactive rows go out of range and the
+    drop-mode scatter discards them. Attention then GATHERS the slot's
+    whole logical window [S, NB*bs, H, hd] from the arena and runs the
+    identical einsum/softmax as the fixed-slot block: per-logical-row
+    values equal means per-slot bits equal, because masked positions
+    contribute EXACT zeros after softmax (exp underflow) and appending
+    exact zeros never changes a float sum — the window length (NB*bs vs
+    max_len) is therefore free to differ between layouts. Shared prefix
+    blocks are read-only by invariant (the pool copy-on-writes before
+    any divergent append), so two slots gathering one physical block is
+    just a shared read."""
+    bs = int(block_size)
+
+    def block_decode(p, x, cache, btab, pos, active):
+        S, D = x.shape
+        H = n_heads
+        hd = D // H
+        NB = btab.shape[1]
+        L = NB * bs
+        n_rows = cache["k"].shape[0]
+        h = _layer_norm(x, p["ln1"]["g"], p["ln1"]["b"])
+        qkv = h @ p["attn"]["wqkv"]                     # [S, 3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        blk = btab[jnp.arange(S), pos // bs]            # [S] physical blk
+        pr = blk * bs + pos % bs                        # frontier row
+        widx = jnp.where(active, pr, n_rows)            # inactive: drop
+        cache = gated_cache_rows(cache, (widx,), k.reshape(S, H, hd),
+                                 v.reshape(S, H, hd))
+        # gather each slot's logical window from the arena
+        flat = (btab[:, :, None] * bs +
+                jnp.arange(bs)[None, None, :]).reshape(S, L)
+        k_rows = jnp.take(cache["k"], flat, axis=0)     # [S, L, H, hd]
+        v_rows = jnp.take(cache["v"], flat, axis=0)
+        qh = q.reshape(S, H, hd)
+        scores = jnp.einsum("shd,slhd->shl", qh,
+                            k_rows) / math.sqrt(hd)     # [S, H, L]
+        mask = jnp.arange(L)[None, None, :] <= pos[:, None, None]
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        att = jax.nn.softmax(scores.astype(jnp.float32),
+                             -1).astype(x.dtype)
+        out = jnp.einsum("shl,slhd->shd", att, v_rows).reshape(S, D)
+        x = x + out @ p["attn"]["wo"]
+        h = _layer_norm(x, p["ln2"]["g"], p["ln2"]["b"])
+        m = jax.nn.gelu(h @ p["mlp"]["w1"] + p["mlp"]["b1"])
+        y = x + m @ p["mlp"]["w2"] + p["mlp"]["b2"]
+        return y, cache
+
+    return block_decode
+
+
+def make_paged_decode_fn(n_heads, block_size):
+    """One ITERATION of continuous-batching decode over the PAGED cache,
+    the whole model:
+
+    step(aux, blocks, cache, btabs [S, NB], pos [S], tok [S], active [S])
+      -> (next_tok [S] i32, logits [S, V] f32, new cache, new pos)
+
+    Same contract as `make_slot_decode_fn` (greedy f32 argmax, gated
+    writes, pos advances by `active`, ONE compiled program per slot
+    count) with the cache swapped for arena + block tables: slot count S
+    is a pure SCHEDULING width — memory is the arena, and admission is
+    gated by free blocks (`serving.kvpool.BlockPool`), not free slots.
+    The block table rides in as a [S, NB] i32 argument each dispatch
+    (host state, like `tok`/`active`) — no extra device dispatch."""
+    block_decode = make_paged_decode_block_fn(n_heads, block_size)
+
+    def step(aux, blocks, cache, btabs, pos, tok, active):
+        x = aux["tok"][tok] + aux["pos"][pos]           # [S, D]
+        new_cache = []
+        for p, c in zip(blocks, cache):
+            x, c = block_decode(p, x, c, btabs, pos, active)
+            new_cache.append(c)
+        logits = logits_fn(aux, x).astype(jnp.float32)  # [S, V]
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        new_pos = pos + active.astype(pos.dtype)
+        return nxt, logits, new_cache, new_pos
+
+    return step
+
+
+def make_paged_prefill_fn(n_heads):
+    """Serving prefill for ONE request over the PAGED cache — the pure
+    COMPUTE half: the forward runs over the whole padded prompt through
+    the ONE `prefill_panels` implementation and returns the k/v panels;
+    `make_paged_install_fn` scatters them into the arena in a separate
+    DONATED program. The split matters: a fused prefill+install would
+    have to take the arena UNDONATED (an admission-time failure must
+    fail only that request, so the arena has to survive a failed call),
+    and an undonated arena output copies every untouched row — the
+    whole pool's bytes — on every admission.
+
+    prefill(aux, blocks, prompt [1, Pb], length)
+      -> (logits [1, V] f32 at the last REAL token,
+          panels [(kp, vp)] per layer, each [1, Pb, H, hd])
+
+    The bucket floor of 2 applies to paged prompt buckets exactly as to
+    fixed ones: Pb=1 would take XLA:CPU's differently-accumulating gemv
+    path."""
+
+    def prefill(aux, blocks, prompt, length):
+        h, panels = prefill_panels(aux, blocks, prompt, n_heads)
+        logits = logits_fn(aux, h[:, length - 1]).astype(jnp.float32)
+        return logits, panels
+
+    return prefill
+
+
+def make_paged_install_fn(block_size):
+    """Install half of the paged prefill: scatter the prompt's k/v
+    panels to their block-table rows. The caller jits this with the
+    arena DONATED (aliased in place, exactly like the fixed path's
+    install scatter) and runs it only AFTER the prefill dispatch
+    succeeded, preserving per-request failure isolation.
+
+    install(cache, panels, btab [NB], length, shared_len) -> new cache
+
+    Three row classes never install: the bucket-padding tail (rows >=
+    `length` — overwritten-before-attended, the standard bucket
+    argument), rows < `shared_len` (the PREFIX-CACHE hit: physically
+    resident blocks another stream already filled, possibly refcount
+    > 1 — recomputed k/v for those rows equal the resident bits because
+    per-row bits are independent of batch shape, the measured property
+    every padding pin rests on, so skipping their install changes only
+    the write set), and nothing else — all suppressed by index (sent
+    out of range, drop-mode scatter)."""
+    bs = int(block_size)
+
+    def install(cache, panels, btab, length, shared_len):
+        P = panels[0][0].shape[1]
+        r = jnp.arange(P)
+        pr = btab[r // bs] * bs + r % bs                # [P] physical
+        n_rows = cache[0]["k"].shape[0]
+        write = (r >= shared_len) & (r < length)
+        widx = jnp.where(write, pr, n_rows)             # suppressed: drop
+        return [gated_cache_rows(c, (widx,), kp[0], vp[0])
+                for c, (kp, vp) in zip(cache, panels)]
+
+    return install
+
+
+def make_block_copy_fn(block_size):
+    """Copy-on-write worker: copy one physical block's rows (all layers)
+    to another — the device half of the pool's lazy CoW (a stream about
+    to append into a SHARED partial block gets a private copy first).
+    One compiled program serves every (src, dst) pair; rows past the
+    shared content it copies are dead rows the new owner overwrites
+    before any query attends to them (the bucket-prefill argument)."""
+    bs = int(block_size)
+
+    def copy(cache, src, dst):
+        s_rows = src * bs + jnp.arange(bs)
+        d_rows = dst * bs + jnp.arange(bs)
+        return [{"k": c["k"].at[d_rows].set(c["k"][s_rows]),
+                 "v": c["v"].at[d_rows].set(c["v"][s_rows])}
+                for c in cache]
+
+    return copy
 
 
 def init_lm(vocab_size, d_model=128, n_heads=4, n_layers=4, d_ff=None,

@@ -19,6 +19,13 @@ serving systems converge on, built here over the existing containers:
     ONE K-wide verify dispatch accepts 1..K of them — greedy streams
     pinned bit-identical to plain decode (acceptance-by-exact-argmax-
     match), so speculation is a pure dispatch-amortization lever.
+  * Paged KV cache (`kvpool.py` + `ContinuousDecodeServer(paged=True)`,
+    vLLM SOSP'23): fixed-size KV blocks in one arena, per-request block
+    tables, free-list/refcount allocation with prompt-PREFIX reuse
+    (shared leading blocks, copy-on-write before a divergent append) —
+    admission gates on free blocks, so concurrency scales with memory
+    actually used, not slots x worst-case length. Streams stay pinned
+    bit-identical to fixed-slot and solo decode.
 
 `ServingMetrics` (p50/p99, TTFT/inter-token histograms, queue depth,
 occupancy, shed/swap counts) feeds the existing UI via
@@ -38,6 +45,7 @@ from .server import (DeadlineExceededError, InferenceServer,
                      ServerClosedError, ServerOverloadedError,
                      ServingError, UnhealthyOutputError)
 from .decode import ContinuousDecodeServer
+from .kvpool import BlockPool, PagedAllocation
 from .loadgen import (ClosedLoop, DecodeSizeMix, InferenceSizeMix,
                       OnOffProcess, PoissonProcess, Schedule,
                       build_schedule, run_load)
@@ -47,6 +55,7 @@ __all__ = [
     "InferenceServer", "ContinuousDecodeServer", "ServingMetrics",
     "ServingError", "ServerOverloadedError", "DeadlineExceededError",
     "UnhealthyOutputError", "ServerClosedError",
+    "BlockPool", "PagedAllocation",
     "Speculator", "DraftSource", "NGramDraft", "ModelDraft",
     "PoissonProcess", "OnOffProcess", "ClosedLoop",
     "DecodeSizeMix", "InferenceSizeMix", "Schedule",

@@ -42,9 +42,29 @@ Deadlines are enforced mid-decode, not just at admission: a slot whose
 request outlives its latency budget is evicted between iterations
 (future fails with DeadlineExceededError, shed counted, slot refilled
 the same iteration).
+
+Paged KV cache (`paged=True`, serving/kvpool.py + the zoo's
+`make_paged_decode_fn` / `make_paged_prefill_fn`): the fixed-slot cache
+reserves `max_len` rows per slot, so concurrency is bounded by
+WORST-CASE length. Paged mode keeps one flat block arena instead; every
+request holds a block table, admission is gated by FREE BLOCKS (a
+request that cannot get its blocks waits in a memory queue — counted
+`blocked_on_memory` — while slots are a pure scheduling width), and
+prompt prefixes shared across requests (system prompts, few-shot
+templates) map to ONE physical copy with copy-on-write before any
+divergent append. Prefill is two programs — a pure prefill returning
+k/v panels plus a small DONATED install scatter (mirroring the fixed
+path; a fused install would copy the whole undonated arena); decode
+stays one dispatch per iteration — paging adds ZERO device dispatches
+per token (pinned by counter A/B in tests/test_paged.py), and the
+join==solo determinism pin carries over unchanged. `paged=True` +
+`speculate=` raises at construction: the K-wide verify program indexes
+the fixed-slot cache layout, and silently composing it with a block
+table is exactly the wrong-cache failure mode to block.
 """
 from __future__ import annotations
 
+import collections
 import concurrent.futures as cf
 import logging
 import queue
@@ -55,7 +75,7 @@ import numpy as np
 
 from .. import obs
 from .server import (DeadlineExceededError, ServerClosedError,
-                     _RequestLoop)
+                     ServerOverloadedError, _RequestLoop)
 
 log = logging.getLogger(__name__)
 
@@ -88,7 +108,8 @@ def _resolve_future(fut, result):
 
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "future", "deadline", "t_submit",
-                 "generated", "slot", "version", "req_id", "t_last_tok")
+                 "generated", "slot", "version", "req_id", "t_last_tok",
+                 "alloc", "mem_blocked")
 
     def __init__(self, prompt, max_new, deadline):
         self.prompt = prompt
@@ -101,6 +122,8 @@ class _DecodeRequest:
         self.version = None
         self.req_id = None      # assigned at submit (the trace/request id)
         self.t_last_tok = None  # when this request's last token landed
+        self.alloc = None       # paged mode: kvpool.PagedAllocation
+        self.mem_blocked = False    # counted blocked_on_memory once
 
 
 class ContinuousDecodeServer(_RequestLoop):
@@ -121,8 +144,14 @@ class ContinuousDecodeServer(_RequestLoop):
                  max_queue=64, fault_injector=None, retry_policy=None,
                  metrics=None, stats_reporter=None, report_every=64,
                  static_batching=False, speculate=None, tracer=None,
-                 flight_recorder=None):
-        from ..models.zoo.transformer import (make_prefill_fn,
+                 flight_recorder=None, paged=False, block_size=16,
+                 n_blocks=None, prefix_cache=True,
+                 max_blocks_per_slot=None):
+        from ..models.zoo.transformer import (make_block_copy_fn,
+                                              make_paged_decode_fn,
+                                              make_paged_install_fn,
+                                              make_paged_prefill_fn,
+                                              make_prefill_fn,
                                               make_slot_decode_fn)
         from .speculate import as_speculator
         import jax
@@ -151,12 +180,50 @@ class ContinuousDecodeServer(_RequestLoop):
         self._cache_dtype = lm.aux["tok"].dtype
         self._n_layers = len(lm.blocks)
         self._versions = [(lm.aux, lm.blocks)]   # index = param version
+
+        # paged KV cache (module docstring): arena + block tables
+        # replace the fixed per-slot cache; admission gates on free
+        # blocks. Config resolves BEFORE _reset_device_state builds the
+        # device state from it.
+        self._paged = bool(paged)
+        if self._paged and speculate is not None:
+            # the verify program indexes the FIXED-SLOT cache layout;
+            # running it against a block arena would read/write the
+            # wrong physical rows and corrupt neighbouring streams —
+            # fail at construction, never silently
+            raise ValueError(
+                "paged=True does not compose with speculate=: the "
+                "K-wide verify program addresses the fixed-slot cache "
+                "layout, not the block table (make the verify program "
+                "paged, or drop one of the two flags)")
+        self._block_size = int(block_size)
+        if self._paged and self._block_size < 1:
+            raise ValueError(f"need block_size >= 1, got {block_size}")
+        # default arena == the fixed-slot footprint at the same slot
+        # count (equal bytes); callers scale slots/arena independently
+        self._n_blocks = (int(n_blocks) if n_blocks is not None else
+                          -(-self.slots * self.max_len
+                            // self._block_size))
+        # per-slot logical capacity: enough table entries for max_len
+        # rows (the submit() length guard caps every stream there)
+        self._nb_slot = (int(max_blocks_per_slot)
+                         if max_blocks_per_slot is not None else
+                         -(-self.max_len // self._block_size))
+        self._prefix_cache = bool(prefix_cache)
+        self._mem_wait = collections.deque()     # blocked on FREE BLOCKS
+
         self._reset_device_state()
         # ONE decode program for the life of the server (fixed slot count;
         # params are arguments, so hot swap reuses it). Cache and pos are
         # donated — they are THE device state, rebound every iteration.
-        self._step = jax.jit(make_slot_decode_fn(n_heads),
-                             donate_argnums=(2, 3))
+        if self._paged:
+            # (aux, blocks, cache, btabs, pos, tok, active)
+            self._step = jax.jit(
+                make_paged_decode_fn(n_heads, self._block_size),
+                donate_argnums=(2, 4))
+        else:
+            self._step = jax.jit(make_slot_decode_fn(n_heads),
+                                 donate_argnums=(2, 3))
         # speculative decoding (serving/speculate.py): ONE K-wide verify
         # program replaces the 1-token step for every iteration — drafts
         # in, 1..K accepted tokens out per slot per dispatch, token
@@ -167,16 +234,37 @@ class ContinuousDecodeServer(_RequestLoop):
         self._verify = (None if self._spec is None else
                         lm._spec_verify(self._spec.k))
         self._prefills = {}                      # bucket -> jitted program
-        self._make_prefill = lambda: jax.jit(make_prefill_fn(
-            n_heads, self.max_len))
+        # Paged prefill mirrors the fixed path's two-program shape:
+        # a pure-compute prefill returning panels (no arena argument —
+        # an admission-time failure must fail ONLY that request, and a
+        # program that neither takes nor returns the arena trivially
+        # leaves it valid) plus a small DONATED install scatter that
+        # aliases the arena in place. Fusing install into the prefill
+        # would force the arena through an UNDONATED output and copy
+        # every untouched row — the whole pool's bytes — per admission.
+        # The CoW copy is donated for the same reason; it runs inside
+        # _decode_iteration, whose failure path — like the donated
+        # decode step's — resets the entire device state anyway.
+        if self._paged:
+            self._make_prefill = lambda: jax.jit(make_paged_prefill_fn(
+                n_heads))
+            self._paged_install = jax.jit(
+                make_paged_install_fn(self._block_size),
+                donate_argnums=(0,))
+            self._cow_copy = jax.jit(
+                make_block_copy_fn(self._block_size),
+                donate_argnums=(0,))
+        else:
+            self._make_prefill = lambda: jax.jit(make_prefill_fn(
+                n_heads, self.max_len))
 
-        def install(cache, rows, s):
-            return [{"k": c["k"].at[s].set(r["k"][0]),
-                     "v": c["v"].at[s].set(r["v"][0])}
-                    for c, r in zip(cache, rows)]
-        # only the cache is donated: its buffers alias the output exactly,
-        # while the [1, L, H, hd] prefill rows never could
-        self._install = jax.jit(install, donate_argnums=(0,))
+            def install(cache, rows, s):
+                return [{"k": c["k"].at[s].set(r["k"][0]),
+                         "v": c["v"].at[s].set(r["v"][0])}
+                        for c, r in zip(cache, rows)]
+            # only the cache is donated: its buffers alias the output
+            # exactly, while the [1, L, H, hd] prefill rows never could
+            self._install = jax.jit(install, donate_argnums=(0,))
 
         self._swap_lock = threading.Lock()
         self._init_loop(max_queue)
@@ -200,6 +288,27 @@ class ContinuousDecodeServer(_RequestLoop):
             raise ValueError(
                 f"prompt+new tokens ({len(prompt)}+{max_new_tokens}) "
                 f"exceed max_len {self.max_len}")
+        if self._paged:
+            # never-fits check: a request whose worst-case block table
+            # exceeds the WHOLE pool would wait forever in the memory
+            # queue — shed it loudly at submit instead
+            need = self._pool.blocks_needed(
+                len(prompt) + int(max_new_tokens) - 1)
+            if need > self._n_blocks:
+                self.metrics.count("shed_blocks")
+                raise ServerOverloadedError(
+                    f"request needs {need} KV blocks but the pool holds "
+                    f"{self._n_blocks} (block_size="
+                    f"{self._block_size})")
+            if need > self._nb_slot:
+                # the per-slot block TABLE is the other hard ceiling: a
+                # caller-tuned max_blocks_per_slot below ceil(max_len/bs)
+                # must shed here, not crash the admission thread on the
+                # table write
+                self.metrics.count("shed_blocks")
+                raise ServerOverloadedError(
+                    f"request needs {need} KV blocks but a slot's table "
+                    f"holds {self._nb_slot} (max_blocks_per_slot)")
         if self._injector is not None:
             self._injector.fire("serve.request")
         self.metrics.count("received")
@@ -267,10 +376,22 @@ class ContinuousDecodeServer(_RequestLoop):
         been consumed by the failed call — they cannot be trusted)."""
         import jax.numpy as jnp
 
-        from ..models.zoo.transformer import init_kv_cache
-        self._cache = init_kv_cache(self._n_layers, self.slots,
-                                    self.max_len, self._d_model,
-                                    self._n_heads, self._cache_dtype)
+        from ..models.zoo.transformer import (init_kv_cache,
+                                              init_paged_kv_cache)
+        if self._paged:
+            from .kvpool import BlockPool
+            self._cache = init_paged_kv_cache(
+                self._n_layers, self._n_blocks, self._block_size,
+                self._d_model, self._n_heads, self._cache_dtype)
+            # the pool dies with the arena: every allocation referenced
+            # rows in buffers that no longer exist
+            self._pool = BlockPool(self._n_blocks, self._block_size,
+                                   prefix_cache=self._prefix_cache)
+            self._btabs = np.zeros((self.slots, self._nb_slot), np.int32)
+        else:
+            self._cache = init_kv_cache(self._n_layers, self.slots,
+                                        self.max_len, self._d_model,
+                                        self._n_heads, self._cache_dtype)
         self._pos = jnp.zeros((self.slots,), jnp.int32)
         self._tok = jnp.zeros((self.slots,), jnp.int32)
         self._slot_req = [None] * self.slots     # host-side occupancy
@@ -290,8 +411,15 @@ class ContinuousDecodeServer(_RequestLoop):
                 return b
         return self.prompt_buckets[-1]
 
-    def _admit(self, req, slot):
-        """Prefill `req`'s prompt and install it into `slot`."""
+    def _admit(self, req, slot, alloc=None, version=None):
+        """Prefill `req`'s prompt and install it into `slot` (paged
+        mode: through `alloc`'s block table — a pure prefill dispatch
+        plus the donated install scatter on success). `version` is the
+        (vidx, aux, blocks) the PAGED caller
+        already bound when it tagged the pool admission — prefill must
+        run under exactly the params the prefix match was tagged with,
+        or a swap racing the admission could share old-version rows
+        into a new-version stream."""
         import jax.numpy as jnp
         tr = self._tracer
         if tr.enabled:
@@ -309,9 +437,12 @@ class ContinuousDecodeServer(_RequestLoop):
                      bucket)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(req.prompt)] = req.prompt
-        with self._swap_lock:       # version index + params read atomically
-            vidx = len(self._versions) - 1
-            aux, blocks = self._versions[vidx]
+        if version is not None:
+            vidx, aux, blocks = version
+        else:
+            with self._swap_lock:   # version index + params read atomically
+                vidx = len(self._versions) - 1
+                aux, blocks = self._versions[vidx]
 
         def dispatch():
             if self._injector is not None:
@@ -328,6 +459,23 @@ class ContinuousDecodeServer(_RequestLoop):
                     on_retry=lambda a, e, d: self.metrics.count("retries"))
             else:
                 logits, rows = dispatch()
+        if self._paged:
+            # `rows` are the prompt's k/v panels: scatter them to their
+            # block-table rows in the DONATED install (arena aliased in
+            # place — a prefill failure above leaves it untouched). Only
+            # now are the prompt blocks really filled, so only now may
+            # they enter the prefix index — commit() BEFORE this point
+            # would let a failed prefill leave garbage blocks matchable
+            tab = np.zeros((self._nb_slot,), np.int32)
+            tab[:len(alloc.ids)] = alloc.ids
+            self._cache = self._paged_install(
+                self._cache, rows, jnp.asarray(tab),
+                jnp.asarray(len(req.prompt), jnp.int32),
+                jnp.asarray(alloc.shared_rows, jnp.int32))
+            self._pool.commit(alloc)
+            self.metrics.count("prefix_rows_total", len(req.prompt))
+            if alloc.shared_rows:
+                self.metrics.count("prefix_rows_hit", alloc.shared_rows)
         first = int(np.argmax(np.asarray(logits)[0]))
         req.generated.append(first)
         # TTFT closes HERE: prefill's argmax IS the first generated
@@ -336,9 +484,18 @@ class ContinuousDecodeServer(_RequestLoop):
         self.metrics.record_ttft((req.t_last_tok - req.t_submit) * 1e3)
         if len(req.generated) >= req.max_new:
             # one-token request: done at prefill, never occupies a slot
+            # (paged: its blocks release immediately — and a shared
+            # partial block it rode needed no CoW, the zero-copy case)
             self._complete(req, time.monotonic())
+            if self._paged:
+                self._pool.release(alloc)
             return
-        self._cache = self._install(self._cache, rows, slot)
+        if self._paged:
+            req.alloc = alloc
+            self._btabs[slot, :] = 0
+            self._btabs[slot, :len(alloc.ids)] = alloc.ids
+        else:
+            self._cache = self._install(self._cache, rows, slot)
         self._pos = self._pos.at[slot].set(len(req.prompt))
         self._tok = self._tok.at[slot].set(first)
         req.slot = slot
@@ -349,27 +506,45 @@ class ContinuousDecodeServer(_RequestLoop):
             # is safe — start() resets the key, _free_slot stops it)
             self._spec.draft.start(slot, list(req.prompt) + req.generated)
 
+    def _next_request(self, wait):
+        """Head of the admission line: memory-blocked requests first
+        (FIFO — a small late request must not starve a big early one),
+        then the submit queue."""
+        if self._mem_wait:
+            return self._mem_wait.popleft()
+        try:
+            return self._q.get(timeout=wait) if wait \
+                else self._q.get_nowait()
+        except queue.Empty:
+            return None
+
     def _admit_pending(self, timeout=0.0):
         """Fill free slots from the queue. `timeout` blocks on the FIRST
         get only — the idle loop's way of waiting for work on the queue
-        itself instead of busy-polling at the 1 ms decode tick."""
+        itself instead of busy-polling at the 1 ms decode tick. Paged
+        mode adds the MEMORY gate: a request that cannot get its blocks
+        parks at the head of the line (`blocked_on_memory` counted once)
+        and admission stops until completions free blocks."""
         if not self._running and not self._drain_on_stop:
-            return      # fail-fast stop: queued requests must NOT be
-            #             admitted into freed slots — the loop's final
-            #             drain fails them once the busy slots finish
+            # fail-fast stop: queued requests must NOT be admitted into
+            # freed slots — the loop's final drain fails them once the
+            # busy slots finish. The memory-wait line is failed HERE,
+            # not at loop exit: parked requests count as _busy(), so
+            # leaving them parked would keep the loop alive (and their
+            # futures unresolved) forever once the slots drain.
+            self._fail_mem_wait(ServerClosedError("server stopped"))
+            return
         free = [s for s in range(self.slots) if self._slot_req[s] is None]
         if self._static and len(free) < self.slots:
             return      # gang scheduling: wait for the whole batch
         wait = float(timeout)
         for s in free:
-            req = None
+            req, alloc = None, None
             while req is None:
-                try:
-                    req = (self._q.get(timeout=wait) if wait
-                           else self._q.get_nowait())
-                except queue.Empty:
-                    return
+                req = self._next_request(wait)
                 wait = 0.0
+                if req is None:
+                    return
                 if req.future.done():   # failed by a raced submit/stop
                     req = None
                 elif req.deadline is not None and \
@@ -379,20 +554,72 @@ class ContinuousDecodeServer(_RequestLoop):
                         self.metrics.count("shed_deadline")
                         self.metrics.record_slo_miss()
                     req = None
+                elif self._paged:
+                    # admission gated by FREE BLOCKS, not free slots:
+                    # reserve everything the request will ever write
+                    # (prompt + decode rows, minus any shared prefix).
+                    # The param version is bound HERE, before the prefix
+                    # match: the match is tagged with it and the prefill
+                    # below runs under the same params, so a swap racing
+                    # this admission cannot share old-version rows into
+                    # a new-version stream.
+                    with self._swap_lock:
+                        vidx = len(self._versions) - 1
+                        aux, blocks = self._versions[vidx]
+                    version = (vidx, aux, blocks)
+                    alloc = self._pool.admit(
+                        req.prompt, len(req.prompt) + req.max_new - 1,
+                        will_append=req.max_new > 1, tag=vidx)
+                    if alloc is None:
+                        if not req.mem_blocked:
+                            req.mem_blocked = True
+                            self.metrics.count("blocked_on_memory")
+                        self._mem_wait.appendleft(req)
+                        return
             try:
-                self._admit(req, s)
+                self._admit(req, s, alloc,
+                            version=version if self._paged else None)
             except BaseException as e:  # noqa: BLE001 — fail THIS request
+                if alloc is not None:
+                    self._pool.release(alloc)
                 _fail_future(req.future, e)
                 self.metrics.count("failed")
 
     def _free_slot(self, slot):
-        """Release `slot`'s host-side occupancy (and its draft stream).
+        """Release `slot`'s host-side occupancy (and its draft stream,
+        and — paged — its block-table allocation back to the pool).
         Device rows/pos are left stale on purpose: the next admission
         resets pos and decode overwrites rows before attending (the
-        dead-row contract)."""
+        dead-row contract); a freed slot's stale block table is inert
+        because inactive slots' writes are index-dropped."""
+        req = self._slot_req[slot]
         self._slot_req[slot] = None
+        if self._paged and req is not None and req.alloc is not None:
+            self._pool.release(req.alloc)
+            req.alloc = None
+            self._btabs[slot, :] = 0
         if self._spec is not None:
             self._spec.draft.stop(slot)
+
+    def _expire_mem_wait(self, now):
+        """Deadline enforcement for requests parked on the MEMORY gate:
+        blocked-on-blocks is queue wait too, and a request must not
+        outlive its budget just because it never won blocks."""
+        if not self._mem_wait:
+            return
+        keep = collections.deque()
+        while self._mem_wait:
+            r = self._mem_wait.popleft()
+            if r.future.done():
+                continue
+            if r.deadline is not None and now > r.deadline:
+                if _fail_future(r.future, DeadlineExceededError(
+                        "deadline expired while blocked on KV blocks")):
+                    self.metrics.count("shed_deadline")
+                    self.metrics.record_slo_miss()
+            else:
+                keep.append(r)
+        self._mem_wait = keep
 
     def _evict_expired(self):
         """Mid-decode deadline enforcement: a slot whose request deadline
@@ -403,6 +630,7 @@ class ContinuousDecodeServer(_RequestLoop):
         that expire in the queue; this protects the slots themselves from
         requests whose token budget outlives their latency budget."""
         now = time.monotonic()
+        self._expire_mem_wait(now)
         evicted = False
         for s, r in enumerate(self._slot_req):
             if r is None or r.deadline is None or now <= r.deadline:
@@ -417,6 +645,37 @@ class ContinuousDecodeServer(_RequestLoop):
             evicted = True
         if evicted:
             self._gc_versions()
+
+    def _materialize_cow(self, live):
+        """Lazy copy-on-write, at exactly the FIRST divergent append: a
+        live slot whose next write lands in a block it still SHARES gets
+        its private copy now — the spare was reserved at admission, so
+        this can never fail for lack of blocks. One small device copy
+        per CoW event (per REQUEST, not per token — the per-token
+        dispatch count is pinned unchanged by tests/test_paged.py)."""
+        import jax.numpy as jnp
+        for s, r in live:
+            if r.alloc is None or r.alloc.cow is None:
+                continue
+            src, dst = self._pool.cow(r.alloc)
+            self._btabs[s, :len(r.alloc.ids)] = r.alloc.ids
+            with self._tracer.span("decode.cow", cat="serve",
+                                   track="server", src=src, dst=dst):
+                self._cache = self._cow_copy(
+                    self._cache, jnp.asarray(src, jnp.int32),
+                    jnp.asarray(dst, jnp.int32))
+            self.metrics.count("cow_copies")
+
+    def _fail_mem_wait(self, exc):
+        while self._mem_wait:
+            r = self._mem_wait.popleft()
+            if _fail_future(r.future, exc):
+                self.metrics.count("failed")
+
+    def _fail_queued(self, exc):
+        """Queued = the submit queue AND the paged memory-wait line."""
+        self._fail_mem_wait(exc)
+        super()._fail_queued(exc)
 
     def _decode_iteration(self):
         """One scheduling iteration for every occupied slot: one dispatch
@@ -435,6 +694,11 @@ class ContinuousDecodeServer(_RequestLoop):
         tr = self._tracer
         t_iter0 = time.monotonic_ns() if tr.enabled else None
         self.metrics.record_occupancy(len(live), self.slots)
+        self.metrics.record_live_streams(len(live))
+        if self._paged:
+            self._materialize_cow(live)
+            self.metrics.record_pool(self._pool.blocks_in_use,
+                                     self._pool.capacity)
         versions = sorted({r.version for _, r in live})
         new_tok = {}
         for v in versions:
@@ -447,6 +711,11 @@ class ContinuousDecodeServer(_RequestLoop):
             def dispatch():
                 if self._injector is not None:
                     self._injector.fire("serve.batch")
+                if self._paged:
+                    return self._step(aux, blocks, self._cache,
+                                      jnp.asarray(self._btabs),
+                                      self._pos, self._tok,
+                                      jnp.asarray(active))
                 return self._step(aux, blocks, self._cache, self._pos,
                                   self._tok, jnp.asarray(active))
 
@@ -519,6 +788,7 @@ class ContinuousDecodeServer(_RequestLoop):
         t_iter0 = time.monotonic_ns() if tr.enabled else None
         n_accepted = 0
         self.metrics.record_occupancy(len(live), self.slots)
+        self.metrics.record_live_streams(len(live))
         K = self._spec.k
         draft = self._spec.draft
         d0 = getattr(draft, "dispatch_count", 0)   # ModelDraft device cost
@@ -627,7 +897,8 @@ class ContinuousDecodeServer(_RequestLoop):
                     self._versions[v] = None
 
     def _busy(self):
-        return any(r is not None for r in self._slot_req)
+        return any(r is not None for r in self._slot_req) \
+            or bool(self._mem_wait)
 
     def _loop_once(self):
         # evict deadline-expired slots FIRST so the admit below can refill

@@ -127,6 +127,14 @@ class ServingMetrics:
         hist = self.registry.histogram
         self._ttft_ms = hist(p + "ttft_ms")
         self._inter_token_ms = hist(p + "inter_token_ms")
+        # paged KV-cache view (serving/kvpool.py): arena pressure as a
+        # reservoir (last/max like queue depth), capacity as a gauge,
+        # live decode streams as a reservoir whose MAX is the measured
+        # concurrency — all on the registry, so the Prometheus route
+        # exports them next to the serving counters
+        self._blocks_in_use = res(p + "blocks_in_use", self._window)
+        self._pool_blocks = self.registry.gauge(p + "pool_blocks")
+        self._live_streams = res(p + "live_streams", self._window)
         self._counters = {}     # key -> Counter, resolved once per key
 
     # -- hot-path recorders -------------------------------------------
@@ -195,6 +203,21 @@ class ServingMetrics:
     def record_occupancy(self, active, slots):
         """Decode-scheduler slot occupancy for one token iteration."""
         self._occupancy.record(active / float(slots) if slots else 0.0)
+
+    def record_live_streams(self, n):
+        """Concurrently-decoding streams this iteration; the snapshot's
+        `live_streams_max` is the measured concurrency — the number the
+        paged-vs-fixed A/B compares at equal arena bytes."""
+        self._live_streams.record(int(n))
+
+    def record_pool(self, in_use, capacity):
+        """Paged KV arena pressure, sampled once per decode iteration:
+        blocks held by live requests vs pool capacity. The event
+        counters around it (`prefix_rows_hit`/`prefix_rows_total`,
+        `cow_copies`, `blocked_on_memory`, `shed_blocks`) are plain
+        `count()` keys recorded by the decode server at their sites."""
+        self._blocks_in_use.record(int(in_use))
+        self._pool_blocks.set(int(capacity))
 
     def record_speculation(self, accepted, drafted, matched):
         """One slot's share of one speculative verify dispatch: `accepted`
@@ -276,6 +299,29 @@ class ServingMetrics:
         out["dispatches_per_token"] = (d / t) if t else None
         out["device_dispatches_per_token"] = (
             (d + out.get("draft_dispatches", 0)) / t) if t else None
+        # paged KV-cache pool view: always-present keys (zeros/None on a
+        # fixed-slot or idle server) so dashboards and the paged A/Bs
+        # read one stable surface. prefix_hit_rate is ROW-weighted —
+        # the fraction of admitted prompt rows that were already
+        # physically resident.
+        cap = self._pool_blocks.value
+        out["pool_blocks"] = 0 if cap is None else int(cap)
+        in_use_last = self._blocks_in_use.last()
+        in_use_max = self._blocks_in_use.max()
+        out["blocks_in_use_last"] = 0 if in_use_last is None \
+            else int(in_use_last)
+        out["blocks_in_use_max"] = 0 if in_use_max is None \
+            else int(in_use_max)
+        live_max = self._live_streams.max()
+        out["live_streams_max"] = 0 if live_max is None else int(live_max)
+        out.setdefault("prefix_rows_hit", 0)
+        out.setdefault("prefix_rows_total", 0)
+        out.setdefault("cow_copies", 0)
+        out.setdefault("blocked_on_memory", 0)
+        out.setdefault("shed_blocks", 0)
+        out["prefix_hit_rate"] = (
+            out["prefix_rows_hit"] / out["prefix_rows_total"]
+            if out["prefix_rows_total"] else None)
         # SLO attainment: met / (met + missed-or-shed). Always present so
         # the traffic-harness round starts from pinned keys.
         out.setdefault("slo_total", 0)

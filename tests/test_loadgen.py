@@ -352,3 +352,30 @@ class TestSmokeSweep:
             "queue_wait_ms", "prefill_ms", "decode_ms", "sched_gap_ms"}
         assert os.path.exists(out + ".txt")
         assert os.path.exists(out + ".trace.json")
+
+    def test_smoke_sweep_paged_mode(self):
+        """One PAGED-mode sweep rate in tier-1: the same loadgen
+        arrivals through `ContinuousDecodeServer(paged=True)`, so every
+        CI run exercises the block-gated admission path (kvpool admit/
+        release under real traffic, not just the unit pins). Its report
+        uploads next to the fixed-slot one (tier1.yml)."""
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        mod = importlib.import_module("load_sweep")
+        out = os.path.join(
+            os.environ.get("SMOKE_REPORT_DIR") or tempfile.gettempdir(),
+            "load_sweep_smoke_paged")
+        res = mod.run_sweep(server="decode", rates=(40.0,), n_req=8,
+                            slo_ms=250.0, seed=0, trace=False,
+                            report_path=out, paged=True)
+        (decode,) = res
+        assert decode["paged"] is True
+        (pt,) = decode["curve"]
+        assert pt["completed"] == 8
+        assert pt["tokens_per_sec"] > 0
+        # the paged pool really carried the traffic
+        snap = json.load(open(out + ".json"))["metrics"]["decode"]
+        assert snap["pool_blocks"] > 0
+        assert snap["blocks_in_use_max"] > 0

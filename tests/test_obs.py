@@ -412,6 +412,14 @@ class TestMetricsPins:
         "batch_occupancy_mean", "batch_size_mean",
         "spec_accepted_per_dispatch_mean", "spec_acceptance_rate_mean",
         "dispatches_per_token", "device_dispatches_per_token",
+        # paged KV-cache pool view (serving/kvpool.py): arena pressure,
+        # measured concurrency, prefix-cache hit rate, CoW and
+        # memory-gate accounting — consumed by tools/serve_ab.py's
+        # paged_vs_fixed arm and bench.py's paged_decode config
+        "pool_blocks", "blocks_in_use_last", "blocks_in_use_max",
+        "live_streams_max", "prefix_rows_hit", "prefix_rows_total",
+        "prefix_hit_rate", "cow_copies", "blocked_on_memory",
+        "shed_blocks",
         "slo_total", "slo_met", "slo_tokens_met", "slo_attainment",
         "ttft_ms_p50", "ttft_ms_p99", "ttft_ms_mean", "ttft_ms_count",
         "inter_token_ms_p50", "inter_token_ms_p99",

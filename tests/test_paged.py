@@ -1,0 +1,508 @@
+"""Paged KV-cache subsystem pins (ISSUE 8 acceptance criteria).
+
+  (a) BlockPool invariants: free-list/refcount accounting survives
+      property-style churn with zero leaks; prefix matching, LRU
+      eviction of cached blocks, and the CoW spare reservation behave.
+  (b) Determinism: the paged decode server's streams are BIT-IDENTICAL
+      to solo decode, to the fixed-slot server, across a mid-stream
+      join, and with prefix sharing on vs off (shared leading blocks +
+      copy-on-write change WHERE rows live, never what any stream
+      reads).
+  (c) Scheduling: admission gates on free blocks (blocked_on_memory,
+      deadline enforcement while blocked, out-of-blocks shed at
+      submit), hot swap drains dual-version over paged slots, and the
+      dispatch-counter A/B pins that paging adds ZERO device dispatches
+      per token.
+  (d) paged=True + speculate= is refused at construction — the K-wide
+      verify program addresses the fixed-slot layout, and composing it
+      silently with a block table is the wrong-cache failure mode.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+from deeplearning4j_tpu.serving import (BlockPool, ContinuousDecodeServer,
+                                        DeadlineExceededError, NGramDraft,
+                                        ServerOverloadedError, Speculator)
+
+
+def _lm(seed=3):
+    return TransformerLM(64, d_model=32, n_heads=2, n_layers=2,
+                         max_len=64, seed=seed)
+
+
+def _paged(lm, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("prompt_buckets", (8, 16))
+    kw.setdefault("block_size", 4)
+    kw.setdefault("n_blocks", 40)
+    return ContinuousDecodeServer(lm, paged=True, **kw)
+
+
+# ---------------------------------------------------------------------------
+# (a) BlockPool: host-side invariants, no device needed
+# ---------------------------------------------------------------------------
+class TestBlockPool:
+    def test_churn_no_leak_property(self):
+        """Random admit/release churn (shared prefixes included): the
+        accounting invariants hold after EVERY operation and the pool
+        returns to fully-reusable when the last request releases."""
+        rng = np.random.default_rng(0)
+        pool = BlockPool(24, 4)
+        live = []
+        prefixes = [tuple(rng.integers(1, 9, 8)),
+                    tuple(rng.integers(1, 9, 8))]
+        for _ in range(300):
+            if live and (rng.random() < 0.45 or len(live) >= 8):
+                alloc = live.pop(int(rng.integers(0, len(live))))
+                if alloc.cow is not None and rng.random() < 0.5:
+                    pool.cow(alloc)     # sometimes materialize first
+                pool.release(alloc)
+            else:
+                base = list(prefixes[int(rng.integers(0, 2))])
+                prompt = base[:int(rng.integers(1, 9))] + \
+                    list(rng.integers(1, 9, int(rng.integers(0, 4))))
+                rows = len(prompt) + int(rng.integers(1, 12))
+                alloc = pool.admit(prompt, rows)
+                if alloc is not None:
+                    pool.commit(alloc)   # as the scheduler does on
+                    live.append(alloc)   # prefill success
+            pool.check()
+        for alloc in live:
+            pool.release(alloc)
+        pool.check()
+        assert pool.blocks_in_use == 0
+        assert pool.blocks_free == pool.capacity
+
+    def test_prefix_reuse_and_lru_eviction(self):
+        pool = BlockPool(6, 4)
+        p = list(range(1, 9))                   # 2 full blocks
+        a = pool.admit(p, len(p))
+        assert a is not None and a.shared_rows == 0
+        pool.commit(a)                           # "prefill succeeded"
+        pool.release(a)                          # retire to prefix cache
+        assert pool.blocks_in_use == 0 and pool.blocks_free == 6
+        b = pool.admit(p, len(p) + 3)            # same prompt: full hit
+        assert b.shared_rows == 8 and b.n_shared == 2
+        # demand exceeding the free list evicts cached blocks LRU
+        pool.release(b)
+        c = pool.admit(list(range(20, 44)), 24)  # needs all 6 blocks
+        assert c is not None
+        pool.release(c)
+        # the old prefix was evicted to make room: no hit anymore
+        d = pool.admit(p, len(p))
+        assert d.shared_rows == 0
+        pool.release(d)
+        pool.check()
+
+    def test_prefix_index_namespaced_by_tag(self):
+        """Blocks indexed under one tag never match another tag's
+        lookups: the server tags by param version, so k/v rows computed
+        under swapped-out weights are structurally unreachable."""
+        pool = BlockPool(8, 4)
+        p = list(range(1, 9))
+        a = pool.admit(p, len(p), tag=0)
+        pool.commit(a)
+        pool.release(a)
+        assert pool.match_prefix(p, tag=0)[1] == 8
+        assert pool.match_prefix(p, tag=1) == ([], 0, None)
+        b = pool.admit(p, len(p) + 3, tag=1)     # no cross-tag hit
+        assert b.shared_rows == 0
+        pool.commit(b)
+        pool.release(b)
+        # both versions' blocks now cached, each under its own tag
+        assert pool.match_prefix(p, tag=0)[1] == 8
+        assert pool.match_prefix(p, tag=1)[1] == 8
+        pool.check()
+
+    def test_partial_match_reserves_cow_spare(self):
+        pool = BlockPool(12, 4)
+        long = list(range(1, 9))                # blocks [1..4][5..8]
+        a = pool.admit(long, len(long) + 4)
+        pool.commit(a)
+        short = long[:6]                        # rides block 2 partially
+        b = pool.admit(short, len(short) + 4, will_append=True)
+        assert b.shared_rows == 6 and b.cow is not None
+        idx, spare = b.cow
+        assert b.ids[idx] == a.ids[1]           # shared physical block
+        src, dst = pool.cow(b)
+        assert (src, dst) == (a.ids[1], spare) and b.cow is None
+        assert b.ids[idx] == spare
+        # a prefill-only rider shares with NO spare and no copy
+        c = pool.admit(short, len(short), will_append=False)
+        assert c.shared_rows == 6 and c.cow is None
+        for alloc in (a, b, c):
+            pool.release(alloc)
+        pool.check()
+        assert pool.blocks_in_use == 0
+
+    def test_capacity_sized_table_forgoes_cow_ride(self):
+        """A capacity-sized block table plus its CoW spare can NEVER be
+        satisfied — admit() must forgo the partial-tail ride (prefill
+        recomputes those rows) instead of returning None forever, which
+        would park the request at the head of the memory queue and
+        deadlock every later admission behind it."""
+        pool = BlockPool(4, 4)
+        a = pool.admit(list(range(1, 9)), 8)     # 2 blocks
+        pool.commit(a)
+        pool.release(a)                          # both cached + indexed
+        # 6-token prompt rides a's partial tail; 15 total rows -> a
+        # 4-block table == capacity, so the spare would be block 5
+        b = pool.admit(list(range(1, 7)), 15)
+        assert b is not None                     # not parked forever
+        assert b.cow is None and len(b.ids) == 4
+        assert b.shared_rows == 4                # full-block hit kept
+        pool.release(b)
+        pool.check()
+
+    def test_admit_blocks_when_pool_short(self):
+        pool = BlockPool(4, 4)
+        a = pool.admit(list(range(1, 7)), 12)   # 3 blocks
+        assert pool.admit(list(range(30, 36)), 12) is None  # 3 > 1 free
+        pool.release(a)
+        assert pool.admit(list(range(30, 36)), 12) is not None
+
+
+# ---------------------------------------------------------------------------
+# (b) determinism pins
+# ---------------------------------------------------------------------------
+class TestPagedDeterminism:
+    def test_join_running_batch_equals_solo(self):
+        """The continuous-decode determinism pin, over the block table:
+        a request joining mid-flight emits the same tokens as alone."""
+        lm = _lm()
+        rng = np.random.default_rng(4)
+        pa = rng.integers(1, 64, 5).tolist()
+        pb = rng.integers(1, 64, 8).tolist()
+        pc = rng.integers(1, 64, 3).tolist()
+        with _paged(lm) as srv:
+            solo = srv.generate(pa, 10, timeout=60)
+            flong = srv.submit(pb, 30)
+            time.sleep(0.05)
+            fa = srv.submit(pa, 10)
+            fc = srv.submit(pc, 6)
+            joined = fa.result(60)
+            flong.result(60)
+            fc.result(60)
+        assert joined == solo
+
+    def test_paged_equals_fixed_slot_and_generate(self):
+        """Same request through the paged server, the fixed-slot server,
+        and the pinned generate(use_cache=True) reference: one stream."""
+        lm = _lm()
+        rng = np.random.default_rng(5)
+        p = rng.integers(1, 64, 6).tolist()
+        expect = lm.generate(p, max_new_tokens=9)
+        with ContinuousDecodeServer(lm, slots=2,
+                                    prompt_buckets=(8,)) as srv:
+            fixed = srv.generate(p, 9, timeout=60)
+        with _paged(lm) as srv:
+            paged = srv.generate(p, 9, timeout=60)
+        assert fixed == expect
+        assert paged == expect
+
+    def test_prefix_shared_equals_unshared(self):
+        """Two requests behind one system prefix decode bit-identically
+        with sharing on (leading blocks one physical copy) and off —
+        and the shared run actually hits the prefix cache."""
+        lm = _lm()
+        rng = np.random.default_rng(6)
+        sysp = rng.integers(1, 64, 8).tolist()      # 2 full blocks
+        pa = sysp + rng.integers(1, 64, 3).tolist()
+        pb = sysp + rng.integers(1, 64, 2).tolist()
+        with _paged(lm, prefix_cache=False) as srv:
+            ra0 = srv.generate(pa, 8, timeout=60)
+            rb0 = srv.generate(pb, 8, timeout=60)
+            assert srv.metrics.snapshot()["prefix_rows_hit"] == 0
+        with _paged(lm) as srv:
+            fa = srv.submit(pa, 8)
+            time.sleep(0.05)
+            fb = srv.submit(pb, 8)
+            ra, rb = fa.result(60), fb.result(60)
+            snap = srv.metrics.snapshot()
+        assert ra == ra0 and rb == rb0
+        # B's two leading blocks were resident from A
+        assert snap["prefix_rows_hit"] >= 8
+        assert snap["prefix_hit_rate"] > 0
+
+    def test_copy_on_write_correctness(self):
+        """A shorter prompt rides a longer prompt's final block; its
+        first divergent append triggers exactly one CoW, and BOTH
+        streams stay bit-identical to their unshared runs."""
+        lm = _lm()
+        rng = np.random.default_rng(7)
+        p8 = rng.integers(1, 64, 8).tolist()
+        p6 = p8[:6]
+        with _paged(lm, prefix_cache=False) as srv:
+            a0 = srv.generate(p8, 10, timeout=60)
+            b0 = srv.generate(p6, 10, timeout=60)
+        with _paged(lm) as srv:
+            fa = srv.submit(p8, 10)
+            time.sleep(0.05)
+            fb = srv.submit(p6, 10)     # shares [p8[0:4]] + part of blk 2
+            a1, b1 = fa.result(60), fb.result(60)
+            snap = srv.metrics.snapshot()
+        assert a1 == a0          # owner's rows never clobbered
+        assert b1 == b0          # sharer diverges onto its private copy
+        assert snap["cow_copies"] == 1
+        assert snap["prefix_rows_hit"] >= 6
+
+
+# ---------------------------------------------------------------------------
+# (c) scheduling: memory gate, shed accounting, swap, dispatch A/B
+# ---------------------------------------------------------------------------
+class TestPagedScheduling:
+    def test_blocked_on_memory_admits_when_blocks_free(self):
+        """Admission is gated by FREE BLOCKS: a request that cannot get
+        its reservation waits (counted once), then serves correctly
+        when the resident request completes — no deadlock, no drop."""
+        lm = _lm()
+        rng = np.random.default_rng(8)
+        p1 = rng.integers(1, 64, 8).tolist()
+        p2 = rng.integers(1, 64, 6).tolist()
+        expect = lm.generate(p2, max_new_tokens=16)
+        with _paged(lm, slots=4, n_blocks=8) as srv:
+            f1 = srv.submit(p1, 16)          # 6 of 8 blocks
+            time.sleep(0.05)
+            f2 = srv.submit(p2, 16)          # needs 6 > 2 free: waits
+            r1, r2 = f1.result(60), f2.result(60)
+            snap = srv.metrics.snapshot()
+            assert srv._pool.blocks_in_use == 0     # all returned
+        assert len(r1) == 8 + 16
+        assert r2 == expect
+        assert snap["blocked_on_memory"] == 1
+        assert snap.get("failed", 0) == 0
+
+    def test_never_fits_shed_at_submit(self):
+        lm = _lm()
+        with _paged(lm, n_blocks=4) as srv:
+            with pytest.raises(ServerOverloadedError, match="KV blocks"):
+                srv.submit([1, 2, 3, 4], 30)     # needs 9 > 4 blocks
+            assert srv.metrics.snapshot()["shed_blocks"] == 1
+
+    def test_deadline_expires_while_blocked_on_memory(self):
+        """Blocked-on-blocks is queue wait: the deadline still fires,
+        the shed is counted, and the blocks it never got stay free.
+        Delay-only faults pace the decode iterations (the
+        test_serving.py eviction pattern) so the block-holder reliably
+        outlives the blocked request's deadline."""
+        from deeplearning4j_tpu.common.resilience import FaultInjector
+        lm = _lm()
+        rng = np.random.default_rng(9)
+        p1 = rng.integers(1, 64, 8).tolist()
+        inj = FaultInjector(seed=6).plan(
+            "serve.batch", on_calls=range(1, 120), times=120,
+            delay=0.02, exc=None)
+        with _paged(lm, slots=4, n_blocks=8,
+                    fault_injector=inj) as srv:
+            f1 = srv.submit(p1, 24)          # holds 31 rows -> all 8
+            # wait past prefill + the first (compile-bearing) decode
+            # iterations, so admission examines the doomed request
+            # BEFORE its deadline can expire
+            t0 = time.monotonic()
+            while srv.metrics.count_value("dispatches") < 3 and \
+                    time.monotonic() - t0 < 30:
+                time.sleep(0.01)
+            doomed = srv.submit(p1, 16, deadline_ms=150)
+            # the shed fires from whichever sweep sees the expiry first:
+            # the mem-wait sweep ("KV blocks") or the admission re-check
+            # ("before prefill") — both count it identically
+            with pytest.raises(DeadlineExceededError,
+                               match="KV blocks|before prefill"):
+                doomed.result(60)
+            f1.result(60)
+        snap = srv.metrics.snapshot()
+        assert snap["shed_deadline"] == 1
+        assert snap["blocked_on_memory"] == 1
+
+    def test_no_leak_after_request_churn(self):
+        """N mixed requests (shared prefixes, mixed lengths) through a
+        small arena: every future resolves, the pool ends empty, and
+        the invariants hold — the serving-level refcount/free-list
+        pin."""
+        lm = _lm()
+        rng = np.random.default_rng(10)
+        sysp = rng.integers(1, 64, 4).tolist()
+        with _paged(lm, slots=3, n_blocks=16) as srv:
+            futs = []
+            for i in range(12):
+                own = rng.integers(1, 64, int(rng.integers(1, 5))).tolist()
+                p = (sysp + own) if i % 2 else own
+                futs.append(srv.submit(p, int(rng.integers(2, 8))))
+            for f in futs:
+                assert f.result(120)
+            assert srv._pool.blocks_in_use == 0
+            assert srv._pool.check()
+            assert srv.metrics.snapshot().get("failed", 0) == 0
+
+    def test_dispatch_counter_ab_zero_extra_per_token(self):
+        """Paging must be free in DISPATCHES: the same workload through
+        fixed-slot and paged servers costs the identical number of
+        decode dispatches (the per-token device cost), and the paged
+        arm pays no CoW copies on an unshared workload."""
+        lm = _lm()
+        rng = np.random.default_rng(11)
+        work = [(rng.integers(1, 64, int(rng.integers(3, 8))).tolist(),
+                 int(rng.integers(3, 9))) for _ in range(6)]
+        counts = {}
+        for name, srv in (
+                ("fixed", ContinuousDecodeServer(
+                    lm, slots=2, prompt_buckets=(8,))),
+                ("paged", _paged(lm, slots=2))):
+            with srv:
+                for p, n in work:       # sequential: same iteration count
+                    srv.generate(p, n, timeout=60)
+                snap = srv.metrics.snapshot()
+            counts[name] = (snap["dispatches"], snap["tokens_out"],
+                            snap.get("cow_copies", 0))
+        assert counts["fixed"][:2] == counts["paged"][:2]
+        assert counts["paged"][2] == 0
+
+    def test_hot_swap_drain_with_paged_slots(self):
+        """Dual-version drain over the block table: in-flight requests
+        finish on pre-swap params, a post-swap request gets the new —
+        zero failures, blocks all returned."""
+        lm1, lm2 = _lm(3), _lm(11)
+        rng = np.random.default_rng(12)
+        pa = rng.integers(1, 64, 4).tolist()
+        pb = rng.integers(1, 64, 4).tolist()
+        with _paged(lm1, slots=2) as srv:
+            solo_old = srv.generate(pa, 14, timeout=60)
+            fa = srv.submit(pa, 14)
+            time.sleep(0.03)
+            srv.swap(lm2)
+            fb = srv.submit(pb, 5)
+            ra, rb = fa.result(60), fb.result(60)
+            assert srv._pool.blocks_in_use == 0
+        assert ra == solo_old
+        expect_new = lm2.generate_batch(np.asarray([pb], np.int32),
+                                        max_new_tokens=5)
+        assert rb == expect_new[0].tolist()
+        assert srv.metrics.snapshot().get("failed", 0) == 0
+
+    def test_fail_fast_stop_fails_memory_waiters(self):
+        """stop(drain=False) with a request parked on the memory gate:
+        the parked future fails with ServerClosedError and the loop
+        exits promptly. Parked requests count as _busy(), so leaving
+        them parked would keep the serve thread spinning (and the
+        caller blocked on the future) forever once the slots drain."""
+        from deeplearning4j_tpu.serving import ServerClosedError
+        lm = _lm()
+        rng = np.random.default_rng(15)
+        pa = rng.integers(1, 64, 4).tolist()
+        pb = rng.integers(1, 64, 4).tolist()
+        srv = _paged(lm, slots=2, n_blocks=4).start()
+        try:
+            fa = srv.submit(pa, 9)          # 12 rows -> 3 of 4 blocks
+            time.sleep(0.05)                # let A occupy its slot
+            fb = srv.submit(pb, 9)          # needs 3, 1 free: parks
+            deadline = time.monotonic() + 5
+            while (srv.metrics.snapshot().get("blocked_on_memory", 0)
+                   < 1 and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert srv.metrics.snapshot()["blocked_on_memory"] == 1
+        finally:
+            srv.stop(drain=False, timeout=30)
+        assert srv._thread is None          # loop actually exited
+        assert fa.result(1) == lm.generate(pa, max_new_tokens=9)
+        with pytest.raises(ServerClosedError):
+            fb.result(1)
+
+    def test_swap_invalidates_prefix_reuse(self):
+        """A post-swap request with a prompt already in the prefix cache
+        must NOT share the old version's blocks — those k/v rows were
+        computed under the old params. Pinned two ways: the post-swap
+        result is bit-identical to the new params' solo decode, and the
+        prefix-hit counter does not move across the swap."""
+        lm1, lm2 = _lm(3), _lm(11)
+        p = list(range(1, 10))                   # 2 full blocks + tail
+        with _paged(lm1, slots=2) as srv:
+            srv.generate(p, 4, timeout=60)       # populates the index
+            srv.generate(p, 4, timeout=60)       # proves it hits
+            hits_before = srv.metrics.snapshot()["prefix_rows_hit"]
+            assert hits_before >= 8
+            srv.swap(lm2)
+            got = srv.generate(p, 4, timeout=60)
+            assert srv.metrics.snapshot()["prefix_rows_hit"] \
+                == hits_before                   # no cross-version hit
+        expect = lm2.generate_batch(np.asarray([p], np.int32),
+                                    max_new_tokens=4)
+        assert got == expect[0].tolist()
+
+    def test_paged_thread_survives_terminal_dispatch_fault(self):
+        """A terminal decode-dispatch fault fails the occupied requests
+        LOUDLY and rebuilds arena + pool + tables together (a pool that
+        outlived its arena would hand out rows in dead buffers); the
+        server keeps serving."""
+        from deeplearning4j_tpu.common.resilience import (FaultInjected,
+                                                          FaultInjector)
+        lm = _lm()
+        inj = FaultInjector(seed=5).plan("serve.batch", on_call=1,
+                                         exc=FaultInjected)  # 0 = prefill
+        rng = np.random.default_rng(13)
+        p = rng.integers(1, 64, 4).tolist()
+        with _paged(lm, slots=2, fault_injector=inj) as srv:
+            f = srv.submit(p, 6)
+            with pytest.raises(FaultInjected):
+                f.result(60)
+            got = srv.generate(p, 6, timeout=60)
+            assert srv._pool.blocks_in_use == 0
+        assert got == lm.generate(p, max_new_tokens=6)
+        assert srv.metrics.snapshot().get("failed") == 1
+
+    def test_paged_prefill_fault_fails_only_that_request(self):
+        """The paged prefill does NOT donate the arena precisely so a
+        prefill-time failure stays per-request: the arena survives, the
+        failed request's reserved blocks release, the next request
+        serves bit-identically."""
+        from deeplearning4j_tpu.common.resilience import (FaultInjected,
+                                                          FaultInjector)
+        lm = _lm()
+        inj = FaultInjector(seed=5).plan("serve.batch", on_call=0,
+                                         exc=FaultInjected)
+        rng = np.random.default_rng(14)
+        p = rng.integers(1, 64, 4).tolist()
+        with _paged(lm, slots=2, fault_injector=inj) as srv:
+            f = srv.submit(p, 6)
+            with pytest.raises(FaultInjected):
+                f.result(60)
+            assert srv._pool.blocks_in_use == 0
+            got = srv.generate(p, 6, timeout=60)
+        assert got == lm.generate(p, max_new_tokens=6)
+        assert srv.metrics.snapshot().get("failed") == 1
+
+    def test_one_token_request_releases_blocks_at_prefill(self):
+        lm = _lm()
+        p = [5, 9, 2]
+        expect = lm.generate(p, max_new_tokens=1)
+        with _paged(lm) as srv:
+            got = srv.generate(p, 1, timeout=60)
+            assert srv._pool.blocks_in_use == 0
+        assert got == expect
+
+
+# ---------------------------------------------------------------------------
+# (d) refused compositions
+# ---------------------------------------------------------------------------
+class TestPagedGuards:
+    def test_oversize_for_slot_table_shed_at_submit(self):
+        """A caller-tuned max_blocks_per_slot below ceil(max_len/bs) is
+        a hard per-request ceiling too: an oversize request sheds
+        loudly at submit instead of crashing the admission thread on
+        the block-table write."""
+        lm = _lm()
+        with _paged(lm, max_blocks_per_slot=2) as srv:
+            with pytest.raises(ServerOverloadedError, match="table"):
+                srv.submit(list(range(1, 10)), 5)
+            got = srv.generate([5, 1], 4, timeout=60)
+            assert srv.metrics.snapshot()["shed_blocks"] == 1
+        assert got == lm.generate([5, 1], max_new_tokens=4)
+
+    def test_paged_with_speculate_raises_loudly(self):
+        lm = _lm()
+        with pytest.raises(ValueError, match="paged.*speculate"):
+            ContinuousDecodeServer(
+                lm, paged=True,
+                speculate=Speculator(NGramDraft(n=3), k=4))

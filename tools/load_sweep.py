@@ -107,12 +107,18 @@ def _knee(curve):
 
 
 def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
-                 process="poisson", tracer=None, lm=None, slots=4):
+                 process="poisson", tracer=None, lm=None, slots=4,
+                 paged=False, block_size=8):
     """Rate ladder over the ContinuousDecodeServer. One server serves
     every rate (compile once); per-point accounting is delta-based
     (loadgen baselines at entry), so points never contaminate each
     other. Offered/achieved compare in TOKENS/s — the decode server's
-    capacity is token throughput, not request admission."""
+    capacity is token throughput, not request admission.
+
+    `paged=True` swaps in the block-table KV cache (serving/kvpool.py)
+    at the default equal-bytes arena: the same sweep drives the
+    block-gated admission path instead of the slot-gated one — the
+    tier-1 smoke sweep runs one paged rate so CI exercises it."""
     from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
                                             DecodeSizeMix,
                                             ServingMetrics,
@@ -121,7 +127,8 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
     metrics = ServingMetrics(slo_target_ms=slo_ms)
     srv = ContinuousDecodeServer(
         lm, slots=slots, prompt_buckets=(8, 16), max_queue=1024,
-        metrics=metrics, tracer=tracer).start()
+        metrics=metrics, tracer=tracer, paged=paged,
+        block_size=block_size).start()
     # mostly short chat turns + a tail of long generations — the mixed-
     # length shape continuous batching exists for
     mix = DecodeSizeMix(((0.8, (3, 12), (4, 24)),
@@ -144,11 +151,12 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
         srv.stop(timeout=120)
     # describe the model actually measured (bench.py passes bigger ones)
     d_model = int(lm.aux["tok"].shape[1])
-    return {"server": "decode", "process": process,
+    cache = (f"paged bs={block_size}" if paged else "fixed-slot")
+    return {"server": "decode", "process": process, "paged": bool(paged),
             "config": f"TransformerLM L={len(lm.blocks)} d={d_model} "
-                      f"slots={slots}, mix 80% short(p3-11/n4-23) + "
-                      f"20% long(p8-15/n24-43), {n_req} reqs/rate, "
-                      f"slo={slo_ms:g}ms",
+                      f"slots={slots} cache={cache}, mix 80% "
+                      f"short(p3-11/n4-23) + 20% long(p8-15/n24-43), "
+                      f"{n_req} reqs/rate, slo={slo_ms:g}ms",
             "unit": "generated tokens/sec",
             "curve": curve, "knee": _knee(curve)}, snap
 
@@ -196,17 +204,18 @@ def sweep_microbatch(rates, n_req=96, slo_ms=50.0, seed=0,
 
 def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
               process="poisson", n_req=64, slo_ms=150.0, seed=0,
-              trace=True, report_path=None):
+              trace=True, report_path=None, paged=False):
     """Drive the sweep(s) and (optionally) write the combined
     obs_report (JSON + text + Chrome trace). Returns the results list.
-    The tier-1 smoke test calls this with tiny parameters."""
+    The tier-1 smoke test calls this with tiny parameters (and once
+    with paged=True so CI exercises the block-gated admission path)."""
     from deeplearning4j_tpu.obs import Tracer
     tracer = Tracer(capacity=1 << 16, enabled=True) if trace else None
     results, snaps = [], {}
     if server in ("decode", "both"):
         body, snap = sweep_decode(rates, n_req=n_req, slo_ms=slo_ms,
                                   seed=seed, process=process,
-                                  tracer=tracer)
+                                  tracer=tracer, paged=paged)
         results.append(body)
         snaps["decode"] = snap
     if server in ("microbatch", "both"):
@@ -263,6 +272,10 @@ def main():
     ap.add_argument("--no-trace", action="store_true",
                     help="disable span tracing (no decomposition in "
                          "the report)")
+    ap.add_argument("--paged", action="store_true",
+                    help="decode server uses the paged block-table KV "
+                         "cache (equal-bytes arena) instead of fixed "
+                         "slots")
     args = ap.parse_args()
     rates = tuple(float(r) for r in args.rates.split(","))
     t0 = time.perf_counter()
@@ -270,7 +283,7 @@ def main():
                         process=args.process, n_req=args.requests,
                         slo_ms=args.slo_ms, seed=args.seed,
                         trace=not args.no_trace,
-                        report_path=args.report)
+                        report_path=args.report, paged=args.paged)
     for r in results:
         print(json.dumps(r))
     print(json.dumps({"elapsed_s": fmt(time.perf_counter() - t0, 1),

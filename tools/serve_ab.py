@@ -18,6 +18,16 @@ equally):
     repetitive text. Token streams are pinned bit-identical;
     the A/B isolates dispatch amortization (dispatches/token, acceptance
     rate reported next to tokens/s).
+  * paged_vs_fixed — the SAME continuous-decode scheduler over the paged
+    block-table KV cache (serving/kvpool.py, `paged=True`) vs the
+    fixed-slot cache, at EQUAL ARENA BYTES: fixed reserves
+    slots x max_len rows up front, paged holds the same rows as
+    free-listed blocks with slot count a pure scheduling width. The
+    workload is mixed-length requests behind one shared system prefix
+    (the dominant real-traffic shape), so the paged arm also exercises
+    prefix reuse. Token streams are pinned bit-identical
+    (tests/test_paged.py); the A/B isolates CONCURRENCY: max live
+    streams (live_streams_max) and tokens/s at the same memory.
   * microbatch_vs_per_request — InferenceServer's adaptive micro-batching
     (Clipper) vs the bare per-request `output()` loop the reference
     shipped. Dispatch-overhead-dominated small models are exactly the
@@ -157,6 +167,100 @@ def bench_decode_ab(segments, reqs_per_seg=16, slo_ms=100.0):
         "slo": {n: _slo_view(lat[n], ab[n]["median"], base[n])
                 for n in lat},
     }, lat, None
+
+
+def bench_paged_ab(segments, reqs_per_seg=16, slo_ms=100.0):
+    """paged vs fixed-slot decode cache at EQUAL ARENA BYTES: fixed =
+    4 slots x 64 rows; paged = 32 blocks x 8 rows (the same 256 KV rows)
+    with slots=16 as pure scheduling width. Requests share a 16-token
+    system prefix (two full blocks — stored once in the paged arm) and
+    spread over mixed prompt/decode lengths, so fixed mode is bounded by
+    4 worst-case slots while paged admission is bounded by rows actually
+    reserved. Streams are pinned bit-identical (tests/test_paged.py);
+    here we measure what paging buys: max concurrent streams at the same
+    memory, and the tokens/s that concurrency carries."""
+    import numpy as np
+
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            ServingMetrics)
+
+    lm = _lm()                          # max_len=64
+    sys_prefix = np.random.default_rng(7).integers(1, 96, 16).tolist()
+
+    def workload(rng, n):
+        out = []
+        for _ in range(n):
+            own = rng.integers(1, 96, int(rng.integers(1, 8))).tolist()
+            out.append((sys_prefix + own, int(rng.integers(4, 28))))
+        return out
+
+    servers = {
+        "paged": ContinuousDecodeServer(
+            lm, slots=16, prompt_buckets=(24,), max_queue=256,
+            paged=True, block_size=8, n_blocks=32,
+            metrics=ServingMetrics(slo_target_ms=slo_ms)).start(),
+        "fixed": ContinuousDecodeServer(
+            lm, slots=4, prompt_buckets=(24,), max_queue=256,
+            metrics=ServingMetrics(slo_target_ms=slo_ms)).start(),
+    }
+    warm = workload(np.random.default_rng(0), 6)
+    for srv in servers.values():        # compile off the clock
+        for p, n in warm:
+            srv.generate(p, n, timeout=120)
+    # SLO baseline after warm-up: compile-latency misses stay off the books
+    base = {n: servers[n].metrics.snapshot() for n in servers}
+
+    seg_idx = {name: [0] for name in servers}
+
+    def seg(name):
+        srv = servers[name]
+
+        def run():
+            rng = np.random.default_rng(100 + seg_idx[name][0])
+            seg_idx[name][0] += 1
+            work = workload(rng, reqs_per_seg)
+            toks = sum(n for _, n in work)
+            t0 = time.perf_counter()
+            futs = [srv.submit(p, n) for p, n in work]
+            for f in futs:
+                f.result(300)
+            return toks / (time.perf_counter() - t0)
+        return run
+
+    ab = _interleaved({n: seg(n) for n in servers}, segments=segments)
+    snaps = {n: servers[n].metrics.snapshot() for n in servers}
+    for srv in servers.values():
+        srv.stop()
+    p = snaps["paged"]
+    streams = {n: snaps[n]["live_streams_max"] for n in snaps}
+    return {
+        "config": "TransformerLM L=2 d=32, EQUAL ARENA (256 KV rows): "
+                  "fixed 4 slots x 64 rows vs paged 32 blocks x 8 rows "
+                  "(slots=16 scheduling width), 16-token shared system "
+                  "prefix + mixed own prompts 1-7 / decode 4-27, "
+                  "16 reqs/segment, greedy",
+        "unit": "generated tokens/sec",
+        "ab": ab,
+        "speedup_paged_over_fixed": round(
+            ab["paged"]["median"] / ab["fixed"]["median"], 3),
+        "max_concurrent_streams": streams,
+        "streams_paged_over_fixed": round(
+            streams["paged"] / max(1, streams["fixed"]), 2),
+        "arena_rows": {"paged": 32 * 8, "fixed": 4 * 64},
+        "blocks_in_use_max": p["blocks_in_use_max"],
+        "pool_blocks": p["pool_blocks"],
+        "prefix_hit_rate": fmt(p["prefix_hit_rate"], 4),
+        "cow_copies": p["cow_copies"],
+        "blocked_on_memory": p["blocked_on_memory"],
+        "dispatches_per_token": {
+            n: fmt(snaps[n]["dispatches_per_token"], 4) for n in snaps},
+        "request_latency_ms": {
+            n: {"p50": fmt(snaps[n]["latency_ms_p50"]),
+                "p99": fmt(snaps[n]["latency_ms_p99"])} for n in snaps},
+        "slo_ms": slo_ms,
+        "slo": {n: _slo_view(snaps[n], ab[n]["median"], base[n])
+                for n in snaps},
+    }, snaps, None
 
 
 def bench_speculative_ab(segments, reqs_per_seg=16, slo_ms=100.0):
@@ -403,6 +507,7 @@ def main():
     all_snaps = {}
     tracer = None
     benches = (("decode_continuous_vs_static", bench_decode_ab),
+               ("paged_vs_fixed", bench_paged_ab),
                ("speculative_vs_plain", bench_speculative_ab),
                ("microbatch_vs_per_request", bench_microbatch_ab),
                ("tracing_on_vs_off", bench_tracing_ab))
