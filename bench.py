@@ -38,7 +38,9 @@ plus beyond-reference extras (budget permitting, skipped first):
                         tokens/s, request p99, TTFT p99, goodput-under-
                         SLO per rate + the saturation knee; one pinned
                         sweep point per record (tools/load_sweep.py is
-                        the full standalone)
+                        the full standalone), plus the PR 9 overload A/B
+                        (chunked prefill + deadline admission) at the
+                        past-knee rate
 
 Output protocol (round-4 restructure — the r2 record died to a driver
 timeout with output buffered (rc=124) and the r3 record died to an
@@ -950,6 +952,16 @@ def bench_load_sweep(rng, small=False):
         rates, n_req, slots = (100.0, 400.0, 1600.0), 48, 8
     body, _snap = sweep_decode(rates, n_req=n_req, slo_ms=150.0, seed=0,
                                tracer=None, lm=lm, slots=slots)
+    # overload-control arm (PR 9): the TOP (past-knee) rate replayed
+    # with chunked prefill + deadline-aware admission — the goodput
+    # those levers recover is the record's robustness read-out
+    # seed offset: sweep_decode seeds rung i with seed+i, so the
+    # single-rate controlled replay must start where the baseline's TOP
+    # rung landed — otherwise the A/B compares different schedules
+    body_c, _ = sweep_decode((rates[-1],), n_req=n_req, slo_ms=150.0,
+                             seed=len(rates) - 1, tracer=None, lm=lm,
+                             slots=slots, chunked_prefill=8,
+                             admission=True)
     pts, knee = body["curve"], body["knee"]
     pinned = next((p for p in pts
                    if p["offered_rate_target"]
@@ -977,9 +989,23 @@ def bench_load_sweep(rng, small=False):
                "attainment": (p.get("slo") or {}).get("attainment"),
                "goodput_tokens_per_sec":
                    (p.get("slo") or {}).get("goodput_tokens_per_sec"),
-               "shed": p["shed_at_submit"]} for p in pts],
+               "shed": p["shed_at_submit"],
+               "sheds": p.get("sheds")} for p in pts],
            "vs_baseline": round(pinned["tokens_per_sec"]
                                 / BASELINE_DECODE_TOKENS_PER_SEC, 3)}
+    ctrl = body_c["curve"][0]
+    rec["overload_ab"] = {
+        "offered_rps": rates[-1],
+        "controlled": "chunked_prefill=8 + deadline-aware admission "
+                      "(deadline = SLO)",
+        "goodput_tokens_per_sec": {
+            "baseline": (pts[-1].get("slo") or {}).get(
+                "goodput_tokens_per_sec"),
+            "controlled": (ctrl.get("slo") or {}).get(
+                "goodput_tokens_per_sec")},
+        "ttft_ms_p99": {"baseline": pts[-1].get("ttft_ms_p99"),
+                        "controlled": ctrl.get("ttft_ms_p99")},
+        "sheds_controlled": ctrl.get("sheds")}
     return rec
 
 
@@ -1043,8 +1069,9 @@ SECONDARY_CONFIGS = {
     # max live streams + tokens/s, paged vs fixed-slot cache
     "paged_decode": (bench_paged_decode, 110),
     # the traffic-harness pinned sweep point (ISSUE 7): arrivals +
-    # queueing, not backlog replay — knee + goodput-under-SLO per record
-    "load_sweep": (bench_load_sweep, 100),
+    # queueing, not backlog replay — knee + goodput-under-SLO per
+    # record, plus the PR 9 overload-control goodput A/B at the top rate
+    "load_sweep": (bench_load_sweep, 130),
     "resnet50_fit_pipeline": (bench_resnet50_pipeline, 150),
     "flash_attention_8k": (bench_flash_attention, 110),
     "parallel_wrapper_resnet50": (bench_parallel_wrapper, 120),

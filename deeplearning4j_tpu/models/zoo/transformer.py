@@ -724,6 +724,171 @@ def make_paged_install_fn(block_size):
     return install
 
 
+def make_paged_chunk_block_fn(n_heads, block_size):
+    """`make_paged_decode_block_fn` widened to K query positions per
+    slot: the per-block unit of CHUNKED PREFILL over the paged cache
+    (the paged twin of `make_slot_verify_block_fn`).
+
+    block_chunk(p, x [S, K, D], cache {k,v: [n_rows, H, hd]},
+                btab [S, NB], pos [S], active [S] bool,
+                wfrom [S], wto [S]) -> (y [S, K, D], updated cache)
+
+    Slot s's K inputs sit at LOGICAL rows pos[s]..pos[s]+K-1; their k/v
+    land at the table-mapped physical rows, all written BEFORE attention
+    (exactly as the verify block fills its window), and query i attends
+    causally to logical rows <= pos[s]+i through the block-table gather.
+    Gating is by INDEX like every paged write (gated_cache_rows
+    gate=None): a row writes only when its slot is active AND its
+    logical position falls in [wfrom[s], wto[s]) — the write window.
+    The window is what makes chunked prefill COMPOSE with prefix reuse
+    and with chunk padding: rows below wfrom are a prefix-cache hit
+    (physically resident, possibly refcount > 1 — recomputed bits equal
+    the resident bits, the measured per-row batch-shape independence,
+    so they are computed for attention but never written), and rows at
+    or past wto are the final chunk's bucket padding, whose logical
+    position may exceed the request's RESERVED block table — an
+    ungated write there would resolve through a zeroed table entry to
+    physical block 0 and corrupt whichever stream owns it. Suppressed
+    rows go out of range; the drop-mode scatter discards them."""
+    bs = int(block_size)
+
+    def block_chunk(p, x, cache, btab, pos, active, wfrom, wto):
+        S, K, D = x.shape
+        H = n_heads
+        hd = D // H
+        NB = btab.shape[1]
+        L = NB * bs
+        n_rows = cache["k"].shape[0]
+        h = _layer_norm(x, p["ln1"]["g"], p["ln1"]["b"])
+        qkv = h @ p["attn"]["wqkv"]                     # [S, K, 3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        lrows = pos[:, None] + jnp.arange(K)[None, :]   # [S, K] logical
+        blk = btab[jnp.arange(S)[:, None],
+                   jnp.clip(lrows // bs, 0, NB - 1)]
+        pr = blk * bs + lrows % bs                      # physical rows
+        ok = (active[:, None] & (lrows >= wfrom[:, None])
+              & (lrows < wto[:, None]) & (lrows < L))
+        widx = jnp.where(ok, pr, n_rows)                # suppressed: drop
+        cache = gated_cache_rows(cache, (widx,),
+                                 k.reshape(S, K, H, hd),
+                                 v.reshape(S, K, H, hd))
+        # gather each slot's logical window from the arena (identical to
+        # the 1-wide paged decode block; rows past the reserved table
+        # resolve to block 0 but are masked to exact softmax zeros)
+        flat = (btab[:, :, None] * bs +
+                jnp.arange(bs)[None, None, :]).reshape(S, L)
+        k_rows = jnp.take(cache["k"], flat, axis=0)     # [S, L, H, hd]
+        v_rows = jnp.take(cache["v"], flat, axis=0)
+        qh = q.reshape(S, K, H, hd)
+        scores = jnp.einsum("skhd,slhd->shkl", qh,
+                            k_rows) / math.sqrt(hd)     # [S, H, K, L]
+        mask = (jnp.arange(L)[None, None, None, :]
+                <= lrows[:, None, :, None])             # [S, 1, K, L]
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        att = jax.nn.softmax(scores.astype(jnp.float32),
+                             -1).astype(x.dtype)
+        out = jnp.einsum("shkl,slhd->skhd", att, v_rows).reshape(S, K, D)
+        x = x + out @ p["attn"]["wo"]
+        h = _layer_norm(x, p["ln2"]["g"], p["ln2"]["b"])
+        m = jax.nn.gelu(h @ p["mlp"]["w1"] + p["mlp"]["b1"])
+        y = x + m @ p["mlp"]["w2"] + p["mlp"]["b2"]
+        return y, cache
+
+    return block_chunk
+
+
+def make_chunked_prefill_fn(n_heads, chunk, block_size=None):
+    """CHUNKED prefill: one decode-iteration-sized slice of a prompt per
+    dispatch, attending into the rows earlier chunks already installed —
+    the head-of-line surgery program (a long joiner stops stalling every
+    co-resident stream for its whole prompt; it stalls them one chunk at
+    a time instead, and the scheduler interleaves decode iterations
+    between chunks).
+
+    block_size=None builds the FIXED-SLOT layout:
+
+      step(aux, blocks, cache, pos [S], toks [S, C], nrows [S],
+           active [S]) -> (nxt [S, C] i32, new cache, new pos)
+
+    an int builds the PAGED block-table layout:
+
+      step(aux, blocks, cache, btabs [S, NB], pos [S], toks [S, C],
+           nrows [S], active [S], wfrom [S], wto [S])
+        -> (nxt [S, C] i32, new cache, new pos)
+
+    Both are the VERIFY program's shape with prefill semantics: the C
+    chunk tokens' k/v are written at rows pos..pos+C-1 before attention
+    (fixed: the verify block itself, so chunked prefill can never drift
+    from the pinned K-wide program; paged: `make_paged_chunk_block_fn`,
+    its block-table twin), every position emits a greedy f32 argmax, and
+    pos advances by nrows (the REAL rows this chunk carried — the final
+    chunk is bucket-padded up to C). The host consumes nxt[s, nrows-1]
+    of the LAST chunk only: that argmax IS the request's first generated
+    token, exactly as the one-shot prefill's last-real-row argmax is.
+
+    Bit-identity with one-shot prefill rests on the two measured
+    properties every serving pin already uses: per-row gemm bits are
+    independent of batch shape (a chunk's rows see the same qkv bits the
+    full-prompt forward computes — hence the chunk floor of 2: C=1 would
+    take XLA:CPU's differently-accumulating gemv path), and masked
+    positions contribute EXACT softmax zeros, so attending through the
+    cache window instead of the in-flight forward changes no row's sum.
+    Chunk padding rows (the last chunk past nrows) write dead rows the
+    decode pointer overwrites before attending — the verify program's
+    rejected-suffix argument; in the paged layout they are additionally
+    index-gated off by the [wfrom, wto) write window (see
+    `make_paged_chunk_block_fn` — an ungated padding write could alias
+    another stream's block 0). wfrom > pos composes chunked prefill with
+    PREFIX REUSE: resident shared rows are attended, recomputed only in
+    the final chunk's window when needed for logits, and never
+    re-written — the partial-prefill compute reuse the paged subsystem
+    left open."""
+    C = int(chunk)
+    if C < 2:
+        # same floor as the padding buckets: a 1-row chunk is a gemv
+        # with a different accumulation order, silently breaking the
+        # chunked == one-shot bit-identity pin
+        raise ValueError(f"chunk size must be >= 2 (the XLA:CPU gemv "
+                         f"floor), got {chunk}")
+    if block_size is None:
+        block_verify = make_slot_verify_block_fn(n_heads)
+
+        def step(aux, blocks, cache, pos, toks, nrows, active):
+            max_len = aux["pos"].shape[0]
+            pcols = jnp.clip(pos[:, None] + jnp.arange(C)[None, :],
+                             0, max_len - 1)
+            x = aux["tok"][toks] + aux["pos"][pcols]    # [S, C, D]
+            new_cache = []
+            for p, c in zip(blocks, cache):
+                x, c = block_verify(p, x, c, pos, active)
+                new_cache.append(c)
+            logits = logits_fn(aux, x).astype(jnp.float32)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)   # [S, C]
+            new_pos = pos + jnp.where(active, nrows, 0).astype(pos.dtype)
+            return nxt, new_cache, new_pos
+
+        return step
+
+    block_chunk = make_paged_chunk_block_fn(n_heads, block_size)
+
+    def step(aux, blocks, cache, btabs, pos, toks, nrows, active,
+             wfrom, wto):
+        max_len = aux["pos"].shape[0]
+        pcols = jnp.clip(pos[:, None] + jnp.arange(C)[None, :],
+                         0, max_len - 1)
+        x = aux["tok"][toks] + aux["pos"][pcols]        # [S, C, D]
+        new_cache = []
+        for p, c in zip(blocks, cache):
+            x, c = block_chunk(p, x, c, btabs, pos, active, wfrom, wto)
+            new_cache.append(c)
+        logits = logits_fn(aux, x).astype(jnp.float32)
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)       # [S, C]
+        new_pos = pos + jnp.where(active, nrows, 0).astype(pos.dtype)
+        return nxt, new_cache, new_pos
+
+    return step
+
+
 def make_block_copy_fn(block_size):
     """Copy-on-write worker: copy one physical block's rows (all layers)
     to another — the device half of the pool's lazy CoW (a stream about

@@ -88,7 +88,11 @@ def bucket_quantile(bounds, counts, q):
     if total <= 0:
         return None
     target = (q / 100.0) * total
-    cum, lo = 0, 0.0
+    # signed grids (the admission-error histogram spans negative bounds):
+    # the first bucket's lower edge is its own bound, not 0.0 — otherwise
+    # interpolation inside a negative first bucket would run BACKWARDS
+    # (from 0 down to the bound) and misplace the whole quantile
+    cum, lo = 0, min(0.0, bounds[0])
     for i, ub in enumerate(bounds):
         c = counts[i] if i < len(counts) else 0
         if cum + c >= target:

@@ -39,7 +39,18 @@ on/off, closed-loop fixed concurrency) and request-size mixes — the
 offered-load side of the ROADMAP's "handles heavy traffic" claim;
 `tools/load_sweep.py` sweeps offered rate into a throughput–latency
 curve with goodput-under-SLO and the saturation knee.
+
+Overload control (`admission.py` + `ContinuousDecodeServer(
+chunked_prefill=, admission=, brownout=, default_deadline_ms=)`):
+chunked prefill slices long prompts into decode-iteration-sized chunks
+(head-of-line surgery, streams pinned bit-identical to one-shot
+prefill), a service-rate estimator sheds predicted deadline misses at
+ENQUEUE (`shed_predicted`), and a per-class brownout policy makes
+saturation behavior explicit — goodput stays monotone past the
+saturation knee instead of collapsing.
 """
+from .admission import (AdmissionController, BrownoutPolicy,
+                        ServiceRateEstimator)
 from .metrics import ServingMetrics
 from .server import (DeadlineExceededError, InferenceServer,
                      ServerClosedError, ServerOverloadedError,
@@ -56,6 +67,7 @@ __all__ = [
     "ServingError", "ServerOverloadedError", "DeadlineExceededError",
     "UnhealthyOutputError", "ServerClosedError",
     "BlockPool", "PagedAllocation",
+    "AdmissionController", "BrownoutPolicy", "ServiceRateEstimator",
     "Speculator", "DraftSource", "NGramDraft", "ModelDraft",
     "PoissonProcess", "OnOffProcess", "ClosedLoop",
     "DecodeSizeMix", "InferenceSizeMix", "Schedule",

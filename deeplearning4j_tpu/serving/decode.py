@@ -61,6 +61,51 @@ join==solo determinism pin carries over unchanged. `paged=True` +
 `speculate=` raises at construction: the K-wide verify program indexes
 the fixed-slot cache layout, and silently composing it with a block
 table is exactly the wrong-cache failure mode to block.
+
+Overload control (PR 9; serving/admission.py + the zoo's
+`make_chunked_prefill_fn`) makes saturation a SURVIVABLE regime instead
+of the goodput collapse PR 7 measured (past the knee: 2,515 -> 635
+tok/s, TTFT p99 x30, queue_wait 72% of request time). Three levers:
+
+* **Chunked prefill** (`chunked_prefill=C`): a joining request's prompt
+  no longer runs as one monolithic prefill dispatch that stalls every
+  co-resident stream for the whole prompt. The request is admitted into
+  its slot in a PREFILL phase and advances C rows per scheduling
+  iteration through a verify-shaped chunk program (fixed-slot and paged
+  layouts), interleaved with everyone else's decode iterations — the
+  head-of-line stall shrinks from O(prompt) to O(chunk), which is what
+  the `sched_gap` phase in obs/decompose.py measures. The SIZING RULE
+  (see _admit): only prompts longer than one chunk take this path — a
+  short prompt already is a chunk-sized stall, and the one-shot bucket
+  program runs it at [1, Pb] where the chunk program pays [slots, C].
+  The chunked stream is BIT-IDENTICAL to the one-shot stream (the
+  join==solo pin extended — tests/test_overload.py), and in paged mode
+  chunking starts AFTER any resident shared prefix, so a prefix-cache
+  hit now saves the prompt COMPUTE too (the partial-prefill seam PR 8
+  left open), not just the memory.
+* **Deadline-aware admission** (`admission=` an
+  `admission.AdmissionController`, or True for defaults): a
+  service-rate estimator over recent scheduling iterations (rolling
+  median of iteration time + per-slot token rate — admission.py
+  explains why those are the robust, occupancy-independent primitives)
+  predicts, at ENQUEUE, when a request would complete behind the
+  current backlog of work units; requests that cannot make their
+  deadline are shed immediately as `shed_predicted` instead of eating
+  queue slots and dying mid-decode. The estimator sheds LATE by
+  construction (conservatism knob, cold warm-up guard) and
+  SELF-CORRECTS systematic optimism: every prediction's signed error
+  — completions exactly, evictions as a certain bound — feeds both
+  the `admission_error_ms` histogram (observability) and the
+  controller's bias loop.
+* **Brownout policy** (`brownout=` an `admission.BrownoutPolicy`):
+  accept/defer/shed per request CLASS (`submit(..., klass=)`) driven by
+  queue depth and recent SLO attainment — deferred requests park in a
+  side line served only when the primary queue is empty, so batch-class
+  work yields to interactive work under pressure by POLICY, not queue
+  accident. Deferred and memory-parked lines are both failed on
+  fail-fast stop and both drain bounded by their remaining work on
+  stop(drain=True) — expired deadlines shed at admission, so a
+  saturated drain never decodes work nobody can use.
 """
 from __future__ import annotations
 
@@ -109,9 +154,10 @@ def _resolve_future(fut, result):
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "future", "deadline", "t_submit",
                  "generated", "slot", "version", "req_id", "t_last_tok",
-                 "alloc", "mem_blocked")
+                 "alloc", "mem_blocked", "pf_next", "pf_wfrom",
+                 "work_left", "work_counted", "predicted_done", "klass")
 
-    def __init__(self, prompt, max_new, deadline):
+    def __init__(self, prompt, max_new, deadline, klass="default"):
         self.prompt = prompt
         self.max_new = int(max_new)
         self.future = cf.Future()
@@ -124,6 +170,12 @@ class _DecodeRequest:
         self.t_last_tok = None  # when this request's last token landed
         self.alloc = None       # paged mode: kvpool.PagedAllocation
         self.mem_blocked = False    # counted blocked_on_memory once
+        self.pf_next = None     # chunked prefill: next prompt row to run
+        self.pf_wfrom = 0       # chunked paged: first row to WRITE
+        self.work_left = int(max_new)   # admission backlog accounting
+        self.work_counted = False       # work_left added to the backlog?
+        self.predicted_done = None      # estimator's completion estimate
+        self.klass = klass      # brownout request class
 
 
 class ContinuousDecodeServer(_RequestLoop):
@@ -146,13 +198,17 @@ class ContinuousDecodeServer(_RequestLoop):
                  static_batching=False, speculate=None, tracer=None,
                  flight_recorder=None, paged=False, block_size=16,
                  n_blocks=None, prefix_cache=True,
-                 max_blocks_per_slot=None):
+                 max_blocks_per_slot=None, chunked_prefill=None,
+                 admission=None, brownout=None,
+                 default_deadline_ms=None):
         from ..models.zoo.transformer import (make_block_copy_fn,
+                                              make_chunked_prefill_fn,
                                               make_paged_decode_fn,
                                               make_paged_install_fn,
                                               make_paged_prefill_fn,
                                               make_prefill_fn,
                                               make_slot_decode_fn)
+        from .admission import AdmissionController
         from .speculate import as_speculator
         import jax
 
@@ -212,6 +268,32 @@ class ContinuousDecodeServer(_RequestLoop):
         self._prefix_cache = bool(prefix_cache)
         self._mem_wait = collections.deque()     # blocked on FREE BLOCKS
 
+        # overload control (module docstring; serving/admission.py):
+        # chunk size, admission predictor, brownout policy, default
+        # per-request deadline (the InferenceServer contract)
+        self._chunk = None if chunked_prefill is None \
+            else int(chunked_prefill)
+        if self._chunk is not None and self._chunk > self.max_len:
+            raise ValueError(f"chunked_prefill {self._chunk} > model "
+                             f"max_len {self.max_len}")
+        self._admission = (AdmissionController() if admission is True
+                           else admission)
+        if self._admission is not None and \
+                self._admission.estimator.slots is None:
+            # predictions scale capacity by the scheduling width; a
+            # caller-built controller usually leaves it for us to fill
+            self._admission.estimator.slots = self.slots
+        self._brownout = brownout
+        self.default_deadline = (None if default_deadline_ms is None
+                                 else float(default_deadline_ms) / 1e3)
+        self._defer_q = collections.deque()      # brownout-deferred line
+        self._work_lock = threading.Lock()
+        self._work_tokens = 0   # work-unit backlog (queued + live)
+        # admission hysteresis: any actual eviction/queue expiry
+        # CONFIRMS overload and tightens prediction shedding to exactly
+        # the deadline budget for this long (admission.py should_shed)
+        self._thrash_until = 0.0
+
         self._reset_device_state()
         # ONE decode program for the life of the server (fixed slot count;
         # params are arguments, so hot swap reuses it). Cache and pos are
@@ -224,6 +306,28 @@ class ContinuousDecodeServer(_RequestLoop):
         else:
             self._step = jax.jit(make_slot_decode_fn(n_heads),
                                  donate_argnums=(2, 3))
+        # chunked prefill (module docstring): ONE verify-shaped chunk
+        # program for the life of the server — every prefilling slot
+        # advances C prompt rows per scheduling iteration through it,
+        # interleaved with the decode dispatches. Cache and pos are
+        # donated exactly like the decode step's: chunk dispatches run
+        # inside the scheduler loop, whose terminal-failure path resets
+        # the whole device state anyway.
+        if self._chunk is None:
+            self._chunk_step = None
+        elif self._paged:
+            self._chunk_step = jax.jit(
+                make_chunked_prefill_fn(n_heads, self._chunk,
+                                        self._block_size),
+                donate_argnums=(2, 4))
+        else:
+            self._chunk_step = jax.jit(
+                make_chunked_prefill_fn(n_heads, self._chunk),
+                donate_argnums=(2, 3))
+        # rolling window of recent SLO outcomes (1 met / 0 missed): the
+        # brownout policy's attainment signal — RECENT, not all-time,
+        # so recovery after a burst reopens admission
+        self._slo_recent = collections.deque(maxlen=64)
         # speculative decoding (serving/speculate.py): ONE K-wide verify
         # program replaces the 1-token step for every iteration — drafts
         # in, 1..K accepted tokens out per slot per dispatch, token
@@ -270,9 +374,13 @@ class ContinuousDecodeServer(_RequestLoop):
         self._init_loop(max_queue)
 
     # -- client API ----------------------------------------------------
-    def submit(self, prompt, max_new_tokens, deadline_ms=None):
+    def submit(self, prompt, max_new_tokens, deadline_ms=None,
+               klass="default"):
         """Enqueue one decode request; Future resolves to the full token
-        list (prompt + `max_new_tokens` greedy continuations)."""
+        list (prompt + `max_new_tokens` greedy continuations).
+        `deadline_ms` falls back to the server's `default_deadline_ms`;
+        `klass` is the brownout request class (ignored without a
+        `brownout=` policy)."""
         if not self._running:
             raise ServerClosedError("server is not running")
         prompt = [int(t) for t in np.asarray(prompt).ravel()]
@@ -312,9 +420,183 @@ class ContinuousDecodeServer(_RequestLoop):
         if self._injector is not None:
             self._injector.fire("serve.request")
         self.metrics.count("received")
-        dl = (time.monotonic() + deadline_ms / 1e3
-              if deadline_ms is not None else None)
-        return self._enqueue(_DecodeRequest(prompt, max_new_tokens, dl))
+        now = time.monotonic()
+        if deadline_ms is not None:
+            dl = now + deadline_ms / 1e3
+        else:
+            dl = (now + self.default_deadline
+                  if self.default_deadline is not None else None)
+        deferred = False
+        if self._brownout is not None:
+            from .admission import DEFER, SHED
+            # maxsize <= 0 is queue.Queue's unbounded convention: depth
+            # pressure is undefined there, so the depth thresholds never
+            # engage (attainment brownout still can)
+            frac = (self._q.qsize() / self._q.maxsize
+                    if self._q.maxsize > 0 else 0.0)
+            decision = self._brownout.decide(
+                klass, frac, self._recent_attainment())
+            if decision == SHED:
+                self.metrics.count("shed_brownout")
+                self.metrics.record_queue_depth(self._q.qsize())
+                raise ServerOverloadedError(
+                    f"brownout: class {klass!r} shed at queue depth "
+                    f"{frac:.0%}")
+            deferred = decision == DEFER
+        if self._admission is not None and dl is not None \
+                and not deferred:
+            # predicted completion at ENQUEUE: work ahead (queued + live
+            # generated-token backlog) plus this request's own budget,
+            # over the measured aggregate rate. Shedding here — before
+            # the request costs a queue slot, blocks, or decode work —
+            # is the whole point; the estimator's conservatism contract
+            # (sheds late, never a request solo execution could finish
+            # in time) lives in serving/admission.py and is pinned by
+            # property test. Submit-time sheds stay out of slo_total,
+            # matching the queue-full precedent: attainment is over
+            # ADMITTED requests.
+            backlog = self._work_tokens
+            own = int(max_new_tokens) + self._pf_units(len(prompt))
+            if self._admission.should_shed(
+                    backlog, own, dl - now,
+                    strict=now < self._thrash_until):
+                self.metrics.count("shed_predicted")
+                pred = self._admission.predict_seconds(backlog, own)
+                raise ServerOverloadedError(
+                    f"predicted completion in {pred * 1e3:.0f}ms behind "
+                    f"{backlog} backlog work units cannot make the "
+                    f"{(dl - now) * 1e3:.0f}ms deadline budget")
+        req = _DecodeRequest(prompt, max_new_tokens, dl, klass=klass)
+        # work is counted in ITERATION-EQUIVALENT units: generated
+        # tokens plus the prefill dispatches (chunks) the prompt will
+        # consume — a slot spends one scheduling iteration per unit, so
+        # backlog predictions see prefill-heavy queues at true size
+        req.work_left += self._pf_units(len(prompt))
+        if self._admission is not None and not deferred:
+            # DEFERRED requests carry no prediction: their service time
+            # is brownout policy (they yield until the primary queue
+            # empties), and stamping a primary-queue prediction on them
+            # would feed huge phantom "optimism" errors into the bias
+            # loop and thrash window when they complete late BY DESIGN
+            pred = self._admission.predict_seconds(
+                self._work_tokens, req.work_left)
+            if pred is not None:
+                # stamped for the (predicted - actual) error histogram —
+                # recorded for every admitted PRIMARY-line prediction,
+                # deadline-tight or not, so the estimator's drift is
+                # visible even while nothing is being shed
+                req.predicted_done = now + pred
+        # backlog accounting: the request's whole unit budget joins the
+        # backlog now and retires unit-by-unit as it prefills/decodes;
+        # ANY resolution of the future (result, failure, caller cancel)
+        # retires the remainder exactly once, so the counter cannot
+        # drift under sheds, evictions, or stop(). DEFERRED requests
+        # join only when they leave the deferred line (_next_request):
+        # they run BEHIND the primary queue, so counting them ahead of
+        # primary submissions would invert the priority inside
+        # predictions and shed feasible primary requests
+        if not deferred:
+            with self._work_lock:
+                self._work_tokens += req.work_left
+                req.work_counted = True
+        req.future.add_done_callback(
+            lambda _f, r=req: self._retire_work(r))
+        try:
+            return (self._enqueue_deferred(req) if deferred
+                    else self._enqueue(req))
+        except BaseException:
+            self._retire_work(req)
+            raise
+
+    def _deadline_miss(self, req, now, thrash=True):
+        """The ONE deadline-expiry bookkeeping path for all four shed
+        sites (submit queue, memory gate, deferred line, mid-decode):
+        counters, SLO miss, the rolling attainment window, admission
+        feedback, and — unless the expiry is brownout deferral starving
+        a class by POLICY rather than overload — the admission thrash
+        window."""
+        self.metrics.count("shed_deadline")
+        self.metrics.record_slo_miss()
+        self._slo_recent.append(0)
+        self._admission_outcome(req, now, completed=False)
+        if thrash:
+            self._thrash_until = now + 0.5
+
+    def _admission_outcome(self, req, now, completed):
+        """Close one prediction's feedback loop: the signed
+        (predicted - actual) error at completion; at an eviction/expiry
+        the actual end is unknown but >= now, so a NEGATIVE
+        (predicted - now) is a CERTAIN lower bound on the optimism —
+        recorded too (an uninformative positive bound is dropped, and
+        skipping evictions entirely would survivor-bias the histogram
+        toward pessimism). Both the histogram (observability) and the
+        controller's bias loop (self-correction) are fed here."""
+        if req.predicted_done is None:
+            return
+        err = req.predicted_done - now
+        req.predicted_done = None
+        if not completed and err >= 0:
+            return
+        self.metrics.record_admission_error(err * 1e3)
+        if self._admission is not None:
+            self._admission.observe_error(err)
+
+    def _pf_units(self, plen):
+        """Prefill cost of a prompt in iteration-equivalent work units:
+        its chunk count when it will take the chunked path (longer than
+        one chunk — the sizing rule in _admit), one one-shot dispatch
+        otherwise."""
+        if self._chunk is not None and int(plen) > self._chunk:
+            return -(-int(plen) // self._chunk)
+        return 1
+
+    def _retire_work(self, req):
+        """Remove a request's unproduced work units from the admission
+        backlog (idempotent — work_left zeroes on first retirement; a
+        still-deferred request was never counted in)."""
+        with self._work_lock:
+            if req.work_counted:
+                self._work_tokens -= req.work_left
+            req.work_left = 0
+
+    def _spend_work(self, req, units=1):
+        """Retire `units` of a request's backlog as they are served."""
+        with self._work_lock:
+            n = min(units, req.work_left)
+            req.work_left -= n
+            self._work_tokens -= n
+
+    def _recent_attainment(self):
+        """Mean of the rolling SLO-outcome window (None while empty):
+        the brownout policy's attainment input."""
+        win = list(self._slo_recent)
+        return (sum(win) / len(win)) if win else None
+
+    def _enqueue_deferred(self, req):
+        """Park a brownout-DEFERRED request in the side line the
+        scheduler serves only when the primary queue is empty. Same
+        contracts as `_enqueue`: bounded (sheds loudly when the line is
+        as deep as the queue), traced, and a raced stop() fails the
+        future rather than stranding the caller."""
+        if req.req_id is None:
+            req.req_id = next(self._req_ids)
+        if 0 < self._q.maxsize <= len(self._defer_q):
+            self.metrics.count("shed_queue_full")
+            self.metrics.record_queue_depth(self._q.maxsize)
+            raise ServerOverloadedError(
+                f"deferred line full ({self._q.maxsize} parked)")
+        self.metrics.count("deferred")
+        self._defer_q.append(req)
+        tr = self._tracer
+        if tr.enabled:
+            tr.instant("serve.enqueue", cat="serve",
+                       track=f"req-{req.req_id}", trace_id=req.req_id)
+        if not self._running:
+            if not req.future.done():
+                req.future.set_exception(
+                    ServerClosedError("server stopped during submit"))
+            raise ServerClosedError("server stopped during submit")
+        return req.future
 
     def generate(self, prompt, max_new_tokens, deadline_ms=None,
                  timeout=None):
@@ -360,6 +642,9 @@ class ContinuousDecodeServer(_RequestLoop):
             total_ms, tokens=len(req.generated),
             deadline_met=(None if req.deadline is None
                           else t_now <= req.deadline))
+        if req.deadline is not None:
+            self._slo_recent.append(1 if t_now <= req.deadline else 0)
+        self._admission_outcome(req, t_now, completed=True)
         tr = self._tracer
         if tr.enabled:
             t0 = int(req.t_submit * 1e9)
@@ -393,7 +678,12 @@ class ContinuousDecodeServer(_RequestLoop):
                                         self.max_len, self._d_model,
                                         self._n_heads, self._cache_dtype)
         self._pos = jnp.zeros((self.slots,), jnp.int32)
-        self._tok = jnp.zeros((self.slots,), jnp.int32)
+        # tok is HOST state uploaded per dispatch (like active/btabs):
+        # chunk-prefill transitions and decode iterations both write
+        # per-slot entries, and a device-side array rebuilt from one
+        # iteration's live set would silently zero the slots the other
+        # path just set
+        self._tok = np.zeros((self.slots,), np.int32)
         self._slot_req = [None] * self.slots     # host-side occupancy
         spec = getattr(self, "_spec", None)      # unset on first call
         if spec is not None:
@@ -429,6 +719,27 @@ class ContinuousDecodeServer(_RequestLoop):
             tr.emit("serve.queue_wait", t0, time.monotonic_ns() - t0,
                     cat="serve", track=f"req-{req.req_id}",
                     trace_id=req.req_id)
+        if version is not None:
+            vidx, aux, blocks = version
+        else:
+            with self._swap_lock:   # version index + params read atomically
+                vidx = len(self._versions) - 1
+                aux, blocks = self._versions[vidx]
+        if self._chunk is not None and len(req.prompt) > self._chunk:
+            # chunked prefill: NO monolithic prompt dispatch here — the
+            # request enters its slot in the PREFILL phase and the
+            # scheduler advances it C rows per iteration
+            # (_chunk_iteration), interleaved with everyone's decode.
+            # The CHUNK SIZING RULE: only prompts LONGER than one chunk
+            # take this path — a prompt that fits in one chunk already
+            # IS a chunk-sized stall, and the one-shot bucket program
+            # below runs it at [1, Pb] instead of the chunk program's
+            # [slots, C] (the S-wide chunk dispatch computes every slot
+            # unconditionally, so routing short prompts through it
+            # would multiply the fleet-dominant traffic's prefill
+            # compute by the slot count for zero head-of-line benefit).
+            self._admit_chunked(req, slot, alloc, vidx)
+            return
         bucket = self._prompt_bucket(len(req.prompt))
         prog = self._prefills.get(bucket)
         if prog is None:
@@ -437,12 +748,6 @@ class ContinuousDecodeServer(_RequestLoop):
                      bucket)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(req.prompt)] = req.prompt
-        if version is not None:
-            vidx, aux, blocks = version
-        else:
-            with self._swap_lock:   # version index + params read atomically
-                vidx = len(self._versions) - 1
-                aux, blocks = self._versions[vidx]
 
         def dispatch():
             if self._injector is not None:
@@ -482,6 +787,7 @@ class ContinuousDecodeServer(_RequestLoop):
         # token, whether or not the request goes on to occupy a slot
         req.t_last_tok = time.monotonic()
         self.metrics.record_ttft((req.t_last_tok - req.t_submit) * 1e3)
+        self._spend_work(req, 2)    # the prefill unit + the first token
         if len(req.generated) >= req.max_new:
             # one-token request: done at prefill, never occupies a slot
             # (paged: its blocks release immediately — and a shared
@@ -497,7 +803,7 @@ class ContinuousDecodeServer(_RequestLoop):
         else:
             self._cache = self._install(self._cache, rows, slot)
         self._pos = self._pos.at[slot].set(len(req.prompt))
-        self._tok = self._tok.at[slot].set(first)
+        self._tok[slot] = first
         req.slot = slot
         req.version = vidx
         self._slot_req[slot] = req
@@ -506,17 +812,68 @@ class ContinuousDecodeServer(_RequestLoop):
             # is safe — start() resets the key, _free_slot stops it)
             self._spec.draft.start(slot, list(req.prompt) + req.generated)
 
+    def _admit_chunked(self, req, slot, alloc, vidx):
+        """Install `req` into `slot` in the PREFILL phase: block table /
+        position state only, zero dispatches. Paged mode starts the
+        chunk cursor past any resident shared prefix — a prefix-cache
+        hit now saves the prompt COMPUTE, not just the install — but
+        always re-runs at least the final prompt row, whose argmax IS
+        the first generated token (write-gated below `pf_wfrom`, so
+        recomputed shared rows are never re-installed and a shared
+        partial block is never touched)."""
+        plen = len(req.prompt)
+        if self._paged:
+            self._btabs[slot, :] = 0
+            self._btabs[slot, :len(alloc.ids)] = alloc.ids
+            req.alloc = alloc
+            start = min(alloc.shared_rows, plen - 1)
+            req.pf_wfrom = alloc.shared_rows
+        else:
+            start = 0
+            req.pf_wfrom = 0
+        req.pf_next = start
+        # prefix hits skip leading chunks: retire their work units NOW,
+        # or they would sit in the admission backlog as phantoms until
+        # the future resolves, over-predicting every later request
+        chunks_left = -(-(plen - start) // self._chunk)
+        self._spend_work(req, max(
+            0, self._pf_units(plen) - chunks_left))
+        self._pos = self._pos.at[slot].set(start)
+        self._tok[slot] = 0
+        req.slot = slot
+        req.version = vidx
+        self._slot_req[slot] = req
+
     def _next_request(self, wait):
         """Head of the admission line: memory-blocked requests first
         (FIFO — a small late request must not starve a big early one),
-        then the submit queue."""
+        then the submit queue, then the brownout-DEFERRED line — served
+        only when the primary queue is empty, which is the policy:
+        deferred classes yield until pressure drops. The blocking `wait`
+        engages only when every line is empty (the idle sleep)."""
         if self._mem_wait:
             return self._mem_wait.popleft()
         try:
-            return self._q.get(timeout=wait) if wait \
-                else self._q.get_nowait()
+            return self._q.get_nowait()
         except queue.Empty:
-            return None
+            pass
+        if self._defer_q:
+            try:
+                r = self._defer_q.popleft()
+            except IndexError:          # raced a concurrent drain
+                return None
+            # leaving the deferred line: its work joins the backlog now
+            with self._work_lock:
+                if not r.work_counted:
+                    self._work_tokens += r.work_left
+                    r.work_counted = True
+            return r
+        if wait:
+            try:
+                return self._q.get(timeout=wait)
+            except queue.Empty:
+                return None
+        return None
 
     def _admit_pending(self, timeout=0.0):
         """Fill free slots from the queue. `timeout` blocks on the FIRST
@@ -528,11 +885,12 @@ class ContinuousDecodeServer(_RequestLoop):
         if not self._running and not self._drain_on_stop:
             # fail-fast stop: queued requests must NOT be admitted into
             # freed slots — the loop's final drain fails them once the
-            # busy slots finish. The memory-wait line is failed HERE,
-            # not at loop exit: parked requests count as _busy(), so
-            # leaving them parked would keep the loop alive (and their
-            # futures unresolved) forever once the slots drain.
-            self._fail_mem_wait(ServerClosedError("server stopped"))
+            # busy slots finish. The memory-wait AND deferred lines are
+            # failed HERE, not at loop exit: parked requests count as
+            # _busy(), so leaving either parked would keep the loop
+            # alive (and their futures unresolved) forever once the
+            # slots drain.
+            self._fail_parked(ServerClosedError("server stopped"))
             return
         free = [s for s in range(self.slots) if self._slot_req[s] is None]
         if self._static and len(free) < self.slots:
@@ -551,8 +909,7 @@ class ContinuousDecodeServer(_RequestLoop):
                         time.monotonic() > req.deadline:
                     if _fail_future(req.future, DeadlineExceededError(
                             "deadline expired before prefill")):
-                        self.metrics.count("shed_deadline")
-                        self.metrics.record_slo_miss()
+                        self._deadline_miss(req, time.monotonic())
                     req = None
                 elif self._paged:
                     # admission gated by FREE BLOCKS, not free slots:
@@ -615,11 +972,33 @@ class ContinuousDecodeServer(_RequestLoop):
             if r.deadline is not None and now > r.deadline:
                 if _fail_future(r.future, DeadlineExceededError(
                         "deadline expired while blocked on KV blocks")):
-                    self.metrics.count("shed_deadline")
-                    self.metrics.record_slo_miss()
+                    self._deadline_miss(r, now)
             else:
                 keep.append(r)
         self._mem_wait = keep
+
+    def _expire_deferred(self, now):
+        """Deadline enforcement for brownout-DEFERRED requests: deferral
+        is queue wait too. One FIFO rotation of the line (popleft/append
+        are each atomic, so a concurrent submit's append is safe)."""
+        keep = []
+        for _ in range(len(self._defer_q)):
+            try:
+                r = self._defer_q.popleft()
+            except IndexError:
+                break
+            if r.future.done():
+                continue
+            if r.deadline is not None and now > r.deadline:
+                if _fail_future(r.future, DeadlineExceededError(
+                        "deadline expired while brownout-deferred")):
+                    self._deadline_miss(r, now, thrash=False)
+            else:
+                keep.append(r)
+        # keepers return to the FRONT in order: a submit appending
+        # concurrently lands BEHIND them, so the sweep preserves
+        # deferred-FIFO fairness instead of leapfrogging old requests
+        self._defer_q.extendleft(reversed(keep))
 
     def _evict_expired(self):
         """Mid-decode deadline enforcement: a slot whose request deadline
@@ -631,16 +1010,22 @@ class ContinuousDecodeServer(_RequestLoop):
         requests whose token budget outlives their latency budget."""
         now = time.monotonic()
         self._expire_mem_wait(now)
+        self._expire_deferred(now)
         evicted = False
         for s, r in enumerate(self._slot_req):
             if r is None or r.deadline is None or now <= r.deadline:
                 continue
+            mid_decode = r.pf_next is None
+            phase = (f"mid-decode after {len(r.generated)} tokens"
+                     if mid_decode else "during chunked prefill")
             if _fail_future(r.future, DeadlineExceededError(
-                    f"deadline expired mid-decode after "
-                    f"{len(r.generated)} tokens")):
-                self.metrics.count("shed_deadline")
-                self.metrics.count("evicted_mid_decode")
-                self.metrics.record_slo_miss()
+                    f"deadline expired {phase}")):
+                if mid_decode:
+                    # prefill-phase evictions stay OUT of this counter:
+                    # it is the decode-work-thrown-away signal the
+                    # overload A/B judges the admission predictor on
+                    self.metrics.count("evicted_mid_decode")
+                self._deadline_miss(r, now)
             self._free_slot(s)
             evicted = True
         if evicted:
@@ -666,44 +1051,197 @@ class ContinuousDecodeServer(_RequestLoop):
                     jnp.asarray(dst, jnp.int32))
             self.metrics.count("cow_copies")
 
-    def _fail_mem_wait(self, exc):
+    def _fail_parked(self, exc):
+        """Fail everything parked OUTSIDE the submit queue: the paged
+        memory-wait line and the brownout-deferred line (both count as
+        _busy(), so both must resolve before a stop may exit — the PR 8
+        memory-waiter livelock pin, extended to deferral)."""
         while self._mem_wait:
             r = self._mem_wait.popleft()
             if _fail_future(r.future, exc):
                 self.metrics.count("failed")
+        while self._defer_q:
+            try:
+                r = self._defer_q.popleft()
+            except IndexError:
+                break
+            if _fail_future(r.future, exc):
+                self.metrics.count("failed")
 
     def _fail_queued(self, exc):
-        """Queued = the submit queue AND the paged memory-wait line."""
-        self._fail_mem_wait(exc)
+        """Queued = the submit queue, the paged memory-wait line, AND
+        the brownout-deferred line."""
+        self._fail_parked(exc)
         super()._fail_queued(exc)
 
-    def _decode_iteration(self):
-        """One scheduling iteration for every occupied slot: one dispatch
-        per live param version, active mask restricted to that version's
-        slots. Plain mode advances every slot exactly one token;
-        speculative mode (`speculate=`) advances each slot 1..K tokens
-        per dispatch (per-slot positions already support ragged
-        advance)."""
+    def _observe_rate(self, tokens, dt, active=0):
+        """Feed one scheduling iteration into the admission estimator
+        and publish the live capacity estimate (no-op without admission
+        control)."""
+        if self._admission is None:
+            return
+        est = self._admission.estimator
+        est.observe(tokens, dt, active)
+        tps = est.tokens_per_second
+        if tps is not None:
+            self.metrics.record_service_rate(tps)
+
+    def _chunk_iteration(self, pf):
+        """Advance every PREFILLING slot one chunk (C prompt rows): one
+        chunk dispatch per live param version, active mask restricted to
+        that version's prefilling slots. A slot whose FINAL chunk lands
+        transitions to the decode phase: the last real row's argmax is
+        the first generated token (TTFT closes here, exactly as the
+        one-shot prefill's argmax closes it), the paged prompt blocks
+        commit to the prefix index only now (a failed chunk must never
+        leave garbage blocks matchable), and a one-token request
+        completes without ever decoding. Chunk dispatches count
+        `chunk_dispatches`, not `dispatches` — prefill work has never
+        been in the per-token dispatch counters."""
         import jax.numpy as jnp
+        C = self._chunk
+        tr = self._tracer
+        done_any = False
+        for v in sorted({r.version for _, r in pf}):
+            pf_v = [(s, r) for s, r in pf if r.version == v]
+            active = np.zeros((self.slots,), bool)
+            toks = np.zeros((self.slots, C), np.int32)
+            nrows = np.zeros((self.slots,), np.int32)
+            wfrom = np.zeros((self.slots,), np.int32)
+            wto = np.zeros((self.slots,), np.int32)
+            for s, r in pf_v:
+                active[s] = True
+                n = min(C, len(r.prompt) - r.pf_next)
+                nrows[s] = n
+                toks[s, :n] = r.prompt[r.pf_next:r.pf_next + n]
+                wfrom[s] = r.pf_wfrom
+                wto[s] = len(r.prompt)
+            aux, blocks = self._versions[v]
+
+            def dispatch():
+                if self._injector is not None:
+                    self._injector.fire("serve.batch")
+                if self._paged:
+                    return self._chunk_step(
+                        aux, blocks, self._cache,
+                        jnp.asarray(self._btabs), self._pos,
+                        jnp.asarray(toks), jnp.asarray(nrows),
+                        jnp.asarray(active), jnp.asarray(wfrom),
+                        jnp.asarray(wto))
+                return self._chunk_step(
+                    aux, blocks, self._cache, self._pos,
+                    jnp.asarray(toks), jnp.asarray(nrows),
+                    jnp.asarray(active))
+
+            # same donated-buffer retry contract as the decode step: the
+            # injector site sits BEFORE the compiled call; a failure
+            # inside it is terminal here (loop resets device state)
+            t0 = time.monotonic_ns() if tr.enabled else None
+            if self._retry is not None:
+                nxt, self._cache, self._pos = self._retry.call(
+                    dispatch,
+                    on_retry=lambda a, e, d: self.metrics.count(
+                        "retries"))
+            else:
+                nxt, self._cache, self._pos = dispatch()
+            self.metrics.count("chunk_dispatches")
+            for s, r in pf_v:
+                self._spend_work(r)     # one chunk = one work unit
+            nxt = np.asarray(nxt)
+            if t0 is not None:
+                # one prefill span per PREFILLING REQUEST over the
+                # shared chunk window, on its own request lane:
+                # decompose attributes the window to each prefilled
+                # request's prefill_ms, while co-resident decoders still
+                # see it as sched_gap — the before/after head-of-line
+                # metric chunking exists to shrink
+                dur = time.monotonic_ns() - t0
+                for s, r in pf_v:
+                    tr.emit("decode.prefill", t0, dur, cat="serve",
+                            track=f"req-{r.req_id}", trace_id=r.req_id,
+                            args={"chunk": int(nrows[s]), "slot": s})
+            t_now = time.monotonic()
+            for s, r in pf_v:
+                r.pf_next += int(nrows[s])
+                if r.pf_next < len(r.prompt):
+                    continue
+                r.pf_next = None        # final chunk: decode phase now
+                if self._paged:
+                    self._pool.commit(r.alloc)
+                    self.metrics.count("prefix_rows_total",
+                                       len(r.prompt))
+                    if r.alloc.shared_rows:
+                        self.metrics.count("prefix_rows_hit",
+                                           r.alloc.shared_rows)
+                first = int(nxt[s, int(nrows[s]) - 1])
+                r.generated.append(first)
+                r.t_last_tok = t_now
+                self.metrics.record_ttft(
+                    (r.t_last_tok - r.t_submit) * 1e3)
+                self._spend_work(r)     # the first token
+                if len(r.generated) >= r.max_new:
+                    # one-token request: done at prefill, never decodes
+                    # (_free_slot releases its blocks)
+                    self._complete(r, t_now)
+                    self._free_slot(s)
+                    done_any = True
+                    continue
+                self._tok[s] = first
+                if self._spec is not None:
+                    self._spec.draft.start(
+                        s, list(r.prompt) + r.generated)
+        if done_any:
+            self._gc_versions()
+
+    def _decode_iteration(self):
+        """One scheduling iteration: advance PREFILLING slots one chunk
+        each (chunked mode, `_chunk_iteration`), then one decode
+        dispatch per live param version over the DECODING slots, active
+        mask restricted to that version's slots. Plain mode advances
+        every decoding slot exactly one token; speculative mode
+        (`speculate=`) advances each slot 1..K tokens per dispatch
+        (per-slot positions already support ragged advance)."""
+        import jax.numpy as jnp
+        t_iter_start = time.monotonic()
         live = [(s, r) for s, r in enumerate(self._slot_req)
                 if r is not None]
         if not live:
             return False
+        pf = [(s, r) for s, r in live if r.pf_next is not None]
+        if pf:
+            self._chunk_iteration(pf)
+        # transitions/completions in the chunk pass may have changed the
+        # slot map: recompute the DECODING set
+        dec = [(s, r) for s, r in enumerate(self._slot_req)
+               if r is not None and r.pf_next is None]
+        # occupancy/live_streams recorded ONCE per scheduling iteration,
+        # from the post-chunk-pass occupied count (prefilling slots
+        # included, freed one-token slots excluded) — identical
+        # semantics in plain and speculative modes
+        n_occ = sum(1 for r in self._slot_req if r is not None)
+        if n_occ:
+            self.metrics.record_occupancy(n_occ, self.slots)
+            self.metrics.record_live_streams(n_occ)
+        if not dec:
+            # pure prefill pass: zero tokens — the estimator accumulates
+            # this pass's wall time into the next token-bearing sample
+            # (prefill cost must dilute the measured rate, not vanish)
+            self._observe_rate(0, time.monotonic() - t_iter_start, 0)
+            self._after_iteration()
+            return True
         if self._spec is not None:
-            return self._spec_iteration(live)
+            return self._spec_iteration(dec, t_iter_start)
         tr = self._tracer
         t_iter0 = time.monotonic_ns() if tr.enabled else None
-        self.metrics.record_occupancy(len(live), self.slots)
-        self.metrics.record_live_streams(len(live))
         if self._paged:
-            self._materialize_cow(live)
+            self._materialize_cow(dec)
             self.metrics.record_pool(self._pool.blocks_in_use,
                                      self._pool.capacity)
-        versions = sorted({r.version for _, r in live})
+        versions = sorted({r.version for _, r in dec})
         new_tok = {}
         for v in versions:
             active = np.zeros((self.slots,), bool)
-            for s, r in live:
+            for s, r in dec:
                 if r.version == v:
                     active[s] = True
             aux, blocks = self._versions[v]
@@ -714,10 +1252,12 @@ class ContinuousDecodeServer(_RequestLoop):
                 if self._paged:
                     return self._step(aux, blocks, self._cache,
                                       jnp.asarray(self._btabs),
-                                      self._pos, self._tok,
+                                      self._pos,
+                                      jnp.asarray(self._tok),
                                       jnp.asarray(active))
                 return self._step(aux, blocks, self._cache, self._pos,
-                                  self._tok, jnp.asarray(active))
+                                  jnp.asarray(self._tok),
+                                  jnp.asarray(active))
 
             # NOTE on retry composition: cache/pos are donated, so a
             # failure INSIDE the compiled call is not retryable at this
@@ -735,15 +1275,16 @@ class ContinuousDecodeServer(_RequestLoop):
                     nxt, _, self._cache, self._pos = dispatch()
             self.metrics.count("dispatches")
             nxt = np.asarray(nxt)
-            for s, r in live:
+            for s, r in dec:
                 if r.version == v:
                     new_tok[s] = int(nxt[s])
-        self._tok = jnp.asarray(
-            [new_tok.get(s, 0) for s in range(self.slots)], jnp.int32)
-        self.metrics.count("tokens_out", len(live))
+        self.metrics.count("tokens_out", len(dec))
+        for s, r in dec:
+            self._spend_work(r)
         done_any = False
         t_now = time.monotonic()
-        for s, r in live:
+        for s, r in dec:
+            self._tok[s] = new_tok[s]
             r.generated.append(new_tok[s])
             # one inter-token sample per decode iteration per slot
             if r.t_last_tok is not None:
@@ -764,14 +1305,16 @@ class ContinuousDecodeServer(_RequestLoop):
             tr.emit("decode.iteration", t_iter0,
                     time.monotonic_ns() - t_iter0, cat="serve",
                     track="server",
-                    args={"slot_occupancy": len(live) / self.slots,
-                          "accepted": len(live)})
+                    args={"slot_occupancy": n_occ / self.slots,
+                          "accepted": len(dec)})
+        self._observe_rate(len(dec), time.monotonic() - t_iter_start,
+                           len(dec))
         if done_any:
             self._gc_versions()
         self._after_iteration()
         return True
 
-    def _spec_iteration(self, live):
+    def _spec_iteration(self, live, t_iter_start=None):
         """One SPECULATIVE iteration: per live version, gather each
         slot's draft (K-1 tokens, zero-padded — padding costs acceptance,
         never correctness), run ONE K-wide verify dispatch, and advance
@@ -782,13 +1325,16 @@ class ContinuousDecodeServer(_RequestLoop):
         parity, speculate.py). Draft and verify are both evaluated
         under the slot's pinned param version (`r.version`); the draft
         source itself needs no pinning because a mismatched draft cannot
-        alter accepted tokens."""
+        alter accepted tokens. `live` is the DECODING slot set (chunked
+        mode runs prefilling slots through `_chunk_iteration` first)."""
         import jax.numpy as jnp
+        if t_iter_start is None:
+            t_iter_start = time.monotonic()
         tr = self._tracer
         t_iter0 = time.monotonic_ns() if tr.enabled else None
         n_accepted = 0
-        self.metrics.record_occupancy(len(live), self.slots)
-        self.metrics.record_live_streams(len(live))
+        # occupancy/live_streams were recorded by _decode_iteration
+        # (one record per scheduling iteration, both modes)
         K = self._spec.k
         draft = self._spec.draft
         d0 = getattr(draft, "dispatch_count", 0)   # ModelDraft device cost
@@ -849,6 +1395,7 @@ class ContinuousDecodeServer(_RequestLoop):
                 r.t_last_tok = t_now
                 n_accepted += take
                 self.metrics.count("tokens_out", take)
+                self._spend_work(r, take)
                 # drafted = REAL draft tokens (zero-padding is not a
                 # draft); matched likewise capped — a pad that happens to
                 # equal the argmax is accepted (it IS the argmax) but
@@ -874,6 +1421,8 @@ class ContinuousDecodeServer(_RequestLoop):
                     args={"slot_occupancy": len(live) / self.slots,
                           "accepted": n_accepted,
                           "draft_dispatches": dd})
+        self._observe_rate(n_accepted, time.monotonic() - t_iter_start,
+                           len(live))
         if done_any:
             self._gc_versions()
         self._after_iteration()
@@ -898,7 +1447,7 @@ class ContinuousDecodeServer(_RequestLoop):
 
     def _busy(self):
         return any(r is not None for r in self._slot_req) \
-            or bool(self._mem_wait)
+            or bool(self._mem_wait) or bool(self._defer_q)
 
     def _loop_once(self):
         # evict deadline-expired slots FIRST so the admit below can refill

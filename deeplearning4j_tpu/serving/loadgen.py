@@ -241,7 +241,7 @@ def run_load(server, schedule, submit=None, metrics=None,
     run's books.
     """
     from ..obs.registry import bucket_quantile, fmt, percentile
-    from .metrics import slo_view
+    from .metrics import shed_view, slo_view
 
     submit = submit or _default_submit
     if metrics is None:
@@ -365,7 +365,11 @@ def run_load(server, schedule, submit=None, metrics=None,
             out[k + "_p50"] = fmt(bucket_quantile(h.buckets, delta, 50))
             out[k + "_p99"] = fmt(bucket_quantile(h.buckets, delta, 99))
             out[k + "_count"] = sum(delta)
-        for c in ("shed_queue_full", "shed_deadline",
-                  "evicted_mid_decode"):
-            out[c] = snap.get(c, 0) - (base or {}).get(c, 0)
+        # shed-reason BREAKDOWN (the one shed_view implementation):
+        # `shed_at_submit` above counts what THIS generator saw; the
+        # per-cause deltas say why — queue backpressure vs deadline
+        # expiry vs KV-block shortage vs predicted-miss admission vs
+        # brownout policy — which is the difference between "the server
+        # dropped work" and "overload control worked as designed"
+        out["sheds"] = shed_view(snap, base)
     return out

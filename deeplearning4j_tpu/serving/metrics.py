@@ -40,6 +40,18 @@ deadline-attainment and goodput-under-SLO numerators the ROADMAP's
 production-traffic harness starts from. Shed/evicted deadline-carrying
 requests count as misses: attainment is over requests ADMITTED to an
 SLO, not just the ones that survived to completion.
+
+Overload-control view (PR 9, serving/admission.py): the decode server's
+service-rate estimator publishes `service_rate_tokens_per_sec` (gauge)
+and the signed `admission_error_ms` histogram — (predicted - actual)
+completion error per completed request, NEGATIVE when the estimator was
+optimistic (the dangerous direction: optimism admits doomed requests,
+pessimism sheds feasible ones) — so a wrongly-shedding estimator is
+visible on the Prometheus route before it costs goodput. The shed
+counters split by CAUSE (`shed_queue_full` / `shed_deadline` /
+`shed_blocks` / `shed_predicted` / `shed_brownout`), rendered together
+by `shed_view()` — the one breakdown implementation behind
+loadgen/load_sweep/serve_ab/bench, as `slo_view` is for goodput.
 """
 from __future__ import annotations
 
@@ -48,7 +60,7 @@ import itertools
 from ..obs.registry import (MetricsRegistry, bucket_quantile, fmt,
                             percentile as _pct)
 
-__all__ = ["ServingMetrics", "fmt", "slo_view"]
+__all__ = ["ServingMetrics", "fmt", "slo_view", "shed_view"]
 
 _ANON = itertools.count()
 
@@ -80,6 +92,26 @@ def slo_view(snap, throughput=None, base=None):
         out["goodput_fraction"] = fmt(frac, 4)
         out["goodput_requests_per_sec"] = fmt(throughput * frac, 1)
     return out
+
+
+def shed_view(snap, base=None):
+    """Shed-reason breakdown from one snapshot() dict (deltas vs `base`,
+    like `slo_view`): the distinct counters behind what used to print as
+    one "sheds" number. ONE implementation shared by
+    `serving.loadgen.run_load`, `tools/load_sweep.py`,
+    `tools/serve_ab.py`, and bench.py so the column set cannot drift
+    between reports. `evicted_mid_decode` rides along (it is the shed
+    the admission predictor exists to prevent: work paid for, then
+    thrown away)."""
+    def delta(key):
+        return snap.get(key, 0) - (base.get(key, 0) if base else 0)
+
+    return {"shed_queue": delta("shed_queue_full"),
+            "shed_deadline": delta("shed_deadline"),
+            "shed_blocks": delta("shed_blocks"),
+            "shed_predicted": delta("shed_predicted"),
+            "shed_brownout": delta("shed_brownout"),
+            "evicted_mid_decode": delta("evicted_mid_decode")}
 
 
 class ServingMetrics:
@@ -127,6 +159,17 @@ class ServingMetrics:
         hist = self.registry.histogram
         self._ttft_ms = hist(p + "ttft_ms")
         self._inter_token_ms = hist(p + "inter_token_ms")
+        # admission-estimator observability (serving/admission.py):
+        # signed (predicted - actual) completion error — the grid spans
+        # NEGATIVE bounds because optimistic predictions (actual later
+        # than predicted) are the dangerous direction and must not be
+        # folded into the first nonnegative bucket
+        self._admission_error_ms = hist(
+            p + "admission_error_ms",
+            buckets=(-10000, -2500, -1000, -250, -100, -25, 0,
+                     25, 100, 250, 1000, 2500, 10000))
+        self._service_rate = self.registry.gauge(
+            p + "service_rate_tokens_per_sec")
         # paged KV-cache view (serving/kvpool.py): arena pressure as a
         # reservoir (last/max like queue depth), capacity as a gauge,
         # live decode streams as a reservoir whose MAX is the measured
@@ -188,6 +231,19 @@ class ServingMetrics:
         stream rate the user sees, not the per-dispatch stall)."""
         self._inter_token_ms.observe(float(ms))
 
+    def record_admission_error(self, ms):
+        """Signed (predicted - actual) completion error for one request
+        the admission estimator made a prediction for: positive =
+        pessimistic (finished earlier than predicted), negative =
+        optimistic (the direction that admits doomed requests)."""
+        self._admission_error_ms.observe(float(ms))
+
+    def record_service_rate(self, tokens_per_sec):
+        """The admission estimator's current aggregate decode rate,
+        published once per scheduling iteration — the live capacity
+        number predictions divide by."""
+        self._service_rate.set(float(tokens_per_sec))
+
     def record_queue_depth(self, depth):
         """Depth sample OUTSIDE batch formation (enqueue / shed time) —
         the staleness fix: an idle-then-bursty server reports admission
@@ -232,12 +288,15 @@ class ServingMetrics:
 
     # -- read-out ------------------------------------------------------
     def latency_histograms(self):
-        """The per-token SLO histograms by snapshot key — the PUBLIC
+        """The cumulative-bucket histograms by snapshot key — the PUBLIC
         handle `serving.loadgen.run_load` uses for per-run bucket-count
         deltas (reaching for the private attributes would degrade
-        silently on a rename)."""
+        silently on a rename). `admission_error_ms` rides with the SLO
+        pair so a sweep point reports the estimator's per-run error
+        distribution next to its TTFT."""
         return {"ttft_ms": self._ttft_ms,
-                "inter_token_ms": self._inter_token_ms}
+                "inter_token_ms": self._inter_token_ms,
+                "admission_error_ms": self._admission_error_ms}
 
     def count_value(self, key):
         from ..obs.registry import Counter
@@ -319,6 +378,14 @@ class ServingMetrics:
         out.setdefault("cow_copies", 0)
         out.setdefault("blocked_on_memory", 0)
         out.setdefault("shed_blocks", 0)
+        # overload-control view (serving/admission.py): always-present
+        # keys so dashboards and the overload A/Bs read one stable
+        # surface on any server, controlled or not
+        out.setdefault("shed_predicted", 0)
+        out.setdefault("shed_brownout", 0)
+        out.setdefault("deferred", 0)
+        out.setdefault("chunk_dispatches", 0)
+        out["service_rate_tokens_per_sec"] = self._service_rate.value
         out["prefix_hit_rate"] = (
             out["prefix_rows_hit"] / out["prefix_rows_total"]
             if out["prefix_rows_total"] else None)

@@ -353,6 +353,45 @@ class TestSmokeSweep:
         assert os.path.exists(out + ".txt")
         assert os.path.exists(out + ".trace.json")
 
+    def test_smoke_sweep_overload_goodput_monotone(self):
+        """The ISSUE 9 monotonicity pin at smoke scale: one at-knee-ish
+        rate and one FAR-past-knee rate through the overload-controlled
+        decode server (chunked prefill + deadline-aware admission,
+        deadline = SLO). Goodput at the past-knee rate must be >= the
+        knee-rate goodput — the baseline curve's pinned behavior at the
+        same point is a COLLAPSE (PR 7: 2,515 -> 635 tok/s), which is
+        exactly what overload control exists to prevent. The margin is
+        structural, not statistical: the low-rate point's goodput is
+        bounded by its tiny offered rate while the past-knee point runs
+        at machine capacity. Report uploads next to the other smoke
+        sweeps (tier1.yml)."""
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        mod = importlib.import_module("load_sweep")
+        out = os.path.join(
+            os.environ.get("SMOKE_REPORT_DIR") or tempfile.gettempdir(),
+            "load_sweep_smoke_overload")
+        res = mod.run_sweep(server="decode", rates=(40.0, 2000.0),
+                            n_req=8, slo_ms=500.0, seed=0, trace=False,
+                            report_path=out, chunked_prefill=4,
+                            admission=True)
+        (decode,) = res
+        assert decode["overload_control"] is True
+        knee_pt, past_pt = decode["curve"]
+        g_knee = (knee_pt.get("slo") or {}).get(
+            "goodput_tokens_per_sec") or 0.0
+        g_past = (past_pt.get("slo") or {}).get(
+            "goodput_tokens_per_sec") or 0.0
+        assert g_knee > 0
+        assert g_past >= g_knee, (
+            f"goodput collapsed past the knee: {g_past} < {g_knee}")
+        # the shed-reason breakdown columns ride every sweep point
+        assert set(past_pt["sheds"]) == {
+            "shed_queue", "shed_deadline", "shed_blocks",
+            "shed_predicted", "shed_brownout", "evicted_mid_decode"}
+
     def test_smoke_sweep_paged_mode(self):
         """One PAGED-mode sweep rate in tier-1: the same loadgen
         arrivals through `ContinuousDecodeServer(paged=True)`, so every

