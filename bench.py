@@ -32,6 +32,12 @@ plus beyond-reference extras (budget permitting, skipped first):
                         ARENA BYTES, mixed lengths behind a shared
                         system prefix — max concurrent streams, prefix
                         hit rate, tokens/s (streams pinned bit-identical)
+ 11b. paged_speculative_decode  speculation OVER the paged cache
+                        (ISSUE 10: block-table verify program) vs paged
+                        plain decode, same arena both arms —
+                        dispatches/token + tokens/s headline, the PR 5
+                        amortization on the PR 8 memory model (streams
+                        pinned bit-identical)
  12. load_sweep         production-traffic harness (serving/loadgen.py):
                         seeded Poisson arrivals at a 3-rate ladder
                         through the ContinuousDecodeServer — achieved
@@ -926,6 +932,130 @@ def bench_paged_decode(rng, small=False):
     return rec
 
 
+def bench_paged_speculative(rng, small=False):
+    """Speculative decode OVER the paged KV cache vs paged plain decode
+    (ISSUE 10: the block-table verify program — the PR 5 dispatch
+    amortization re-measured on the PR 8 memory model;
+    tools/serve_ab.py `paged_spec_vs_paged` is the richer standalone).
+    BOTH arms run the identical paged server config (block-table arena,
+    shared system prefix stored once, slots a scheduling width); only
+    the spec arm drafts (K=4 n-gram prompt-lookup) and verifies K
+    tokens per dispatch through `make_paged_verify_fn`. Streams are
+    pinned bit-identical (tests/test_paged.py), so the headline is
+    dispatches/token vs the paged baseline next to tokens/s — on a
+    remote-attached chip every saved dispatch is a tunnel round-trip,
+    so the production configuration (paged memory + speculation) is
+    exactly where the win matters."""
+    import numpy as np
+
+    from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            NGramDraft, ServingMetrics,
+                                            Speculator)
+
+    V, L, D, H = (96, 2, 32, 2) if small else (256, 4, 256, 8)
+    max_len = 96 if small else 160
+    slots = 8 if small else 16
+    bs = 8 if small else 16
+    n_blocks = (48 if small else 80)     # arena rows = n_blocks * bs
+    n_req = 16 if small else 24
+    train_steps = 60 if small else 150
+    lm = TransformerLM(V, d_model=D, n_heads=H, n_layers=L,
+                       max_len=max_len, seed=5, learning_rate=0.3)
+    T = 32
+    r = np.random.default_rng(0)
+    for _ in range(train_steps):        # off the clock: cycle continuation
+        xs = []
+        for _ in range(16):
+            pat = r.integers(1, V, int(r.integers(2, 5))).tolist()
+            xs.append((pat * (T // len(pat) + 2))[:T + 1])
+        xs = np.asarray(xs, np.int32)
+        lm.fit_batch(xs[:, :-1], xs[:, 1:])
+    sys_prefix = np.random.default_rng(7).integers(1, V, 16).tolist()
+
+    def workload(seed, n):
+        rr = np.random.default_rng(seed)
+        out = []
+        for _ in range(n):
+            pat = rr.integers(1, V, int(rr.integers(2, 5))).tolist()
+            p = sys_prefix + (pat * 8)[:int(rr.integers(4, 15))]
+            out.append((p, int(rr.integers(16, 41))))
+        return out
+
+    slo_ms = 100.0
+    paged_kw = dict(slots=slots, prompt_buckets=(32,),
+                    max_queue=4 * n_req, paged=True, block_size=bs,
+                    n_blocks=n_blocks)
+    servers = {
+        "paged_spec": ContinuousDecodeServer(
+            lm, speculate=Speculator(NGramDraft(n=3), k=4),
+            metrics=ServingMetrics(slo_target_ms=slo_ms),
+            **paged_kw).start(),
+        "paged": ContinuousDecodeServer(
+            lm, metrics=ServingMetrics(slo_target_ms=slo_ms),
+            **paged_kw).start(),
+    }
+    for srv in servers.values():       # compile off the clock
+        for p, n in workload(0, 4):
+            srv.generate(p, n, timeout=300)
+    base = {n: servers[n].metrics.snapshot() for n in servers}
+
+    seg_idx = {name: [0] for name in servers}
+
+    def seg(name):
+        srv = servers[name]
+
+        def run():
+            work = workload(100 + seg_idx[name][0], n_req)
+            seg_idx[name][0] += 1
+            toks = sum(n for _, n in work)
+            t0 = time.perf_counter()
+            for f in [srv.submit(p, n) for p, n in work]:
+                f.result(600)
+            return toks / (time.perf_counter() - t0)
+        return run
+
+    ab = _interleaved_median({n: seg(n) for n in servers},
+                             segments=3 if small else 5)
+    snaps = {n: servers[n].metrics.snapshot() for n in servers}
+    for srv in servers.values():
+        srv.stop()
+    s = snaps["paged_spec"]
+    dpt = {n: snaps[n]["dispatches_per_token"] for n in snaps}
+    rec = {"value": ab["paged_spec"]["median"], "unit": "tokens/sec",
+           "config": f"ContinuousDecodeServer L={L} d={D}, BOTH arms "
+                     f"paged {n_blocks} blocks x {bs} (slots={slots} "
+                     f"scheduling width), 16-token shared prefix + "
+                     f"repetitive prompts, n-gram draft K=4 on the "
+                     f"spec arm, {n_req} reqs/seg (streams "
+                     f"bit-identical)",
+           "paged_spec_ab": ab,
+           "speedup_spec_over_paged": round(
+               ab["paged_spec"]["median"] / ab["paged"]["median"], 3),
+           "dispatches_per_token_ratio": round(
+               dpt["paged_spec"] / dpt["paged"], 3),
+           "vs_baseline": round(ab["paged_spec"]["median"]
+                                / BASELINE_DECODE_TOKENS_PER_SEC, 3)}
+    from deeplearning4j_tpu.obs.registry import fmt
+    from deeplearning4j_tpu.serving.metrics import slo_view
+    for n, snp in snaps.items():
+        rec[f"dispatches_per_token_{n}"] = fmt(dpt[n], 4)
+        rec[f"p50_request_ms_{n}"] = fmt(snp["latency_ms_p50"])
+        rec[f"p99_request_ms_{n}"] = fmt(snp["latency_ms_p99"])
+        rec[f"live_streams_max_{n}"] = snp["live_streams_max"]
+        view = slo_view(snp, ab[n]["median"], base[n])
+        rec[f"slo_attainment_{n}"] = view["attainment"]
+        rec[f"goodput_tokens_per_sec_{n}"] = view.get(
+            "goodput_tokens_per_sec")
+    rec["slo_ms"] = slo_ms
+    rec["acceptance_rate"] = fmt(s["spec_acceptance_rate_mean"], 4)
+    rec["accepted_per_dispatch"] = fmt(
+        s["spec_accepted_per_dispatch_mean"], 3)
+    rec["prefix_hit_rate"] = fmt(s["prefix_hit_rate"], 4)
+    rec["cow_copies"] = s["cow_copies"]
+    return rec
+
+
 def bench_load_sweep(rng, small=False):
     """One pinned traffic-harness sweep point (the ISSUE 7 acceptance
     metric): seeded open-loop Poisson arrivals through the REAL
@@ -1068,6 +1198,10 @@ SECONDARY_CONFIGS = {
     # paged KV cache (ISSUE 8): concurrency at equal arena bytes —
     # max live streams + tokens/s, paged vs fixed-slot cache
     "paged_decode": (bench_paged_decode, 110),
+    # speculation over the paged cache (ISSUE 10): dispatches/token +
+    # tokens/s vs the paged baseline — the PR 5 amortization on the
+    # PR 8 memory model (the production configuration)
+    "paged_speculative_decode": (bench_paged_speculative, 120),
     # the traffic-harness pinned sweep point (ISSUE 7): arrivals +
     # queueing, not backlog replay — knee + goodput-under-SLO per
     # record, plus the PR 9 overload-control goodput A/B at the top rate
@@ -1324,6 +1458,7 @@ def main():
             backlog_first = ("resnet50_remat", "flash_attention_8k",
                              "char_rnn_lstm", "char_rnn_lstm_unroll",
                              "decode_tokens_sec", "speculative_decode",
+                             "paged_speculative_decode",
                              "resnet50_fit_pipeline")
             rerun_order = ([n for n in backlog_first
                             if n in SECONDARY_CONFIGS]

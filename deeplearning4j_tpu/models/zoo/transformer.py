@@ -724,35 +724,42 @@ def make_paged_install_fn(block_size):
     return install
 
 
-def make_paged_chunk_block_fn(n_heads, block_size):
+def make_paged_verify_block_fn(n_heads, block_size):
     """`make_paged_decode_block_fn` widened to K query positions per
-    slot: the per-block unit of CHUNKED PREFILL over the paged cache
-    (the paged twin of `make_slot_verify_block_fn`).
+    slot: the per-block unit of the K-wide programs over the PAGED
+    cache — speculative decoding's VERIFY dispatch and chunked
+    prefill's chunk dispatch share it, exactly as the fixed layout's
+    `make_slot_verify_block_fn` is shared by its verify and chunk
+    programs (one K-wide program per layout, so the two roles can
+    never drift).
 
-    block_chunk(p, x [S, K, D], cache {k,v: [n_rows, H, hd]},
-                btab [S, NB], pos [S], active [S] bool,
-                wfrom [S], wto [S]) -> (y [S, K, D], updated cache)
+    block_verify(p, x [S, K, D], cache {k,v: [n_rows, H, hd]},
+                 btab [S, NB], pos [S], active [S] bool,
+                 wfrom [S], wto [S]) -> (y [S, K, D], updated cache)
 
     Slot s's K inputs sit at LOGICAL rows pos[s]..pos[s]+K-1; their k/v
     land at the table-mapped physical rows, all written BEFORE attention
-    (exactly as the verify block fills its window), and query i attends
-    causally to logical rows <= pos[s]+i through the block-table gather.
-    Gating is by INDEX like every paged write (gated_cache_rows
-    gate=None): a row writes only when its slot is active AND its
-    logical position falls in [wfrom[s], wto[s]) — the write window.
-    The window is what makes chunked prefill COMPOSE with prefix reuse
-    and with chunk padding: rows below wfrom are a prefix-cache hit
-    (physically resident, possibly refcount > 1 — recomputed bits equal
-    the resident bits, the measured per-row batch-shape independence,
-    so they are computed for attention but never written), and rows at
-    or past wto are the final chunk's bucket padding, whose logical
-    position may exceed the request's RESERVED block table — an
-    ungated write there would resolve through a zeroed table entry to
-    physical block 0 and corrupt whichever stream owns it. Suppressed
-    rows go out of range; the drop-mode scatter discards them."""
+    (exactly as the fixed verify block fills its window), and query i
+    attends causally to logical rows <= pos[s]+i through the
+    block-table gather. Gating is by INDEX like every paged write
+    (gated_cache_rows gate=None): a row writes only when its slot is
+    active AND its logical position falls in [wfrom[s], wto[s]) — the
+    write window. The window is what makes the K-wide shape SAFE over
+    a block table: rows below wfrom are a prefix-cache hit (physically
+    resident, possibly refcount > 1 — recomputed bits equal the
+    resident bits, the measured per-row batch-shape independence, so
+    they are computed for attention but never written; the verify
+    caller passes wfrom = pos, every verify row being a new write),
+    and rows at or past wto — chunk bucket padding, or a speculative
+    round's overhang near the end of a request's reservation — have a
+    logical position that may exceed the request's RESERVED block
+    table: an ungated write there would resolve through a zeroed table
+    entry to physical block 0 and corrupt whichever stream owns it.
+    Suppressed rows go out of range; the drop-mode scatter discards
+    them."""
     bs = int(block_size)
 
-    def block_chunk(p, x, cache, btab, pos, active, wfrom, wto):
+    def block_verify(p, x, cache, btab, pos, active, wfrom, wto):
         S, K, D = x.shape
         H = n_heads
         hd = D // H
@@ -794,7 +801,78 @@ def make_paged_chunk_block_fn(n_heads, block_size):
         y = x + m @ p["mlp"]["w2"] + p["mlp"]["b2"]
         return y, cache
 
-    return block_chunk
+    return block_verify
+
+
+def make_paged_chunk_block_fn(n_heads, block_size):
+    """Chunked prefill's per-block unit over the paged cache: the ONE
+    K-wide paged block program (`make_paged_verify_block_fn`) under its
+    chunk-role name — kept so the two roles are named at their call
+    sites while the program itself cannot drift."""
+    return make_paged_verify_block_fn(n_heads, block_size)
+
+
+def make_paged_verify_fn(n_heads, k, block_size):
+    """`make_slot_verify_fn` re-addressed through the block table: one
+    SPECULATIVE iteration of paged continuous-batching decode — up to K
+    tokens per device dispatch, the whole model:
+
+    verify(aux, blocks, cache, btabs [S, NB], pos [S], toks [S, K],
+           active [S], wto [S])
+      -> (nxt [S, K] i32, n_acc [S] i32, logits [S, K, V] f32,
+          new cache, new pos)
+
+    Identical contract to the fixed-layout verify — toks[s, 0] is the
+    last accepted token, toks[s, 1:] are K-1 drafts, all K k/v rows are
+    written before attention, acceptance is the on-device
+    longest-prefix argmax match, pos advances n_acc+1 — with the cache
+    swapped for arena + block tables. Writes land at the table-mapped
+    frontier rows pos[s]..pos[s]+K-1 under the SAME [wfrom, wto)
+    index gating the paged chunk program uses (wfrom = pos: every
+    verify row is a new write; wto = the slot's reserved row capacity,
+    `BlockPool.writable_rows` — an ungated overhang write near the end
+    of a reservation would resolve through btab entry 0 into another
+    stream's block); attention gathers the slot's whole logical window
+    through the table and runs the identical einsum/softmax, so
+    per-logical-row bits equal the fixed verify's (masked rows are
+    exact softmax zeros — the window length is free to differ).
+    Rejected-suffix rows are dead rows inside blocks the request
+    already owns: the pointer never passed them and the next round's
+    K-wide write covers them before any query attends (the fixed
+    verify's bucket-prefill argument, unchanged by paging). A round
+    that crosses a block boundary writes into blocks the reservation
+    already holds — `admit()` reserved every row the request will ever
+    write, so speculation adds NO allocation path — and a CoW-shared
+    partial block must be materialized by the scheduler BEFORE the
+    first verify dispatch, exactly as before the first 1-wide append
+    (the K-wide write starts at the frontier, inside that block).
+    Consumed tokens need their query's whole row range written:
+    positions past the reservation emit garbage logits, but the host's
+    `take = min(n_acc+1, remaining budget)` cap — the same cap the
+    fixed path applies — stops consumption at rows the reservation
+    covers, so gating changes no consumed token's bits."""
+    block_verify = make_paged_verify_block_fn(n_heads, block_size)
+    k = int(k)
+    if k < 1:
+        raise ValueError(f"speculative width k must be >= 1, got {k}")
+
+    def verify(aux, blocks, cache, btabs, pos, toks, active, wto):
+        max_len = aux["pos"].shape[0]
+        pcols = jnp.clip(pos[:, None] + jnp.arange(k)[None, :],
+                         0, max_len - 1)
+        x = aux["tok"][toks] + aux["pos"][pcols]        # [S, K, D]
+        new_cache = []
+        for p, c in zip(blocks, cache):
+            x, c = block_verify(p, x, c, btabs, pos, active, pos, wto)
+            new_cache.append(c)
+        logits = logits_fn(aux, x).astype(jnp.float32)  # [S, K, V]
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)  # [S, K]
+        match = (nxt[:, :k - 1] == toks[:, 1:]).astype(jnp.int32)
+        n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # 0..K-1
+        new_pos = pos + jnp.where(active, n_acc + 1, 0).astype(pos.dtype)
+        return nxt, n_acc.astype(jnp.int32), logits, new_cache, new_pos
+
+    return verify
 
 
 def make_chunked_prefill_fn(n_heads, chunk, block_size=None):

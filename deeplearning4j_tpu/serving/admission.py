@@ -101,18 +101,44 @@ class ServiceRateEstimator:
 
     `slots` is the scheduling width predictions scale capacity by; the
     decode server fills it in at construction when the caller left it
-    None."""
+    None.
 
-    def __init__(self, slots=None, alpha=0.2, min_samples=8, window=64):
+    VARIANCE-AWARE MARGIN (`margin`): under speculation the per-slot
+    rate is 1..K tokens per iteration and swings with the workload's
+    self-similarity — a few lucky high-acceptance iterations inflate
+    the EWMA, the inflated rate admits marginal requests, acceptance
+    reverts, and they die mid-decode (admit-then-evict thrash, the
+    high-variance twin of the optimism the bias loop corrects —
+    except the bias loop only learns AFTER evictions, while variance
+    is visible BEFORE). Predictions therefore use a CONSERVATIVE rate:
+    mean minus `margin` standard deviations (EWMA variance over the
+    same samples), floored at 1.0 token/slot/iteration — the floor is
+    structural, not a tuning: every decoding slot advances at least
+    its bonus token per token-bearing iteration, so 1.0 is always
+    achievable and the never-sheds-feasible-solo invariant survives
+    any margin (a request whose deadline covers its worst-case
+    1-token-per-iteration solo run predicts within budget by
+    construction — pinned by property test in tests/test_overload.py).
+    Plain decode has zero variance (every sample is exactly 1.0), so
+    the margin is structurally free there. The `tokens_per_second`
+    gauge keeps reporting the MEAN — it is the capacity/autoscaling
+    read-out, not an admission decision."""
+
+    def __init__(self, slots=None, alpha=0.2, min_samples=8, window=64,
+                 margin=1.0):
         self.alpha = float(alpha)
         if not 0.0 < self.alpha <= 1.0:
             raise ValueError(f"need 0 < alpha <= 1, got {alpha}")
+        self.margin = float(margin)
+        if self.margin < 0.0:
+            raise ValueError(f"need margin >= 0, got {margin}")
         self.slots = None if slots is None else int(slots)
         self.min_samples = int(min_samples)
         self.samples = 0
         self._iters = collections.deque(maxlen=int(window))
         self._s_iter = None     # rolling MEDIAN of the window above
         self._tok_slot = None   # EWMA tokens per ACTIVE slot per iter
+        self._tok_var = 0.0     # EWMA variance of the same samples
         # delivered-rate window: (tokens, dt) per iteration — the
         # MEASURED aggregate rate, chunk passes/churn/host contention
         # and all. Under confirmed overload this is the true capacity
@@ -138,9 +164,17 @@ class ServiceRateEstimator:
                 return
             if active > 0:
                 per_slot = tokens / float(active)
-                self._tok_slot = (per_slot if self._tok_slot is None
-                                  else self.alpha * per_slot
-                                  + (1.0 - self.alpha) * self._tok_slot)
+                if self._tok_slot is None:
+                    self._tok_slot = per_slot
+                else:
+                    # EWMA mean + EWMA variance (deviation measured
+                    # against the PRE-update mean — the standard
+                    # exponentially-weighted pair)
+                    dev = per_slot - self._tok_slot
+                    self._tok_var = ((1.0 - self.alpha)
+                                     * (self._tok_var
+                                        + self.alpha * dev * dev))
+                    self._tok_slot += self.alpha * dev
             self.samples += 1
 
     @property
@@ -166,10 +200,25 @@ class ServiceRateEstimator:
     @property
     def tokens_per_second(self):
         """Full-occupancy capacity estimate (slots x per-slot rate /
-        iteration time) — the `service_rate_tokens_per_sec` gauge."""
+        iteration time) — the `service_rate_tokens_per_sec` gauge.
+        Reports the MEAN rate (the capacity/autoscaling read-out);
+        admission predictions use the variance-margined rate below."""
         if not self.ready:
             return None
         return self.slots * (self._tok_slot or 1.0) / self._s_iter
+
+    @property
+    def tokens_per_slot_conservative(self):
+        """The per-slot rate predictions divide by: EWMA mean minus
+        `margin` EWMA standard deviations, floored at the structural
+        1.0 token/slot/iteration worst case (every decoding slot lands
+        at least its bonus token) and never above the mean. Plain
+        decode: variance 0, so exactly the mean. None while no
+        token-bearing sample has landed."""
+        if self._tok_slot is None:
+            return None
+        pess = self._tok_slot - self.margin * (self._tok_var ** 0.5)
+        return min(self._tok_slot, max(1.0, pess))
 
     def predict_seconds(self, backlog_tokens, own_tokens,
                         saturated=False):
@@ -181,10 +230,13 @@ class ServiceRateEstimator:
         rate — under full occupancy that rate is ground truth, and the
         structural model, which never sees zero-token passes or host
         contention, reads high exactly when optimism turns into
-        eviction thrash."""
+        eviction thrash. The per-slot rate is the VARIANCE-MARGINED one
+        (class docstring): high-variance acceptance widens predictions
+        before it can thrash, and the 1.0 floor keeps the
+        never-sheds-feasible-solo invariant for free."""
         if not self.ready:
             return None
-        tps = self._tok_slot or 1.0
+        tps = self.tokens_per_slot_conservative or 1.0
         cap = self.slots * tps / self._s_iter
         if saturated:
             d = self.delivered_tokens_per_second
@@ -206,13 +258,14 @@ class AdmissionController:
     it from the serve thread."""
 
     def __init__(self, conservatism=1.2, alpha=0.2, min_samples=8,
-                 slots=None, bias_window=64):
+                 slots=None, bias_window=64, margin=1.0):
         self.conservatism = float(conservatism)
         if self.conservatism < 1.0:
             raise ValueError(f"conservatism must be >= 1.0 (shed late, "
                              f"never early), got {conservatism}")
         self.estimator = ServiceRateEstimator(slots=slots, alpha=alpha,
-                                              min_samples=min_samples)
+                                              min_samples=min_samples,
+                                              margin=margin)
         # closed-loop bias correction: recent signed prediction errors
         # (predicted - actual; the decode server feeds completions and
         # eviction-time optimism BOUNDS). Only systematic OPTIMISM is

@@ -57,10 +57,17 @@ k/v panels plus a small DONATED install scatter (mirroring the fixed
 path; a fused install would copy the whole undonated arena); decode
 stays one dispatch per iteration — paging adds ZERO device dispatches
 per token (pinned by counter A/B in tests/test_paged.py), and the
-join==solo determinism pin carries over unchanged. `paged=True` +
-`speculate=` raises at construction: the K-wide verify program indexes
-the fixed-slot cache layout, and silently composing it with a block
-table is exactly the wrong-cache failure mode to block.
+join==solo determinism pin carries over unchanged. `paged=True`
+COMPOSES with `speculate=`: the K-wide verify program has a
+block-table twin (`make_paged_verify_fn` — writes at table-mapped
+frontier rows under the same [wfrom, wto) index gate the paged chunk
+program uses, gather attention over the slot's logical window), so
+the production configuration keeps the dispatch-amortization win. A
+speculative round consumes only blocks its reserve-at-admit table
+already holds (no new allocation path), and a CoW-shared partial
+block materializes before the FIRST verify dispatch — the K-wide
+write starts at the frontier inside that block, so the 1-wide CoW
+rule covers it unchanged.
 
 Overload control (PR 9; serving/admission.py + the zoo's
 `make_chunked_prefill_fn`) makes saturation a SURVIVABLE regime instead
@@ -106,6 +113,26 @@ tok/s, TTFT p99 x30, queue_wait 72% of request time). Three levers:
   fail-fast stop and both drain bounded by their remaining work on
   stop(drain=True) — expired deadlines shed at admission, so a
   saturated drain never decodes work nobody can use.
+* **Prefix-hit priority admission** (`prefix_priority=`, default on
+  where it means something: paged + prefix_cache + chunked_prefill):
+  a full-prefix-hit request costs ONE chunk of prefill (chunked paged
+  prefill skips resident shared rows — the PR 9 compute reuse), so at
+  equal queue position it buys strictly more goodput per slot-second
+  than a cold prompt. submit() routes requests whose prompt is fully
+  resident in the prefix index (cost == 1 chunk where a cold run would
+  pay more) to a priority line served ahead of the primary queue —
+  the admission predictor already prices both via `_pf_units`, and an
+  admit that actually overtook queued work counts
+  `admitted_prefix_priority`. The hit test at submit is advisory (the
+  binding match re-runs at admission under the version tag, as
+  always): an index entry evicted in between costs the request its
+  priority, never its correctness. Priority requests carry the same
+  deadline sweep, fail-fast, and drain contracts as the other parked
+  lines; the line and the primary queue SHARE the `max_queue` budget
+  (neither can stack pending work past the operator's bound); and
+  after `_PRIO_BURST` consecutive overtakes the primary head takes
+  one turn, so sustained hit traffic degrades cold prompts' position
+  but can never starve them outright.
 """
 from __future__ import annotations
 
@@ -151,11 +178,30 @@ def _resolve_future(fut, result):
     return False
 
 
+class _Wake:
+    """Sentinel pushed through the PRIMARY queue to wake the idle
+    blocking get when a priority submit parks in the side line (the
+    get watches only the queue). Its future is born resolved, so every
+    existing consumer discards it naturally: `_admit_pending` skips
+    done-future requests, and the base `_fail_queued` only fails
+    futures that are not done — no consumer needs to know sentinels
+    exist."""
+
+    __slots__ = ("future", "deadline", "req_id")
+
+    def __init__(self):
+        self.future = cf.Future()
+        self.future.set_result(None)
+        self.deadline = None
+        self.req_id = None
+
+
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "future", "deadline", "t_submit",
                  "generated", "slot", "version", "req_id", "t_last_tok",
                  "alloc", "mem_blocked", "pf_next", "pf_wfrom",
-                 "work_left", "work_counted", "predicted_done", "klass")
+                 "work_left", "work_counted", "predicted_done", "klass",
+                 "prio_overtook", "pf_quoted")
 
     def __init__(self, prompt, max_new, deadline, klass="default"):
         self.prompt = prompt
@@ -176,6 +222,11 @@ class _DecodeRequest:
         self.work_counted = False       # work_left added to the backlog?
         self.predicted_done = None      # estimator's completion estimate
         self.klass = klass      # brownout request class
+        self.prio_overtook = False  # popped off the priority line ahead
+        #                             of queued work; counted at ADMIT
+        self.pf_quoted = 1      # prefill units QUOTED at submit (a
+        #                         priority hit is quoted 1 chunk; the
+        #                         chunked admit retires against this)
 
 
 class ContinuousDecodeServer(_RequestLoop):
@@ -191,6 +242,11 @@ class ContinuousDecodeServer(_RequestLoop):
 
     _thread_name = "continuous-decode"
     _default_stop_timeout = 60.0
+    # after this many consecutive priority overtakes, the primary
+    # queue's head gets one turn: sustained prefix-hit traffic must
+    # never starve cold prompts outright (the hit line is a goodput
+    # preference, not an SLA inversion)
+    _PRIO_BURST = 4
 
     def __init__(self, lm, slots=4, prompt_buckets=(8, 16, 32),
                  max_queue=64, fault_injector=None, retry_policy=None,
@@ -200,12 +256,13 @@ class ContinuousDecodeServer(_RequestLoop):
                  n_blocks=None, prefix_cache=True,
                  max_blocks_per_slot=None, chunked_prefill=None,
                  admission=None, brownout=None,
-                 default_deadline_ms=None):
+                 default_deadline_ms=None, prefix_priority=True):
         from ..models.zoo.transformer import (make_block_copy_fn,
                                               make_chunked_prefill_fn,
                                               make_paged_decode_fn,
                                               make_paged_install_fn,
                                               make_paged_prefill_fn,
+                                              make_paged_verify_fn,
                                               make_prefill_fn,
                                               make_slot_decode_fn)
         from .admission import AdmissionController
@@ -242,16 +299,6 @@ class ContinuousDecodeServer(_RequestLoop):
         # blocks. Config resolves BEFORE _reset_device_state builds the
         # device state from it.
         self._paged = bool(paged)
-        if self._paged and speculate is not None:
-            # the verify program indexes the FIXED-SLOT cache layout;
-            # running it against a block arena would read/write the
-            # wrong physical rows and corrupt neighbouring streams —
-            # fail at construction, never silently
-            raise ValueError(
-                "paged=True does not compose with speculate=: the "
-                "K-wide verify program addresses the fixed-slot cache "
-                "layout, not the block table (make the verify program "
-                "paged, or drop one of the two flags)")
         self._block_size = int(block_size)
         if self._paged and self._block_size < 1:
             raise ValueError(f"need block_size >= 1, got {block_size}")
@@ -287,6 +334,16 @@ class ContinuousDecodeServer(_RequestLoop):
         self.default_deadline = (None if default_deadline_ms is None
                                  else float(default_deadline_ms) / 1e3)
         self._defer_q = collections.deque()      # brownout-deferred line
+        # prefix-hit priority admission (module docstring): effective
+        # only where a full-prefix hit really is cheaper — paged prefix
+        # cache + chunked prefill, where a full hit costs ONE chunk
+        # while a cold prompt pays ceil(P/C)
+        self._prefix_priority = (bool(prefix_priority) and self._paged
+                                 and self._prefix_cache
+                                 and self._chunk is not None)
+        self._prio_q = collections.deque()       # prefix-hit fast line
+        self._prio_streak = 0   # consecutive genuine overtakes (anti-
+        #                         starvation: see _next_request)
         self._work_lock = threading.Lock()
         self._work_tokens = 0   # work-unit backlog (queued + live)
         # admission hysteresis: any actual eviction/queue expiry
@@ -331,12 +388,24 @@ class ContinuousDecodeServer(_RequestLoop):
         # speculative decoding (serving/speculate.py): ONE K-wide verify
         # program replaces the 1-token step for every iteration — drafts
         # in, 1..K accepted tokens out per slot per dispatch, token
-        # streams pinned bit-identical to the plain step. The program is
-        # the model's OWN cached verify jit (`_spec_verify`), shared with
-        # generate(draft=...) so the same (model, K) never compiles twice.
+        # streams pinned bit-identical to the plain step. Fixed layout:
+        # the model's OWN cached verify jit (`_spec_verify`), shared
+        # with generate(draft=...) so the same (model, K) never
+        # compiles twice. Paged layout: the block-table verify twin
+        # (`make_paged_verify_fn`), jitted here because block_size is
+        # server config; cache and pos donated exactly like the decode
+        # step's — they are THE device state, and the loop's
+        # terminal-failure path resets all of it anyway.
         self._spec = as_speculator(speculate)
-        self._verify = (None if self._spec is None else
-                        lm._spec_verify(self._spec.k))
+        if self._spec is None:
+            self._verify = None
+        elif self._paged:
+            self._verify = jax.jit(
+                make_paged_verify_fn(n_heads, self._spec.k,
+                                     self._block_size),
+                donate_argnums=(2, 4))
+        else:
+            self._verify = lm._spec_verify(self._spec.k)
         self._prefills = {}                      # bucket -> jitted program
         # Paged prefill mirrors the fixed path's two-program shape:
         # a pure-compute prefill returning panels (no arena argument —
@@ -431,18 +500,41 @@ class ContinuousDecodeServer(_RequestLoop):
             from .admission import DEFER, SHED
             # maxsize <= 0 is queue.Queue's unbounded convention: depth
             # pressure is undefined there, so the depth thresholds never
-            # engage (attainment brownout still can)
-            frac = (self._q.qsize() / self._q.maxsize
-                    if self._q.maxsize > 0 else 0.0)
+            # engage (attainment brownout still can). The priority line
+            # counts toward depth: its requests bypass the queue.Queue
+            # but are pending work all the same.
+            frac = ((self._q.qsize() + len(self._prio_q))
+                    / self._q.maxsize if self._q.maxsize > 0 else 0.0)
             decision = self._brownout.decide(
                 klass, frac, self._recent_attainment())
             if decision == SHED:
                 self.metrics.count("shed_brownout")
-                self.metrics.record_queue_depth(self._q.qsize())
+                self.metrics.record_queue_depth(self._pending_depth())
                 raise ServerOverloadedError(
                     f"brownout: class {klass!r} shed at queue depth "
                     f"{frac:.0%}")
             deferred = decision == DEFER
+        prio = False
+        if self._prefix_priority and not deferred \
+                and len(prompt) > self._chunk:
+            # prefix-hit priority (module docstring): a FULL-prefix hit
+            # leaves at most one chunk of prefill where a cold prompt
+            # pays ceil(P/C) — route it to the fast line. Advisory test
+            # under the newest version tag; the binding match re-runs
+            # at admission, so an index entry evicted in between costs
+            # priority, never correctness. Prompts that fit one chunk
+            # anyway gain nothing and stay FIFO. The lookup runs on the
+            # CLIENT thread against pool dicts the serve thread
+            # mutates: a raced resize mid-walk degrades to FIFO (the
+            # same cost as a missed match), never to a failed submit.
+            with self._swap_lock:
+                vidx = len(self._versions) - 1
+            try:
+                rows = self._pool.match_prefix(prompt, tag=vidx)[1]
+            except RuntimeError:    # dict resized during the walk
+                rows = 0
+            start = min(rows, len(prompt) - 1)
+            prio = len(prompt) - start <= self._chunk
         if self._admission is not None and dl is not None \
                 and not deferred:
             # predicted completion at ENQUEUE: work ahead (queued + live
@@ -456,7 +548,12 @@ class ContinuousDecodeServer(_RequestLoop):
             # matching the queue-full precedent: attainment is over
             # ADMITTED requests.
             backlog = self._work_tokens
-            own = int(max_new_tokens) + self._pf_units(len(prompt))
+            # the predictor prices BOTH prefill costs: a priority-line
+            # prefix hit re-runs one chunk, a cold prompt its full
+            # chunk count — so a hit request's shed decision reflects
+            # the cheaper admission it will actually get
+            own = int(max_new_tokens) + (1 if prio else
+                                         self._pf_units(len(prompt)))
             if self._admission.should_shed(
                     backlog, own, dl - now,
                     strict=now < self._thrash_until):
@@ -470,8 +567,15 @@ class ContinuousDecodeServer(_RequestLoop):
         # work is counted in ITERATION-EQUIVALENT units: generated
         # tokens plus the prefill dispatches (chunks) the prompt will
         # consume — a slot spends one scheduling iteration per unit, so
-        # backlog predictions see prefill-heavy queues at true size
-        req.work_left += self._pf_units(len(prompt))
+        # backlog predictions see prefill-heavy queues at true size.
+        # A priority-line hit is QUOTED its real 1-chunk cost (matching
+        # the shed decision above), so the prediction stamped below and
+        # the bias loop's (predicted - actual) error measure the same
+        # request the admission decision admitted — full-cost phantom
+        # units here would read systematically pessimistic for every
+        # hit and mask genuine optimism from cold requests.
+        req.pf_quoted = 1 if prio else self._pf_units(len(prompt))
+        req.work_left += req.pf_quoted
         if self._admission is not None and not deferred:
             # DEFERRED requests carry no prediction: their service time
             # is brownout policy (they yield until the primary queue
@@ -502,8 +606,11 @@ class ContinuousDecodeServer(_RequestLoop):
         req.future.add_done_callback(
             lambda _f, r=req: self._retire_work(r))
         try:
-            return (self._enqueue_deferred(req) if deferred
-                    else self._enqueue(req))
+            if deferred:
+                return self._enqueue_deferred(req)
+            if prio:
+                return self._enqueue_priority(req)
+            return self._enqueue(req)
         except BaseException:
             self._retire_work(req)
             raise
@@ -587,6 +694,70 @@ class ContinuousDecodeServer(_RequestLoop):
                 f"deferred line full ({self._q.maxsize} parked)")
         self.metrics.count("deferred")
         self._defer_q.append(req)
+        tr = self._tracer
+        if tr.enabled:
+            tr.instant("serve.enqueue", cat="serve",
+                       track=f"req-{req.req_id}", trace_id=req.req_id)
+        if not self._running:
+            if not req.future.done():
+                req.future.set_exception(
+                    ServerClosedError("server stopped during submit"))
+            raise ServerClosedError("server stopped during submit")
+        return req.future
+
+    def _pending_depth(self):
+        """Enqueue-time depth includes the parked priority line: its
+        requests are pending work the gauge must not hide, and the one
+        base-class sample per enqueue stays the ONLY sample."""
+        return self._q.qsize() + len(self._prio_q)
+
+    def _shed_if_lines_full(self):
+        """The ONE shared-budget check both admission paths run: the
+        primary queue and the priority line together may never stack
+        pending work past `max_queue` — otherwise M parked hits plus M
+        queued colds would reach 2x the operator's backpressure bound.
+        (Two racing submits can each pass the sum check — the same
+        benign width every parked-line bound has; the Queue's own
+        put_nowait still hard-caps the primary line.)"""
+        if 0 < self._q.maxsize <= len(self._prio_q) + self._q.qsize():
+            self.metrics.count("shed_queue_full")
+            self.metrics.record_queue_depth(self._pending_depth())
+            raise ServerOverloadedError(
+                f"queue full ({self._q.maxsize} pending)")
+
+    def _enqueue(self, req):
+        """The primary enqueue with the budget shared BOTH ways (see
+        `_shed_if_lines_full`)."""
+        self._shed_if_lines_full()
+        return super()._enqueue(req)
+
+    def _enqueue_priority(self, req):
+        """Park a prefix-hit request in the PRIORITY line served ahead
+        of the primary queue (module docstring). Same contracts as
+        `_enqueue`: bounded (the line and the primary queue share the
+        queue budget — a full house sheds loudly), depth-sampled,
+        traced, and a raced stop() fails the future rather than
+        stranding the caller."""
+        if req.req_id is None:
+            req.req_id = next(self._req_ids)
+        self._shed_if_lines_full()
+        self._prio_q.append(req)
+        if not any(r is not None for r in self._slot_req):
+            # wake a possibly idle-BLOCKED serve loop: the idle wait
+            # blocks on the primary queue only, and without a nudge a
+            # hit landing on an idle server would eat the whole idle
+            # timeout — latency the cold path never pays. Only the
+            # idle loop needs it (a busy loop checks the priority line
+            # every iteration without blocking), and only then is the
+            # sentinel consumed promptly — pushed while busy it would
+            # sit in the queue eating backpressure budget. The
+            # idle-check race (loop going idle right after we look)
+            # costs at most one 50 ms idle timeout, the pre-fix cost.
+            try:
+                self._q.put_nowait(_Wake())
+            except queue.Full:
+                pass
+        self.metrics.record_queue_depth(self._pending_depth())
         tr = self._tracer
         if tr.enabled:
             tr.instant("serve.enqueue", cat="serve",
@@ -834,10 +1005,13 @@ class ContinuousDecodeServer(_RequestLoop):
         req.pf_next = start
         # prefix hits skip leading chunks: retire their work units NOW,
         # or they would sit in the admission backlog as phantoms until
-        # the future resolves, over-predicting every later request
+        # the future resolves, over-predicting every later request.
+        # Retirement is against the units QUOTED at submit (a priority
+        # hit was quoted 1 chunk already, so a surviving hit retires
+        # nothing here; an evaporated hit's extra chunks clamp against
+        # the request's remaining budget in _spend_work)
         chunks_left = -(-(plen - start) // self._chunk)
-        self._spend_work(req, max(
-            0, self._pf_units(plen) - chunks_left))
+        self._spend_work(req, max(0, req.pf_quoted - chunks_left))
         self._pos = self._pos.at[slot].set(start)
         self._tok[slot] = 0
         req.slot = slot
@@ -847,16 +1021,46 @@ class ContinuousDecodeServer(_RequestLoop):
     def _next_request(self, wait):
         """Head of the admission line: memory-blocked requests first
         (FIFO — a small late request must not starve a big early one),
-        then the submit queue, then the brownout-DEFERRED line — served
-        only when the primary queue is empty, which is the policy:
-        deferred classes yield until pressure drops. The blocking `wait`
-        engages only when every line is empty (the idle sleep)."""
+        then the prefix-hit PRIORITY line (a full-prefix hit costs one
+        chunk of prefill, so it overtakes cold prompts by policy —
+        counted `admitted_prefix_priority` when it actually overtakes
+        queued work), then the submit queue, then the brownout-DEFERRED
+        line — served only when the primary queue is empty, which is
+        the policy: deferred classes yield until pressure drops. The
+        blocking `wait` engages only when every line is empty (the
+        idle sleep)."""
         if self._mem_wait:
             return self._mem_wait.popleft()
+        # discard wake sentinels at the queue head FIRST (safe: this
+        # loop is the queue's only consumer; producers only append):
+        # a sentinel is a nudge, not work — left in place it would
+        # read as queued work to the overtake flag below and spend the
+        # anti-starvation fairness turn on a no-op
+        while True:
+            try:
+                if not isinstance(self._q.queue[0], _Wake):
+                    break
+                self._q.get_nowait()
+            except (IndexError, queue.Empty):
+                break
+        # anti-starvation bound: after _PRIO_BURST consecutive genuine
+        # overtakes, the primary head takes one turn — sustained hit
+        # traffic degrades cold prompts' position, never parks them
+        # forever (the deferred line's reciprocal guarantee)
+        if not (self._prio_streak >= self._PRIO_BURST
+                and not self._q.empty()):
+            r = self._pop_prio()
+            if r is not None:
+                if r.prio_overtook:
+                    self._prio_streak += 1
+                return r
         try:
-            return self._q.get_nowait()
+            r = self._q.get_nowait()
         except queue.Empty:
             pass
+        else:
+            self._prio_streak = 0
+            return r
         if self._defer_q:
             try:
                 r = self._defer_q.popleft()
@@ -869,11 +1073,32 @@ class ContinuousDecodeServer(_RequestLoop):
                     r.work_counted = True
             return r
         if wait:
+            # the idle sleep. Priority submits that land while the get
+            # blocks push a `_Wake` sentinel through the queue (see
+            # `_enqueue_priority`): the get returns it, the caller
+            # discards its done future, and the next `_next_request`
+            # pops the priority line first — no polling, no timeout
+            # eaten by the parked request.
             try:
                 return self._q.get(timeout=wait)
             except queue.Empty:
                 return None
         return None
+
+    def _pop_prio(self):
+        """Pop the priority line's head (None when empty or raced),
+        flagging whether it genuinely overtook queued work — the flag
+        is counted only when the request actually ADMITS, so a
+        deadline-expired or caller-cancelled pop never reports an
+        overtake that did not happen."""
+        if not self._prio_q:
+            return None
+        try:
+            r = self._prio_q.popleft()
+        except IndexError:              # raced a concurrent drain
+            return None
+        r.prio_overtook = not self._q.empty()
+        return r
 
     def _admit_pending(self, timeout=0.0):
         """Fill free slots from the queue. `timeout` blocks on the FIRST
@@ -941,6 +1166,12 @@ class ContinuousDecodeServer(_RequestLoop):
                     self._pool.release(alloc)
                 _fail_future(req.future, e)
                 self.metrics.count("failed")
+            else:
+                if req.prio_overtook:
+                    # a REAL reordered admission: the request left the
+                    # priority line past queued work and prefilled
+                    req.prio_overtook = False
+                    self.metrics.count("admitted_prefix_priority")
 
     def _free_slot(self, slot):
         """Release `slot`'s host-side occupancy (and its draft stream,
@@ -958,47 +1189,31 @@ class ContinuousDecodeServer(_RequestLoop):
         if self._spec is not None:
             self._spec.draft.stop(slot)
 
-    def _expire_mem_wait(self, now):
-        """Deadline enforcement for requests parked on the MEMORY gate:
-        blocked-on-blocks is queue wait too, and a request must not
-        outlive its budget just because it never won blocks."""
-        if not self._mem_wait:
-            return
-        keep = collections.deque()
-        while self._mem_wait:
-            r = self._mem_wait.popleft()
-            if r.future.done():
-                continue
-            if r.deadline is not None and now > r.deadline:
-                if _fail_future(r.future, DeadlineExceededError(
-                        "deadline expired while blocked on KV blocks")):
-                    self._deadline_miss(r, now)
-            else:
-                keep.append(r)
-        self._mem_wait = keep
-
-    def _expire_deferred(self, now):
-        """Deadline enforcement for brownout-DEFERRED requests: deferral
-        is queue wait too. One FIFO rotation of the line (popleft/append
-        are each atomic, so a concurrent submit's append is safe)."""
+    def _sweep_line(self, dq, msg, now, thrash=True):
+        """THE deadline sweep for every parked FIFO line (memory gate,
+        priority line, deferred line — waiting anywhere is queue wait):
+        one rotation skipping already-resolved futures and failing
+        expired ones through the shared `_deadline_miss` bookkeeping.
+        Keepers return to the FRONT in order (deque ops are each
+        atomic, so a submit appending concurrently is safe and lands
+        BEHIND them — the sweep preserves line-FIFO fairness instead
+        of leapfrogging old requests). `thrash=False` is the deferred
+        line's flag: a class starved by brownout POLICY expiring is
+        not evidence of overload, so it must not tighten admission."""
         keep = []
-        for _ in range(len(self._defer_q)):
+        for _ in range(len(dq)):
             try:
-                r = self._defer_q.popleft()
+                r = dq.popleft()
             except IndexError:
                 break
             if r.future.done():
                 continue
             if r.deadline is not None and now > r.deadline:
-                if _fail_future(r.future, DeadlineExceededError(
-                        "deadline expired while brownout-deferred")):
-                    self._deadline_miss(r, now, thrash=False)
+                if _fail_future(r.future, DeadlineExceededError(msg)):
+                    self._deadline_miss(r, now, thrash=thrash)
             else:
                 keep.append(r)
-        # keepers return to the FRONT in order: a submit appending
-        # concurrently lands BEHIND them, so the sweep preserves
-        # deferred-FIFO fairness instead of leapfrogging old requests
-        self._defer_q.extendleft(reversed(keep))
+        dq.extendleft(reversed(keep))
 
     def _evict_expired(self):
         """Mid-decode deadline enforcement: a slot whose request deadline
@@ -1009,8 +1224,14 @@ class ContinuousDecodeServer(_RequestLoop):
         that expire in the queue; this protects the slots themselves from
         requests whose token budget outlives their latency budget."""
         now = time.monotonic()
-        self._expire_mem_wait(now)
-        self._expire_deferred(now)
+        self._sweep_line(self._mem_wait,
+                         "deadline expired while blocked on KV blocks",
+                         now)
+        self._sweep_line(self._prio_q,
+                         "deadline expired in the priority line", now)
+        self._sweep_line(self._defer_q,
+                         "deadline expired while brownout-deferred",
+                         now, thrash=False)
         evicted = False
         for s, r in enumerate(self._slot_req):
             if r is None or r.deadline is None or now <= r.deadline:
@@ -1053,11 +1274,19 @@ class ContinuousDecodeServer(_RequestLoop):
 
     def _fail_parked(self, exc):
         """Fail everything parked OUTSIDE the submit queue: the paged
-        memory-wait line and the brownout-deferred line (both count as
-        _busy(), so both must resolve before a stop may exit — the PR 8
-        memory-waiter livelock pin, extended to deferral)."""
+        memory-wait line, the prefix-hit priority line, and the
+        brownout-deferred line (all count as _busy(), so all must
+        resolve before a stop may exit — the PR 8 memory-waiter
+        livelock pin, extended to every parked line)."""
         while self._mem_wait:
             r = self._mem_wait.popleft()
+            if _fail_future(r.future, exc):
+                self.metrics.count("failed")
+        while self._prio_q:
+            try:
+                r = self._prio_q.popleft()
+            except IndexError:
+                break
             if _fail_future(r.future, exc):
                 self.metrics.count("failed")
         while self._defer_q:
@@ -1326,7 +1555,17 @@ class ContinuousDecodeServer(_RequestLoop):
         under the slot's pinned param version (`r.version`); the draft
         source itself needs no pinning because a mismatched draft cannot
         alter accepted tokens. `live` is the DECODING slot set (chunked
-        mode runs prefilling slots through `_chunk_iteration` first)."""
+        mode runs prefilling slots through `_chunk_iteration` first).
+
+        Paged mode swaps the program for the block-table verify twin
+        (`make_paged_verify_fn`): the block table and a per-slot write
+        bound `wto` (the reservation's row capacity —
+        `BlockPool.writable_rows`) ride in as host arguments like
+        tok/active, a round that crosses a block boundary writes into
+        blocks the reserve-at-admit table already holds (no allocation
+        here), and any pending CoW materializes FIRST — the K-wide
+        write starts at the frontier, inside a still-shared partial
+        block (the 1-wide CoW rule's K-wide twin)."""
         import jax.numpy as jnp
         if t_iter_start is None:
             t_iter_start = time.monotonic()
@@ -1338,15 +1577,22 @@ class ContinuousDecodeServer(_RequestLoop):
         K = self._spec.k
         draft = self._spec.draft
         d0 = getattr(draft, "dispatch_count", 0)   # ModelDraft device cost
+        if self._paged:
+            self._materialize_cow(live)
+            self.metrics.record_pool(self._pool.blocks_in_use,
+                                     self._pool.capacity)
         versions = sorted({r.version for _, r in live})
         done_any = False
         for v in versions:
             live_v = [(s, r) for s, r in live if r.version == v]
             active = np.zeros((self.slots,), bool)
             toks = np.zeros((self.slots, K), np.int32)
+            wto = np.zeros((self.slots,), np.int32)
             n_dr = {}
             for s, r in live_v:
                 active[s] = True
+                if self._paged:
+                    wto[s] = self._pool.writable_rows(r.alloc)
                 # never request drafts past the request's remaining token
                 # budget: a ModelDraft would pay real dispatches for
                 # tokens that can never be accepted, and the acceptance
@@ -1361,6 +1607,12 @@ class ContinuousDecodeServer(_RequestLoop):
             def dispatch():
                 if self._injector is not None:
                     self._injector.fire("serve.batch")
+                if self._paged:
+                    return self._verify(
+                        aux, blocks, self._cache,
+                        jnp.asarray(self._btabs), self._pos,
+                        jnp.asarray(toks), jnp.asarray(active),
+                        jnp.asarray(wto))
                 return self._verify(aux, blocks, self._cache, self._pos,
                                     jnp.asarray(toks), jnp.asarray(active))
 
@@ -1447,7 +1699,8 @@ class ContinuousDecodeServer(_RequestLoop):
 
     def _busy(self):
         return any(r is not None for r in self._slot_req) \
-            or bool(self._mem_wait) or bool(self._defer_q)
+            or bool(self._mem_wait) or bool(self._prio_q) \
+            or bool(self._defer_q)
 
     def _loop_once(self):
         # evict deadline-expired slots FIRST so the admit below can refill

@@ -133,6 +133,19 @@ class BlockPool:
         never written back)."""
         return max(1, -(-int(total_rows) // self.block_size))
 
+    def writable_rows(self, alloc):
+        """Row capacity of one allocation's reserved table — the
+        EXCLUSIVE write bound (`wto`) the K-wide paged programs gate
+        on. Rows between the request's last real row and this bound are
+        the tail of its final reserved block: dead-writable overhang a
+        speculative round or chunk padding may scribble on (the pointer
+        never passes them, and the block is privately owned). Rows at
+        or past this bound are OUTSIDE the reservation — an ungated
+        write there would resolve through a zeroed block-table entry
+        into block 0, i.e. someone else's memory — so the verify/chunk
+        programs index-drop them."""
+        return len(alloc.ids) * self.block_size
+
     # -- prefix matching ----------------------------------------------
     def match_prefix(self, prompt, tag=None):
         """(full_ids, rows_matched, partial_id): the longest run of
@@ -251,8 +264,12 @@ class BlockPool:
         """Materialize a lazy copy-on-write: the spare reserved at
         admit() replaces the shared partial block in `alloc`'s table.
         Returns (src, dst) physical ids — the CALLER performs the device
-        row copy (`make_block_copy_fn`) before its next append
-        dispatch."""
+        row copy (`make_block_copy_fn`) before its next append dispatch,
+        whatever its width: the 1-wide decode step writes one frontier
+        row into the shared block, and a K-wide VERIFY dispatch writes
+        its whole [pos, pos+K) burst starting there — both must see the
+        private copy first (the scheduler materializes any pending CoW
+        before the first decode-phase dispatch, which covers both)."""
         idx, spare = alloc.cow
         src = alloc.ids[idx]
         alloc.ids = list(alloc.ids)

@@ -385,6 +385,9 @@ class ServingMetrics:
         out.setdefault("shed_brownout", 0)
         out.setdefault("deferred", 0)
         out.setdefault("chunk_dispatches", 0)
+        # prefix-hit priority admission (serving/decode.py): admits
+        # that genuinely overtook queued cold-prompt work
+        out.setdefault("admitted_prefix_priority", 0)
         out["service_rate_tokens_per_sec"] = self._service_rate.value
         out["prefix_hit_rate"] = (
             out["prefix_rows_hit"] / out["prefix_rows_total"]

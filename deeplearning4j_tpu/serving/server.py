@@ -175,6 +175,13 @@ class _RequestLoop:
         self.stop()
 
     # -- queue machinery -----------------------------------------------
+    def _pending_depth(self):
+        """The depth sampled at enqueue time. Subclasses with parked
+        side lines (the decode server's priority line) add them here so
+        every enqueue records ONE consistent number — overriding the
+        sample itself would double-record."""
+        return self._q.qsize()
+
     def _enqueue(self, req):
         """Admit `req` (has .future) or shed loudly; returns the future."""
         if req.req_id is None:
@@ -190,7 +197,7 @@ class _RequestLoop:
                 f"queue full ({self._q.maxsize} pending)") from None
         # depth sampled at ENQUEUE, not only at batch formation: an
         # idle-then-bursty server must report admission pressure
-        self.metrics.record_queue_depth(self._q.qsize())
+        self.metrics.record_queue_depth(self._pending_depth())
         tr = self._tracer
         if tr.enabled:
             tr.instant("serve.enqueue", cat="serve",

@@ -6,9 +6,15 @@ work attacked for training, and on a remote-attached chip every dispatch
 is a tunnel round-trip. Speculative decoding (Leviathan et al. 2023,
 "Fast Inference from Transformers via Speculative Decoding") amortizes
 it: a cheap DRAFT proposes K-1 candidate tokens, ONE K-wide verify
-dispatch (`models.zoo.transformer.make_slot_verify_fn`) scores all of
-them, and the scheduler accepts the longest prefix whose greedy argmax
-matches the draft plus one bonus token — 1..K tokens per dispatch.
+dispatch scores all of them, and the scheduler accepts the longest
+prefix whose greedy argmax matches the draft plus one bonus token —
+1..K tokens per dispatch. BOTH cache layouts run it: the fixed-slot
+verify program (`models.zoo.transformer.make_slot_verify_fn`) and its
+block-table twin (`make_paged_verify_fn` — same contract, writes
+re-addressed through the block table under the paged chunk program's
+[wfrom, wto) index gate), so `ContinuousDecodeServer(paged=True,
+speculate=...)` — the production configuration — keeps the
+dispatch-amortization win on the paged memory model.
 
 Because the decode path is GREEDY, acceptance-by-exact-match makes the
 emitted stream the verify program's OWN argmax chain by construction:

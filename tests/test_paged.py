@@ -13,9 +13,17 @@
       submit), hot swap drains dual-version over paged slots, and the
       dispatch-counter A/B pins that paging adds ZERO device dispatches
       per token.
-  (d) paged=True + speculate= is refused at construction — the K-wide
-      verify program addresses the fixed-slot layout, and composing it
-      silently with a block table is the wrong-cache failure mode.
+  (d) Paged SPECULATION (ISSUE 10): the K-wide verify program
+      re-addressed through the block table (`make_paged_verify_fn`) —
+      paged speculative streams bit-identical to plain greedy AND to
+      fixed-layout speculation (solo, join==solo, across a hot swap,
+      K in {2,4,8}, both draft sources); CoW-shared prefix + divergent
+      K-wide verify write yields exactly one copy with both streams
+      intact; verify-round block accounting leaves the pool empty
+      after churn; mid-round deadline eviction releases blocks; and
+      the dispatch-counter A/B pins that the PAGED verify costs the
+      identical dispatch count as the fixed verify (paging adds zero,
+      under speculation too).
 """
 import time
 
@@ -24,8 +32,9 @@ import pytest
 
 from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
 from deeplearning4j_tpu.serving import (BlockPool, ContinuousDecodeServer,
-                                        DeadlineExceededError, NGramDraft,
-                                        ServerOverloadedError, Speculator)
+                                        DeadlineExceededError, ModelDraft,
+                                        NGramDraft, ServerOverloadedError,
+                                        Speculator)
 
 
 def _lm(seed=3):
@@ -484,7 +493,201 @@ class TestPagedScheduling:
 
 
 # ---------------------------------------------------------------------------
-# (d) refused compositions
+# (d) paged speculation: the block-table verify program (ISSUE 10)
+# ---------------------------------------------------------------------------
+def _spec(k=4, draft=None):
+    return Speculator(draft if draft is not None else NGramDraft(n=3),
+                      k=k)
+
+
+class TestPagedSpeculative:
+    def test_constructs_and_serves(self):
+        """The PR 8 refusal is gone: paged=True + speculate= builds the
+        block-table verify program and serves — the production
+        configuration (paged memory + speculation) exists."""
+        lm = _lm()
+        p = [5, 9, 2, 7]
+        with _paged(lm, speculate=_spec()) as srv:
+            got = srv.generate(p, 6, timeout=60)
+            assert srv._pool.blocks_in_use == 0
+        assert got == lm.generate(p, max_new_tokens=6)
+
+    def test_solo_join_fixed_bit_identical_across_k(self):
+        """For K in {2,4,8}: the paged speculative stream == plain
+        greedy == fixed-layout speculation — solo, and joining a
+        running speculative batch (the continuous-decode pin under
+        ragged multi-token advance, over the block table)."""
+        lm = _lm()
+        rng = np.random.default_rng(21)
+        pa = rng.integers(1, 64, 5).tolist()
+        pb = rng.integers(1, 64, 8).tolist()
+        plain = lm.generate(pa, 10, use_cache=True)
+        for k in (2, 4, 8):
+            with ContinuousDecodeServer(
+                    lm, slots=4, prompt_buckets=(8, 16),
+                    speculate=_spec(k)) as srv:
+                fixed = srv.generate(pa, 10, timeout=60)
+            with _paged(lm, speculate=_spec(k)) as srv:
+                solo = srv.generate(pa, 10, timeout=60)
+                flong = srv.submit(pb, 24)      # running batch
+                time.sleep(0.05)
+                joined = srv.submit(pa, 10).result(60)
+                flong.result(60)
+                assert srv._pool.blocks_in_use == 0
+            assert fixed == plain
+            assert solo == plain
+            assert joined == plain
+
+    def test_model_draft_bit_identical(self):
+        """The small-model draft source over the paged layout — and
+        the self-draft amortization ceiling: the target drafting for
+        itself accepts exactly K per dispatch, dispatches/token = 1/K,
+        unchanged by paging."""
+        lm = _lm()
+        draft_lm = TransformerLM(64, d_model=16, n_heads=2, n_layers=1,
+                                 max_len=80, seed=21)
+        rng = np.random.default_rng(22)
+        p = rng.integers(1, 64, 5).tolist()
+        plain = lm.generate(p, 16, use_cache=True)
+        with _paged(lm, slots=2, speculate=_spec(4, ModelDraft(
+                draft_lm))) as srv:
+            assert srv.generate(p, 16, timeout=60) == plain
+        k = 4
+        with _paged(lm, slots=2, speculate=_spec(k, ModelDraft(
+                lm))) as srv:
+            got = srv.generate(p, 21, timeout=60)
+            snap = srv.metrics.snapshot()
+        assert got == lm.generate(p, 21, use_cache=True)
+        assert snap["spec_accepted_per_dispatch_mean"] == pytest.approx(k)
+        assert snap["dispatches_per_token"] == pytest.approx(1.0 / k)
+
+    def test_cow_divergent_verify_write(self):
+        """A shorter prompt riding a longer prompt's final block under
+        SPECULATION: the first K-wide verify write starts inside the
+        shared block, so the CoW must materialize first — exactly one
+        copy, both streams bit-identical to their unshared runs."""
+        lm = _lm()
+        rng = np.random.default_rng(23)
+        p8 = rng.integers(1, 64, 8).tolist()
+        p6 = p8[:6]
+        with _paged(lm, prefix_cache=False, speculate=_spec()) as srv:
+            a0 = srv.generate(p8, 10, timeout=60)
+            b0 = srv.generate(p6, 10, timeout=60)
+        with _paged(lm, speculate=_spec()) as srv:
+            fa = srv.submit(p8, 10)
+            time.sleep(0.05)
+            fb = srv.submit(p6, 10)
+            a1, b1 = fa.result(60), fb.result(60)
+            snap = srv.metrics.snapshot()
+            assert srv._pool.blocks_in_use == 0
+        assert a1 == a0          # owner's rows never clobbered
+        assert b1 == b0          # sharer diverges onto its private copy
+        assert snap["cow_copies"] == 1
+        assert snap["prefix_rows_hit"] >= 6
+
+    def test_no_leak_after_spec_request_churn(self):
+        """Mixed speculative requests (shared prefixes, mixed lengths,
+        block-boundary-crossing verify rounds) through a small arena:
+        every future resolves, the pool ends empty, invariants hold —
+        the verify-round block-accounting pin."""
+        lm = _lm()
+        rng = np.random.default_rng(24)
+        sysp = rng.integers(1, 64, 4).tolist()
+        with _paged(lm, slots=3, n_blocks=16, speculate=_spec(8)) as srv:
+            futs = []
+            for i in range(12):
+                own = rng.integers(1, 64, int(rng.integers(1, 5))).tolist()
+                p = (sysp + own) if i % 2 else own
+                futs.append(srv.submit(p, int(rng.integers(2, 10))))
+            for f in futs:
+                assert f.result(120)
+            assert srv._pool.blocks_in_use == 0
+            assert srv._pool.check()
+            assert srv.metrics.snapshot().get("failed", 0) == 0
+
+    def test_mid_round_deadline_eviction_releases_blocks(self):
+        """A deadline expiring between verify rounds evicts the slot:
+        future fails, its blocks release, the server keeps serving.
+        Delay-only faults pace the verify dispatches so the doomed
+        request reliably outlives its budget mid-decode."""
+        from deeplearning4j_tpu.common.resilience import FaultInjector
+        lm = _lm()
+        rng = np.random.default_rng(25)
+        p = rng.integers(1, 64, 4).tolist()
+        inj = FaultInjector(seed=7).plan(
+            "serve.batch", on_calls=range(0, 200), times=200,
+            delay=0.03, exc=None)
+        with _paged(lm, slots=2, fault_injector=inj,
+                    speculate=_spec()) as srv:
+            # warm the compile OFF the doomed request's clock
+            srv.generate([1, 2], 2, deadline_ms=600_000, timeout=120)
+            doomed = srv.submit(p, 40, deadline_ms=120)
+            with pytest.raises(DeadlineExceededError):
+                doomed.result(120)
+            deadline = time.monotonic() + 10
+            while srv._pool.blocks_in_use and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert srv._pool.blocks_in_use == 0
+            snap = srv.metrics.snapshot()
+        assert snap["shed_deadline"] == 1
+        assert snap["evicted_mid_decode"] == 1
+
+    def test_dispatch_counter_ab_paged_spec_equals_fixed_spec(self):
+        """Paging must stay free in DISPATCHES under speculation: the
+        same sequential speculative workload through fixed and paged
+        servers costs the identical verify-dispatch count per token
+        (the PR 5 amortization carries over unchanged), with zero CoW
+        copies on an unshared workload."""
+        lm = _lm()
+        rng = np.random.default_rng(26)
+        # repetitive prompts so the n-gram draft really accepts (the
+        # amortization regime, not just the bonus-token floor)
+        work = []
+        for _ in range(6):
+            pat = rng.integers(1, 64, 3).tolist()
+            work.append(((pat * 3)[:int(rng.integers(4, 8))],
+                         int(rng.integers(6, 12))))
+        counts = {}
+        for name, srv in (
+                ("fixed", ContinuousDecodeServer(
+                    lm, slots=2, prompt_buckets=(8,),
+                    speculate=_spec())),
+                ("paged", _paged(lm, slots=2, speculate=_spec()))):
+            with srv:
+                for p, n in work:       # sequential: same round count
+                    srv.generate(p, n, timeout=60)
+                snap = srv.metrics.snapshot()
+            counts[name] = (snap["dispatches"], snap["tokens_out"],
+                            snap.get("cow_copies", 0))
+        assert counts["fixed"][:2] == counts["paged"][:2]
+        assert counts["paged"][2] == 0
+
+    def test_hot_swap_drain_paged_speculative(self):
+        """Dual-version drain under paged speculation: the in-flight
+        stream finishes on pre-swap params (verify pinned to the slot's
+        version over the block table) while a post-swap request gets
+        the new params; blocks all returned."""
+        lm1, lm2 = _lm(3), _lm(11)
+        rng = np.random.default_rng(27)
+        pa = rng.integers(1, 64, 4).tolist()
+        pb = rng.integers(1, 64, 4).tolist()
+        with _paged(lm1, slots=2, speculate=_spec()) as srv:
+            solo_old = srv.generate(pa, 14, timeout=60)
+            fa = srv.submit(pa, 14)
+            time.sleep(0.03)
+            srv.swap(lm2)
+            fb = srv.submit(pb, 5)
+            ra, rb = fa.result(60), fb.result(60)
+            assert srv._pool.blocks_in_use == 0
+        assert ra == solo_old
+        expect_new = lm2.generate_batch(np.asarray([pb], np.int32),
+                                        max_new_tokens=5)
+        assert rb == expect_new[0].tolist()
+        assert srv.metrics.snapshot().get("failed", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# guards that remain
 # ---------------------------------------------------------------------------
 class TestPagedGuards:
     def test_oversize_for_slot_table_shed_at_submit(self):
@@ -499,10 +702,3 @@ class TestPagedGuards:
             got = srv.generate([5, 1], 4, timeout=60)
             assert srv.metrics.snapshot()["shed_blocks"] == 1
         assert got == lm.generate([5, 1], max_new_tokens=4)
-
-    def test_paged_with_speculate_raises_loudly(self):
-        lm = _lm()
-        with pytest.raises(ValueError, match="paged.*speculate"):
-            ContinuousDecodeServer(
-                lm, paged=True,
-                speculate=Speculator(NGramDraft(n=3), k=4))

@@ -2,9 +2,11 @@
 
   (a) Bit-identity: the speculative greedy token stream is IDENTICAL to
       plain greedy decode — solo generate(), batched generate_batch(),
-      solo and co-batched through ContinuousDecodeServer, for K in
-      {2, 4, 8}, for BOTH draft sources (NGramDraft prompt-lookup and
-      ModelDraft small-model), and across a mid-stream hot swap.
+      solo and co-batched through ContinuousDecodeServer (BOTH cache
+      layouts: fixed-slot and paged block-table — the paged-specific
+      pins live in tests/test_paged.py), for K in {2, 4, 8}, for BOTH
+      draft sources (NGramDraft prompt-lookup and ModelDraft
+      small-model), and across a mid-stream hot swap.
       Acceptance-by-exact-argmax-match makes the stream the verify
       program's own argmax chain by construction — a draft only changes
       the dispatch count — and these pins hold it to the plain decode
@@ -174,6 +176,23 @@ class TestServerSpeculative:
                 speculate=Speculator(ModelDraft(_draft_lm()), k=4)) as srv:
             got = srv.generate(p, 14, timeout=60)
         assert got == lm.generate(p, 14, use_cache=True)
+
+    def test_paged_server_bit_identical_both_sources(self):
+        """Speculation over the PAGED cache (ISSUE 10 — the block-table
+        verify twin; the heavy pins live in tests/test_paged.py): same
+        stream as plain greedy for both draft sources through
+        `ContinuousDecodeServer(paged=True, speculate=...)`."""
+        lm = _lm()
+        p = _prompt()
+        plain = lm.generate(p, 14, use_cache=True)
+        for draft in (NGramDraft(), ModelDraft(_draft_lm())):
+            with ContinuousDecodeServer(
+                    lm, slots=2, prompt_buckets=(8,), paged=True,
+                    block_size=4, n_blocks=40,
+                    speculate=Speculator(draft, k=4)) as srv:
+                got = srv.generate(p, 14, timeout=60)
+                assert srv._pool.blocks_in_use == 0
+            assert got == plain
 
     def test_equal_arrival_matches_generate_batch(self):
         lm = _lm()

@@ -21,7 +21,8 @@ Run (CPU backend, no chip needed):
         [--server both] [--rates 50,100,200,400,800] \
         [--process poisson|onoff|closed] [--requests 64] \
         [--slo-ms 150] [--seed 0] [--report /tmp/sweep] [--no-trace] \
-        [--chunked-prefill C] [--admission] [--overload-ab]
+        [--chunked-prefill C] [--admission] [--overload-ab] \
+        [--paged] [--speculate K]
 
 `--process onoff` keeps the same MEAN rate but bursts at 2x with a 50%
 duty cycle (the p99 stressor); `--process closed` reinterprets each
@@ -115,7 +116,8 @@ def _knee(curve):
 def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
                  process="poisson", tracer=None, lm=None, slots=4,
                  paged=False, block_size=8, chunked_prefill=None,
-                 admission=None, brownout=None, deadline_ms=None):
+                 admission=None, brownout=None, deadline_ms=None,
+                 speculate_k=None):
     """Rate ladder over the ContinuousDecodeServer. One server serves
     every rate (compile once); per-point accounting is delta-based
     (loadgen baselines at entry), so points never contaminate each
@@ -126,6 +128,11 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
     at the default equal-bytes arena: the same sweep drives the
     block-gated admission path instead of the slot-gated one — the
     tier-1 smoke sweep runs one paged rate so CI exercises it.
+
+    `speculate_k=K` adds a K-wide n-gram speculative decode (both
+    layouts — paged speculation is the ISSUE 10 composition; the
+    tier-1 smoke sweep runs one paged+speculate rate so CI exercises
+    the block-table verify program under real arrivals).
 
     `n_req` may be a sequence (one count per rate): the overload A/B
     scales requests WITH rate so every rung offers the same DURATION of
@@ -141,18 +148,20 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
     goodput-under-SLO semantics made enforceable) — together the
     protected arm of the `--overload-ab` comparison."""
     from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
-                                            DecodeSizeMix,
-                                            ServingMetrics,
+                                            DecodeSizeMix, NGramDraft,
+                                            ServingMetrics, Speculator,
                                             build_schedule, run_load)
     lm = lm if lm is not None else _lm()
     metrics = ServingMetrics(slo_target_ms=slo_ms)
     controlled = (chunked_prefill is not None or admission or
                   brownout is not None)
+    spec = (None if speculate_k is None
+            else Speculator(NGramDraft(n=3), k=int(speculate_k)))
     srv = ContinuousDecodeServer(
         lm, slots=slots, prompt_buckets=(8, 16), max_queue=1024,
         metrics=metrics, tracer=tracer, paged=paged,
         block_size=block_size, chunked_prefill=chunked_prefill,
-        admission=admission, brownout=brownout,
+        admission=admission, brownout=brownout, speculate=spec,
         default_deadline_ms=(deadline_ms if deadline_ms is not None
                              else (slo_ms if admission else None))
         ).start()
@@ -188,8 +197,11 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
         ctrl = (f", overload control: chunk={chunked_prefill} "
                 f"admission={'on' if admission else 'off'} "
                 f"deadline={deadline_ms if deadline_ms is not None else slo_ms:g}ms")
+    if spec is not None:
+        ctrl += f", speculate k={spec.k} (n-gram)"
     return {"server": "decode", "process": process, "paged": bool(paged),
             "overload_control": bool(controlled),
+            "speculate_k": speculate_k,
             "config": f"TransformerLM L={len(lm.blocks)} d={d_model} "
                       f"slots={slots} cache={cache}, mix 80% "
                       f"short(p3-11/n4-23) + 20% long(p8-15/n24-43), "
@@ -312,7 +324,8 @@ def overload_compare(baseline, controlled, dec_base=None, dec_ctrl=None):
 def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
               process="poisson", n_req=64, slo_ms=150.0, seed=0,
               trace=True, report_path=None, paged=False,
-              chunked_prefill=None, admission=None, overload_ab=False):
+              chunked_prefill=None, admission=None, overload_ab=False,
+              speculate_k=None):
     """Drive the sweep(s) and (optionally) write the combined
     obs_report (JSON + text + Chrome trace). Returns the results list.
     The tier-1 smoke test calls this with tiny parameters (and once
@@ -360,7 +373,8 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
                                   seed=seed, process=process,
                                   tracer=tracer, paged=paged,
                                   chunked_prefill=chunked_prefill,
-                                  admission=admission)
+                                  admission=admission,
+                                  speculate_k=speculate_k)
         results.append(body)
         snaps["decode"] = snap
     if server in ("microbatch", "both"):
@@ -422,6 +436,10 @@ def main():
                     help="decode server uses the paged block-table KV "
                          "cache (equal-bytes arena) instead of fixed "
                          "slots")
+    ap.add_argument("--speculate", type=int, default=None, metavar="K",
+                    help="K-wide n-gram speculative decode on the "
+                         "decode server (composes with --paged: the "
+                         "block-table verify program)")
     ap.add_argument("--chunked-prefill", type=int, default=None,
                     metavar="C",
                     help="slice prompts into C-row prefill chunks "
@@ -447,7 +465,8 @@ def main():
                         report_path=args.report, paged=args.paged,
                         chunked_prefill=args.chunked_prefill,
                         admission=args.admission,
-                        overload_ab=args.overload_ab)
+                        overload_ab=args.overload_ab,
+                        speculate_k=args.speculate)
     for r in results:
         print(json.dumps(r))
     print(json.dumps({"elapsed_s": fmt(time.perf_counter() - t0, 1),

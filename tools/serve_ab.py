@@ -28,6 +28,13 @@ equally):
     prefix reuse. Token streams are pinned bit-identical
     (tests/test_paged.py); the A/B isolates CONCURRENCY: max live
     streams (live_streams_max) and tokens/s at the same memory.
+  * paged_spec_vs_paged — the SAME paged server config with and without
+    a K=4 n-gram draft verified through the BLOCK-TABLE verify program
+    (ISSUE 10: `make_paged_verify_fn` — speculation over the paged KV
+    cache, the two biggest serving wins composed). Streams pinned
+    bit-identical; the A/B isolates dispatch amortization on the paged
+    layout (dispatches/token vs the paged baseline, acceptance, and the
+    equal-arena concurrency class that must survive speculation).
   * overload_vs_baseline — the SAME seeded past-knee arrival schedule
     (serving/loadgen.py, NOT a backlog: overload is a queueing
     phenomenon) through an uncontrolled decode server vs one with
@@ -372,6 +379,122 @@ def bench_speculative_ab(segments, reqs_per_seg=16, slo_ms=100.0):
     }, snaps, None
 
 
+def bench_paged_spec_ab(segments, reqs_per_seg=16, slo_ms=100.0):
+    """paged+speculative vs paged plain decode (ISSUE 10): the SAME
+    paged server config — block-table arena, 16-token shared system
+    prefix stored once, slots a pure scheduling width — with and
+    without a K=4 n-gram draft verified through the BLOCK-TABLE verify
+    program (`make_paged_verify_fn`). Streams are pinned bit-identical
+    (tests/test_paged.py), so the A/B isolates dispatch amortization ON
+    the paged layout: the PR 5 win (dispatches/token 0.32 -> 0.14)
+    re-measured over the PR 8 memory model, the two serving wins
+    composed. Workload is repetitive text behind the shared prefix (the
+    prompt-lookup regime on the real-traffic shape); watch
+    dispatches/token spec vs plain (target <= 0.6x), tokens/s (>=
+    parity on compute-bound CPU), and live_streams_max (the equal-arena
+    concurrency class must survive speculation)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            NGramDraft, ServingMetrics,
+                                            Speculator)
+
+    V, max_len = 96, 96
+    lm = TransformerLM(V, d_model=32, n_heads=2, n_layers=2,
+                       max_len=max_len, seed=5, learning_rate=0.3)
+    T = 32
+    r = np.random.default_rng(0)
+    for _ in range(60):                 # off the clock: cycle continuation
+        xs = []
+        for _ in range(16):
+            pat = r.integers(1, V, int(r.integers(2, 5))).tolist()
+            xs.append((pat * (T // len(pat) + 2))[:T + 1])
+        xs = np.asarray(xs, np.int32)
+        lm.fit_batch(xs[:, :-1], xs[:, 1:])
+    sys_prefix = np.random.default_rng(7).integers(1, V, 16).tolist()
+
+    def workload(rng, n):
+        out = []
+        for _ in range(n):
+            pat = rng.integers(1, V, int(rng.integers(2, 5))).tolist()
+            p = sys_prefix + (pat * 8)[:int(rng.integers(4, 15))]
+            out.append((p, int(rng.integers(16, 41))))
+        return out
+
+    paged_kw = dict(slots=16, prompt_buckets=(32,), max_queue=256,
+                    paged=True, block_size=8, n_blocks=48)
+    servers = {
+        "paged_spec": ContinuousDecodeServer(
+            lm, speculate=Speculator(NGramDraft(n=3), k=4),
+            metrics=ServingMetrics(slo_target_ms=slo_ms),
+            **paged_kw).start(),
+        "paged": ContinuousDecodeServer(
+            lm, metrics=ServingMetrics(slo_target_ms=slo_ms),
+            **paged_kw).start(),
+    }
+    warm = workload(np.random.default_rng(0), 6)
+    for srv in servers.values():        # compile off the clock
+        for p, n in warm:
+            srv.generate(p, n, timeout=120)
+    # SLO baseline after warm-up: compile-latency misses stay off the books
+    base = {n: servers[n].metrics.snapshot() for n in servers}
+
+    seg_idx = {name: [0] for name in servers}
+
+    def seg(name):
+        srv = servers[name]
+
+        def run():
+            rng = np.random.default_rng(100 + seg_idx[name][0])
+            seg_idx[name][0] += 1
+            work = workload(rng, reqs_per_seg)
+            toks = sum(n for _, n in work)
+            t0 = time.perf_counter()
+            futs = [srv.submit(p, n) for p, n in work]
+            for f in futs:
+                f.result(300)
+            return toks / (time.perf_counter() - t0)
+        return run
+
+    ab = _interleaved({n: seg(n) for n in servers}, segments=segments)
+    snaps = {n: servers[n].metrics.snapshot() for n in servers}
+    for srv in servers.values():
+        srv.stop()
+    s = snaps["paged_spec"]
+    dpt = {n: snaps[n]["dispatches_per_token"] for n in snaps}
+    return {
+        "config": "TransformerLM L=2 d=32 (trained on cyclic patterns), "
+                  "BOTH arms paged 48 blocks x 8 rows (slots=16 "
+                  "scheduling width), 16-token shared system prefix + "
+                  "repetitive own prompts 4-14 / decode 16-40, n-gram "
+                  "draft K=4 on the spec arm, 16 reqs/segment, greedy",
+        "unit": "generated tokens/sec",
+        "ab": ab,
+        "speedup_spec_over_paged": round(
+            ab["paged_spec"]["median"] / ab["paged"]["median"], 3),
+        "dispatches_per_token": {n: fmt(dpt[n], 4) for n in dpt},
+        "dispatches_per_token_ratio": round(
+            dpt["paged_spec"] / dpt["paged"], 3),
+        "acceptance_rate": fmt(s["spec_acceptance_rate_mean"], 4),
+        "accepted_per_dispatch": fmt(
+            s["spec_accepted_per_dispatch_mean"], 3),
+        "max_concurrent_streams": {
+            n: snaps[n]["live_streams_max"] for n in snaps},
+        "prefix_hit_rate": {
+            n: fmt(snaps[n]["prefix_hit_rate"], 4) for n in snaps},
+        "cow_copies": {n: snaps[n]["cow_copies"] for n in snaps},
+        "blocked_on_memory": {
+            n: snaps[n]["blocked_on_memory"] for n in snaps},
+        "request_latency_ms": {
+            n: {"p50": fmt(snaps[n]["latency_ms_p50"]),
+                "p99": fmt(snaps[n]["latency_ms_p99"])} for n in snaps},
+        "slo_ms": slo_ms,
+        "slo": {n: _slo_view(snaps[n], ab[n]["median"], base[n])
+                for n in snaps},
+    }, snaps, None
+
+
 def bench_overload_ab(segments, reqs_per_seg=320, slo_ms=120.0):
     """Overload robustness A/B (PR 9): the SAME seeded Poisson schedule,
     offered well past the tiny model's saturation knee, replayed per
@@ -607,6 +730,7 @@ def main():
                ("paged_vs_fixed", bench_paged_ab),
                ("overload_vs_baseline", bench_overload_ab),
                ("speculative_vs_plain", bench_speculative_ab),
+               ("paged_spec_vs_paged", bench_paged_spec_ab),
                ("microbatch_vs_per_request", bench_microbatch_ab),
                ("tracing_on_vs_off", bench_tracing_ab))
     for name, fn in benches:
