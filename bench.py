@@ -38,6 +38,13 @@ plus beyond-reference extras (budget permitting, skipped first):
                         dispatches/token + tokens/s headline, the PR 5
                         amortization on the PR 8 memory model (streams
                         pinned bit-identical)
+ 11c. preempt_vs_shed   durable-KV preemption (ISSUE 11: serving/
+                        kvstate.py) vs shed-only at FULL block
+                        occupancy — batch-class slots spill to host and
+                        resume bit-identically while interactive
+                        requests take their blocks; interactive
+                        goodput-under-deadline + completion p99 vs the
+                        blocked/shed baseline
  12. load_sweep         production-traffic harness (serving/loadgen.py):
                         seeded Poisson arrivals at a 3-rate ladder
                         through the ContinuousDecodeServer — achieved
@@ -1056,6 +1063,38 @@ def bench_paged_speculative(rng, small=False):
     return rec
 
 
+def bench_preempt_vs_shed(rng, small=False):
+    """Durable-KV preemption A/B (ISSUE 11): at FULL block occupancy,
+    interactive-class goodput-under-deadline with preemption (batch
+    slots spill to host, resume bit-identically) vs the shed-only
+    baseline where blocked interactive work can only wait out the batch
+    or die at its deadline. tools/serve_ab.py `preempt_vs_shed` is the
+    implementation (client-side per-class accounting); the headline is
+    the preempt arm's interactive goodput with the ratio over shed-only
+    alongside — the acceptance bar is ratio > 1 (strictly more
+    interactive tokens landed in-deadline than shedding alone)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from serve_ab import bench_preempt_ab
+
+    segments = 3 if small else 5
+    body, snaps, _ = bench_preempt_ab(segments,
+                                      reqs_per_seg=8 if small else 12)
+    ab = body["ab"]
+    return {"value": ab["preempt"]["median"],
+            "unit": "interactive goodput tokens/sec (within deadline)",
+            "config": body["config"] + f", {segments} segments",
+            "preempt_ab": ab,
+            "interactive_goodput_preempt_over_shed":
+                body["interactive_goodput_preempt_over_shed"],
+            "interactive_completion_ms":
+                body["interactive_completion_ms"],
+            "preempted": body["preempted"]["preempt"],
+            "resumed": body["resumed"]["preempt"],
+            "spill_bytes": body["spill_bytes"]["preempt"],
+            "sheds": body["sheds"]}
+
+
 def bench_load_sweep(rng, small=False):
     """One pinned traffic-harness sweep point (the ISSUE 7 acceptance
     metric): seeded open-loop Poisson arrivals through the REAL
@@ -1202,6 +1241,10 @@ SECONDARY_CONFIGS = {
     # tokens/s vs the paged baseline — the PR 5 amortization on the
     # PR 8 memory model (the production configuration)
     "paged_speculative_decode": (bench_paged_speculative, 120),
+    # durable-KV preemption (ISSUE 11): interactive goodput-under-
+    # deadline at full block occupancy, preempt vs shed-only — the
+    # robustness lever queue-depth admission cannot supply
+    "preempt_vs_shed": (bench_preempt_vs_shed, 100),
     # the traffic-harness pinned sweep point (ISSUE 7): arrivals +
     # queueing, not backlog replay — knee + goodput-under-SLO per
     # record, plus the PR 9 overload-control goodput A/B at the top rate

@@ -724,6 +724,40 @@ def make_paged_install_fn(block_size):
     return install
 
 
+def make_block_extract_fn(block_size):
+    """Extract half of durable KV state (serving/kvstate.py): gather a
+    block-table's rows OUT of the arena into a host-bound panel — the
+    exact inverse of `make_paged_install_fn`'s scatter. One pure READ
+    program (the arena is not donated and not returned: a failed
+    extract trivially leaves it valid, mirroring the pure-prefill
+    failure-isolation argument), jitted once per table width because
+    the caller always passes the server's full `[NB]` table, zero-padded
+    past the allocation like every paged dispatch.
+
+    extract(cache, btab [NB]) -> panels [(k, v)] per layer,
+                                 each [NB * bs, H, hd]
+
+    Row r of a panel is LOGICAL row r of the table's request (physical
+    `btab[r // bs] * bs + r % bs`). The host slices `[:pos]` — rows at
+    or past the request's frontier are dead rows (never passed by the
+    pointer: rejected speculative suffixes, chunk padding) or rows
+    resolved through zeroed table entries into block 0; both are
+    garbage by contract and must not enter a durable artifact. Shared
+    leading blocks (refcount > 1) and a still-pending CoW partial block
+    are READ here, never written — a gather cannot violate the CoW
+    rule, so extraction needs no materialization (the restore side
+    re-acquires shared rows via the prefix index instead of duplicating
+    them, or re-installs them into private blocks)."""
+    bs = int(block_size)
+
+    def extract(cache, btab):
+        flat = (btab[:, None] * bs +
+                jnp.arange(bs)[None, :]).reshape(-1)    # [NB*bs]
+        return [(c["k"][flat], c["v"][flat]) for c in cache]
+
+    return extract
+
+
 def make_paged_verify_block_fn(n_heads, block_size):
     """`make_paged_decode_block_fn` widened to K query positions per
     slot: the per-block unit of the K-wide programs over the PAGED

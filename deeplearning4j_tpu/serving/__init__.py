@@ -40,6 +40,16 @@ offered-load side of the ROADMAP's "handles heavy traffic" claim;
 `tools/load_sweep.py` sweeps offered rate into a throughput–latency
 curve with goodput-under-SLO and the saturation knee.
 
+Durable KV state (`kvstate.py` + the zoo's `make_block_extract_fn`):
+a request's KV block set leaves the arena as a tag-checked host
+artifact and comes back bit-identically — preemption (`preempt=True`:
+batch-class slots spill to host so blocked interactive work takes
+their blocks, bounding TTFT at full block occupancy), a persistent
+cross-restart prefix cache (`prefix_cache_dir=`; version-fingerprint
+mismatch refuses loudly), and live-request migration between server
+instances (`migrate_out`/`migrate_in`, the prefill/decode
+disaggregation seam).
+
 Overload control (`admission.py` + `ContinuousDecodeServer(
 chunked_prefill=, admission=, brownout=, default_deadline_ms=)`):
 chunked prefill slices long prompts into decode-iteration-sized chunks
@@ -53,10 +63,13 @@ from .admission import (AdmissionController, BrownoutPolicy,
                         ServiceRateEstimator)
 from .metrics import ServingMetrics
 from .server import (DeadlineExceededError, InferenceServer,
-                     ServerClosedError, ServerOverloadedError,
-                     ServingError, UnhealthyOutputError)
+                     RequestMigratedError, ServerClosedError,
+                     ServerOverloadedError, ServingError,
+                     UnhealthyOutputError)
 from .decode import ContinuousDecodeServer
 from .kvpool import BlockPool, PagedAllocation
+from .kvstate import (KVStateError, KVStateVersionError,
+                      PrefixCacheArtifact, RequestArtifact)
 from .loadgen import (ClosedLoop, DecodeSizeMix, InferenceSizeMix,
                       OnOffProcess, PoissonProcess, Schedule,
                       build_schedule, run_load)
@@ -67,6 +80,8 @@ __all__ = [
     "ServingError", "ServerOverloadedError", "DeadlineExceededError",
     "UnhealthyOutputError", "ServerClosedError",
     "BlockPool", "PagedAllocation",
+    "RequestArtifact", "PrefixCacheArtifact", "KVStateError",
+    "KVStateVersionError", "RequestMigratedError",
     "AdmissionController", "BrownoutPolicy", "ServiceRateEstimator",
     "Speculator", "DraftSource", "NGramDraft", "ModelDraft",
     "PoissonProcess", "OnOffProcess", "ClosedLoop",

@@ -77,11 +77,12 @@ import collections
 import threading
 
 __all__ = ["ServiceRateEstimator", "AdmissionController",
-           "BrownoutPolicy", "ACCEPT", "DEFER", "SHED"]
+           "BrownoutPolicy", "ACCEPT", "DEFER", "SHED", "PREEMPT"]
 
 ACCEPT = "accept"
 DEFER = "defer"
 SHED = "shed"
+PREEMPT = "preempt"
 
 
 class ServiceRateEstimator:
@@ -362,6 +363,23 @@ class BrownoutPolicy:
                                  f"first)")
         self.min_attainment = (None if min_attainment is None
                                else float(min_attainment))
+
+    def may_preempt(self, victim_klass, claimant_klass):
+        """The PREEMPT verb (durable KV state, serving/kvstate.py):
+        True when a live `victim_klass` slot should yield its KV blocks
+        to a `claimant_klass` request blocked on memory. The ranking is
+        the one this policy already encodes: a class whose `defer_at`
+        is STRICTLY below another's is the class that steps aside under
+        queue pressure, so under MEMORY pressure it steps aside too —
+        its work is spilled to host (resumable bit-identically), not
+        thrown away, which is what bounds interactive TTFT at full
+        block occupancy where queue-depth admission structurally
+        cannot. Equal-rank classes never preempt each other (no
+        same-class churn), and the shipped never-defer default (1.01)
+        can never be a victim of another default-class request."""
+        vd = self.classes.get(str(victim_klass), self.default)[0]
+        cd = self.classes.get(str(claimant_klass), self.default)[0]
+        return vd < cd
 
     def decide(self, klass, queue_frac, attainment=None):
         """One admission decision: ACCEPT, DEFER, or SHED."""

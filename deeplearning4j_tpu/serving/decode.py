@@ -113,6 +113,42 @@ tok/s, TTFT p99 x30, queue_wait 72% of request time). Three levers:
   fail-fast stop and both drain bounded by their remaining work on
   stop(drain=True) — expired deadlines shed at admission, so a
   saturated drain never decodes work nobody can use.
+* **Durable KV state** (`serving/kvstate.py` + the zoo's
+  `make_block_extract_fn`): a live request's KV block set can leave the
+  arena as a host-side `RequestArtifact` (panel rows + token history +
+  position + param-version tag) and come back bit-identically — ONE
+  serialization primitive closing three production seams. (1)
+  PREEMPTION (`preempt=True`, paged + brownout): when a request whose
+  class outranks a live slot's (`BrownoutPolicy.may_preempt` — the
+  accept/defer/shed verbs extended with preempt) is blocked on KV
+  blocks, the victim slot is spilled to host (`preempted`,
+  `spill_bytes`), its blocks go to the claimant, and the victim parks
+  on a RESUME LINE served ahead of the queue as blocks free
+  (`resumed`) — interactive TTFT is bounded at FULL BLOCK OCCUPANCY,
+  which queue-depth admission structurally cannot do; the resume
+  line's remaining work stays in the admission estimator's backlog
+  (plus one re-install unit), so predictions price parked work
+  truthfully. (2) PERSISTENT PREFIX CACHE (`prefix_cache_dir=`): on
+  stop(), the LRU-cached prefix blocks + index entries are saved under
+  the newest param version's content fingerprint; a restarted server
+  re-offers the warm blocks (`prefix_restore_hits`), and a restore
+  under different params refuses them loudly
+  (`KVStateVersionError` — the hot-swap invalidation rule extended
+  across restarts). (3) MIGRATION (`migrate_out`/`migrate_in`): a live
+  decode-phase request moves between server instances, tag-checked at
+  import AND at admission, resumed bit-identical to an uninterrupted
+  run — the seam prefill/decode disaggregation and replica fleets
+  consume. Extraction is a pure table gather (never a write), so a
+  still-pending CoW spare is simply FORGONE — the artifact carries the
+  rows, release() returns the spare, and restore re-acquires shared
+  leading blocks through the prefix index (refcount++, never
+  duplicated) with its own CoW spare if it rides a partial block
+  again. All of it composes with chunked prefill and speculation
+  (victims/exports are decode-phase slots only; a prefilling slot is
+  never spilled — its artifact would be a half-written panel), and the
+  non-preempting path stays at ZERO added device dispatches per token
+  (counter-pinned: extract/install run only when a spill actually
+  happens).
 * **Prefix-hit priority admission** (`prefix_priority=`, default on
   where it means something: paged + prefix_cache + chunked_prefill):
   a full-prefix-hit request costs ONE chunk of prefill (chunked paged
@@ -139,6 +175,7 @@ from __future__ import annotations
 import collections
 import concurrent.futures as cf
 import logging
+import os
 import queue
 import threading
 import time
@@ -146,10 +183,34 @@ import time
 import numpy as np
 
 from .. import obs
-from .server import (DeadlineExceededError, ServerClosedError,
-                     ServerOverloadedError, _RequestLoop)
+from .kvstate import (KVStateError, KVStateVersionError,
+                      PrefixCacheArtifact, RequestArtifact,
+                      artifact_kind)
+from .server import (DeadlineExceededError, RequestMigratedError,
+                     ServerClosedError, ServerOverloadedError,
+                     _RequestLoop)
 
 log = logging.getLogger(__name__)
+
+
+def _param_fingerprint(aux, blocks):
+    """Content fingerprint of one param version: sha256 over every
+    leaf's shape/dtype/bytes. THE durable version tag
+    (serving/kvstate.py): the in-process prefix index is namespaced by
+    version INDEX, but an index means nothing across a restart or
+    between servers — only the weights themselves do. Computed lazily
+    once per version (the host transfer is paid only when durable
+    state is actually saved/restored, never on the decode path)."""
+    import hashlib
+
+    import jax
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves((aux, blocks)):
+        a = np.asarray(leaf)
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]
 
 
 def _fail_future(fut, exc):
@@ -201,7 +262,8 @@ class _DecodeRequest:
                  "generated", "slot", "version", "req_id", "t_last_tok",
                  "alloc", "mem_blocked", "pf_next", "pf_wfrom",
                  "work_left", "work_counted", "predicted_done", "klass",
-                 "prio_overtook", "pf_quoted")
+                 "prio_overtook", "pf_quoted", "artifact", "migrated",
+                 "progress_base")
 
     def __init__(self, prompt, max_new, deadline, klass="default"):
         self.prompt = prompt
@@ -227,6 +289,17 @@ class _DecodeRequest:
         self.pf_quoted = 1      # prefill units QUOTED at submit (a
         #                         priority hit is quoted 1 chunk; the
         #                         chunked admit retires against this)
+        self.artifact = None    # durable KV state parked for resume
+        #                         (kvstate.RequestArtifact: preempted
+        #                         or migrated-in; None once installed)
+        self.migrated = False   # arrived via migrate_in (counted
+        #                         `migrated` at restore admission;
+        #                         preempted locals count `resumed`)
+        self.progress_base = 0  # len(generated) at the last restore:
+        #                         a victim must advance
+        #                         _PREEMPT_MIN_PROGRESS tokens past
+        #                         this before it may be spilled again
+        #                         (anti-thrash — see _try_preempt_for)
 
 
 class ContinuousDecodeServer(_RequestLoop):
@@ -242,6 +315,13 @@ class ContinuousDecodeServer(_RequestLoop):
 
     _thread_name = "continuous-decode"
     _default_stop_timeout = 60.0
+    # a preemption victim must have decoded this many tokens since its
+    # last (re)start before it may be spilled again: each spill's
+    # extract+install round-trip is amortized over at least this much
+    # progress, so sustained interactive pressure degrades a batch
+    # stream's latency but can never pin it in a spill/restore loop
+    # with O(1) tokens per full-panel round-trip
+    _PREEMPT_MIN_PROGRESS = 4
     # after this many consecutive priority overtakes, the primary
     # queue's head gets one turn: sustained prefix-hit traffic must
     # never starve cold prompts outright (the hit line is a goodput
@@ -256,8 +336,10 @@ class ContinuousDecodeServer(_RequestLoop):
                  n_blocks=None, prefix_cache=True,
                  max_blocks_per_slot=None, chunked_prefill=None,
                  admission=None, brownout=None,
-                 default_deadline_ms=None, prefix_priority=True):
+                 default_deadline_ms=None, prefix_priority=True,
+                 preempt=False, prefix_cache_dir=None):
         from ..models.zoo.transformer import (make_block_copy_fn,
+                                              make_block_extract_fn,
                                               make_chunked_prefill_fn,
                                               make_paged_decode_fn,
                                               make_paged_install_fn,
@@ -344,6 +426,40 @@ class ContinuousDecodeServer(_RequestLoop):
         self._prio_q = collections.deque()       # prefix-hit fast line
         self._prio_streak = 0   # consecutive genuine overtakes (anti-
         #                         starvation: see _next_request)
+        # durable KV state (module docstring; serving/kvstate.py):
+        # preemption policy, the resume line, migration plumbing, and
+        # the persistent prefix-cache directory. The preempt verb needs
+        # BOTH the paged pool (fixed-slot state has no extractable
+        # block set) and a brownout policy (class ranking IS the
+        # policy; without one no class may preempt another and the
+        # flag would be a silent no-op).
+        self._preempt_on = bool(preempt)
+        if self._preempt_on and not self._paged:
+            raise ValueError("preempt=True requires paged=True (only a "
+                             "block-table KV set can be spilled)")
+        if self._preempt_on and brownout is None:
+            raise ValueError("preempt=True requires a brownout= policy: "
+                             "BrownoutPolicy.may_preempt ranks request "
+                             "classes, and without a ranking nothing "
+                             "may ever be preempted")
+        self._prefix_dir = (None if prefix_cache_dir is None
+                            else str(prefix_cache_dir))
+        if self._prefix_dir is not None and not (
+                self._paged and self._prefix_cache):
+            raise ValueError("prefix_cache_dir requires paged=True with "
+                             "prefix_cache=True (there is no prefix "
+                             "cache to persist otherwise)")
+        self._resume_q = collections.deque()     # serve-thread ONLY:
+        #   spilled requests (artifact set) awaiting blocks + a slot
+        self._migrate_in_q = collections.deque()  # client -> serve
+        #   staging for migrate_in (drained into _resume_q by the loop
+        #   so _resume_q never races a client append)
+        self._migrate_cmds = collections.deque()  # (future, reply)
+        self._tag_cache = {}    # version index -> param fingerprint
+        self._prefix_saved = True   # nothing to save before start()
+        self._gate_key = None   # preempting-gate rescan guard: the
+        #   (pool, progress, depth) signature of the last full scan
+        #   that admitted nothing — identical signature => skip
         self._work_lock = threading.Lock()
         self._work_tokens = 0   # work-unit backlog (queued + live)
         # admission hysteresis: any actual eviction/queue expiry
@@ -427,6 +543,13 @@ class ContinuousDecodeServer(_RequestLoop):
             self._cow_copy = jax.jit(
                 make_block_copy_fn(self._block_size),
                 donate_argnums=(0,))
+            # durable-KV extract: a pure [NB]-table gather (arena read,
+            # never donated) — one compiled program per server, shared
+            # by preemption, migration export, and the prefix-cache
+            # save (which batches cached blocks through the same table
+            # width)
+            self._extract = jax.jit(
+                make_block_extract_fn(self._block_size))
         else:
             self._make_prefill = lambda: jax.jit(make_prefill_fn(
                 n_heads, self.max_len))
@@ -441,6 +564,15 @@ class ContinuousDecodeServer(_RequestLoop):
 
         self._swap_lock = threading.Lock()
         self._init_loop(max_queue)
+        if self._prefix_dir is not None and \
+                artifact_kind(self._prefix_dir) == "prefix_cache":
+            # warm start: a committed snapshot exists — restore it into
+            # the fresh pool BEFORE serving begins. A version mismatch
+            # raises KVStateVersionError out of the constructor (LOUD:
+            # the operator pointed a new model at an old cache; zero
+            # silent reuse). An absent/partial snapshot is a cold
+            # start, not an error.
+            self.restore_prefix_cache(self._prefix_dir)
 
     # -- client API ----------------------------------------------------
     def submit(self, prompt, max_new_tokens, deadline_ms=None,
@@ -706,24 +838,29 @@ class ContinuousDecodeServer(_RequestLoop):
         return req.future
 
     def _pending_depth(self):
-        """Enqueue-time depth includes the parked priority line: its
-        requests are pending work the gauge must not hide, and the one
-        base-class sample per enqueue stays the ONLY sample."""
-        return self._q.qsize() + len(self._prio_q)
+        """Enqueue-time depth includes every parked line — the priority
+        line and the resume/migrate-in lines are pending work the gauge
+        must not hide — and the one base-class sample per enqueue stays
+        the ONLY sample."""
+        return (self._q.qsize() + len(self._prio_q)
+                + len(self._resume_q) + len(self._migrate_in_q))
 
     def _shed_if_lines_full(self):
-        """The ONE shared-budget check both admission paths run: the
-        primary queue and the priority line together may never stack
-        pending work past `max_queue` — otherwise M parked hits plus M
-        queued colds would reach 2x the operator's backpressure bound.
-        (Two racing submits can each pass the sum check — the same
-        benign width every parked-line bound has; the Queue's own
-        put_nowait still hard-caps the primary line.)"""
-        if 0 < self._q.maxsize <= len(self._prio_q) + self._q.qsize():
+        """The ONE shared-budget check every admission path runs (plain
+        submit, priority line, migrate_in): the primary queue and ALL
+        parked lines — priority, resume, migrate-in staging — together
+        may never stack pending work past `max_queue`, otherwise parked
+        hits/artifacts plus queued colds would multiply the operator's
+        backpressure bound (and the resume/staging lines hold full KV
+        panels in host memory). (Two racing submits can each pass the
+        sum check — the same benign width every parked-line bound has;
+        the Queue's own put_nowait still hard-caps the primary line.)"""
+        if 0 < self._q.maxsize <= self._pending_depth():
             self.metrics.count("shed_queue_full")
             self.metrics.record_queue_depth(self._pending_depth())
             raise ServerOverloadedError(
-                f"queue full ({self._q.maxsize} pending)")
+                f"queue full ({self._q.maxsize} pending incl. parked "
+                f"lines)")
 
     def _enqueue(self, req):
         """The primary enqueue with the budget shared BOTH ways (see
@@ -797,6 +934,575 @@ class ContinuousDecodeServer(_RequestLoop):
                                      f"{o.shape}/{o.dtype}")
             self._versions.append(new)
             self.metrics.count("swaps")
+
+    # -- durable KV state (serving/kvstate.py) -------------------------
+    def start(self):
+        # a (re)started server has live state the next clean stop must
+        # persist again
+        self._prefix_saved = self._prefix_dir is None
+        return super().start()
+
+    def stop(self, drain=True, timeout=None):
+        """Stop the loop (base semantics), then — when constructed with
+        `prefix_cache_dir=` and the loop really exited — persist the
+        prefix cache so the next server instance warm-starts. The save
+        runs on the CALLER's thread against a dead loop (the serve
+        thread owned the arena until it exited); a join timeout skips
+        it (the loop still owns the arena) but a RETRIED stop() after
+        the drain finally finishes performs it — the `_prefix_saved`
+        flag, not a was-running snapshot, decides, so a slow drain
+        cannot silently cost the warm start. A failed save is logged,
+        not raised: stop() must tear the server down regardless."""
+        super().stop(drain=drain, timeout=timeout)
+        t = self._thread
+        if (self._prefix_dir is not None and not self._prefix_saved
+                and not self._running
+                and (t is None or not t.is_alive())):
+            self._prefix_saved = True
+            try:
+                self.save_prefix_cache(self._prefix_dir)
+            except Exception:   # noqa: BLE001 — teardown must finish
+                log.exception("prefix-cache save failed at stop()")
+
+    def _version_tag(self, vidx):
+        """Content fingerprint of param version `vidx` — the durable
+        tag artifacts carry (computed once per version, cached)."""
+        tag = self._tag_cache.get(vidx)
+        if tag is None:
+            with self._swap_lock:
+                ver = self._versions[vidx]
+            if ver is None:
+                raise KVStateError(f"param version {vidx} already "
+                                   f"drained; nothing to fingerprint")
+            tag = self._tag_cache[vidx] = _param_fingerprint(*ver)
+        return tag
+
+    def _extract_artifact(self, slot):
+        """Pull `slot`'s complete KV state to host as a
+        `RequestArtifact` (serve thread only; decode phase only). One
+        extract dispatch — a pure table gather, so a still-pending CoW
+        spare needs no materialization (the shared partial block is
+        READ; restore re-acquires shared rows through the prefix index
+        or re-installs them privately) and the arena is never at risk
+        from a failed call."""
+        import jax.numpy as jnp
+        r = self._slot_req[slot]
+        pos = len(r.prompt) + len(r.generated) - 1
+        tab = np.zeros((self._nb_slot,), np.int32)
+        tab[:len(r.alloc.ids)] = r.alloc.ids
+        with self._tracer.span("decode.extract", cat="serve",
+                               track="server", trace_id=r.req_id,
+                               slot=slot, rows=pos):
+            panels = self._extract(self._cache, jnp.asarray(tab))
+        # slice to the frontier on host: rows >= pos are dead rows
+        # (rejected speculative suffixes, chunk padding) or zero-table
+        # resolutions — garbage by contract, never serialized
+        panels = [(np.asarray(k)[:pos].copy(), np.asarray(v)[:pos].copy())
+                  for k, v in panels]
+        art = RequestArtifact(r.prompt, r.generated, r.max_new,
+                              self._version_tag(r.version),
+                              self._block_size, panels, klass=r.klass)
+        self.metrics.count("spill_bytes", art.nbytes)
+        return art
+
+    def _preempt_slot(self, slot):
+        """PAUSE `slot`'s request: spill its KV state to host, release
+        its blocks to the pool, park it on the resume line. The future
+        stays pending (the caller notices nothing but latency), the
+        request's remaining tokens stay in the admission backlog, and
+        one re-install unit joins them — the resume line is real work
+        the estimator must price."""
+        r = self._slot_req[slot]
+        r.artifact = self._extract_artifact(slot)
+        self._free_slot(slot)           # blocks back to the pool
+        r.slot = None                   # r.version KEPT: the resume
+        #                                 must run under the params the
+        #                                 rows were computed with
+        #                                 (_gc_versions guards it)
+        with self._work_lock:
+            if r.work_counted:
+                r.work_left += 1        # the resume-install unit
+                self._work_tokens += 1
+        self._resume_q.append(r)
+        self.metrics.count("preempted")
+        tr = self._tracer
+        if tr.enabled:
+            tr.instant("decode.preempt", cat="serve",
+                       track=f"req-{r.req_id}", trace_id=r.req_id)
+
+    def _gate_signature(self):
+        """Everything the preempting memory gate's outcome depends on:
+        pool occupancy (admit feasibility), total decode progress (the
+        anti-thrash eligibility clock — a victim becomes preemptible by
+        decoding), and pending depth (new work to scan). Identical
+        signature => an identical rescan outcome, so the gate skips it
+        (see _admit_pending). Deadline expiries and line sweeps shrink
+        the depth; completions/evictions/preemptions move the pool."""
+        return (self._pool.blocks_free, self._pool.blocks_in_use,
+                self.metrics.count_value("tokens_out"),
+                self._pending_depth())
+
+    def _try_preempt_for(self, req):
+        """Free blocks for a memory-blocked `req` by preempting ONE
+        victim slot, or return False when policy/occupancy offer none.
+        Victims are DECODE-PHASE slots whose class the brownout policy
+        ranks strictly below the claimant's (`may_preempt`) AND that
+        have decoded at least `_PREEMPT_MIN_PROGRESS` tokens since
+        their last (re)start — the anti-thrash floor: without it a
+        just-resumed victim is immediately eligible again, and a
+        sustained interactive stream pins it in a spill/restore loop
+        paying a full-panel round-trip per ~token. Among candidates
+        the most-yielding class goes first and, within it, the slot
+        holding the most blocks (fewest preemptions to free the
+        claimant's demand). A prefilling slot is never a victim — its
+        panel is half-written."""
+        if not self._preempt_on or self._brownout is None:
+            return False
+        cands = []
+        for s, r in enumerate(self._slot_req):
+            if r is None or r.pf_next is not None or r.alloc is None:
+                continue
+            if len(r.generated) - r.progress_base \
+                    < self._PREEMPT_MIN_PROGRESS:
+                continue
+            if not self._brownout.may_preempt(r.klass, req.klass):
+                continue
+            rank = self._brownout.classes.get(
+                str(r.klass), self._brownout.default)[0]
+            cands.append((rank, -len(r.alloc.ids), s))
+        if not cands:
+            return False
+        self._preempt_slot(min(cands)[2])
+        return True
+
+    def _check_artifact(self, art):
+        """Structural fit of an artifact against THIS server (the
+        version tag is checked separately — structure says the bytes
+        can land, the tag says they may)."""
+        k0 = art.panels[0][0]
+        hd = self._d_model // self._n_heads
+        if art.block_size != self._block_size:
+            raise KVStateError(
+                f"artifact block_size {art.block_size} != server "
+                f"block_size {self._block_size}")
+        if (len(art.panels) != self._n_layers
+                or k0.shape[1:] != (self._n_heads, hd)
+                or k0.dtype != np.dtype(self._cache_dtype)):
+            raise KVStateError(
+                f"artifact panel [{k0.shape[0]}, {k0.shape[1]}, "
+                f"{k0.shape[2]}] x {len(art.panels)} layers "
+                f"({k0.dtype}) does not fit this server's cache "
+                f"([rows, {self._n_heads}, {hd}] x {self._n_layers}, "
+                f"{np.dtype(self._cache_dtype)})")
+        if len(art.prompt) + art.max_new > self.max_len:
+            raise KVStateError(
+                f"artifact needs {len(art.prompt)} + {art.max_new} "
+                f"rows; server max_len is {self.max_len}")
+
+    def migrate_out(self, future, timeout=30.0):
+        """Export a live request's KV state as a `RequestArtifact` and
+        DROP it locally: the request identified by its submit()
+        `future` is extracted between scheduling iterations (the serve
+        thread performs the gather; this call blocks until it has), its
+        blocks are released, and the local future fails with
+        `RequestMigratedError` — the importing server's
+        `migrate_in(artifact)` future carries the resumed stream,
+        bit-identical to an uninterrupted run. Only decode-phase
+        requests are migratable (a prefilling panel is half-written; a
+        queued request has no KV state to move — just resubmit it)."""
+        if not self._paged:
+            raise ValueError("migrate_out requires paged=True")
+        if not self._running:
+            raise ServerClosedError("server is not running")
+        reply = cf.Future()
+        self._migrate_cmds.append((future, reply))
+        try:        # nudge an idle-blocked loop (the priority-line
+            self._q.put_nowait(_Wake())     # wake pattern)
+        except queue.Full:
+            pass
+        return reply.result(timeout)
+
+    def _service_migrations(self):
+        """Serve-thread half of `migrate_out`: resolve each pending
+        export command against the live slots (and the resume line — a
+        PREEMPTED request already is its artifact)."""
+        while self._migrate_cmds:
+            fut, reply = self._migrate_cmds.popleft()
+            try:
+                art = self._migrate_out_now(fut)
+            except BaseException as e:  # noqa: BLE001 — reply carries it
+                reply.set_exception(e)
+            else:
+                reply.set_result(art)
+
+    def _migrate_out_now(self, fut):
+        for s, r in enumerate(self._slot_req):
+            if r is None or r.future is not fut:
+                continue
+            if r.pf_next is not None:
+                raise KVStateError(
+                    "request is still in chunked prefill; only "
+                    "decode-phase requests are migratable")
+            art = self._extract_artifact(s)
+            _fail_future(r.future, RequestMigratedError(
+                "request exported to another server"))
+            self._free_slot(s)
+            self._gc_versions()
+            self.metrics.count("migrated_out")
+            return art
+        for r in list(self._resume_q):
+            if r.future is fut and r.artifact is not None:
+                self._resume_q.remove(r)
+                art = r.artifact
+                r.artifact = None
+                _fail_future(r.future, RequestMigratedError(
+                    "request exported to another server"))
+                self.metrics.count("migrated_out")
+                return art
+        raise KVStateError(
+            "request not found in a decode slot (completed, failed, "
+            "still queued, or never admitted here)")
+
+    def migrate_in(self, artifact, deadline_ms=None):
+        """Adopt another server's exported `RequestArtifact`: returns a
+        Future resolving to the FULL token list (prompt + every
+        generated token, pre- and post-migration), exactly what the
+        source's future would have resolved to uninterrupted. The
+        artifact's param tag must match this server's newest version
+        (`KVStateVersionError` otherwise — checked here AND re-checked
+        at admission, so a hot swap racing the import still refuses
+        stale rows); the request then parks on the resume line and is
+        installed when blocks and a slot free up."""
+        if not self._paged:
+            raise ValueError("migrate_in requires paged=True")
+        if not self._running:
+            raise ServerClosedError("server is not running")
+        art = artifact
+        with self._swap_lock:
+            vidx = len(self._versions) - 1
+        art.require_tag(self._version_tag(vidx), what="migrated request")
+        self._check_artifact(art)
+        need = self._pool.blocks_needed(len(art.prompt) + art.max_new - 1)
+        if need > self._n_blocks or need > self._nb_slot:
+            self.metrics.count("shed_blocks")
+            raise ServerOverloadedError(
+                f"migrated request needs {need} KV blocks but the "
+                f"server holds {min(self._n_blocks, self._nb_slot)} "
+                f"(pool / per-slot table)")
+        # the max_queue budget caps MIGRATED pending work too (the ONE
+        # shared check — a rebalancer draining a failing replica into
+        # this one hits the same backpressure bound ordinary submits do)
+        self._shed_if_lines_full()
+        self.metrics.count("received")
+        now = time.monotonic()
+        if deadline_ms is not None:
+            dl = now + deadline_ms / 1e3
+        else:
+            dl = (now + self.default_deadline
+                  if self.default_deadline is not None else None)
+        req = _DecodeRequest(list(art.prompt), art.max_new, dl,
+                             klass=art.klass)
+        req.generated = list(art.generated)
+        req.req_id = next(self._req_ids)
+        req.migrated = True
+        if art.remaining <= 0:
+            # fully-decoded artifact: nothing left to serve — resolve
+            # immediately rather than park a no-op on the resume line
+            req.future.set_result(list(art.prompt) + req.generated)
+            return req.future
+        req.artifact = art
+        # resume-line work units: the remaining token budget plus one
+        # re-install unit join the backlog NOW — the estimator prices
+        # parked migrated work like any queued work
+        req.work_left = art.remaining + 1
+        with self._work_lock:
+            self._work_tokens += req.work_left
+            req.work_counted = True
+        req.future.add_done_callback(
+            lambda _f, r=req: self._retire_work(r))
+        self._migrate_in_q.append(req)
+        try:        # nudge an idle-blocked loop
+            self._q.put_nowait(_Wake())
+        except queue.Full:
+            pass
+        tr = self._tracer
+        if tr.enabled:
+            tr.instant("serve.enqueue", cat="serve",
+                       track=f"req-{req.req_id}", trace_id=req.req_id)
+        if not self._running:
+            _fail_future(req.future, ServerClosedError(
+                "server stopped during migrate_in"))
+            raise ServerClosedError("server stopped during migrate_in")
+        return req.future
+
+    def _install_panel(self, ids, panels, length, shared_len):
+        """Install host panel rows through a block table: rows
+        [shared_len, length) land at their table-mapped arena rows via
+        the SAME donated install scatter prefill uses, at full table
+        width — one compiled restore shape per server, shared by
+        resume, migrate-in, and the prefix-cache restore."""
+        import jax.numpy as jnp
+        R = self._nb_slot * self._block_size
+        tab = np.zeros((self._nb_slot,), np.int32)
+        tab[:len(ids)] = ids
+        dev = []
+        for k, v in panels:
+            kp = np.zeros((1, R) + k.shape[1:], k.dtype)
+            vp = np.zeros((1, R) + v.shape[1:], v.dtype)
+            kp[0, :k.shape[0]] = k
+            vp[0, :v.shape[0]] = v
+            dev.append((jnp.asarray(kp), jnp.asarray(vp)))
+        self._cache = self._paged_install(
+            self._cache, dev, jnp.asarray(tab),
+            jnp.asarray(int(length), jnp.int32),
+            jnp.asarray(int(shared_len), jnp.int32))
+
+    def _count_restore_hits(self, alloc):
+        """Prefix blocks this admission shares that came from a
+        restored snapshot — the restart-warm-start proof counter."""
+        if not self._pool.restored:
+            return
+        hits = sum(1 for b in alloc.ids[:alloc.n_shared]
+                   if b in self._pool.restored)
+        if hits:
+            self.metrics.count("prefix_restore_hits", hits)
+
+    def _admit_restored(self, req, slot, alloc, vidx):
+        """Install a spilled/migrated request into `slot` from its
+        artifact: block table + position + one install dispatch for
+        the rows the prefix match did not already make resident.
+        Shared FULL leading blocks were re-acquired by the pool
+        (refcount++, never duplicated) and are skipped by the install's
+        index gate; a partial-block ride materializes its CoW spare
+        BEFORE the install (body comment — installing through a
+        still-shared partial block would overwrite the cached owner's
+        tail). The resumed stream is bit-identical: panel rows ARE the
+        bits the uninterrupted run computed, and decode continues from
+        the same (pos, last token) state."""
+        art = req.artifact
+        pos = art.pos
+        if alloc.cow is not None:
+            # a PARTIAL-tail ride must not be installed into: the
+            # install below writes rows [resident, pos), and with the
+            # shared partial block still in the table those rows would
+            # land INSIDE it — overwriting the cached owner's tail that
+            # other prompts still match. Swap the reserved CoW spare in
+            # NOW; no device row-copy is needed (unlike the decode-path
+            # CoW) because the artifact carries every row of that block
+            # and the install writes them all — so the resident set
+            # shrinks to the FULL shared blocks only.
+            self._pool.cow(alloc)
+        resident = alloc.n_shared * self._block_size
+        self._btabs[slot, :] = 0
+        self._btabs[slot, :len(alloc.ids)] = alloc.ids
+        req.alloc = alloc
+        with self._tracer.span("decode.restore", cat="serve",
+                               track="server", trace_id=req.req_id,
+                               slot=slot, rows=pos, shared=resident):
+            self._install_panel(alloc.ids, art.panels, pos, resident)
+        # only now are the request's own prompt blocks really filled —
+        # commit them to the prefix index (same ordering rule as
+        # prefill: a failed install must never leave garbage matchable)
+        self._pool.commit(alloc)
+        self._count_restore_hits(alloc)
+        self._spend_work(req)           # the install unit
+        self._pos = self._pos.at[slot].set(pos)
+        self._tok[slot] = req.generated[-1]
+        req.pf_next = None
+        req.slot = slot
+        req.version = vidx
+        req.artifact = None             # host copy released
+        req.progress_base = len(req.generated)  # anti-thrash floor
+        req.t_last_tok = time.monotonic()
+        self._slot_req[slot] = req
+        if self._spec is not None:
+            self._spec.draft.start(slot, list(req.prompt) + req.generated)
+        self.metrics.count("migrated" if req.migrated else "resumed")
+
+    def _admit_resume(self, slot):
+        """Serve the RESUME LINE into `slot` (ahead of every queue —
+        parked spilled work is the oldest admitted work in the house).
+        Non-blocking: a resume head that cannot get its blocks leaves
+        admission open for queue work (which may fit in less, or
+        preempt its own victim) instead of head-of-line-blocking the
+        door; it retries every iteration and has first claim on freed
+        blocks. Returns True when the slot was filled."""
+        while self._resume_q:
+            req = self._resume_q[0]
+            if req.future.done():       # cancelled / failed while parked
+                self._resume_q.popleft()
+                continue
+            now = time.monotonic()
+            if req.deadline is not None and now > req.deadline:
+                self._resume_q.popleft()
+                if _fail_future(req.future, DeadlineExceededError(
+                        "deadline expired on the resume line")):
+                    self._deadline_miss(req, now)
+                continue
+            art = req.artifact
+            if req.version is not None:
+                vidx = req.version      # in-process preemption: the
+                #                         pinned version (GC-guarded)
+            else:
+                with self._swap_lock:   # migrated in: newest version,
+                    vidx = len(self._versions) - 1      # tag re-checked
+                try:
+                    art.require_tag(self._version_tag(vidx),
+                                    what="migrated request")
+                except KVStateVersionError as e:
+                    self._resume_q.popleft()
+                    if _fail_future(req.future, e):
+                        self.metrics.count("failed")
+                    continue
+            alloc = self._pool.admit(
+                req.prompt, len(req.prompt) + req.max_new - 1,
+                will_append=True, tag=vidx)
+            if alloc is None:
+                if not req.mem_blocked:
+                    req.mem_blocked = True
+                    self.metrics.count("blocked_on_memory")
+                return False
+            self._resume_q.popleft()
+            try:
+                self._admit_restored(req, slot, alloc, vidx)
+            except BaseException as e:  # noqa: BLE001 — fail THIS req
+                self._pool.release(alloc)
+                _fail_future(req.future, e)
+                self.metrics.count("failed")
+                continue
+            return True
+        return False
+
+    def save_prefix_cache(self, path=None):
+        """Persist the prefix cache's resident blocks (the pool's
+        LRU-cached tier) as a `PrefixCacheArtifact` under the NEWEST
+        param version's tag. Only entries indexed under that version
+        are saved — older versions' rows would be unreachable after a
+        restart anyway (the in-process tag rule). Call on a STOPPED
+        server (stop() does, when `prefix_cache_dir` is set); returns
+        the artifact path, or None when there is nothing to save."""
+        if not (self._paged and self._prefix_cache):
+            raise ValueError("no paged prefix cache to save")
+        if self._running or (self._thread is not None
+                             and self._thread.is_alive()):
+            raise KVStateError("save_prefix_cache needs a stopped "
+                               "server (the serve thread owns the "
+                               "arena while running)")
+        path = path if path is not None else self._prefix_dir
+        if path is None:
+            raise ValueError("no path: pass one or construct with "
+                             "prefix_cache_dir=")
+        with self._swap_lock:
+            vidx = len(self._versions) - 1
+        entries = self._pool.cached_entries(tag=vidx)
+        if not entries:
+            # nothing saveable under the NEWEST version. A snapshot
+            # already at the server's OWN prefix_cache_dir is then
+            # STALE (earlier params or an earlier run) and must not
+            # survive: left in place it would strand the next
+            # constructor on a loud version refusal the server's own
+            # lifecycle caused (e.g. hot-swapped then stopped before
+            # any new-version prefix landed). Remove it so the next
+            # start is a clean cold start. An EXPLICITLY passed foreign
+            # path is never deleted — it may be another server's valid
+            # snapshot; the loud refusal stays reserved for those.
+            own = (self._prefix_dir is not None
+                   and os.path.abspath(path)
+                   == os.path.abspath(self._prefix_dir))
+            if own and artifact_kind(path) == "prefix_cache":
+                import shutil
+                shutil.rmtree(path, ignore_errors=True)
+            return None
+        tag = self._version_tag(vidx)
+        bs = self._block_size
+        panels_by_bid = {}
+        # batch extraction through the one compiled [NB]-table gather:
+        # nb_slot blocks per dispatch, rows sliced apart on host
+        import jax.numpy as jnp
+        ids = [bid for bid, _ in entries]
+        for at in range(0, len(ids), self._nb_slot):
+            group = ids[at:at + self._nb_slot]
+            tab = np.zeros((self._nb_slot,), np.int32)
+            tab[:len(group)] = group
+            panels = self._extract(self._cache, jnp.asarray(tab))
+            panels = [(np.asarray(k), np.asarray(v)) for k, v in panels]
+            for i, bid in enumerate(group):
+                panels_by_bid[bid] = [
+                    (k[i * bs:(i + 1) * bs].copy(),
+                     v[i * bs:(i + 1) * bs].copy()) for k, v in panels]
+        art = PrefixCacheArtifact(
+            tag, bs, [(prefix, panels_by_bid[bid])
+                      for bid, prefix in entries])
+        self.metrics.count("spill_bytes", art.nbytes)
+        out = art.save(path)
+        log.info("saved %d prefix-cache blocks (%d bytes) under tag %s "
+                 "at %s", len(entries), art.nbytes, tag, out)
+        return out
+
+    def restore_prefix_cache(self, path=None):
+        """Adopt a saved `PrefixCacheArtifact` into the (fresh) pool:
+        tag-checked against the newest param version FIRST —
+        `KVStateVersionError` on mismatch, zero blocks adopted (the
+        loud-refusal rule) — then every entry gets a block
+        (parent-first), its rows installed before serving can match
+        it. A pool too small for the whole snapshot adopts a prefix of
+        it. Returns the number of blocks restored. Like the save twin,
+        this needs a NOT-running server (the constructor calls it
+        before start()): the serve thread owns the arena and the pool
+        while serving, and an install racing a decode dispatch on the
+        donated buffers corrupts both."""
+        if not (self._paged and self._prefix_cache):
+            raise ValueError("no paged prefix cache to restore into")
+        if self._running or (self._thread is not None
+                             and self._thread.is_alive()):
+            raise KVStateError("restore_prefix_cache needs a stopped "
+                               "server (the serve thread owns the "
+                               "arena while running)")
+        path = path if path is not None else self._prefix_dir
+        if path is None:
+            raise ValueError("no path: pass one or construct with "
+                             "prefix_cache_dir=")
+        art = PrefixCacheArtifact.load(path)
+        with self._swap_lock:
+            vidx = len(self._versions) - 1
+        art.require_tag(self._version_tag(vidx),
+                        what="prefix-cache snapshot")
+        if art.entries:
+            self._check_artifact_panels(art)
+        adopted = []                    # (bid, panels) in adopt order
+        for prefix, panels in art.entries:
+            bid = self._pool.adopt((vidx, prefix))
+            if bid is None:
+                continue
+            adopted.append((bid, panels))
+        bs = self._block_size
+        for at in range(0, len(adopted), self._nb_slot):
+            group = adopted[at:at + self._nb_slot]
+            ids = [bid for bid, _ in group]
+            rows = [(np.concatenate([p[li][0] for _, p in group]),
+                     np.concatenate([p[li][1] for _, p in group]))
+                    for li in range(self._n_layers)]
+            self._install_panel(ids, rows, len(ids) * bs, 0)
+        if adopted:
+            log.info("restored %d prefix-cache blocks under tag %s",
+                     len(adopted), art.tag)
+        return len(adopted)
+
+    def _check_artifact_panels(self, art):
+        """Prefix-cache twin of `_check_artifact` (no request fields)."""
+        k0 = art.entries[0][1][0][0]
+        hd = self._d_model // self._n_heads
+        if (art.block_size != self._block_size
+                or len(art.entries[0][1]) != self._n_layers
+                or k0.shape[1:] != (self._n_heads, hd)
+                or k0.dtype != np.dtype(self._cache_dtype)):
+            raise KVStateError(
+                f"prefix-cache snapshot (block_size {art.block_size}, "
+                f"{len(art.entries[0][1])} layers, rows x "
+                f"{k0.shape[1:]} {k0.dtype}) does not fit this server "
+                f"(block_size {self._block_size}, {self._n_layers} "
+                f"layers, rows x ({self._n_heads}, {hd}) "
+                f"{np.dtype(self._cache_dtype)})")
 
     # -- scheduler internals -------------------------------------------
     def _complete(self, req, t_now):
@@ -1106,7 +1812,14 @@ class ContinuousDecodeServer(_RequestLoop):
         itself instead of busy-polling at the 1 ms decode tick. Paged
         mode adds the MEMORY gate: a request that cannot get its blocks
         parks at the head of the line (`blocked_on_memory` counted once)
-        and admission stops until completions free blocks."""
+        and admission stops until completions free blocks — EXCEPT with
+        `preempt=True`, where a blocked request must not wall off the
+        line behind it: a claimant stuck behind a blocked lower-class
+        head would never reach its preemption chance (head-of-line
+        priority inversion), so the preempting gate keeps scanning —
+        blocked requests collect in arrival order and re-park at the
+        FRONT of the memory line (keeping first claim on freed blocks)
+        while later requests get their own admit-or-preempt attempt."""
         if not self._running and not self._drain_on_stop:
             # fail-fast stop: queued requests must NOT be admitted into
             # freed slots — the loop's final drain fails them once the
@@ -1120,58 +1833,103 @@ class ContinuousDecodeServer(_RequestLoop):
         free = [s for s in range(self.slots) if self._slot_req[s] is None]
         if self._static and len(free) < self.slots:
             return      # gang scheduling: wait for the whole batch
+        if self._preempt_on and self._gate_key is not None \
+                and self._gate_key == self._gate_signature():
+            # the last full preempting-gate scan admitted nothing, and
+            # NOTHING it depends on has changed since (pool occupancy,
+            # decode progress — the anti-thrash eligibility input —
+            # or pending depth): re-running the O(pending x slots)
+            # scan every ~1 ms tick would tax the serve thread exactly
+            # when the machine is most loaded, for an identical outcome
+            return
         wait = float(timeout)
-        for s in free:
-            req, alloc = None, None
-            while req is None:
-                req = self._next_request(wait)
-                wait = 0.0
-                if req is None:
-                    return
-                if req.future.done():   # failed by a raced submit/stop
-                    req = None
-                elif req.deadline is not None and \
-                        time.monotonic() > req.deadline:
-                    if _fail_future(req.future, DeadlineExceededError(
-                            "deadline expired before prefill")):
-                        self._deadline_miss(req, time.monotonic())
-                    req = None
-                elif self._paged:
-                    # admission gated by FREE BLOCKS, not free slots:
-                    # reserve everything the request will ever write
-                    # (prompt + decode rows, minus any shared prefix).
-                    # The param version is bound HERE, before the prefix
-                    # match: the match is tagged with it and the prefill
-                    # below runs under the same params, so a swap racing
-                    # this admission cannot share old-version rows into
-                    # a new-version stream.
-                    with self._swap_lock:
-                        vidx = len(self._versions) - 1
-                        aux, blocks = self._versions[vidx]
-                    version = (vidx, aux, blocks)
-                    alloc = self._pool.admit(
-                        req.prompt, len(req.prompt) + req.max_new - 1,
-                        will_append=req.max_new > 1, tag=vidx)
-                    if alloc is None:
-                        if not req.mem_blocked:
-                            req.mem_blocked = True
-                            self.metrics.count("blocked_on_memory")
-                        self._mem_wait.appendleft(req)
+        blocked = []    # memory-blocked pops, in arrival order
+        admitted = False    # anything placed into a slot this call?
+        try:
+            for s in free:
+                if self._paged and self._admit_resume(s):
+                    # spilled/migrated work re-enters ahead of every
+                    # queue
+                    admitted = True
+                    continue
+                req, alloc = None, None
+                while req is None:
+                    req = self._next_request(wait)
+                    wait = 0.0
+                    if req is None:
                         return
-            try:
-                self._admit(req, s, alloc,
-                            version=version if self._paged else None)
-            except BaseException as e:  # noqa: BLE001 — fail THIS request
-                if alloc is not None:
-                    self._pool.release(alloc)
-                _fail_future(req.future, e)
-                self.metrics.count("failed")
-            else:
-                if req.prio_overtook:
-                    # a REAL reordered admission: the request left the
-                    # priority line past queued work and prefilled
-                    req.prio_overtook = False
-                    self.metrics.count("admitted_prefix_priority")
+                    if req.future.done():   # failed by raced submit/stop
+                        req = None
+                    elif req.deadline is not None and \
+                            time.monotonic() > req.deadline:
+                        if _fail_future(req.future, DeadlineExceededError(
+                                "deadline expired before prefill")):
+                            self._deadline_miss(req, time.monotonic())
+                        req = None
+                    elif self._paged:
+                        # admission gated by FREE BLOCKS, not free
+                        # slots: reserve everything the request will
+                        # ever write (prompt + decode rows, minus any
+                        # shared prefix). The param version is bound
+                        # HERE, before the prefix match: the match is
+                        # tagged with it and the prefill below runs
+                        # under the same params, so a swap racing this
+                        # admission cannot share old-version rows into
+                        # a new-version stream.
+                        with self._swap_lock:
+                            vidx = len(self._versions) - 1
+                            aux, blocks = self._versions[vidx]
+                        version = (vidx, aux, blocks)
+                        alloc = self._pool.admit(
+                            req.prompt, len(req.prompt) + req.max_new - 1,
+                            will_append=req.max_new > 1, tag=vidx)
+                        # PREEMPTION (module docstring): a claimant
+                        # whose class outranks a live slot's takes that
+                        # slot's blocks — victims spill to host one at
+                        # a time until the claimant fits or policy runs
+                        # out of victims
+                        while alloc is None and \
+                                self._try_preempt_for(req):
+                            alloc = self._pool.admit(
+                                req.prompt,
+                                len(req.prompt) + req.max_new - 1,
+                                will_append=req.max_new > 1, tag=vidx)
+                        if alloc is None:
+                            if not req.mem_blocked:
+                                req.mem_blocked = True
+                                self.metrics.count("blocked_on_memory")
+                            blocked.append(req)
+                            if not self._preempt_on:
+                                return      # FIFO gate: stop admission
+                            req = None      # preempting gate: scan on
+                try:
+                    self._admit(req, s, alloc,
+                                version=version if self._paged else None)
+                except BaseException as e:  # noqa: BLE001 — fail THIS
+                    if alloc is not None:   # request
+                        self._pool.release(alloc)
+                    _fail_future(req.future, e)
+                    self.metrics.count("failed")
+                else:
+                    admitted = True
+                    if alloc is not None:
+                        self._count_restore_hits(alloc)
+                    if req.prio_overtook:
+                        # a REAL reordered admission: the request left
+                        # the priority line past queued work and
+                        # prefilled
+                        req.prio_overtook = False
+                        self.metrics.count("admitted_prefix_priority")
+        finally:
+            if blocked:
+                # re-park at the FRONT in arrival order: first claim on
+                # freed blocks stays with the oldest blocked request
+                self._mem_wait.extendleft(reversed(blocked))
+            if self._preempt_on:
+                # arm the rescan guard only after a FULLY blocked scan;
+                # any admission/preemption changed the inputs anyway
+                self._gate_key = (self._gate_signature()
+                                  if blocked and not admitted else None)
 
     def _free_slot(self, slot):
         """Release `slot`'s host-side occupancy (and its draft stream,
@@ -1232,6 +1990,8 @@ class ContinuousDecodeServer(_RequestLoop):
         self._sweep_line(self._defer_q,
                          "deadline expired while brownout-deferred",
                          now, thrash=False)
+        self._sweep_line(self._resume_q,
+                         "deadline expired on the resume line", now)
         evicted = False
         for s, r in enumerate(self._slot_req):
             if r is None or r.deadline is None or now <= r.deadline:
@@ -1278,24 +2038,22 @@ class ContinuousDecodeServer(_RequestLoop):
         brownout-deferred line (all count as _busy(), so all must
         resolve before a stop may exit — the PR 8 memory-waiter
         livelock pin, extended to every parked line)."""
-        while self._mem_wait:
-            r = self._mem_wait.popleft()
-            if _fail_future(r.future, exc):
-                self.metrics.count("failed")
-        while self._prio_q:
+        for dq in (self._mem_wait, self._prio_q, self._defer_q,
+                   self._resume_q, self._migrate_in_q):
+            while dq:
+                try:
+                    r = dq.popleft()
+                except IndexError:      # raced a concurrent drain
+                    break
+                if _fail_future(r.future, exc):
+                    self.metrics.count("failed")
+        while self._migrate_cmds:
             try:
-                r = self._prio_q.popleft()
+                _, reply = self._migrate_cmds.popleft()
             except IndexError:
                 break
-            if _fail_future(r.future, exc):
-                self.metrics.count("failed")
-        while self._defer_q:
-            try:
-                r = self._defer_q.popleft()
-            except IndexError:
-                break
-            if _fail_future(r.future, exc):
-                self.metrics.count("failed")
+            if not reply.done():
+                reply.set_exception(exc)
 
     def _fail_queued(self, exc):
         """Queued = the submit queue, the paged memory-wait line, AND
@@ -1692,6 +2450,13 @@ class ContinuousDecodeServer(_RequestLoop):
         fully-drained PREFIX below the newest can be released)."""
         with self._swap_lock:
             in_use = {r.version for r in self._slot_req if r is not None}
+            # a PREEMPTED request's version is pinned while it parks:
+            # its artifact's rows are only resumable under exactly
+            # those params (migrated-in entries carry version None and
+            # bind the newest at admission)
+            for r in self._resume_q:
+                if r.version is not None:
+                    in_use.add(r.version)
             newest = len(self._versions) - 1
             for v in range(newest):
                 if v not in in_use and self._versions[v] is not None:
@@ -1700,9 +2465,18 @@ class ContinuousDecodeServer(_RequestLoop):
     def _busy(self):
         return any(r is not None for r in self._slot_req) \
             or bool(self._mem_wait) or bool(self._prio_q) \
-            or bool(self._defer_q)
+            or bool(self._defer_q) or bool(self._resume_q) \
+            or bool(self._migrate_in_q) or bool(self._migrate_cmds)
 
     def _loop_once(self):
+        if self._paged:
+            # drain the client-side migrate-in staging into the serve-
+            # thread-only resume line, then answer export commands —
+            # both BEFORE the deadline sweep so a just-arrived artifact
+            # is swept/served this iteration
+            while self._migrate_in_q:
+                self._resume_q.append(self._migrate_in_q.popleft())
+            self._service_migrations()
         # evict deadline-expired slots FIRST so the admit below can refill
         # them in the same iteration
         self._evict_expired()

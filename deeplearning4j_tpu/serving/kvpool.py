@@ -115,6 +115,12 @@ class BlockPool:
         self._index = {}        # (tag, prefix token tuple) -> block id
         self._key_of = {}       # block id -> its index key
         self._children = {}     # parent prefix key -> {id: ext tuple}
+        # blocks ADOPTED from a persistent prefix-cache artifact
+        # (serving/kvstate.py) and still indexed: the decode server
+        # counts a prefix match landing on one as `prefix_restore_hits`
+        # — the restart-warm-start proof. Membership ends at _unindex
+        # (an evicted-then-reallocated block is a fresh block).
+        self.restored = set()
 
     # -- read-outs -----------------------------------------------------
     @property
@@ -279,6 +285,42 @@ class BlockPool:
         self._drop(src)
         return src, spare
 
+    def cached_entries(self, tag=None):
+        """(block id, prefix tokens) for every CACHED (refcount-0,
+        still-indexed) block under `tag`, in LRU order — the saveable
+        set the persistent prefix cache serializes
+        (serving/kvstate.py). An accessor, so persistence reads the
+        cached tier through the pool's API the same way restore writes
+        it through `adopt()` — a representation change here cannot
+        silently break the save path."""
+        return [(bid, key[1]) for bid, key in self._cached.items()
+                if key[0] == tag]
+
+    def adopt(self, key):
+        """Allocate a block for an EXTERNALLY-RESTORED prefix entry
+        (serving/kvstate.py `PrefixCacheArtifact`): take a physical
+        block, register `key` ((tag, prefix tokens)) in the index, and
+        park it straight in the CACHED tier (refcount 0, LRU-evictable
+        — exactly where `release` retires an indexed block). The CALLER
+        installs the artifact's rows into the returned block id before
+        any request can match it; the server does both under one
+        restore call before serving starts, so a half-restored entry is
+        never matchable. Returns None when the key is already indexed
+        (nothing to adopt) or the FREE list is dry — adoption never
+        evicts cached state (on a full pool that would recycle the
+        blocks adoption itself just parked, churning the restore into
+        a last-writer-wins shuffle): a too-small pool restores a
+        prefix of the artifact, never fails the server."""
+        if not self.prefix_cache or key in self._index:
+            return None
+        if not self._free:
+            return None
+        bid = self._free.pop()
+        self._register(bid, key)
+        self._cached[bid] = key
+        self.restored.add(bid)
+        return bid
+
     def release(self, alloc):
         """Return one request's blocks: refcount--, last reference
         retires an indexed block to the prefix cache (LRU-evictable) and
@@ -334,6 +376,7 @@ class BlockPool:
     def _unindex(self, bid, key):
         del self._index[key]
         del self._key_of[bid]
+        self.restored.discard(bid)
         parent, _ = self._parent_ext(key, self.block_size)
         kids = self._children.get(parent)
         if kids is not None:
@@ -356,4 +399,6 @@ class BlockPool:
         assert all(self._key_of.get(b) == k
                    for b, k in self._cached.items()), \
             "cached block lost its index key"
+        assert self.restored <= set(self._key_of), \
+            "restored-block marker outlived its index entry"
         return True

@@ -125,25 +125,35 @@ class DecodeSizeMix:
     of (weight, (prompt_lo, prompt_hi), (new_lo, new_hi)) components
     (hi exclusive, randrange semantics) — e.g. 'mostly short chat turns
     plus a tail of long generations', the shape that separates
-    continuous from gang batching."""
+    continuous from gang batching. A component may carry a FOURTH
+    element, a request-class name ("interactive"/"batch"/...): its
+    samples submit under that brownout class, which is how a mixed-
+    class workload (the preemption A/B's shape) is generated. Classless
+    components emit payloads WITHOUT a klass key, so existing
+    schedules' digests are unchanged."""
 
     def __init__(self, components=((1.0, (3, 16), (4, 44)),), vocab=96):
         self.components = tuple(
-            (float(w), (int(plo), int(phi)), (int(nlo), int(nhi)))
-            for w, (plo, phi), (nlo, nhi) in components)
+            (float(c[0]), (int(c[1][0]), int(c[1][1])),
+             (int(c[2][0]), int(c[2][1])),
+             str(c[3]) if len(c) > 3 else None)
+            for c in components)
         self.vocab = int(vocab)
         if not self.components:
             raise ValueError("need at least one mix component")
 
     def sample(self, rng):
-        pick = rng.random() * sum(w for w, _, _ in self.components)
-        for w, (plo, phi), (nlo, nhi) in self.components:
+        pick = rng.random() * sum(w for w, _, _, _ in self.components)
+        for w, (plo, phi), (nlo, nhi), klass in self.components:
             pick -= w
             if pick <= 0:
                 break
         prompt = tuple(rng.randrange(1, self.vocab)
                        for _ in range(rng.randrange(plo, phi)))
-        return {"prompt": prompt, "max_new": rng.randrange(nlo, nhi)}
+        out = {"prompt": prompt, "max_new": rng.randrange(nlo, nhi)}
+        if klass is not None:
+            out["klass"] = klass
+        return out
 
 
 class InferenceSizeMix:
@@ -218,7 +228,12 @@ def _default_submit(server, item):
     """(future, expected generated tokens) for the two built-in payload
     kinds: 'prompt' -> ContinuousDecodeServer, 'x' -> InferenceServer."""
     if "prompt" in item:
-        return (server.submit(list(item["prompt"]), item["max_new"]),
+        # klass forwarded only when the mix stamped one: classless
+        # payloads keep the exact legacy call (fake/minimal servers in
+        # tests need not grow a klass parameter)
+        kw = {"klass": item["klass"]} if "klass" in item else {}
+        return (server.submit(list(item["prompt"]), item["max_new"],
+                              **kw),
                 item["max_new"])
     import numpy as np      # lazy: only the micro-batch path needs arrays
     return server.submit(np.asarray(item["x"], np.float32)), 1

@@ -179,6 +179,14 @@ class ServingMetrics:
         self._pool_blocks = self.registry.gauge(p + "pool_blocks")
         self._live_streams = res(p + "live_streams", self._window)
         self._counters = {}     # key -> Counter, resolved once per key
+        # durable KV state (serving/kvstate.py): counters created
+        # EAGERLY, not on first event — preemption/migration/restore
+        # are rare by design, and a dashboard (or the Prometheus
+        # route) must read zero, not absence, on a server that simply
+        # has not preempted yet
+        for key in ("preempted", "resumed", "migrated", "migrated_out",
+                    "spill_bytes", "prefix_restore_hits"):
+            self.count(key, 0)
 
     # -- hot-path recorders -------------------------------------------
     def count(self, key, n=1):
@@ -388,6 +396,16 @@ class ServingMetrics:
         # prefix-hit priority admission (serving/decode.py): admits
         # that genuinely overtook queued cold-prompt work
         out.setdefault("admitted_prefix_priority", 0)
+        # durable KV state (serving/kvstate.py): preempt/resume/migrate
+        # event counts, host bytes spilled, and restored-prefix hits —
+        # always present (eagerly created above; the setdefaults keep
+        # the surface stable even for a caller-shared registry)
+        out.setdefault("preempted", 0)
+        out.setdefault("resumed", 0)
+        out.setdefault("migrated", 0)
+        out.setdefault("migrated_out", 0)
+        out.setdefault("spill_bytes", 0)
+        out.setdefault("prefix_restore_hits", 0)
         out["service_rate_tokens_per_sec"] = self._service_rate.value
         out["prefix_hit_rate"] = (
             out["prefix_rows_hit"] / out["prefix_rows_total"]

@@ -75,6 +75,14 @@ class ServerClosedError(ServingError):
     """The server was stopped before the request could run."""
 
 
+class RequestMigratedError(ServingError):
+    """This request's KV state was exported to another server
+    (`ContinuousDecodeServer.migrate_out`): its LOCAL future will never
+    produce tokens — the importing server's future carries the resumed
+    stream. Raised on the local future so a caller polling the wrong
+    server fails loudly instead of hanging."""
+
+
 class _Request:
     __slots__ = ("x", "future", "deadline", "t_submit", "req_id")
 

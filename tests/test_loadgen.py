@@ -452,3 +452,38 @@ class TestSmokeSweep:
         assert snap["blocks_in_use_max"] > 0
         assert snap["spec_tokens"] == snap["tokens_out"] > 0
         assert snap["dispatches_per_token"] <= 1.0
+
+    def test_smoke_sweep_preempt_mode(self):
+        """One PREEMPTION-enabled sweep rate in tier-1 (ISSUE 11:
+        durable KV state): the same loadgen arrivals through
+        `ContinuousDecodeServer(paged=True, preempt=True)` with the
+        mix's long tail submitted as the spillable batch class — every
+        CI run exercises the preempt/spill/resume machinery (and its
+        always-present snapshot keys) under real arrivals, not just
+        the unit pins. Its report uploads next to the paged one
+        (tier1.yml)."""
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        mod = importlib.import_module("load_sweep")
+        out = os.path.join(
+            os.environ.get("SMOKE_REPORT_DIR") or tempfile.gettempdir(),
+            "load_sweep_smoke_preempt")
+        res = mod.run_sweep(server="decode", rates=(40.0,), n_req=8,
+                            slo_ms=250.0, seed=0, trace=False,
+                            report_path=out, preempt=True)
+        (decode,) = res
+        assert decode["preempt"] is True
+        assert decode["paged"] is True      # implied by --preempt
+        (pt,) = decode["curve"]
+        assert pt["completed"] == 8
+        assert pt["tokens_per_sec"] > 0
+        snap = json.load(open(out + ".json"))["metrics"]["decode"]
+        assert snap["pool_blocks"] > 0
+        # the durable-KV keys ride every snapshot (zero when the smoke
+        # rate never saturated the pool — presence is the pin; the
+        # preemption BEHAVIOR pins live in tests/test_kvstate.py)
+        for key in ("preempted", "resumed", "migrated", "spill_bytes",
+                    "prefix_restore_hits"):
+            assert key in snap

@@ -427,6 +427,13 @@ class TestMetricsPins:
         # load_sweep/serve_ab overload A/Bs and the Prometheus route
         "shed_predicted", "shed_brownout", "deferred",
         "chunk_dispatches", "service_rate_tokens_per_sec",
+        # durable KV state (serving/kvstate.py): preempt/resume/migrate
+        # event counts, host bytes spilled, restored-prefix hits —
+        # consumed by tools/serve_ab.py's preempt_vs_shed arm and the
+        # Prometheus route (eagerly created, so a server that never
+        # preempted scrapes zero, not absence)
+        "preempted", "resumed", "migrated", "migrated_out",
+        "spill_bytes", "prefix_restore_hits",
         "admission_error_ms_p50", "admission_error_ms_p99",
         "admission_error_ms_mean", "admission_error_ms_count",
         "slo_total", "slo_met", "slo_tokens_met", "slo_attainment",

@@ -22,7 +22,7 @@ Run (CPU backend, no chip needed):
         [--process poisson|onoff|closed] [--requests 64] \
         [--slo-ms 150] [--seed 0] [--report /tmp/sweep] [--no-trace] \
         [--chunked-prefill C] [--admission] [--overload-ab] \
-        [--paged] [--speculate K]
+        [--paged] [--speculate K] [--preempt]
 
 `--process onoff` keeps the same MEAN rate but bursts at 2x with a 50%
 duty cycle (the p99 stressor); `--process closed` reinterprets each
@@ -117,7 +117,7 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
                  process="poisson", tracer=None, lm=None, slots=4,
                  paged=False, block_size=8, chunked_prefill=None,
                  admission=None, brownout=None, deadline_ms=None,
-                 speculate_k=None):
+                 speculate_k=None, preempt=False):
     """Rate ladder over the ContinuousDecodeServer. One server serves
     every rate (compile once); per-point accounting is delta-based
     (loadgen baselines at entry), so points never contaminate each
@@ -147,12 +147,20 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
     every request a real deadline (default: the SLO itself, the
     goodput-under-SLO semantics made enforceable) — together the
     protected arm of the `--overload-ab` comparison."""
-    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+    from deeplearning4j_tpu.serving import (BrownoutPolicy,
+                                            ContinuousDecodeServer,
                                             DecodeSizeMix, NGramDraft,
                                             ServingMetrics, Speculator,
                                             build_schedule, run_load)
     lm = lm if lm is not None else _lm()
     metrics = ServingMetrics(slo_target_ms=slo_ms)
+    if preempt:
+        # preemption needs the paged pool (a block set to spill) and a
+        # class ranking; the sweep's canonical mixed-class shape is the
+        # short/long split below with the long tail as batch class
+        paged = True
+        if brownout is None:
+            brownout = BrownoutPolicy(classes={"batch": (0.9, 1.01)})
     controlled = (chunked_prefill is not None or admission or
                   brownout is not None)
     spec = (None if speculate_k is None
@@ -162,13 +170,22 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
         metrics=metrics, tracer=tracer, paged=paged,
         block_size=block_size, chunked_prefill=chunked_prefill,
         admission=admission, brownout=brownout, speculate=spec,
+        preempt=preempt,
         default_deadline_ms=(deadline_ms if deadline_ms is not None
                              else (slo_ms if admission else None))
         ).start()
     # mostly short chat turns + a tail of long generations — the mixed-
-    # length shape continuous batching exists for
-    mix = DecodeSizeMix(((0.8, (3, 12), (4, 24)),
-                         (0.2, (8, 16), (24, 44))), vocab=96)
+    # length shape continuous batching exists for. With preemption the
+    # same split becomes the mixed-CLASS shape: the short turns are the
+    # interactive class whose TTFT preemption bounds, the long tail is
+    # the preemptible batch class.
+    if preempt:
+        mix = DecodeSizeMix(((0.8, (3, 12), (4, 24), "interactive"),
+                             (0.2, (8, 16), (24, 44), "batch")),
+                            vocab=96)
+    else:
+        mix = DecodeSizeMix(((0.8, (3, 12), (4, 24)),
+                             (0.2, (8, 16), (24, 44))), vocab=96)
     try:
         # compile both prompt buckets + the decode step off the clock
         # (explicit generous deadline: the controlled arm's DEFAULT
@@ -199,9 +216,11 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
                 f"deadline={deadline_ms if deadline_ms is not None else slo_ms:g}ms")
     if spec is not None:
         ctrl += f", speculate k={spec.k} (n-gram)"
+    if preempt:
+        ctrl += ", preempt=on (batch class spillable)"
     return {"server": "decode", "process": process, "paged": bool(paged),
             "overload_control": bool(controlled),
-            "speculate_k": speculate_k,
+            "speculate_k": speculate_k, "preempt": bool(preempt),
             "config": f"TransformerLM L={len(lm.blocks)} d={d_model} "
                       f"slots={slots} cache={cache}, mix 80% "
                       f"short(p3-11/n4-23) + 20% long(p8-15/n24-43), "
@@ -325,7 +344,7 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
               process="poisson", n_req=64, slo_ms=150.0, seed=0,
               trace=True, report_path=None, paged=False,
               chunked_prefill=None, admission=None, overload_ab=False,
-              speculate_k=None):
+              speculate_k=None, preempt=False):
     """Drive the sweep(s) and (optionally) write the combined
     obs_report (JSON + text + Chrome trace). Returns the results list.
     The tier-1 smoke test calls this with tiny parameters (and once
@@ -374,7 +393,8 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
                                   tracer=tracer, paged=paged,
                                   chunked_prefill=chunked_prefill,
                                   admission=admission,
-                                  speculate_k=speculate_k)
+                                  speculate_k=speculate_k,
+                                  preempt=preempt)
         results.append(body)
         snaps["decode"] = snap
     if server in ("microbatch", "both"):
@@ -440,6 +460,12 @@ def main():
                     help="K-wide n-gram speculative decode on the "
                          "decode server (composes with --paged: the "
                          "block-table verify program)")
+    ap.add_argument("--preempt", action="store_true",
+                    help="durable-KV preemption (implies --paged): the "
+                         "mix's long tail submits as a spillable batch "
+                         "class, short turns as interactive — batch "
+                         "slots spill to host when interactive work "
+                         "is blocked on KV blocks")
     ap.add_argument("--chunked-prefill", type=int, default=None,
                     metavar="C",
                     help="slice prompts into C-row prefill chunks "
@@ -466,7 +492,8 @@ def main():
                         chunked_prefill=args.chunked_prefill,
                         admission=args.admission,
                         overload_ab=args.overload_ab,
-                        speculate_k=args.speculate)
+                        speculate_k=args.speculate,
+                        preempt=args.preempt)
     for r in results:
         print(json.dumps(r))
     print(json.dumps({"elapsed_s": fmt(time.perf_counter() - t0, 1),

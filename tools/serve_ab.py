@@ -35,6 +35,19 @@ equally):
     bit-identical; the A/B isolates dispatch amortization on the paged
     layout (dispatches/token vs the paged baseline, acceptance, and the
     equal-arena concurrency class that must survive speculation).
+  * preempt_vs_shed — durable-KV preemption (ISSUE 11: serving/
+    kvstate.py) vs shed-only overload handling at FULL BLOCK OCCUPANCY:
+    both arms run the same paged server with a brownout class ranking
+    and the same workload — three long batch-class requests each
+    reserving half the block pool (two resident cover it; the third
+    sustains the pressure), then a stream of short deadline-carrying
+    interactive requests. The shed-only arm's interactive
+    requests park on the memory gate until the batch work completes or
+    their deadlines expire; the preempt arm spills a batch slot to host
+    (resumed later bit-identically) and admits them. The A/B isolates
+    what preemption buys: INTERACTIVE-class goodput-under-deadline and
+    completion p99 (a tight TTFT bound — interactive requests are 4
+    tokens) at the occupancy regime queue-depth admission cannot help.
   * overload_vs_baseline — the SAME seeded past-knee arrival schedule
     (serving/loadgen.py, NOT a backlog: overload is a queueing
     phenomenon) through an uncontrolled decode server vs one with
@@ -495,6 +508,145 @@ def bench_paged_spec_ab(segments, reqs_per_seg=16, slo_ms=100.0):
     }, snaps, None
 
 
+def bench_preempt_ab(segments, reqs_per_seg=12, slo_ms=60.0):
+    """Preemption vs shed-only at full block occupancy (module
+    docstring). Per segment: 3 batch-class requests of 14 blocks each
+    against a 28-block pool (two resident reserve it WHOLE, the third
+    keeps it full when one completes), then `reqs_per_seg` interactive
+    requests (4 tokens each, deadline = slo); the metric is interactive-class
+    goodput-under-deadline, computed CLIENT-side per class (deadline
+    known at submit, completion observed, tokens known) because the
+    server's SLO counters aggregate classes. Both arms also report the
+    interactive completion p99 — a tight TTFT bound at 4 tokens — and
+    the preempt arm's spill accounting. The shed-only arm's interactive
+    requests can only park on the memory gate until batch work
+    completes or their deadline sweeps them; the preempt arm spills a
+    batch slot and serves them inside the deadline."""
+    import numpy as np
+
+    from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+    from deeplearning4j_tpu.serving import (BrownoutPolicy,
+                                            ContinuousDecodeServer,
+                                            ServingMetrics)
+
+    # a somewhat bigger model than the other arms': batch occupancy
+    # must OUTLAST the interactive deadline for full occupancy to be a
+    # regime rather than a blip (the tiny shared model finishes 44
+    # tokens inside the deadline and both arms trivially tie)
+    lm = TransformerLM(96, d_model=64, n_heads=4, n_layers=3,
+                       max_len=128, seed=5)
+
+    def mk(preempt):
+        return ContinuousDecodeServer(
+            lm, slots=4, prompt_buckets=(8, 16), max_queue=256,
+            paged=True, block_size=8, n_blocks=28,
+            brownout=BrownoutPolicy(classes={"batch": (0.9, 1.01)}),
+            preempt=preempt,
+            metrics=ServingMetrics(slo_target_ms=slo_ms)).start()
+
+    servers = {"preempt": mk(True), "shed_only": mk(False)}
+    for name, srv in servers.items():   # compile off the clock —
+        # including the preempt arm's extract/restore programs: one
+        # full-pool batch pair + one preempting interactive request
+        srv.generate([1, 2, 3, 4], 4, timeout=300)
+        srv.generate(list(range(1, 11)), 4, timeout=300)
+        warm_b = [srv.submit(list(range(1, 10)), 100, klass="batch")
+                  for _ in range(2)]
+        time.sleep(0.02)
+        try:
+            srv.generate([5, 6, 7], 4, deadline_ms=10_000, timeout=300)
+        except Exception:               # noqa: BLE001 — shed arm: parks
+            pass
+        for f in warm_b:
+            f.result(600)
+    base = {n: servers[n].metrics.snapshot() for n in servers}
+    seg_idx = {n: [0] for n in servers}
+    inter_lat = {n: [] for n in servers}    # interactive completion ms
+
+    def seg(name):
+        srv = servers[name]
+
+        def run():
+            rng = np.random.default_rng(300 + seg_idx[name][0])
+            seg_idx[name][0] += 1
+            t0 = time.perf_counter()
+            # three batch requests, each reserving HALF the pool
+            # (prompt 9 + 100 new = 108 reserved rows = 14 blocks): two
+            # run, the third keeps the pool full when one completes —
+            # occupancy pressure lasts the whole interactive stream (no
+            # deadline: batch is throughput work)
+            batch = [srv.submit(
+                rng.integers(1, 96, 9).tolist(), 100, klass="batch")
+                for _ in range(3)]
+            time.sleep(0.02)            # let them admit + occupy
+            inter = []
+            for _ in range(reqs_per_seg):
+                p = rng.integers(1, 96, int(rng.integers(3, 8))).tolist()
+                dl = time.perf_counter()
+                try:
+                    f = srv.submit(p, 4, deadline_ms=slo_ms,
+                                   klass="interactive")
+                except Exception:       # noqa: BLE001 — shed: a miss
+                    inter.append((None, dl, 4))
+                    continue
+                inter.append((f, dl, 4))
+                time.sleep(0.004)
+            good_tokens = 0
+            for f, t_sub, toks in inter:
+                if f is None:
+                    continue
+                try:
+                    f.result(300)
+                except Exception:       # noqa: BLE001 — shed/evicted
+                    continue
+                done = time.perf_counter()
+                inter_lat[name].append((done - t_sub) * 1e3)
+                if (done - t_sub) * 1e3 <= slo_ms:
+                    good_tokens += toks
+            for f in batch:             # drain: pool clean per segment
+                f.result(600)
+            return good_tokens / (time.perf_counter() - t0)
+        return run
+
+    ab = _interleaved({n: seg(n) for n in servers}, segments=segments)
+    snaps = {n: servers[n].metrics.snapshot() for n in servers}
+    for srv in servers.values():
+        srv.stop(timeout=120)
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return fmt(xs[min(len(xs) - 1, int(q / 100 * len(xs)))]) \
+            if xs else None
+
+    d = {n: snaps[n]["dispatches"] - base[n]["dispatches"]
+         for n in snaps}
+    return {
+        "config": f"TransformerLM L=3 d=64 paged 28 blocks x 8 rows, "
+                  f"3 batch reqs (14 blocks each, 100 tokens) + "
+                  f"{reqs_per_seg} interactive 4-token reqs/segment at "
+                  f"deadline {slo_ms:g}ms; brownout ranks batch < "
+                  f"interactive, preempt arm spills batch to host",
+        "unit": "interactive goodput tokens/sec (within deadline)",
+        "ab": ab,
+        "interactive_goodput_preempt_over_shed": round(
+            ab["preempt"]["median"] / ab["shed_only"]["median"], 3)
+        if ab["shed_only"]["median"] else None,
+        "interactive_completion_ms": {
+            n: {"p50": pct(inter_lat[n], 50),
+                "p99": pct(inter_lat[n], 99)} for n in inter_lat},
+        "preempted": {n: snaps[n]["preempted"] for n in snaps},
+        "resumed": {n: snaps[n]["resumed"] for n in snaps},
+        "spill_bytes": {n: snaps[n]["spill_bytes"] for n in snaps},
+        "blocked_on_memory": {
+            n: snaps[n]["blocked_on_memory"] - base[n][
+                "blocked_on_memory"] for n in snaps},
+        "sheds": {n: _shed_view(snaps[n], base[n]) for n in snaps},
+        "measured_dispatches": d,
+        "slo_ms": slo_ms,
+        "slo": {n: _slo_view(snaps[n], None, base[n]) for n in snaps},
+    }, snaps, None
+
+
 def bench_overload_ab(segments, reqs_per_seg=320, slo_ms=120.0):
     """Overload robustness A/B (PR 9): the SAME seeded Poisson schedule,
     offered well past the tiny model's saturation knee, replayed per
@@ -728,6 +880,7 @@ def main():
     tracer = None
     benches = (("decode_continuous_vs_static", bench_decode_ab),
                ("paged_vs_fixed", bench_paged_ab),
+               ("preempt_vs_shed", bench_preempt_ab),
                ("overload_vs_baseline", bench_overload_ab),
                ("speculative_vs_plain", bench_speculative_ab),
                ("paged_spec_vs_paged", bench_paged_spec_ab),
