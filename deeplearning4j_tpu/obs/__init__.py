@@ -22,6 +22,13 @@ package is that substrate:
     decomposition: each served request's total attributed to
     queue-wait / prefill / decode / scheduling-gap phases (the
     traffic-harness analyzer; rendered by `tools/obs_report.py`).
+  * `fleet.FleetView` / `fleet.merge_traces` /
+    `fleet.AutoscaleSignal` — the fleet plane: kind-correct metrics
+    federation over N instances (in-process or parsed `/metrics`
+    text), clock-anchor trace stitching into one Perfetto file with
+    per-instance process groups, and the ROADMAP autoscaling recipe
+    as a windowed, hysteresis-bounded, tested detector (rendered by
+    `tools/fleet_report.py`).
 
 Hard constraints: stdlib-only (importing or using obs can never pull in
 jax or add a device dispatch — pinned by test), and the disabled tracer
@@ -33,8 +40,10 @@ from __future__ import annotations
 
 from . import registry
 from .decompose import decompose, decompose_requests
+from .fleet import (AutoscaleSignal, FleetView, merge_traces,
+                    parse_prometheus_text)
 from .registry import Histogram, MetricsRegistry, default_registry, fmt
-from .trace import FlightRecorder, Span, Tracer
+from .trace import FlightRecorder, Span, TraceContext, Tracer
 
 TRACER = Tracer(enabled=False)
 
@@ -59,8 +68,11 @@ def disable_tracing():
 
 
 __all__ = [
-    "Tracer", "Span", "FlightRecorder", "MetricsRegistry", "Histogram",
+    "Tracer", "Span", "TraceContext", "FlightRecorder",
+    "MetricsRegistry", "Histogram",
     "default_registry", "fmt", "registry",
     "decompose", "decompose_requests",
+    "FleetView", "AutoscaleSignal", "merge_traces",
+    "parse_prometheus_text",
     "TRACER", "get_tracer", "span", "enable_tracing", "disable_tracing",
 ]

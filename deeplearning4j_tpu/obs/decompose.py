@@ -42,10 +42,16 @@ _PHASES = ("queue_wait_ms", "prefill_ms", "decode_ms", "sched_gap_ms")
 
 
 def _normalize(spans_or_trace):
-    """-> list of {name, t0, dur, trace_id} dicts in MILLISECONDS on one
-    consistent clock (monotonic for live spans, rebased for a saved
-    Chrome trace — decomposition only ever subtracts timestamps from the
-    same source, so the two bases never mix)."""
+    """-> list of {name, t0, dur, trace_id, pid} dicts in MILLISECONDS
+    on one consistent clock (monotonic for live spans, rebased for a
+    saved Chrome trace — decomposition only ever subtracts timestamps
+    from the same source, so the two bases never mix). `pid` carries
+    the trace's process group (0 for live spans / single traces): a
+    MERGED multi-instance trace (obs.fleet.merge_traces) has one
+    single-threaded server lane PER instance, so busy windows and
+    request lanes must attribute within their own pid — pooling them
+    would charge every request with the other replicas' concurrent
+    dispatch windows."""
     if spans_or_trace is None:
         return []
     if hasattr(spans_or_trace, "spans"):        # Tracer
@@ -59,11 +65,13 @@ def _normalize(spans_or_trace):
             out.append({"name": e.get("name"),
                         "t0": e.get("ts", 0) / 1e3,
                         "dur": e.get("dur", 0) / 1e3,
-                        "trace_id": args.get("trace_id")})
+                        "trace_id": args.get("trace_id"),
+                        "pid": e.get("pid", 0)})
     else:
         for s in spans_or_trace:                # Span namedtuples
             out.append({"name": s.name, "t0": s.t0_ns / 1e6,
-                        "dur": s.dur_ns / 1e6, "trace_id": s.trace_id})
+                        "dur": s.dur_ns / 1e6, "trace_id": s.trace_id,
+                        "pid": 0})
     return out
 
 
@@ -77,27 +85,38 @@ def decompose_requests(spans_or_trace):
     plus `total_ms`; phases are clipped to the request's window so they
     partition the total."""
     evs = _normalize(spans_or_trace)
-    reqs, queues, prefills, busy = {}, {}, {}, []
+    # keyed by (pid, trace_id): a merged fleet trace carries one
+    # single-threaded server lane PER process group, and a MIGRATED
+    # request's trace id legitimately appears on two pids (one
+    # serve.request per instance that served it) — each attributes
+    # against its OWN instance's busy windows only
+    reqs, queues, prefills, busy = {}, {}, {}, {}
     for e in evs:
+        key = (e["pid"], e["trace_id"])
         if e["name"] == "serve.request" and e["trace_id"] is not None:
-            reqs[e["trace_id"]] = e
+            reqs[key] = e
         elif e["name"] == "serve.queue_wait" and \
                 e["trace_id"] is not None:
-            queues[e["trace_id"]] = e
+            queues[key] = e
         elif e["name"] == "decode.prefill":
-            prefills.setdefault(e["trace_id"], []).append(e)
+            prefills.setdefault(key, []).append(e)
         elif e["name"] in _BUSY_NAMES:
-            busy.append((e["t0"], e["t0"] + e["dur"]))
-    busy.sort()
+            busy.setdefault(e["pid"], []).append(
+                (e["t0"], e["t0"] + e["dur"]))
+    for windows in busy.values():
+        windows.sort()
     rows = []
-    for tid, req in sorted(reqs.items(), key=lambda kv: kv[1]["t0"]):
+    for (pid, tid), req in sorted(reqs.items(),
+                                  key=lambda kv: kv[1]["t0"]):
         total = req["dur"]
         t0, t1 = req["t0"], req["t0"] + total
-        qw = min(queues[tid]["dur"], total) if tid in queues else 0.0
+        qw = min(queues[(pid, tid)]["dur"], total) \
+            if (pid, tid) in queues else 0.0
         win0 = t0 + qw          # active window: admission -> completion
         pf = sum(_overlap(p["t0"], p["t0"] + p["dur"], win0, t1)
-                 for p in prefills.get(tid, ()))
-        dec = sum(_overlap(b0, b1, win0, t1) for b0, b1 in busy)
+                 for p in prefills.get((pid, tid), ()))
+        dec = sum(_overlap(b0, b1, win0, t1)
+                  for b0, b1 in busy.get(pid, ()))
         gap = max(0.0, total - qw - pf - dec)
         rows.append({"trace_id": tid, "total_ms": total,
                      "queue_wait_ms": qw, "prefill_ms": pf,

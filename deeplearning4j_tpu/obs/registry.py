@@ -43,6 +43,7 @@ import re
 import threading
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_INF_LABEL = 'le="+Inf"'
 
 
 def sanitize(name):
@@ -324,46 +325,98 @@ class MetricsRegistry:
                 out[key + "_count"] = m.total
         return out
 
-    def prometheus_text(self, namespace=""):
+    def kind_snapshot(self, prefix=""):
+        """KIND-TAGGED state export — the federation hook
+        (obs/fleet.py): unlike `snapshot()`'s flat dict, every entry
+        says what it IS, so a merger can apply the correct semantics
+        per kind (counters sum, gauges stay per-instance, histogram
+        bucket counts add element-wise, summaries don't merge at all).
+        Histograms export their full bucket state (bounds + per-bucket
+        counts incl. the +Inf overflow + sum + total) from ONE atomic
+        read; reservoirs export derived percentiles only — their
+        sample windows are NOT aggregable, which is exactly why the
+        Histogram kind exists."""
+        with self._lock:
+            items = [(n, m) for n, m in sorted(self._metrics.items())
+                     if n.startswith(prefix)]
+        out = {}
+        for name, m in items:
+            key = name[len(prefix):] if prefix else name
+            if isinstance(m, Counter):
+                out[key] = {"kind": "counter", "value": m.value}
+            elif isinstance(m, Gauge):
+                out[key] = {"kind": "gauge", "value": m.value}
+            elif isinstance(m, Histogram):
+                counts, s, total = m._state()
+                out[key] = {"kind": "histogram",
+                            "buckets": list(m.buckets),
+                            "counts": counts, "sum": s, "total": total}
+            else:
+                vals = sorted(m.values())
+                out[key] = {"kind": "summary",
+                            "p50": percentile(vals, 50),
+                            "p99": percentile(vals, 99),
+                            "mean": (sum(vals) / len(vals)) if vals
+                            else None,
+                            "count": m.total}
+        return out
+
+    def prometheus_text(self, namespace="", instance=None):
         """Prometheus text exposition format (version 0.0.4): counters,
         gauges (skipped while unset), reservoirs as summaries with
-        quantile labels. Served by ui/server.py's `/metrics` route."""
+        quantile labels. Served by ui/server.py's `/metrics` route.
+
+        `instance` adds an `instance="..."` label to EVERY sample — the
+        federation-friendly form: N replicas' expositions stay
+        distinguishable after a scrape aggregates them, and
+        `obs.fleet.parse_prometheus_text` round-trips it. Default None
+        keeps the output byte-identical to the pre-label format."""
         with self._lock:
             items = sorted(self._metrics.items())
         ns = sanitize(namespace) + "_" if namespace else ""
+        inst = (None if instance is None else
+                str(instance).replace("\\", r"\\").replace('"', r'\"'))
+
+        def lbl(extra=""):
+            parts = [p for p in (extra,
+                                 f'instance="{inst}"' if inst else "")
+                     if p]
+            return "{" + ",".join(parts) + "}" if parts else ""
+
         lines = []
         for name, m in items:
             pname = ns + sanitize(name)
             if isinstance(m, Counter):
                 lines.append(f"# TYPE {pname} counter")
-                lines.append(f"{pname} {m.value}")
+                lines.append(f"{pname}{lbl()} {m.value}")
             elif isinstance(m, Gauge):
                 if m.value is None:
                     continue
                 lines.append(f"# TYPE {pname} gauge")
-                lines.append(f"{pname} {float(m.value)}")
+                lines.append(f"{pname}{lbl()} {float(m.value)}")
             elif isinstance(m, Histogram):
                 counts, total_sum, _ = m._state()
                 lines.append(f"# TYPE {pname} histogram")
                 cum = 0
                 for b, c in zip(m.buckets, counts):
                     cum += c
-                    lines.append(f'{pname}_bucket{{le="{b:g}"}} {cum}')
+                    le = 'le="%g"' % b
+                    lines.append(f"{pname}_bucket{lbl(le)} {cum}")
                 # +Inf closes over the SAME atomic state read, so the
                 # exposition is always internally consistent
                 lines.append(
-                    f'{pname}_bucket{{le="+Inf"}} {sum(counts)}')
-                lines.append(f"{pname}_sum {total_sum}")
-                lines.append(f"{pname}_count {sum(counts)}")
+                    f"{pname}_bucket{lbl(_INF_LABEL)} {sum(counts)}")
+                lines.append(f"{pname}_sum{lbl()} {total_sum}")
+                lines.append(f"{pname}_count{lbl()} {sum(counts)}")
             else:
                 vals = sorted(m.values())
                 lines.append(f"# TYPE {pname} summary")
                 for q, label in ((50, "0.5"), (90, "0.9"), (99, "0.99")):
                     v = percentile(vals, q)
                     if v is not None:
-                        lines.append(
-                            f'{pname}{{quantile="{label}"}} {v}')
-                lines.append(f"{pname}_count {m.total}")
+                        qlbl = 'quantile="%s"' % label
+                        lines.append(f"{pname}{lbl(qlbl)} {v}")
+                lines.append(f"{pname}_count{lbl()} {m.total}")
         return "\n".join(lines) + "\n"
 
 

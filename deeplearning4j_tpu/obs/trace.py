@@ -44,6 +44,36 @@ Span = collections.namedtuple(
     "Span", ["name", "cat", "track", "trace_id", "t0_ns", "dur_ns", "args"])
 
 
+class TraceContext(collections.namedtuple(
+        "TraceContext", ["trace_id", "parent_span", "origin"])):
+    """The cross-process trace baton (Dapper's propagated context):
+    `trace_id` identifies the request across every process that ever
+    served it, `parent_span` names the lane (`req-<id>`) the
+    continuation should extend, `origin` names the instance that
+    emitted the context. It rides the `RequestArtifact` manifest
+    through preempt/migrate (serving/kvstate.py) as a plain dict —
+    `to_manifest()`/`from_manifest()` — so the wire format stays
+    JSON and the destination server can continue the request's lane
+    under the SAME trace id, making a migrated request read as one
+    timeline after `obs.fleet.merge_traces`."""
+
+    __slots__ = ()
+
+    def to_manifest(self):
+        return {"trace_id": self.trace_id,
+                "parent_span": self.parent_span,
+                "origin": self.origin}
+
+    @classmethod
+    def from_manifest(cls, d):
+        """None-tolerant: artifacts written before trace propagation
+        (or by a producer that never traced) read as no context."""
+        if not d or d.get("trace_id") is None:
+            return None
+        return cls(d.get("trace_id"), d.get("parent_span"),
+                   d.get("origin"))
+
+
 class _Noop:
     """Shared do-nothing context manager: the disabled-tracer fast path."""
 
@@ -86,9 +116,13 @@ class _SpanCtx:
 class Tracer:
     """Bounded span recorder; disabled by default."""
 
-    def __init__(self, capacity=16384, enabled=False):
+    def __init__(self, capacity=16384, enabled=False, instance=None):
         self._buf = collections.deque(maxlen=int(capacity))
         self._enabled = bool(enabled)
+        # instance name: the default process_name of this tracer's
+        # chrome_trace() export. A fleet names its replicas' tracers so
+        # obs.fleet.merge_traces renders each as its own process group.
+        self.instance = None if instance is None else str(instance)
         self._auto = None        # [remaining, restore_enabled, callback]
         self._lock = threading.Lock()    # export/clear only, never emit
         # wallclock anchor: ONE (wall_ns, monotonic_ns) pair captured at
@@ -182,18 +216,27 @@ class Tracer:
     def __len__(self):
         return len(self._buf)
 
-    def chrome_trace(self, process_name="deeplearning4j_tpu"):
+    def chrome_trace(self, process_name=None, pid=0):
         """Chrome trace-event JSON (loads in Perfetto / chrome://tracing):
         one complete ("ph":"X") event per span, ts/dur in microseconds
         rebased to the earliest span, tracks mapped to tids with
-        thread_name metadata so lanes are labeled.
+        thread_name metadata so lanes are labeled. Every event carries
+        an EXPLICIT `pid` (default 0, schema-compatible with every
+        existing consumer) and the `process_name` metadata defaults to
+        the tracer's `instance` name when one was set — so a
+        multi-server merge (`obs.fleet.merge_traces`) renders each
+        instance as its own labeled process group in Perfetto.
 
         A `clock_sync` metadata event anchors ts=0 to the wall clock
         (`wallclock_ns_at_ts0`): spans are timed on the bare monotonic
         clock, whose zero is arbitrary per boot/process, so WITHOUT the
         anchor two saved traces cannot be aligned. To overlay trace B on
         trace A in Perfetto, shift B's events by
-        (B.wallclock_ns_at_ts0 - A.wallclock_ns_at_ts0) / 1e3 us."""
+        (B.wallclock_ns_at_ts0 - A.wallclock_ns_at_ts0) / 1e3 us —
+        exactly what merge_traces does."""
+        if process_name is None:
+            process_name = self.instance or "deeplearning4j_tpu"
+        pid = int(pid)
         spans = self.spans()
         wall_ns, mono_ns = self._wall_anchor
         base = min((s.t0_ns for s in spans), default=mono_ns)
@@ -201,17 +244,21 @@ class Tracer:
         for s in spans:
             tracks.setdefault(s.track or "main", len(tracks))
         wall_at_base = wall_ns + (base - mono_ns)
-        events = [{"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
+        sync_args = {
+            "wallclock_ns_at_ts0": wall_at_base,
+            "monotonic_ns_at_ts0": base,
+            "wallclock_iso": datetime.datetime.fromtimestamp(
+                wall_at_base / 1e9,
+                datetime.timezone.utc).isoformat()}
+        if self.instance is not None:
+            sync_args["instance"] = self.instance
+        events = [{"ph": "M", "pid": pid, "tid": 0,
+                   "name": "process_name",
                    "args": {"name": process_name}},
-                  {"ph": "M", "pid": 0, "tid": 0, "name": "clock_sync",
-                   "args": {
-                       "wallclock_ns_at_ts0": wall_at_base,
-                       "monotonic_ns_at_ts0": base,
-                       "wallclock_iso": datetime.datetime.fromtimestamp(
-                           wall_at_base / 1e9,
-                           datetime.timezone.utc).isoformat()}}]
+                  {"ph": "M", "pid": pid, "tid": 0, "name": "clock_sync",
+                   "args": sync_args}]
         for track, tid in tracks.items():
-            events.append({"ph": "M", "pid": 0, "tid": tid,
+            events.append({"ph": "M", "pid": pid, "tid": tid,
                            "name": "thread_name",
                            "args": {"name": track}})
         for s in spans:
@@ -221,13 +268,14 @@ class Tracer:
             events.append({
                 "name": s.name, "cat": s.cat, "ph": "X",
                 "ts": (s.t0_ns - base) / 1e3, "dur": s.dur_ns / 1e3,
-                "pid": 0, "tid": tracks[s.track or "main"], "args": args})
+                "pid": pid, "tid": tracks[s.track or "main"],
+                "args": args})
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
-    def save(self, path, process_name="deeplearning4j_tpu"):
+    def save(self, path, process_name=None, pid=0):
         """Write the Chrome trace JSON to `path` (open in Perfetto)."""
         with open(path, "w") as fh:
-            json.dump(self.chrome_trace(process_name), fh)
+            json.dump(self.chrome_trace(process_name, pid=pid), fh)
         return path
 
 

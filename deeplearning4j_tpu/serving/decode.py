@@ -174,6 +174,7 @@ from __future__ import annotations
 
 import collections
 import concurrent.futures as cf
+import itertools
 import logging
 import os
 import queue
@@ -337,7 +338,7 @@ class ContinuousDecodeServer(_RequestLoop):
                  max_blocks_per_slot=None, chunked_prefill=None,
                  admission=None, brownout=None,
                  default_deadline_ms=None, prefix_priority=True,
-                 preempt=False, prefix_cache_dir=None):
+                 preempt=False, prefix_cache_dir=None, instance=None):
         from ..models.zoo.transformer import (make_block_copy_fn,
                                               make_block_extract_fn,
                                               make_chunked_prefill_fn,
@@ -364,7 +365,17 @@ class ContinuousDecodeServer(_RequestLoop):
         self._injector = fault_injector
         self._retry = retry_policy
         from .metrics import ServingMetrics
-        self.metrics = metrics or ServingMetrics()
+        # instance identity (the fleet plane, obs/fleet.py): names this
+        # server in federated metrics (ServingMetrics endpoint name),
+        # merged traces (per-instance process groups), and — when set
+        # EXPLICITLY — the request/trace ids themselves ("i0-7"), so a
+        # request migrated between named instances keeps one globally
+        # unique trace id across both servers' traces. Default (None)
+        # keeps plain integer ids: single-server behavior unchanged.
+        self.metrics = metrics or ServingMetrics(name=instance)
+        self.instance = (str(instance) if instance is not None
+                         else self.metrics.name)
+        self._named_instance = instance is not None
         self._reporter = stats_reporter
         self._report_every = max(1, int(report_every))
         self._static = bool(static_batching)
@@ -564,6 +575,11 @@ class ContinuousDecodeServer(_RequestLoop):
 
         self._swap_lock = threading.Lock()
         self._init_loop(max_queue)
+        if self._named_instance:
+            # namespaced request/trace ids: every span lane and trace
+            # context this server emits is unique across the fleet
+            self._req_ids = (f"{self.instance}-{n}"
+                             for n in itertools.count())
         if self._prefix_dir is not None and \
                 artifact_kind(self._prefix_dir) == "prefix_cache":
             # warm start: a committed snapshot exists — restore it into
@@ -999,9 +1015,18 @@ class ContinuousDecodeServer(_RequestLoop):
         # resolutions — garbage by contract, never serialized
         panels = [(np.asarray(k)[:pos].copy(), np.asarray(v)[:pos].copy())
                   for k, v in panels]
+        # the Dapper baton: the artifact carries the request's trace id
+        # + origin lane, so the importing server continues the SAME
+        # `req-<id>` lane under the same trace id and the two saved
+        # traces stitch into one timeline (obs.fleet.merge_traces).
+        # Host-side metadata only — zero device work, and a consumer
+        # that never traces simply ignores it.
         art = RequestArtifact(r.prompt, r.generated, r.max_new,
                               self._version_tag(r.version),
-                              self._block_size, panels, klass=r.klass)
+                              self._block_size, panels, klass=r.klass,
+                              trace={"trace_id": r.req_id,
+                                     "parent_span": f"req-{r.req_id}",
+                                     "origin": self.instance})
         self.metrics.count("spill_bytes", art.nbytes)
         return art
 
@@ -1135,6 +1160,16 @@ class ContinuousDecodeServer(_RequestLoop):
             else:
                 reply.set_result(art)
 
+    def _mark_migrate_out(self, r):
+        """Instant marker closing the request's lane on THIS instance:
+        in the merged fleet trace it reads as the spill point between
+        'decode on A' and 'resume on B'."""
+        tr = self._tracer
+        if tr.enabled:
+            tr.instant("serve.migrate_out", cat="serve",
+                       track=f"req-{r.req_id}", trace_id=r.req_id,
+                       origin=self.instance)
+
     def _migrate_out_now(self, fut):
         for s, r in enumerate(self._slot_req):
             if r is None or r.future is not fut:
@@ -1149,6 +1184,7 @@ class ContinuousDecodeServer(_RequestLoop):
             self._free_slot(s)
             self._gc_versions()
             self.metrics.count("migrated_out")
+            self._mark_migrate_out(r)
             return art
         for r in list(self._resume_q):
             if r.future is fut and r.artifact is not None:
@@ -1158,6 +1194,7 @@ class ContinuousDecodeServer(_RequestLoop):
                 _fail_future(r.future, RequestMigratedError(
                     "request exported to another server"))
                 self.metrics.count("migrated_out")
+                self._mark_migrate_out(r)
                 return art
         raise KVStateError(
             "request not found in a decode slot (completed, failed, "
@@ -1203,7 +1240,21 @@ class ContinuousDecodeServer(_RequestLoop):
         req = _DecodeRequest(list(art.prompt), art.max_new, dl,
                              klass=art.klass)
         req.generated = list(art.generated)
-        req.req_id = next(self._req_ids)
+        ctx = art.trace or {}
+        if isinstance(ctx.get("trace_id"), str):
+            # cross-process trace continuity: continue the ORIGIN's
+            # `req-<id>` lane under the same trace id, so the merged
+            # trace reads enqueue -> decode on A -> spill -> resume
+            # here as ONE request timeline. Only NAMED instances mint
+            # string ids ("i0-7") — those are fleet-unique by
+            # construction. An UNNAMED origin's plain integer id could
+            # collide with this server's own counter (both count from
+            # 0), silently fusing two requests' lanes in this trace —
+            # so it gets a fresh local id instead (continuity is a
+            # fleet feature; name the instances to get it).
+            req.req_id = ctx["trace_id"]
+        else:
+            req.req_id = next(self._req_ids)
         req.migrated = True
         if art.remaining <= 0:
             # fully-decoded artifact: nothing left to serve — resolve
@@ -1227,6 +1278,11 @@ class ContinuousDecodeServer(_RequestLoop):
             pass
         tr = self._tracer
         if tr.enabled:
+            kw = {"trace_id": req.req_id}
+            if ctx.get("origin") is not None:
+                kw["migrated_from"] = ctx["origin"]
+            tr.instant("serve.migrate_in", cat="serve",
+                       track=f"req-{req.req_id}", **kw)
             tr.instant("serve.enqueue", cat="serve",
                        track=f"req-{req.req_id}", trace_id=req.req_id)
         if not self._running:

@@ -242,13 +242,21 @@ class RequestArtifact(_TaggedArtifact):
                position math the artifact froze is not).
     klass:     brownout request class, carried so a migrated/resumed
                request keeps its policy treatment.
+    trace:     optional TRACE CONTEXT dict ({"trace_id", "parent_span",
+               "origin"} — obs.trace.TraceContext.to_manifest()): the
+               Dapper baton. A destination server continues the
+               request's `req-<id>` lane under the SAME trace id, so
+               the two instances' saved traces stitch into one
+               timeline (obs.fleet.merge_traces). Pure metadata: never
+               consulted by any restore-correctness path, absent in
+               pre-trace artifacts, and a foreign producer may omit it.
     """
 
     __slots__ = ("prompt", "generated", "max_new", "tag", "block_size",
-                 "klass", "panels")
+                 "klass", "panels", "trace")
 
     def __init__(self, prompt, generated, max_new, tag, block_size,
-                 panels, klass="default"):
+                 panels, klass="default", trace=None):
         self.prompt = tuple(int(t) for t in prompt)
         self.generated = tuple(int(t) for t in generated)
         if not self.prompt or not self.generated:
@@ -259,6 +267,10 @@ class RequestArtifact(_TaggedArtifact):
         self.tag = str(tag)
         self.block_size = int(block_size)
         self.klass = str(klass)
+        # accept a mapping or anything with to_manifest() (TraceContext)
+        if trace is not None and hasattr(trace, "to_manifest"):
+            trace = trace.to_manifest()
+        self.trace = dict(trace) if trace else None
         self.panels = _check_panels(panels)
         if self.panels[0][0].shape[0] != self.pos:
             raise KVStateError(
@@ -285,7 +297,7 @@ class RequestArtifact(_TaggedArtifact):
 
     def save(self, path):
         flat = [a for kv in self.panels for a in kv]
-        return _write_payload(path, {
+        manifest = {
             "kind": "request",
             "tag": self.tag,
             "prompt": list(self.prompt),
@@ -294,13 +306,17 @@ class RequestArtifact(_TaggedArtifact):
             "block_size": self.block_size,
             "klass": self.klass,
             "n_layers": len(self.panels),
-        }, flat)
+        }
+        if self.trace is not None:
+            manifest["trace"] = self.trace
+        return _write_payload(path, manifest, flat)
 
     @classmethod
     def load(cls, path):
         m, flat = _read_payload(path, "request")
         return cls(m["prompt"], m["generated"], m["max_new"], m["tag"],
-                   m["block_size"], _pair_up(flat), klass=m["klass"])
+                   m["block_size"], _pair_up(flat), klass=m["klass"],
+                   trace=m.get("trace"))
 
 
 class PrefixCacheArtifact(_TaggedArtifact):

@@ -188,6 +188,24 @@ class ServingMetrics:
                     "spill_bytes", "prefix_restore_hits"):
             self.count(key, 0)
 
+    @property
+    def instance(self):
+        """The endpoint's instance label — the identity it federates
+        under (`obs.fleet.FleetView`) and exports on a labeled
+        `/metrics` route. Same string as `name`; the alias exists so
+        fleet code reads the intent, not the storage detail."""
+        return self.name
+
+    def kind_snapshot(self):
+        """Kind-tagged state export for federation: this endpoint's
+        metrics with their registry prefix stripped, each entry tagged
+        counter/gauge/histogram/summary so `obs.fleet.FleetView` can
+        merge N endpoints with kind-correct semantics (counters sum,
+        gauges stay per-instance, histogram buckets add element-wise,
+        summaries never merge). The authoritative hook — fleet code
+        never reaches into the registry's private prefix scheme."""
+        return self.registry.kind_snapshot(self._prefix)
+
     # -- hot-path recorders -------------------------------------------
     def count(self, key, n=1):
         # memoized per key: the hot path pays one dict hit + the

@@ -491,6 +491,7 @@ class _Handler(BaseHTTPRequestHandler):
     storage = None
     tsne = None  # session_id -> {"coords": ..., "labels": ...}
     metrics_registry = None  # None -> obs.default_registry() per request
+    metrics_instance = None  # instance label on every /metrics sample
 
     def log_message(self, *a):   # silence request logging
         pass
@@ -545,7 +546,8 @@ class _Handler(BaseHTTPRequestHandler):
             if reg is None:
                 from ..obs.registry import default_registry
                 reg = default_registry()
-            self._text(reg.prometheus_text(namespace="dl4j_tpu"))
+            self._text(reg.prometheus_text(
+                namespace="dl4j_tpu", instance=self.metrics_instance))
         elif self.path == "/api/sessions":
             self._json(s.list_session_ids() if s else [])
         elif self.path.startswith("/api/static/"):
@@ -603,6 +605,7 @@ class UIServer:
         self._thread = None
         self.storage = None
         self.metrics_registry = None
+        self.metrics_instance = None
 
     @classmethod
     def get_instance(cls, port=9000):
@@ -612,19 +615,31 @@ class UIServer:
 
     getInstance = get_instance
 
-    def attach_metrics(self, registry):
+    def attach_metrics(self, registry, instance=None):
         """Bind a specific MetricsRegistry to the `/metrics` route
-        (default: the process-wide obs.default_registry())."""
+        (default: the process-wide obs.default_registry()). `instance`
+        is the federation-friendly replica label: every exposition
+        sample gains `instance="..."` so N replicas' scrapes stay
+        distinguishable when a fleet view (obs/fleet.py) or a real
+        Prometheus aggregates them; None (the default) serves the
+        unlabeled byte-identical format — including on a RE-attach, so
+        rebinding the route to a new registry never leaks the previous
+        registry's label onto the new samples."""
         self.metrics_registry = registry
+        self.metrics_instance = (None if instance is None
+                                 else str(instance))
         if self._httpd is not None:
             self._httpd.RequestHandlerClass.metrics_registry = registry
+            self._httpd.RequestHandlerClass.metrics_instance = \
+                self.metrics_instance
         return self
 
     def attach(self, storage):
         self.storage = storage
         handler = type("BoundHandler", (_Handler,),
                        {"storage": storage, "tsne": {},
-                        "metrics_registry": self.metrics_registry})
+                        "metrics_registry": self.metrics_registry,
+                        "metrics_instance": self.metrics_instance})
         if self._httpd is None:
             self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
                                               handler)
@@ -642,7 +657,8 @@ class UIServer:
         if self._httpd is None:
             handler = type("BoundHandler", (_Handler,),
                            {"storage": None, "tsne": {},
-                            "metrics_registry": self.metrics_registry})
+                            "metrics_registry": self.metrics_registry,
+                            "metrics_instance": self.metrics_instance})
             self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
                                               handler)
             self.port = self._httpd.server_address[1]

@@ -1,0 +1,639 @@
+"""Fleet observability plane pins (ISSUE 12 acceptance criteria).
+
+  (a) Federation correctness: counters SUM exactly; merged histogram
+      quantiles equal pooled-sample-histogram quantiles (bucket-wise
+      merge IS the pooled histogram); per-instance gauges are never
+      averaged into counters; merging N copies of one snapshot scales
+      counters by N and leaves quantiles fixed; a Prometheus text
+      scrape federates identically to the in-process kind-snapshot.
+  (b) Cross-process trace stitching: a request migrated between two
+      NAMED server instances yields ONE merged Perfetto-loadable trace
+      with both instances' spans under the SAME trace id on distinct
+      process groups, span order consistent with the clock_sync anchor
+      alignment.
+  (c) AutoscaleSignal: seeded two-regime synthetic traces produce
+      scale_up only in the shed-accruing/service-not-rising regime,
+      hold below the knee and in the queue-bound (service-rising)
+      regime, scale_down only at idle-low occupancy, and hysteresis
+      prevents single-window flapping.
+  (d) Zero-added-dispatch: federating a serving fleet's metrics and
+      propagating trace context add ZERO device dispatches (the PR 6
+      dispatch-counter A/B), and obs/fleet.py never imports jax/numpy
+      (structural, alongside the package-wide scan in test_obs).
+"""
+import json
+import random
+import time
+
+import pytest
+
+from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+from deeplearning4j_tpu.obs import Tracer
+from deeplearning4j_tpu.obs.fleet import (AutoscaleSignal, FleetView,
+                                          merge_traces,
+                                          parse_prometheus_text)
+from deeplearning4j_tpu.obs.registry import (Histogram, MetricsRegistry,
+                                             bucket_quantile)
+from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                        RequestMigratedError,
+                                        ServingMetrics)
+
+
+def _lm(seed=3):
+    return TransformerLM(64, d_model=16, n_heads=2, n_layers=1,
+                         max_len=64, seed=seed)
+
+
+def _paged(lm, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prompt_buckets", (8,))
+    kw.setdefault("block_size", 4)
+    return ContinuousDecodeServer(lm, paged=True, **kw)
+
+
+def _wait_tokens(srv, n, timeout=60.0):
+    """Block until the server has emitted >= n tokens total."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if srv.metrics.snapshot().get("tokens_out", 0) >= n:
+            return
+        time.sleep(0.005)
+    raise TimeoutError(f"server never reached {n} tokens")
+
+
+# ---------------------------------------------------------------------------
+# (a) federation correctness
+# ---------------------------------------------------------------------------
+class TestFederation:
+    def _instances(self, seeds=(0, 1, 2)):
+        out = []
+        for i, seed in enumerate(seeds):
+            m = ServingMetrics(name=f"i{i}", slo_target_ms=50)
+            rng = random.Random(seed)
+            for _ in range(20 + 10 * i):
+                m.record_ttft(rng.uniform(0.1, 400.0))
+                m.record_request(rng.uniform(1.0, 100.0), tokens=3)
+            m.count("shed_predicted", i)
+            out.append(m)
+        return out
+
+    def test_counters_sum_exactly(self):
+        ms = self._instances()
+        fv = FleetView()
+        for m in ms:
+            fv.add(m.name, m)
+        assert fv.counter("completed") == sum(
+            m.snapshot()["completed"] for m in ms) == 90
+        assert fv.counter("shed_predicted") == 0 + 1 + 2
+        assert fv.counters()["slo_total"] == sum(
+            m.snapshot()["slo_total"] for m in ms)
+
+    def test_merged_histogram_quantile_equals_pooled(self):
+        """THE aggregability pin the fixed-bucket design exists for:
+        bucket-wise merged counts are byte-identical to a histogram
+        that observed the pooled samples, so every interpolated
+        quantile is EXACTLY equal — and both sit within one bucket of
+        the true pooled-sample quantile."""
+        ms = self._instances()
+        fv = FleetView()
+        samples = []
+        for m in ms:
+            fv.add(m.name, m)
+        pooled = ServingMetrics(name="pooled")
+        for i, seed in enumerate((0, 1, 2)):
+            rng = random.Random(seed)
+            for _ in range(20 + 10 * i):
+                v = rng.uniform(0.1, 400.0)
+                pooled.record_ttft(v)
+                samples.append(v)
+                rng.uniform(1.0, 100.0)     # keep streams aligned
+        ph = pooled.latency_histograms()["ttft_ms"]
+        merged = fv.histogram("ttft_ms")
+        assert merged["counts"] == ph.counts()
+        assert merged["total"] == len(samples)
+        samples.sort()
+        for q in (10, 50, 90, 99):
+            est = fv.quantile("ttft_ms", q)
+            assert est == ph.quantile(q)
+            # within bucket resolution of the true pooled quantile:
+            # the estimate lands in the same bucket as the true value
+            true = samples[min(len(samples) - 1,
+                               int(q / 100.0 * (len(samples) - 1)))]
+            bounds = [0.0] + list(ph.buckets)
+            bi = next(j for j in range(1, len(bounds))
+                      if true <= bounds[j] or j == len(bounds) - 1)
+            assert bounds[bi - 1] <= est <= bounds[bi] + 1e-9, (
+                f"q{q}: est {est} outside the bucket holding "
+                f"true {true}")
+
+    def test_gauges_keep_per_instance_never_sum_into_counters(self):
+        a = ServingMetrics(name="a")
+        b = ServingMetrics(name="b")
+        a.record_request(5.0)           # materialize the counter kind
+        a.record_service_rate(100.0)
+        b.record_service_rate(300.0)
+        fv = FleetView().add("a", a).add("b", b)
+        gv = fv.gauge_view("service_rate_tokens_per_sec")
+        assert gv["per_instance"] == {"a": 100.0, "b": 300.0}
+        assert gv["min"] == 100.0 and gv["max"] == 300.0
+        assert gv["mean"] == 200.0
+        # the gauge never appears among the summed counters; summing it
+        # is only available as the EXPLICIT derived verb
+        assert "service_rate_tokens_per_sec" not in fv.counters()
+        assert fv.gauge_sum("service_rate_tokens_per_sec") == 400.0
+        with pytest.raises(ValueError):
+            fv.counter("service_rate_tokens_per_sec")
+        with pytest.raises(ValueError):
+            fv.gauge_view("completed")
+
+    def test_kind_conflict_across_instances_raises(self):
+        fv = FleetView()
+        fv.add("a", {"x": {"kind": "counter", "value": 1}})
+        fv.add("b", {"x": {"kind": "gauge", "value": 2.0}})
+        with pytest.raises(ValueError, match="conflicting kinds"):
+            fv.counters()
+
+    def test_n_copies_scale_counters_and_fix_quantiles(self):
+        m = self._instances(seeds=(7,))[0]
+        solo = FleetView().add("i0", m)
+        fv = FleetView()
+        for i in range(3):
+            fv.add(f"c{i}", m)
+        assert fv.counter("completed") == 3 * solo.counter("completed")
+        for q in (50, 99):
+            # 3x every bucket count: the interpolation is scale-free,
+            # so the quantile is unchanged (to float round-off)
+            assert fv.quantile("ttft_ms", q) == pytest.approx(
+                solo.quantile("ttft_ms", q), rel=1e-12)
+
+    def test_mismatched_histogram_grids_refused(self):
+        fv = FleetView()
+        fv.add("a", {"h": {"kind": "histogram", "buckets": [1, 2],
+                           "counts": [1, 0, 0], "sum": 0.5,
+                           "total": 1}})
+        fv.add("b", {"h": {"kind": "histogram", "buckets": [1, 5],
+                           "counts": [1, 0, 0], "sum": 0.5,
+                           "total": 1}})
+        with pytest.raises(ValueError, match="mismatched bucket grids"):
+            fv.histogram("h")
+
+    def test_mixed_instance_exposition_refused_or_filtered(self):
+        """Review regression: a text carrying SEVERAL instances'
+        samples (an aggregated scrape) must not silently last-win
+        counters — it raises without an instance= filter, and with one
+        it reads exactly that instance's samples."""
+        reg0, reg1 = MetricsRegistry(), MetricsRegistry()
+        ServingMetrics(registry=reg0, name="s").record_request(5.0)
+        m1 = ServingMetrics(registry=reg1, name="s")
+        m1.record_request(5.0)
+        m1.record_request(6.0)
+        agg = (reg0.prometheus_text(namespace="ns", instance="i0")
+               + reg1.prometheus_text(namespace="ns", instance="i1"))
+        with pytest.raises(ValueError, match="several instances"):
+            parse_prometheus_text(agg)
+        snap0 = parse_prometheus_text(
+            agg, strip_prefix="ns_serving_s_", instance="i0")
+        snap1 = parse_prometheus_text(
+            agg, strip_prefix="ns_serving_s_", instance="i1")
+        assert snap0["completed"]["value"] == 1
+        assert snap1["completed"]["value"] == 2
+
+    def test_prometheus_text_federates_identically(self):
+        """A scraped /metrics exposition (instance label included) and
+        the in-process kind-snapshot are the SAME federation input:
+        counters, histogram bucket counts, and gauges all round-trip."""
+        reg = MetricsRegistry()
+        m = ServingMetrics(registry=reg, name="i0", slo_target_ms=50)
+        rng = random.Random(5)
+        for _ in range(40):
+            m.record_ttft(rng.uniform(0.1, 900.0))
+            m.record_request(rng.uniform(1.0, 80.0), tokens=2)
+        m.record_service_rate(123.5)
+        text = reg.prometheus_text(namespace="dl4j_tpu", instance="i0")
+        via_text = FleetView().add(
+            "i0", text, strip_prefix="dl4j_tpu_serving_i0_")
+        via_obj = FleetView().add("i0", m)
+        assert via_text.counter("completed") == \
+            via_obj.counter("completed") == 40
+        ht, ho = (v.histogram("ttft_ms")
+                  for v in (via_text, via_obj))
+        assert ht["counts"] == ho["counts"]
+        assert ht["buckets"] == ho["buckets"]
+        assert via_text.quantile("ttft_ms", 99) == \
+            via_obj.quantile("ttft_ms", 99)
+        gv = via_text.gauge_view("service_rate_tokens_per_sec")
+        assert gv["per_instance"]["i0"] == 123.5
+
+    def test_fleet_snapshot_derived_readouts(self):
+        a = ServingMetrics(name="a", slo_target_ms=50)
+        b = ServingMetrics(name="b", slo_target_ms=50)
+        a.record_request(10.0, tokens=8)        # met
+        b.record_request(90.0, tokens=8)        # missed
+        a.count("tokens_out", 8)
+        b.count("tokens_out", 8)
+        a.record_service_rate(500.0)
+        b.record_service_rate(300.0)
+        b.count("shed_predicted", 4)
+        fv = FleetView().add("a", a).add("b", b)
+        snap = fv.snapshot()
+        assert snap["fleet_instances"] == 2
+        assert snap["fleet_slo_attainment"] == pytest.approx(0.5)
+        # goodput = fleet capacity x within-SLO token fraction (8/16)
+        assert snap["fleet_goodput_tokens_per_sec"] == \
+            pytest.approx(800.0 * 0.5)
+        assert snap["fleet_shed_predicted"] == 4
+        assert snap["fleet_shed_share"] == {"a": 0.0, "b": 1.0}
+        assert snap["autoscale_decision"] is None
+        sig = AutoscaleSignal()
+        assert FleetView(signal=sig).add("a", a).snapshot()[
+            "autoscale_decision"] == "hold"
+
+
+# ---------------------------------------------------------------------------
+# (b) trace stitching + the migrated-request single-timeline pin
+# ---------------------------------------------------------------------------
+class TestTraceStitch:
+    def test_merge_aligns_on_clock_anchors(self):
+        t1 = Tracer(enabled=True, instance="a")
+        with t1.span("first", track="lane"):
+            time.sleep(0.002)
+        time.sleep(0.04)
+        t2 = Tracer(enabled=True, instance="b")
+        with t2.span("second", track="lane"):
+            time.sleep(0.002)
+        merged = merge_traces([t1.chrome_trace(), t2.chrome_trace()])
+        xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert sorted({e["pid"] for e in xs}) == [1, 2]
+        names = {e["args"]["name"]: e["pid"]
+                 for e in merged["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert names == {"a": 1, "b": 2}
+        first = next(e for e in xs if e["name"] == "first")
+        second = next(e for e in xs if e["name"] == "second")
+        # wall-anchor alignment: the later trace's span lands LATER on
+        # the merged timeline by ~the real elapsed gap (>= 30ms here)
+        assert second["ts"] - first["ts"] >= 30e3
+        json.dumps(merged)      # JSON-serializable = Perfetto-loadable
+
+    def test_anchorless_trace_merges_unshifted(self):
+        t = Tracer(enabled=True)
+        with t.span("x"):
+            pass
+        bare = {"traceEvents": [
+            {"name": "y", "cat": "c", "ph": "X", "ts": 1.0, "dur": 1.0,
+             "pid": 0, "tid": 0, "args": {}}]}
+        merged = merge_traces([t.chrome_trace(), bare])
+        xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert {e["name"] for e in xs} == {"x", "y"}
+        assert sorted({e["pid"] for e in xs}) == [1, 2]
+
+    def test_migrated_request_is_one_timeline(self):
+        """THE acceptance pin: a request moved between two NAMED server
+        instances via migrate_out/migrate_in yields ONE merged
+        Perfetto-loadable trace with both instances' spans under the
+        SAME trace id on distinct process groups, and the destination's
+        resume spans sit AFTER the origin's spill marker on the merged
+        timeline (the clock_sync alignment is the in-process wall==mono
+        delta, pinned in test_obs)."""
+        lm = _lm()
+        ta = Tracer(enabled=True, instance="a")
+        tb = Tracer(enabled=True, instance="b")
+        a = _paged(lm, instance="a", tracer=ta).start()
+        b = _paged(lm, instance="b", tracer=tb).start()
+        try:
+            with _paged(lm) as solo:
+                ref = solo.generate([5, 9, 2, 7, 1, 3], 20, timeout=120)
+            fut = a.submit([5, 9, 2, 7, 1, 3], 20)
+            _wait_tokens(a, 4)
+            art = a.migrate_out(fut)
+            # the trace baton rides the artifact manifest
+            assert art.trace["origin"] == "a"
+            tid = art.trace["trace_id"]
+            assert str(tid).startswith("a-")
+            with pytest.raises(RequestMigratedError):
+                fut.result(10)
+            out = b.migrate_in(art).result(120)
+            assert out == ref       # stream survives, bit-identical
+        finally:
+            a.stop(timeout=120)
+            b.stop(timeout=120)
+        merged = merge_traces([ta.chrome_trace(), tb.chrome_trace()],
+                              names=["a", "b"])
+        evs = [e for e in merged["traceEvents"]
+               if (e.get("args") or {}).get("trace_id") == tid]
+        by_pid = {}
+        for e in evs:
+            by_pid.setdefault(e["pid"], []).append(e)
+        # both instances' spans, same trace id, distinct process groups
+        assert set(by_pid) == {1, 2}
+        a_names = {e["name"] for e in by_pid[1]}
+        b_names = {e["name"] for e in by_pid[2]}
+        assert "serve.migrate_out" in a_names
+        assert "serve.migrate_in" in b_names
+        assert "decode.restore" in b_names
+        assert "serve.request" in b_names       # the completed lane
+        # order across the process boundary: every destination event
+        # sits at/after the origin's spill marker on the merged clock
+        spill = next(e for e in by_pid[1]
+                     if e["name"] == "serve.migrate_out")
+        assert all(e["ts"] >= spill["ts"] - 1e3 for e in by_pid[2]), (
+            "destination spans precede the origin's spill marker")
+        # the continued lane name is the origin's req-<id> lane on BOTH
+        lanes = {e["args"]["name"] for e in merged["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "thread_name"
+                 and e["args"]["name"] == f"req-{tid}"}
+        assert lanes == {f"req-{tid}"}
+        json.dumps(merged)
+
+    def test_artifact_trace_context_survives_disk(self, tmp_path):
+        """The manifest carries the baton through the wire format."""
+        lm = _lm()
+        a = _paged(lm, instance="a").start()
+        try:
+            # long budget: the export must land while the request is
+            # still decoding (a finished request has no state to move)
+            fut = a.submit([1, 2, 3], 48)
+            _wait_tokens(a, 2)
+            art = a.migrate_out(fut)
+        finally:
+            a.stop(timeout=120)
+        from deeplearning4j_tpu.serving.kvstate import RequestArtifact
+        p = str(tmp_path / "art")
+        art.save(p)
+        loaded = RequestArtifact.load(p)
+        assert loaded.trace == art.trace
+        assert loaded.trace["origin"] == "a"
+
+    def test_unnamed_server_keeps_integer_ids(self):
+        """Default (no instance=): request ids stay plain ints — the
+        single-server trace format is unchanged."""
+        lm = _lm()
+        with ContinuousDecodeServer(lm, slots=2,
+                                    prompt_buckets=(8,)) as srv:
+            srv.generate([1, 2, 3], 2, timeout=120)
+            assert srv.instance == srv.metrics.name
+
+    def test_unnamed_origin_id_not_adopted(self):
+        """Review regression: an UNNAMED origin's integer trace id
+        could collide with the destination's own counter (both count
+        from 0) — the destination mints a fresh LOCAL id instead;
+        lane continuity is a named-fleet feature."""
+        lm = _lm()
+        a = _paged(lm).start()      # unnamed: integer ids
+        tb = Tracer(enabled=True)
+        b = _paged(lm, tracer=tb).start()
+        try:
+            a.generate([9, 9], 2, timeout=120)      # burn ids 0..
+            a.generate([8, 8], 2, timeout=120)
+            fut = a.submit([5, 9, 2, 7], 48)        # origin id >= 2
+            _wait_tokens(a, 7)      # 4 warm-up tokens + a few of its own
+            art = a.migrate_out(fut)
+            origin_id = art.trace["trace_id"]
+            assert isinstance(origin_id, int) and origin_id >= 2
+            b.migrate_in(art).result(120)
+        finally:
+            a.stop(timeout=120)
+            b.stop(timeout=120)
+        (mi,) = [s for s in tb.spans() if s.name == "serve.migrate_in"]
+        assert mi.args["trace_id"] == 0     # b's OWN fresh counter
+        assert mi.args["trace_id"] != origin_id
+
+    def test_decompose_partitions_within_each_process_group(self):
+        """Review regression: decomposing a MERGED multi-instance
+        trace must attribute each request against its OWN instance's
+        busy windows — pooling pids charged every request with the
+        other replicas' concurrent dispatches (decode_ms > total_ms,
+        sched_gap clamped to 0)."""
+        from deeplearning4j_tpu.obs.decompose import decompose_requests
+
+        def trace(pid_free, tid):
+            # one request [0, 100]ms with 10ms queue wait and a 40ms
+            # dispatch window; a SECOND 40ms dispatch on the same
+            # timeline belongs to the other instance's trace
+            return {"traceEvents": [
+                {"name": "serve.request", "ph": "X", "ts": 0.0,
+                 "dur": 100e3, "pid": pid_free, "tid": 1,
+                 "args": {"trace_id": tid}},
+                {"name": "serve.queue_wait", "ph": "X", "ts": 0.0,
+                 "dur": 10e3, "pid": pid_free, "tid": 1,
+                 "args": {"trace_id": tid}},
+                {"name": "decode.dispatch", "ph": "X", "ts": 20e3,
+                 "dur": 40e3, "pid": pid_free, "tid": 0, "args": {}},
+            ]}
+        merged = merge_traces([trace(0, "a-0"), trace(0, "b-0")])
+        rows = decompose_requests(merged)
+        assert len(rows) == 2
+        for r in rows:
+            # own 40ms dispatch only — NOT the other instance's too
+            # (decompose rows are in ms; the trace's ts/dur are us)
+            assert r["decode_ms"] == pytest.approx(40.0, rel=1e-6)
+            assert r["queue_wait_ms"] == pytest.approx(10.0, rel=1e-6)
+            total = (r["queue_wait_ms"] + r["prefill_ms"]
+                     + r["decode_ms"] + r["sched_gap_ms"])
+            assert total == pytest.approx(r["total_ms"], rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# (c) the autoscaling signal
+# ---------------------------------------------------------------------------
+def _run_regimes(sig, regimes, rng):
+    """Feed seeded synthetic observations; returns [(regime_idx,
+    decision)] per observation. Each regime dict: n windows,
+    shed_rate (cumulative deltas drawn 0.8x-1.2x), service (drawn
+    +/- jitter), occupancy."""
+    out, cum = [], 0.0
+    for ri, r in enumerate(regimes):
+        for _ in range(r["n"]):
+            cum += r["shed_rate"] * rng.uniform(0.8, 1.2) \
+                if r["shed_rate"] else 0.0
+            svc = r["service"] * (1 + rng.uniform(-r.get("jitter", .03),
+                                                  r.get("jitter", .03)))
+            out.append((ri, sig.observe(sheds=cum, service_rate=svc,
+                                        occupancy=r.get("occ", 0.6))))
+    return out
+
+
+class TestAutoscaleSignal:
+    def test_two_regime_scale_up_only_past_knee(self):
+        """Seeded two-regime trace: below the knee (zero sheds, flat
+        service) the decision never leaves hold; past it (sheds
+        accruing, service flat at capacity) scale_up fires and LATCHES
+        for the rest of the regime."""
+        for seed in range(8):
+            sig = AutoscaleSignal(window=6, hysteresis=2)
+            rng = random.Random(f"fleet:{seed}")
+            hist = _run_regimes(sig, [
+                {"n": 12, "shed_rate": 0.0, "service": 1000.0},
+                {"n": 12, "shed_rate": 8.0, "service": 1000.0},
+            ], rng)
+            below = [d for ri, d in hist if ri == 0]
+            past = [d for ri, d in hist if ri == 1]
+            assert set(below) == {"hold"}, f"seed {seed}: {below}"
+            assert past[-1] == "scale_up", f"seed {seed}: {past}"
+            # once capacity-bound, it stays scale_up (no flapping back)
+            first = past.index("scale_up")
+            assert set(past[first:]) == {"scale_up"}
+
+    def test_queue_bound_regime_holds(self):
+        """Sheds accruing while service rate is STILL RISING = queue /
+        ramp, not capacity — the detector must hold."""
+        for seed in range(8):
+            sig = AutoscaleSignal(window=6, hysteresis=2, flat_tol=0.1)
+            rng = random.Random(f"queue:{seed}")
+            svc, cum, decs = 400.0, 0.0, []
+            for _ in range(14):
+                svc *= 1.18             # capacity ramping hard
+                cum += 5 * rng.uniform(0.8, 1.2)
+                decs.append(sig.observe(sheds=cum, service_rate=svc,
+                                        occupancy=0.9))
+            assert set(decs) == {"hold"}, f"seed {seed}: {decs}"
+
+    def test_scale_down_only_at_idle_low_occupancy(self):
+        sig = AutoscaleSignal(window=6, hysteresis=2,
+                              low_occupancy=0.25)
+        decs = [sig.observe(sheds=0, service_rate=1000.0,
+                            occupancy=0.1) for _ in range(10)]
+        assert decs[-1] == "scale_down"
+        # moderate occupancy: never scale_down
+        sig2 = AutoscaleSignal(window=6, hysteresis=2,
+                               low_occupancy=0.25)
+        decs2 = [sig2.observe(sheds=0, service_rate=1000.0,
+                              occupancy=0.5) for _ in range(10)]
+        assert set(decs2) == {"hold"}
+        # unknown occupancy disables scale_down entirely
+        sig3 = AutoscaleSignal(window=6, hysteresis=2)
+        decs3 = [sig3.observe(sheds=0, service_rate=1000.0)
+                 for _ in range(10)]
+        assert set(decs3) == {"hold"}
+
+    def test_single_window_burst_never_flaps(self):
+        """One anomalous shed burst (a single observation window) must
+        not flip the decision, for any window size: the lower-median
+        delta statistic rejects the lone outlier outright, and the
+        hysteresis bound guards whatever residual raw flip remains."""
+        for window in (4, 5, 6, 8):
+            sig = AutoscaleSignal(window=window, hysteresis=2,
+                                  min_shed_rate=2.0)
+            cum = 0.0
+            for _ in range(2 * window):
+                sig.observe(sheds=cum, service_rate=100.0,
+                            occupancy=0.6)
+            cum += 50.0                 # one burst window
+            decs = [sig.observe(sheds=cum, service_rate=100.0,
+                                occupancy=0.6)]
+            for _ in range(2 * window):     # quiet again
+                decs.append(sig.observe(sheds=cum, service_rate=100.0,
+                                        occupancy=0.6))
+            assert set(decs) == {"hold"}, f"window {window}: {decs}"
+            assert sig.transitions == []
+
+    def test_hysteresis_delays_a_real_transition(self):
+        """The decision changes only after `hysteresis` consecutive
+        identical raw verdicts: under a sustained shed regime the
+        scale_up lands at least one observation AFTER the first raw
+        flip could have occurred."""
+        sig = AutoscaleSignal(window=6, hysteresis=3)
+        cum, decs = 0.0, []
+        for i in range(20):
+            if i >= 8:
+                cum += 10.0             # sustained overload from obs 8
+            decs.append(sig.observe(sheds=cum, service_rate=100.0,
+                                    occupancy=0.8))
+        assert decs[-1] == "scale_up"
+        (first_idx, first) = sig.transitions[0]
+        assert first == "scale_up"
+        # at least `hysteresis` observations of the regime passed
+        # before the decision moved
+        assert first_idx >= 8 + 3
+
+    def test_warmup_never_acts(self):
+        sig = AutoscaleSignal(window=6, hysteresis=1)
+        for _ in range(5):
+            assert sig.observe(sheds=1000, service_rate=1.0,
+                               occupancy=0.0) == "hold"
+
+    def test_deterministic_same_inputs_same_decisions(self):
+        def run():
+            sig = AutoscaleSignal()
+            rng = random.Random("det")
+            return [d for _, d in _run_regimes(sig, [
+                {"n": 10, "shed_rate": 0.0, "service": 500.0},
+                {"n": 10, "shed_rate": 4.0, "service": 500.0},
+            ], rng)]
+        assert run() == run()
+
+    def test_counter_reset_reads_as_quiet_not_negative(self):
+        sig = AutoscaleSignal(window=4, hysteresis=1)
+        cum = 100.0
+        for _ in range(6):
+            sig.observe(sheds=cum, service_rate=100.0, occupancy=0.6)
+        # an instance restarted: the merged counter drops — one quiet
+        # window, never a negative spike / crash
+        assert sig.observe(sheds=10.0, service_rate=100.0,
+                           occupancy=0.6) == "hold"
+
+    def test_snapshot_input_form(self):
+        sig = AutoscaleSignal(window=4, hysteresis=1)
+        snap = {"fleet_shed_predicted": 3,
+                "fleet_service_rate_tokens_per_sec": 100.0,
+                "fleet_occupancy_mean": 0.5}
+        assert sig.observe(snap) == "hold"
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            AutoscaleSignal(window=2)
+        with pytest.raises(ValueError):
+            AutoscaleSignal(hysteresis=0)
+
+
+# ---------------------------------------------------------------------------
+# (d) zero-added-dispatch + structural pins
+# ---------------------------------------------------------------------------
+class TestFleetCostPins:
+    def test_fleet_module_never_imports_device_code(self):
+        import os
+        import re
+        import deeplearning4j_tpu.obs.fleet as fleet_mod
+        src = open(fleet_mod.__file__.replace(".pyc", ".py")).read()
+        bad = re.compile(r"^\s*(?:import|from)\s+(?:jax|numpy)\b",
+                         re.MULTILINE)
+        assert bad.search(src) is None
+        assert os.path.dirname(fleet_mod.__file__).endswith("obs")
+
+    def test_federation_adds_zero_device_dispatches(self):
+        """Same sequential workload twice: bare server vs a server
+        whose metrics are federated into a FleetView + AutoscaleSignal
+        observation after EVERY request, with tracing on. Dispatch
+        counters must be IDENTICAL — the fleet plane observes the
+        schedule, never alters it."""
+        counts = {}
+        for arm in ("bare", "federated"):
+            lm = _lm()
+            tracer = Tracer(enabled=(arm == "federated"),
+                            instance=arm)
+            sig = AutoscaleSignal(window=4, hysteresis=1)
+            with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                        tracer=tracer,
+                                        instance=arm) as srv:
+                for i in range(4):
+                    srv.generate([1 + i, 2, 3], 5, timeout=120)
+                    if arm == "federated":
+                        fv = FleetView(signal=sig).add(
+                            arm, srv.metrics)
+                        sig.observe(fv.snapshot())
+                        fv.snapshot()
+            snap = srv.metrics.snapshot()
+            counts[arm] = (snap["dispatches"], snap["tokens_out"])
+        assert counts["federated"] == counts["bare"]
+
+    def test_named_instance_request_ids_are_fleet_unique(self):
+        lm = _lm()
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                    instance="i0") as a:
+            with ContinuousDecodeServer(lm, slots=2,
+                                        prompt_buckets=(8,),
+                                        instance="i1") as b:
+                a.generate([1, 2, 3], 2, timeout=120)
+                b.generate([1, 2, 3], 2, timeout=120)
+                assert a.metrics.name == "i0"
+                assert b.metrics.name == "i1"

@@ -487,3 +487,50 @@ class TestSmokeSweep:
         for key in ("preempted", "resumed", "migrated", "spill_bytes",
                     "prefix_restore_hits"):
             assert key in snap
+
+    def test_smoke_sweep_fleet_autoscale(self):
+        """The 2-replica fleet mini-sweep in tier-1 (ISSUE 12): a
+        below-knee and a far-past-knee rate through TWO named
+        round-robin decode replicas with deadline-aware admission, the
+        merged fleet snapshot fed to ONE AutoscaleSignal per schedule
+        slice. Pins the e2e acceptance: the detector fires `scale_up`
+        past the knee (sheds accruing while the fleet service-rate
+        estimate is not rising) and stays `hold` below it — plus the
+        merged multi-instance trace artifact CI uploads (tier1.yml)."""
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        mod = importlib.import_module("load_sweep")
+        out = os.path.join(
+            os.environ.get("SMOKE_REPORT_DIR") or tempfile.gettempdir(),
+            "load_sweep_smoke_fleet")
+        res = mod.run_sweep(server="decode", rates=(30.0, 1500.0),
+                            n_req=24, slo_ms=400.0, seed=0, trace=True,
+                            report_path=out, fleet=2,
+                            fleet_obs_per_rate=6, fleet_slice_s=0.2)
+        (body,) = res
+        assert body["server"] == "fleet"
+        assert body["n_replicas"] == 2
+        below, past = body["curve"]
+        # below the knee: zero predicted sheds, the detector holds
+        assert set(below["autoscale_decisions"]) == {"hold"}
+        # far past the knee: sheds accrue every slice while the fleet
+        # capacity estimate stays flat/sagging -> scale_up fires and
+        # ends the rung latched
+        assert "scale_up" in past["autoscale_decisions"]
+        assert past["autoscale_decision"] == "scale_up"
+        assert past["fleet_shed_predicted"] > 0
+        assert body["fleet"]["fleet_instances"] == 2
+        # artifacts: report + the clock-anchor-MERGED trace with both
+        # replicas as distinct process groups
+        rep = json.load(open(out + ".json"))
+        assert rep["sweep"][0]["server"] == "fleet"
+        assert os.path.exists(out + ".txt")
+        merged = json.load(open(out + ".trace.merged.json"))
+        xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert sorted({e["pid"] for e in xs}) == [1, 2]
+        pnames = {e["args"]["name"] for e in merged["traceEvents"]
+                  if e.get("ph") == "M"
+                  and e.get("name") == "process_name"}
+        assert pnames == {"i0", "i1"}

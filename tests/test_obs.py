@@ -226,6 +226,80 @@ class TestTraceSchema:
         assert fmt(1.23456) == 1.235
         assert fmt(1.23456, 1) == 1.2
 
+    def test_histogram_bucketwise_merge_is_pooled(self):
+        """The aggregability contract federation depends on (ISSUE 12):
+        element-wise summing two histograms' bucket counts gives
+        `bucket_quantile` results EQUAL to a single histogram that
+        observed the pooled samples — merged counts ARE the pooled
+        histogram's counts, so the invariant is exact, not
+        approximate."""
+        import random
+
+        from deeplearning4j_tpu.obs import Histogram
+        from deeplearning4j_tpu.obs.registry import bucket_quantile
+        grid = (1, 5, 25, 100, 500)
+        h1, h2, pooled = (Histogram(n, buckets=grid)
+                          for n in ("a", "b", "p"))
+        rng = random.Random("agg-pin")
+        for _ in range(300):
+            v = rng.uniform(0.0, 700.0)
+            (h1 if rng.random() < 0.4 else h2).observe(v)
+            pooled.observe(v)
+        merged = [a + b for a, b in zip(h1.counts(), h2.counts())]
+        assert merged == pooled.counts()
+        assert sum(merged) == 300
+        for q in (1, 25, 50, 75, 99):
+            assert bucket_quantile(grid, merged, q) == \
+                pooled.quantile(q)
+
+    def test_chrome_trace_pid_and_instance_metadata(self):
+        """Satellite pin (ISSUE 12): every event carries an explicit
+        pid (settable, default 0) and process_name defaults to the
+        tracer's instance name — the hooks merged multi-server traces
+        need — while the default export stays schema-compatible with
+        every existing consumer."""
+        t = Tracer(enabled=True)
+        with t.span("x"):
+            pass
+        ct = t.chrome_trace()
+        assert all(e["pid"] == 0 for e in ct["traceEvents"])
+        (pn,) = [e for e in ct["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert pn["args"]["name"] == "deeplearning4j_tpu"
+
+        ti = Tracer(enabled=True, instance="i3")
+        with ti.span("y"):
+            pass
+        ct3 = ti.chrome_trace(pid=7)
+        assert all(e["pid"] == 7 for e in ct3["traceEvents"])
+        (pn3,) = [e for e in ct3["traceEvents"]
+                  if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert pn3["args"]["name"] == "i3"
+        (cs,) = [e for e in ct3["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "clock_sync"]
+        assert cs["args"]["instance"] == "i3"
+
+    def test_prometheus_instance_label(self):
+        """instance= labels EVERY exposition sample (counter, gauge,
+        histogram buckets incl. +Inf, summary quantiles) and composes
+        with existing labels; default output is label-free."""
+        reg = MetricsRegistry()
+        reg.counter("c").inc(2)
+        reg.gauge("g").set(1.5)
+        reg.histogram("h", buckets=(1, 10)).observe(5.0)
+        res = reg.reservoir("r", window=8)
+        res.record(3.0)
+        text = reg.prometheus_text(namespace="ns", instance="i0")
+        assert 'ns_c{instance="i0"} 2' in text
+        assert 'ns_g{instance="i0"} 1.5' in text
+        assert 'ns_h_bucket{le="1",instance="i0"} 0' in text
+        assert 'ns_h_bucket{le="+Inf",instance="i0"} 1' in text
+        assert 'ns_h_count{instance="i0"} 1' in text
+        assert 'ns_r{quantile="0.5",instance="i0"} 3.0' in text
+        assert 'ns_r_count{instance="i0"} 1' in text
+        plain = reg.prometheus_text(namespace="ns")
+        assert "instance=" not in plain
+
 
 class TestPrometheusRoute:
     def test_metrics_route_serves_registry(self):
@@ -247,6 +321,31 @@ class TestPrometheusRoute:
             assert "dl4j_tpu_serving_s1_slo_met 1" in text
             assert 'dl4j_tpu_serving_s1_latency_ms{quantile="0.5"} 10.0' \
                 in text
+        finally:
+            server.stop()
+
+    def test_metrics_route_with_instance_label(self):
+        """attach_metrics(..., instance=) labels every sample — the
+        federation-friendly exposition a fleet's per-replica routes
+        serve, round-trippable by obs.fleet.parse_prometheus_text."""
+        from deeplearning4j_tpu.obs.fleet import FleetView
+        from deeplearning4j_tpu.ui import UIServer
+        reg = MetricsRegistry()
+        m = ServingMetrics(registry=reg, name="r0", slo_target_ms=50)
+        m.record_request(10.0, tokens=4)
+        server = UIServer(port=0).attach_metrics(
+            reg, instance="replica-0").start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            with urllib.request.urlopen(url) as r:
+                text = r.read().decode()
+            assert 'instance="replica-0"' in text
+            assert 'dl4j_tpu_serving_r0_completed{instance="replica-0"}'\
+                ' 1' in text
+            fv = FleetView().add(
+                "replica-0", text,
+                strip_prefix="dl4j_tpu_serving_r0_")
+            assert fv.counter("completed") == 1
         finally:
             server.stop()
 
@@ -441,6 +540,29 @@ class TestMetricsPins:
         "inter_token_ms_p50", "inter_token_ms_p99",
         "inter_token_ms_mean", "inter_token_ms_count",
     )
+
+    # fleet federation read-outs (obs/fleet.py): ALWAYS-PRESENT keys on
+    # FleetView.snapshot() — the tools/fleet_report.py surface and the
+    # AutoscaleSignal's inputs; a rename must fail here before it
+    # silently breaks the fleet report or the detector
+    FLEET_PINNED_KEYS = (
+        "fleet_instances", "fleet_slo_attainment",
+        "fleet_goodput_tokens_per_sec", "autoscale_decision",
+        "fleet_service_rate_tokens_per_sec", "fleet_shed_predicted",
+        "fleet_sheds_total", "fleet_shed_share",
+        "fleet_occupancy_mean", "fleet_tokens_out",
+    )
+
+    def test_fleet_snapshot_keys_pinned(self):
+        from deeplearning4j_tpu.obs.fleet import FleetView
+        # empty fleet AND a populated one: the keys never depend on
+        # what traffic happened to flow
+        for fv in (FleetView(),
+                   FleetView().add("i0", ServingMetrics(
+                       name="i0", slo_target_ms=50))):
+            snap = fv.snapshot()
+            for key in self.FLEET_PINNED_KEYS:
+                assert key in snap, f"missing fleet snapshot key {key}"
 
     def test_registry_storage_keys_via_stats_reporter(self):
         from deeplearning4j_tpu.ui.stats import ServingStatsReporter
@@ -692,3 +814,25 @@ class TestObsReport:
             pass
         rows = mod.span_summary(t.chrome_trace())
         assert rows[0]["name"] == "x" and rows[0]["count"] == 1
+
+    def test_multi_trace_merge_plumbing(self, tmp_path):
+        """Satellite pin (ISSUE 12): obs_report accepts MULTIPLE trace
+        files — merge_trace_files stitches them on the clock anchors
+        and the merged dict feeds build_report like any single trace."""
+        mod = self._mod()
+        t1 = Tracer(enabled=True, instance="a")
+        with t1.span("serve.dispatch"):
+            pass
+        time.sleep(0.02)
+        t2 = Tracer(enabled=True, instance="b")
+        with t2.span("serve.dispatch"):
+            pass
+        p1 = t1.save(str(tmp_path / "a.trace.json"))
+        p2 = t2.save(str(tmp_path / "b.trace.json"))
+        merged = mod.merge_trace_files([p1, p2])
+        xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert sorted({e["pid"] for e in xs}) == [1, 2]
+        report = mod.build_report(spans=merged)
+        row = next(r for r in report["spans"]
+                   if r["name"] == "serve.dispatch")
+        assert row["count"] == 2

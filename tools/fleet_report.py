@@ -1,0 +1,177 @@
+"""Fleet observability report: N instances -> one merged view.
+
+The replica-fleet rendering of `tools/obs_report.py`: federate N
+serving instances' metrics with kind-correct semantics
+(`obs.fleet.FleetView` — counters sum, gauges stay per-instance,
+histogram buckets merge element-wise), stitch their saved traces on
+the `clock_sync` wall-clock anchors into ONE Perfetto file with
+per-instance process groups (`obs.fleet.merge_traces`), and render:
+
+  * the PER-INSTANCE table (completed / tokens / SLO attainment /
+    service rate / sheds / shed share — the imbalance read-out);
+  * the FLEET aggregates (`fleet_slo_attainment`,
+    `fleet_goodput_tokens_per_sec`, `fleet_service_rate`,
+    `autoscale_decision`, ... — the always-present federation keys
+    pinned in tests/test_obs.py);
+  * the combined obs_report (span summary + latency decomposition
+    over the MERGED trace + per-instance metric sections) through the
+    existing `tools/obs_report.py` machinery.
+
+In-process (what `tools/load_sweep.py --fleet N` uses):
+
+    report, merged = build_fleet_report(
+        {name: srv.metrics for name, srv in fleet},
+        traces=[t.chrome_trace() for t in tracers])
+
+From disk (scraped `/metrics` text expositions + saved traces):
+
+    python tools/fleet_report.py \
+        --prom i0=/tmp/i0.prom --prom i1=/tmp/i1.prom \
+        --trace /tmp/i0.trace.json --trace /tmp/i1.trace.json \
+        --out /tmp/fleet
+
+`--strip-template` (default `dl4j_tpu_serving_{name}_`) removes each
+instance's exposition namespace so metric names line up across the
+fleet — the same names an in-process `ServingMetrics.kind_snapshot()`
+exports.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.obs.fleet import (SHED_KEYS,  # noqa: E402
+                                          FleetView, merge_traces)
+from deeplearning4j_tpu.obs.registry import fmt  # noqa: E402
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from obs_report import _table, build_report, format_report  # noqa: E402
+
+
+
+def build_fleet_report(members, traces=None, trace_names=None,
+                       signal=None, strip_template=None):
+    """Assemble the fleet report. `members` maps instance name ->
+    federation source (ServingMetrics / MetricsRegistry / kind-snapshot
+    dict / Prometheus text); `traces` is an optional list of Chrome
+    trace dicts stitched into the merged trace. Returns
+    (report_dict, merged_trace_or_None) — the merged trace stays out
+    of the report dict (it is the big artifact; callers write it next
+    to the report)."""
+    fv = FleetView(signal=signal)
+    for name, src in members.items():
+        strip = (strip_template.format(name=name)
+                 if strip_template else "")
+        fv.add(name, src, strip_prefix=strip)
+    fleet = fv.snapshot()
+    rows = []
+    for inst in fv.instances:
+        flat = fv.flat(inst)
+        slo_total = flat.get("slo_total") or 0
+        rows.append({
+            "instance": inst,
+            "completed": flat.get("completed"),
+            "tokens_out": flat.get("tokens_out"),
+            "slo_attainment": fmt(
+                (flat.get("slo_met") or 0) / slo_total
+                if slo_total else None, 4),
+            "service_rate": fmt(
+                flat.get("service_rate_tokens_per_sec"), 1),
+            "sheds": sum(flat.get(k) or 0 for k in SHED_KEYS),
+            "shed_share": fmt(
+                fleet["fleet_shed_share"].get(inst), 3),
+            "ttft_ms_p99": fmt(flat.get("ttft_ms_p99")),
+        })
+    # one trace feeds the report AS-IS (no pid rewrite, no merged
+    # near-duplicate artifact — the help text promises the merged
+    # trace only for >= 2 inputs); two or more stitch on the anchors
+    merged, spans = None, None
+    if traces:
+        ts = list(traces)
+        if len(ts) > 1:
+            merged = merge_traces(ts, names=trace_names)
+            spans = merged
+        else:
+            spans = ts[0]
+    base = build_report(
+        spans=spans,
+        metrics={inst: fv.flat(inst) for inst in fv.instances})
+    return ({"fleet": fleet, "per_instance": rows,
+             "report": base}, merged)
+
+
+def format_fleet_report(report, top=20):
+    """Human-readable rendering: per-instance table, fleet aggregates,
+    then the combined obs_report text (merged-trace span summary +
+    decomposition + per-instance metric sections)."""
+    lines = _table(report["per_instance"],
+                   ["instance", "completed", "tokens_out",
+                    "slo_attainment", "service_rate", "sheds",
+                    "shed_share", "ttft_ms_p99"],
+                   "fleet instances")
+    lines.append("== fleet aggregates ==")
+    fleet = report["fleet"]
+    for k in sorted(fleet):
+        if k == "fleet_shed_share":
+            continue        # already a table column
+        v = fleet[k]
+        lines.append(f"  {k} = {fmt(v, 4) if isinstance(v, float) else v}")
+    lines.append(format_report(report["report"], top=top))
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--prom", action="append", default=[],
+                    metavar="NAME=PATH",
+                    help="instance name = path to its scraped /metrics "
+                         "text exposition; repeat per instance")
+    ap.add_argument("--trace", action="append", default=[],
+                    help="saved Chrome trace JSON; repeat per instance "
+                         "(stitched on clock_sync anchors)")
+    ap.add_argument("--strip-template",
+                    default="dl4j_tpu_serving_{name}_",
+                    help="per-instance exposition prefix to strip "
+                         "({name} substituted); pass '' to keep names")
+    ap.add_argument("--out", default=None,
+                    help="write report JSON/text (+ merged trace when "
+                         ">=2 --trace) under this path prefix")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as JSON instead of text")
+    args = ap.parse_args()
+    members = {}
+    for spec in args.prom:
+        name, _, path = spec.partition("=")
+        if not path:
+            ap.error(f"--prom needs NAME=PATH, got {spec!r}")
+        with open(path) as fh:
+            members[name] = fh.read()
+    traces = []
+    for p in args.trace:
+        with open(p) as fh:
+            traces.append(json.load(fh))
+    report, merged = build_fleet_report(
+        members, traces=traces or None,
+        strip_template=args.strip_template or None)
+    if args.out:
+        with open(args.out + ".json", "w") as fh:
+            json.dump(report, fh)
+        with open(args.out + ".txt", "w") as fh:
+            fh.write(format_fleet_report(report) + "\n")
+        if merged is not None:
+            with open(args.out + ".trace.merged.json", "w") as fh:
+                json.dump(merged, fh)
+    print(json.dumps(report) if args.json
+          else format_fleet_report(report))
+
+
+if __name__ == "__main__":
+    main()

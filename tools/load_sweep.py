@@ -22,7 +22,7 @@ Run (CPU backend, no chip needed):
         [--process poisson|onoff|closed] [--requests 64] \
         [--slo-ms 150] [--seed 0] [--report /tmp/sweep] [--no-trace] \
         [--chunked-prefill C] [--admission] [--overload-ab] \
-        [--paged] [--speculate K] [--preempt]
+        [--paged] [--speculate K] [--preempt] [--fleet N]
 
 `--process onoff` keeps the same MEAN rate but bursts at 2x with a 50%
 duty cycle (the p99 stressor); `--process closed` reinterprets each
@@ -229,6 +229,151 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
             "curve": curve, "knee": _knee(curve)}, snap
 
 
+class _RoundRobinSplitter:
+    """Minimal fleet front door: submit() rotates over N in-process
+    replicas. Deliberately dumb — the sweep measures the fleet's
+    observability plane (federated metrics, autoscale signal), not a
+    router's placement policy; a shed at one replica is a fleet shed."""
+
+    def __init__(self, servers):
+        self._servers = list(servers)
+        self._i = 0
+
+    def submit(self, prompt, max_new, **kw):
+        srv = self._servers[self._i % len(self._servers)]
+        self._i += 1
+        return srv.submit(prompt, max_new, **kw)
+
+
+def sweep_fleet(rates, n_replicas=2, n_req=64, slo_ms=250.0, seed=0,
+                process="poisson", trace=True, slots=2, lm=None,
+                obs_per_rate=6, slice_s=0.25, signal=None):
+    """Rate ladder over N in-process `ContinuousDecodeServer` replicas
+    behind a round-robin splitter — the `--fleet N` scenario that
+    exercises the whole fleet observability plane end to end:
+
+      * every replica is a NAMED instance (`instance="i<k>"`): its
+        metrics federate under that name, its tracer exports its own
+        process group, and its request ids are fleet-unique;
+      * each rate rung is served as `obs_per_rate` schedule slices;
+        after each slice the merged fleet snapshot
+        (`obs.fleet.FleetView` over every replica's kind_snapshot) is
+        fed to ONE `AutoscaleSignal`, so the ladder drives the
+        detector through a real two-regime trace: below the knee sheds
+        stay quiet (hold), past it `shed_predicted` accrues while the
+        fleet service-rate estimate stays flat at capacity (scale_up —
+        the tier-1 fleet smoke pins exactly this);
+      * replicas run deadline-aware admission (deadline = SLO), the
+        shed_predicted producer the detector reads.
+
+    Returns (body, per_instance_snaps, merged_trace_or_None): `body`
+    carries the per-rate curve (each point with its in-rung decision
+    sequence and final decision) plus the final fleet snapshot;
+    `merged_trace` is the clock-anchor-stitched Chrome trace of every
+    replica (None with trace=False)."""
+    from deeplearning4j_tpu.obs import Tracer
+    from deeplearning4j_tpu.obs.fleet import (AutoscaleSignal, FleetView,
+                                              merge_traces)
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            DecodeSizeMix,
+                                            ServingMetrics,
+                                            build_schedule, run_load)
+    lm = lm if lm is not None else _lm()
+    names = [f"i{k}" for k in range(int(n_replicas))]
+    tracers = {n: (Tracer(capacity=1 << 15, enabled=True, instance=n)
+                   if trace else Tracer(enabled=False, instance=n))
+               for n in names}
+    sig = signal if signal is not None else AutoscaleSignal()
+    servers = []
+    mix = DecodeSizeMix(((0.8, (3, 12), (4, 24)),
+                         (0.2, (8, 16), (24, 44))), vocab=96)
+
+    def _fleet_snapshot():
+        fv = FleetView(signal=sig)
+        for n, s in zip(names, servers):
+            fv.add(n, s.metrics)
+        return fv.snapshot()
+
+    try:
+        # construction INSIDE the try: if replica k's constructor or
+        # first compile raises, the finally still stops replicas
+        # 0..k-1 instead of leaking their serve loops into the caller
+        # process (the tier-1 smoke runs in-process)
+        for n in names:
+            servers.append(ContinuousDecodeServer(
+                lm, slots=slots, prompt_buckets=(8, 16), max_queue=1024,
+                metrics=ServingMetrics(slo_target_ms=slo_ms, name=n),
+                tracer=tracers[n], instance=n, admission=True,
+                default_deadline_ms=slo_ms).start())
+        splitter = _RoundRobinSplitter(servers)
+        # compile both prompt buckets off the clock on EVERY replica
+        # (each jits its own programs), with a generous deadline so the
+        # admission default (the SLO) never sheds a first-compile
+        for srv in servers:
+            for p in ([1, 2, 3, 4], list(range(1, 13))):
+                srv.generate(p, 4, deadline_ms=600_000, timeout=300)
+        curve = []
+        for i, rate in enumerate(rates):
+            # EQUAL OFFERED DURATION per slice (the overload-AB rule):
+            # each observation window sustains the offered rate for
+            # ~slice_s seconds, so a past-knee rung really backlogs the
+            # fleet inside every window instead of lobbing a burst the
+            # replicas drain between slices — at a fixed count the
+            # detector would never see sheds ACCRUE (measured). n_req
+            # keeps a floor for the low-rate rungs; 400/slice caps the
+            # submit storm.
+            slice_n = max(2, int(n_req) // int(obs_per_rate),
+                          min(int(rate * slice_s), 400))
+            decisions, toks, dur = [], 0, 0.0
+            offered = None
+            for k in range(int(obs_per_rate)):
+                sched = build_schedule(
+                    _process_for(process, rate), mix, slice_n,
+                    seed=seed + i * 1000 + k)
+                if offered is None:
+                    offered = sched.offered_tokens_per_sec()
+                pt = run_load(splitter, sched, metrics=None)
+                toks += pt["tokens_out"]
+                dur += float(pt["duration_s"])
+                decisions.append(sig.observe(_fleet_snapshot()))
+            snap = _fleet_snapshot()
+            point = {
+                "offered_rate_target": rate,
+                "tokens_per_sec": fmt(toks / dur if dur else 0.0, 1),
+                "tokens_out": toks,
+                "autoscale_decisions": decisions,
+                "autoscale_decision": decisions[-1],
+                "fleet_shed_predicted": snap["fleet_shed_predicted"],
+                "fleet_service_rate_tokens_per_sec": fmt(
+                    snap["fleet_service_rate_tokens_per_sec"], 1),
+                "fleet_slo_attainment": fmt(
+                    snap["fleet_slo_attainment"], 4),
+                "_offered": offered,
+                "_achieved": toks / dur if dur else 0.0,
+            }
+            curve.append(point)
+        fleet_snap = _fleet_snapshot()
+        snaps = {n: s.metrics.snapshot()
+                 for n, s in zip(names, servers)}
+    finally:
+        for srv in servers:
+            srv.stop(timeout=120)
+    merged = (merge_traces([tracers[n].chrome_trace() for n in names],
+                           names=names) if trace else None)
+    d_model = int(lm.aux["tok"].shape[1])
+    body = {"server": "fleet", "n_replicas": int(n_replicas),
+            "process": process,
+            "config": f"{n_replicas}x TransformerLM L={len(lm.blocks)} "
+                      f"d={d_model} slots={slots} round-robin, "
+                      f"admission deadline={slo_ms:g}ms, "
+                      f"{obs_per_rate} observation slices/rate",
+            "unit": "generated tokens/sec (fleet)",
+            "curve": curve, "knee": _knee(curve),
+            "fleet": fleet_snap,
+            "autoscale_transitions": sig.transitions}
+    return body, snaps, merged
+
+
 def sweep_microbatch(rates, n_req=96, slo_ms=50.0, seed=0,
                      process="poisson", tracer=None):
     """Rate ladder over the InferenceServer (requests/s domain)."""
@@ -344,7 +489,8 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
               process="poisson", n_req=64, slo_ms=150.0, seed=0,
               trace=True, report_path=None, paged=False,
               chunked_prefill=None, admission=None, overload_ab=False,
-              speculate_k=None, preempt=False):
+              speculate_k=None, preempt=False, fleet=0,
+              fleet_obs_per_rate=6, fleet_slice_s=0.25):
     """Drive the sweep(s) and (optionally) write the combined
     obs_report (JSON + text + Chrome trace). Returns the results list.
     The tier-1 smoke test calls this with tiny parameters (and once
@@ -352,11 +498,35 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
     `overload_ab=True` replays the decode ladder through BOTH an
     uncontrolled baseline and a chunked+admission arm and appends the
     comparison record (goodput monotonicity past the knee — the PR 9
-    acceptance pin)."""
+    acceptance pin). `fleet=N` (N >= 2) replaces the single decode
+    server with N round-robin replicas + the fleet observability plane
+    (sweep_fleet): the report's trace becomes the clock-anchor-MERGED
+    multi-instance trace (written as `<report>.trace.merged.json`) and
+    every rate rung carries the autoscale decision sequence."""
     from deeplearning4j_tpu.obs import Tracer, decompose
-    tracer = Tracer(capacity=1 << 16, enabled=True) if trace else None
+    fleet = int(fleet or 0)
+    if fleet == 1:
+        raise ValueError("--fleet needs N >= 2 replicas (a fleet of "
+                         "one is the plain decode sweep — drop the "
+                         "flag)")
+    fleet_mode = fleet >= 2 and server in ("decode", "both")
+    if fleet_mode and overload_ab:
+        raise ValueError("--fleet and --overload-ab are mutually "
+                         "exclusive: the overload A/B compares one "
+                         "controlled server against one baseline — "
+                         "run them as separate sweeps")
+    tracer = (Tracer(capacity=1 << 16, enabled=True)
+              if trace and not fleet_mode else None)
+    fleet_trace = None
     results, snaps = [], {}
-    if overload_ab and server in ("decode", "both"):
+    if fleet_mode:
+        body, inst_snaps, fleet_trace = sweep_fleet(
+            rates, n_replicas=fleet, n_req=n_req, slo_ms=slo_ms,
+            seed=seed, process=process, trace=trace,
+            obs_per_rate=fleet_obs_per_rate, slice_s=fleet_slice_s)
+        results.append(body)
+        snaps.update({f"fleet_{n}": s for n, s in inst_snaps.items()})
+    elif overload_ab and server in ("decode", "both"):
         # EQUAL OFFERED DURATION per rung, both arms on identical
         # schedules: requests scale with rate (~1.5 s of traffic each),
         # because at a fixed count higher rates compress the arrival
@@ -415,7 +585,9 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
         if tools_dir not in sys.path:
             sys.path.insert(0, tools_dir)
         from obs_report import build_report, format_report
-        report = build_report(spans=tracer, metrics=snaps)
+        report = build_report(
+            spans=fleet_trace if fleet_trace is not None else tracer,
+            metrics=snaps)
         report["sweep"] = results
         with open(report_path + ".json", "w") as fh:
             json.dump(report, fh)
@@ -428,6 +600,11 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
                     fh.write(json.dumps(pt) + "\n")
                 if "knee" in r:
                     fh.write(json.dumps(r["knee"]) + "\n")
+        if fleet_trace is not None:
+            # the fleet's one trace artifact IS the merged trace: every
+            # replica's process group on one clock-anchored timeline
+            with open(report_path + ".trace.merged.json", "w") as fh:
+                json.dump(fleet_trace, fh)
         if tracer is not None:
             tracer.save(report_path + ".trace.json")
     return results
@@ -460,6 +637,12 @@ def main():
                     help="K-wide n-gram speculative decode on the "
                          "decode server (composes with --paged: the "
                          "block-table verify program)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="drive N in-process decode replicas behind a "
+                         "round-robin splitter (named instances, "
+                         "federated metrics, one AutoscaleSignal fed "
+                         "per schedule slice, clock-anchor-merged "
+                         "trace) instead of one decode server")
     ap.add_argument("--preempt", action="store_true",
                     help="durable-KV preemption (implies --paged): the "
                          "mix's long tail submits as a spillable batch "
@@ -493,7 +676,7 @@ def main():
                         admission=args.admission,
                         overload_ab=args.overload_ab,
                         speculate_k=args.speculate,
-                        preempt=args.preempt)
+                        preempt=args.preempt, fleet=args.fleet)
     for r in results:
         print(json.dumps(r))
     print(json.dumps({"elapsed_s": fmt(time.perf_counter() - t0, 1),

@@ -20,6 +20,14 @@ renders a saved trace + profile dir + metrics JSON from disk:
 
     python tools/obs_report.py --trace /tmp/serve.trace.json \
         [--profile /tmp/prof] [--metrics /tmp/snapshot.json]
+
+`--trace` repeats: two or more saved traces are stitched on their
+`clock_sync` wall-clock anchors into ONE Perfetto-loadable file
+(`obs.fleet.merge_traces` — per-instance process groups, shared trace
+ids intact), written next to the report (`--merged-trace` overrides
+the path) and used as the report's span input — so a migrated
+request's cross-server timeline feeds the same span summary and
+decomposition a single-server trace does.
 """
 from __future__ import annotations
 
@@ -98,6 +106,18 @@ def build_report(spans=None, profile_logdir=None, metrics=None):
     return report
 
 
+def merge_trace_files(paths, names=None):
+    """Load N saved Chrome traces and stitch them on their clock_sync
+    anchors (`obs.fleet.merge_traces`) — the multi-`--trace` plumbing,
+    importable so tools/fleet_report.py and tests share it."""
+    from deeplearning4j_tpu.obs.fleet import merge_traces
+    traces = []
+    for p in paths:
+        with open(p) as fh:
+            traces.append(json.load(fh))
+    return merge_traces(traces, names=names)
+
+
 def _table(rows, cols, title, limit=None):
     out = [f"== {title} =="]
     if not rows:
@@ -147,16 +167,29 @@ def format_report(report, top=20):
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--trace", help="saved Chrome trace JSON "
-                                    "(Tracer.save output)")
+    ap.add_argument("--trace", action="append", default=None,
+                    help="saved Chrome trace JSON (Tracer.save output); "
+                         "repeat to stitch multiple traces on their "
+                         "clock_sync anchors into one merged trace")
+    ap.add_argument("--merged-trace", default=None,
+                    help="where to write the merged trace when more "
+                         "than one --trace is given (default: "
+                         "<first-trace>.merged.json)")
     ap.add_argument("--profile", help="jax.profiler logdir to summarize")
     ap.add_argument("--metrics", help="metrics snapshot JSON file")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of text")
     args = ap.parse_args()
     spans = None
-    if args.trace:
-        with open(args.trace) as fh:
+    if args.trace and len(args.trace) > 1:
+        spans = merge_trace_files(args.trace)
+        out = args.merged_trace or args.trace[0] + ".merged.json"
+        with open(out, "w") as fh:
+            json.dump(spans, fh)
+        print(f"merged trace ({len(args.trace)} inputs) -> {out}",
+              file=sys.stderr)
+    elif args.trace:
+        with open(args.trace[0]) as fh:
             spans = json.load(fh)
     metrics = None
     if args.metrics:
