@@ -428,6 +428,14 @@ class FleetView:
         out["fleet_sheds_total"] = sum(
             counters.get(k, 0) for k in SHED_KEYS)
         out["fleet_shed_share"] = self.shed_share()
+        # fleet-control event counters (serving/fleet.py FleetManager):
+        # summed like any counter (the manager's own metrics carry
+        # them; a FleetManager.fleet_snapshot() overlays its live
+        # values) — always present so tools/fleet_report.py renders
+        # the control plane's activity next to the federation keys
+        for key in ("replica_spawned", "replica_drained", "replica_dead",
+                    "failover_resubmitted", "canary_rollbacks"):
+            out["fleet_" + key] = counters.get(key, 0)
         # mean of per-instance occupancy statistics (summary kind:
         # recent scheduling-iteration slot occupancy) — the scale_down
         # input. A PARSED exposition carries no window mean (summaries
@@ -613,6 +621,19 @@ class AutoscaleSignal:
             self.decision = raw
             self.transitions.append((self._n_obs, raw))
         return self.decision
+
+    def reset(self):
+        """Forget the observation window and re-enter warm-up (decision
+        back to HOLD; transition history kept). The fleet manager calls
+        this after ACTING on a decision — the actuation twin of the
+        hysteresis bound: one action per argued regime, so the next
+        scale move must be argued entirely from observations of the NEW
+        fleet shape instead of the stale window that justified the
+        last one (without it a sustained-overload window would spawn a
+        replica per tick)."""
+        self._obs.clear()
+        self._pending, self._pending_n = self.HOLD, 0
+        self.decision = self.HOLD
 
     # -- classification ------------------------------------------------
     def _raw(self):

@@ -63,10 +63,12 @@ from .admission import (AdmissionController, BrownoutPolicy,
                         ServiceRateEstimator)
 from .metrics import ServingMetrics
 from .server import (DeadlineExceededError, InferenceServer,
+                     ReplicaDeadError, RequestDrainedError,
                      RequestMigratedError, ServerClosedError,
                      ServerOverloadedError, ServingError,
                      UnhealthyOutputError)
 from .decode import ContinuousDecodeServer
+from .fleet import FleetManager, RoundRobinSplitter
 from .kvpool import BlockPool, PagedAllocation
 from .kvstate import (KVStateError, KVStateVersionError,
                       PrefixCacheArtifact, RequestArtifact)
@@ -82,6 +84,8 @@ __all__ = [
     "BlockPool", "PagedAllocation",
     "RequestArtifact", "PrefixCacheArtifact", "KVStateError",
     "KVStateVersionError", "RequestMigratedError",
+    "FleetManager", "RoundRobinSplitter", "ReplicaDeadError",
+    "RequestDrainedError",
     "AdmissionController", "BrownoutPolicy", "ServiceRateEstimator",
     "Speculator", "DraftSource", "NGramDraft", "ModelDraft",
     "PoissonProcess", "OnOffProcess", "ClosedLoop",

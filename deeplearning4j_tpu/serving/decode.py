@@ -187,7 +187,8 @@ from .. import obs
 from .kvstate import (KVStateError, KVStateVersionError,
                       PrefixCacheArtifact, RequestArtifact,
                       artifact_kind)
-from .server import (DeadlineExceededError, RequestMigratedError,
+from .server import (DeadlineExceededError, ReplicaDeadError,
+                     RequestDrainedError, RequestMigratedError,
                      ServerClosedError, ServerOverloadedError,
                      _RequestLoop)
 
@@ -466,6 +467,11 @@ class ContinuousDecodeServer(_RequestLoop):
         #   staging for migrate_in (drained into _resume_q by the loop
         #   so _resume_q never races a client append)
         self._migrate_cmds = collections.deque()  # (future, reply)
+        self._drain_cmds = collections.deque()   # (migrate, reply):
+        #   the fleet drain verb — serve thread hands back EVERY
+        #   admitted request in one pass (see drain())
+        self._killed = False    # crash-injection verb fired (kill());
+        #   terminal — a killed replica never serves again
         self._tag_cache = {}    # version index -> param fingerprint
         self._prefix_saved = True   # nothing to save before start()
         self._gate_key = None   # preempting-gate rescan guard: the
@@ -951,8 +957,193 @@ class ContinuousDecodeServer(_RequestLoop):
             self._versions.append(new)
             self.metrics.count("swaps")
 
+    def current_params(self):
+        """(aux, blocks) of the NEWEST param version — the canary
+        rollout's rollback snapshot (`serving/fleet.py` swaps it back
+        through a duck-typed params view when the gate trips)."""
+        with self._swap_lock:
+            return self._versions[-1]
+
+    # -- fleet verbs (serving/fleet.py) --------------------------------
+    @property
+    def alive(self):
+        """True while the serve loop is running on a live thread — the
+        fleet router's liveness probe. A killed or crashed loop reads
+        False even before anyone calls stop()."""
+        t = self._thread
+        return bool(self._running and not self._killed
+                    and t is not None and t.is_alive())
+
+    def kill(self):
+        """Abrupt replica death — the crash-injection verb the fleet's
+        `fleet.replica` FaultInjector sever action lands on. The serve
+        loop exits at the next iteration boundary and EVERY in-flight,
+        parked, and queued future fails loudly with `ReplicaDeadError`;
+        nothing drains and nothing persists (a real crash would not).
+        Terminal and idempotent: a killed server refuses start().
+        Thread-safe; callable from any thread including callbacks on
+        this server's own futures."""
+        self._killed = True
+        self._running = False
+        self._drain_on_stop = False
+        try:                        # wake an idle-blocked loop
+            self._q.put_nowait(_Wake())
+        except queue.Full:
+            pass
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(10.0)
+        if t is None or not t.is_alive() \
+                or t is threading.current_thread():
+            # the loop is gone (or IS this thread): nobody else will
+            # fail the stragglers — do it here (idempotent: resolved
+            # futures are skipped)
+            self._die_now()
+
+    def _die_now(self):
+        """Fail every request this server still holds with the crash
+        error (kill()'s delivery half — runs on the serve thread when
+        the loop notices `_killed`, or on the killer's thread once the
+        loop is gone)."""
+        exc = ReplicaDeadError(f"replica {self.instance!r} crashed")
+        n_failed = 0
+        for s, r in enumerate(self._slot_req):
+            if r is not None and _fail_future(r.future, exc):
+                n_failed += 1
+            self._slot_req[s] = None
+        if n_failed:
+            self.metrics.count("failed", n_failed)
+        self._fail_parked(exc)
+        super()._fail_queued(exc)
+
+    def drain(self, migrate=None, timeout=60.0):
+        """Hand off EVERY admitted request in ONE verb, then stop.
+
+        Returns ``(migrated, replayed)``:
+
+          * ``migrated`` — list of ``(local_future, RequestArtifact)``
+            for DECODE-PHASE requests (live slots plus the parked
+            resume line): each local future fails with
+            `RequestMigratedError`; `migrate_in(artifact)` on another
+            server resumes the stream bit-identically (the durable-KV
+            pin, now exercised across the router).
+          * ``replayed`` — list of ``(local_future, spec)`` for queued,
+            deferred, priority-parked, memory-blocked, and PREFILLING
+            requests. A half-written prefill panel is NEVER an
+            artifact (the preemption victim rule, enforced at this
+            seam too), so these replay from their prompt instead:
+            each local future fails with `RequestDrainedError` and
+            ``spec`` carries ``{"prompt", "max_new", "deadline"
+            (absolute monotonic or None), "klass"}`` ready to resubmit
+            on a survivor — deterministic greedy decode makes the
+            replayed stream equal the uninterrupted one.
+
+        `migrate` defaults to the cache layout's capability (paged
+        servers migrate, fixed-slot servers replay everything);
+        migrate=True on a fixed-slot server raises. The extraction
+        runs on the serve thread between iterations (the migrate_out
+        machinery); on return the loop is STOPPED and the server holds
+        zero requests."""
+        migrate = self._paged if migrate is None else bool(migrate)
+        if migrate and not self._paged:
+            raise ValueError("drain(migrate=True) requires paged=True "
+                             "(only a block-table KV set can leave the "
+                             "arena); fixed-slot servers drain with "
+                             "migrate=False — everything replays")
+        if not self._running:
+            raise ServerClosedError("server is not running")
+        reply = cf.Future()
+        self._drain_cmds.append((migrate, reply))
+        try:                        # wake an idle-blocked loop
+            self._q.put_nowait(_Wake())
+        except queue.Full:
+            pass
+        migrated, replayed = reply.result(timeout)
+        self.stop(drain=False, timeout=timeout)
+        return migrated, replayed
+
+    def _service_drain(self):
+        """Serve-thread half of `drain()`."""
+        while self._drain_cmds:
+            migrate, reply = self._drain_cmds.popleft()
+            try:
+                out = self._drain_now(migrate)
+            except BaseException as e:  # noqa: BLE001 — reply carries it
+                if not reply.done():
+                    reply.set_exception(e)
+            else:
+                if not reply.done():
+                    reply.set_result(out)
+
+    def _drain_now(self, migrate):
+        migrated, replayed = [], []
+
+        def spec_of(r):
+            return {"prompt": list(r.prompt), "max_new": r.max_new,
+                    "deadline": r.deadline, "klass": r.klass}
+
+        def hand_off(r, art):
+            """One request out the door: decode-phase state with rows
+            in hand migrates (when asked), everything else replays."""
+            if migrate and art is not None:
+                if _fail_future(r.future, RequestMigratedError(
+                        "request drained to another replica")):
+                    migrated.append((r.future, art))
+                    self.metrics.count("migrated_out")
+                    self._mark_migrate_out(r)
+            elif _fail_future(r.future, RequestDrainedError(
+                    "request replayed on another replica (queued/"
+                    "prefill-phase state is never migrated)")):
+                replayed.append((r.future, spec_of(r)))
+
+        # live slots: decode-phase slots carry extractable rows; a
+        # PREFILLING slot's panel is half-written — never an artifact
+        for s, r in enumerate(self._slot_req):
+            if r is None:
+                continue
+            if r.future.done():
+                self._free_slot(s)
+                continue
+            art = None
+            if migrate and r.pf_next is None and r.generated:
+                art = self._extract_artifact(s)
+            hand_off(r, art)
+            self._free_slot(s)
+        # parked artifacts (resume line + migrate-in staging) already
+        # ARE their own baton
+        while self._migrate_in_q:
+            self._resume_q.append(self._migrate_in_q.popleft())
+        while self._resume_q:
+            r = self._resume_q.popleft()
+            if r.future.done():
+                continue
+            art, r.artifact = r.artifact, None
+            hand_off(r, art)
+        # queued lines: no KV state anywhere — replay specs
+        for dq in (self._mem_wait, self._prio_q, self._defer_q):
+            while dq:
+                try:
+                    r = dq.popleft()
+                except IndexError:
+                    break
+                if not r.future.done():
+                    hand_off(r, None)
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if not r.future.done():     # skips _Wake sentinels too
+                hand_off(r, None)
+        self._gc_versions()
+        return migrated, replayed
+
     # -- durable KV state (serving/kvstate.py) -------------------------
     def start(self):
+        if self._killed:
+            raise ServerClosedError(
+                "replica was killed; build a new server instead of "
+                "restarting a crashed one")
         # a (re)started server has live state the next clean stop must
         # persist again
         self._prefix_saved = self._prefix_dir is None
@@ -2110,10 +2301,23 @@ class ContinuousDecodeServer(_RequestLoop):
                 break
             if not reply.done():
                 reply.set_exception(exc)
+        while self._drain_cmds:
+            try:
+                _, reply = self._drain_cmds.popleft()
+            except IndexError:
+                break
+            if not reply.done():
+                reply.set_exception(exc)
 
     def _fail_queued(self, exc):
         """Queued = the submit queue, the paged memory-wait line, AND
-        the brownout-deferred line."""
+        the brownout-deferred line. On a KILLED server the named crash
+        error wins whatever exception the exiting loop passed (the
+        loop may notice `_running` dropped before it notices
+        `_killed` — a queued caller must still see the crash, not a
+        clean shutdown)."""
+        if self._killed:
+            exc = ReplicaDeadError(f"replica {self.instance!r} crashed")
         self._fail_parked(exc)
         super()._fail_queued(exc)
 
@@ -2522,9 +2726,16 @@ class ContinuousDecodeServer(_RequestLoop):
         return any(r is not None for r in self._slot_req) \
             or bool(self._mem_wait) or bool(self._prio_q) \
             or bool(self._defer_q) or bool(self._resume_q) \
-            or bool(self._migrate_in_q) or bool(self._migrate_cmds)
+            or bool(self._migrate_in_q) or bool(self._migrate_cmds) \
+            or bool(self._drain_cmds)
 
     def _loop_once(self):
+        if self._killed:
+            # crash-injection verb (kill()): fail everything loudly and
+            # let the loop exit — no drain, no persistence
+            self._die_now()
+            return
+        self._service_drain()
         if self._paged:
             # drain the client-side migrate-in staging into the serve-
             # thread-only resume line, then answer export commands —

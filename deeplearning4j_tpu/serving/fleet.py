@@ -1,0 +1,959 @@
+"""Replica fleet manager: the observability plane's tested ACTUATOR.
+
+PR 12 built the fleet's sensors — kind-correct metrics federation
+(`obs.fleet.FleetView`), stitched traces, and `AutoscaleSignal`, the
+hysteresis-bounded scale detector. This module closes the loop: a
+`FleetManager` owns N in-process `ContinuousDecodeServer` replicas
+behind a router and ACTS on what the sensors say.
+
+  * **Router** — the PR 12 round-robin splitter promoted into the
+    package (`RoundRobinSplitter` stays available as the deliberately
+    dumb baseline/A-B arm) and grown into a real front door:
+    least-backlog dispatch over ALIVE replicas, a per-replica health
+    state machine (healthy -> degraded -> dead, driven by each
+    replica's `ServingMetrics` shed/failure deltas and a serve-thread
+    liveness probe), and `RetryPolicy`-bounded resubmission on
+    failover. The manager's `submit()` future is the caller's ONE
+    handle: it resolves with the token stream no matter which replica
+    (or how many, across failovers) produced it. The control plane is
+    host-side only — the no-fault fleet path adds ZERO device
+    dispatches per token over N bare servers (dispatch-counter A/B,
+    tests/test_fleet_manager.py).
+
+  * **Closed autoscale loop** — each `control_tick()` federates every
+    replica's `kind_snapshot()` into one fleet snapshot, feeds it to
+    the `AutoscaleSignal`, and ACTS: `scale_up` spawns a fresh replica
+    (factory-built, warmed, fleet-unique instance id — ids are NEVER
+    reused, so federation and traces can never alias a dead replica
+    with its successor); `scale_down` gracefully drains one —
+    `drain(migrate=True)` moves its live decode-phase requests to
+    survivors as `RequestArtifact`s (resumed streams bit-identical,
+    the durable-KV pin exercised across the router) and replays its
+    queued/prefilling requests from their prompts. After every action
+    the signal resets: the next move must be argued entirely from
+    observations of the NEW fleet shape. The detector's scale_down
+    occupancy input is the manager-computed UTILIZATION (delivered
+    tokens/s over the tick window / fleet capacity): the per-replica
+    occupancy reservoirs are iteration-weighted and no iterations run
+    at idle, so a quiet fleet would otherwise never read as idle.
+
+  * **Health-gated canary rollout** — `rollout(new_lm)` screens the
+    new params with `rowwise_finite` FIRST (a NaN/Inf leaf rolls back
+    before any replica — and therefore any request — ever touches the
+    poisoned weights), then hot-swaps ONE canary replica and watches
+    it over a probation window: failure/unhealthy-output deltas, SLO
+    attainment, and shed deltas vs the survivors. A tripped gate swaps
+    the canary back (`canary_rollbacks` counted) — version-tagged
+    params mean the prefix index and admission already cooperate, and
+    the dual-version drain keeps every in-flight request alive through
+    both the swap and the rollback. A passing gate rolls forward
+    replica by replica; future spawns inherit the new params.
+
+  * **Crash survival** — `FaultInjector` sites: `fleet.submit` (fired
+    per routed request — a raising rule is a router fault) and
+    `fleet.replica` (fired once per alive replica per control tick;
+    the SEVER action is replica death mid-stream — it lands on
+    `ContinuousDecodeServer.kill()`, which fails every in-flight
+    future loudly with `ReplicaDeadError`). The router marks the
+    replica dead, takes a final counters-only snapshot (a TOMBSTONE,
+    so federated counters stay monotone after the instance is gone),
+    and resubmits the dead replica's in-flight requests to survivors
+    via prompt replay: deterministic greedy decode makes the replayed
+    stream bit-identical to an uninterrupted solo run, so a crash
+    costs latency, never bits — and never a silently lost future
+    (every admitted future resolves: completed via failover replay or
+    failed loudly with a named error). The autoscale loop backfills
+    capacity: `control_tick()` re-spawns up to `min_replicas` before
+    consulting the signal.
+
+The manager itself publishes the fleet-control event counters —
+`replica_spawned` / `replica_drained` / `replica_dead` /
+`failover_resubmitted` / `canary_rollbacks` — through its own
+`ServingMetrics` (always-present snapshot keys, on the Prometheus
+route like every other endpoint) and overlays them onto
+`fleet_snapshot()` as `fleet_*` keys next to the PR 12 federation
+read-outs.
+"""
+from __future__ import annotations
+
+import collections
+import concurrent.futures as cf
+import itertools
+import logging
+import threading
+import time
+
+from ..common.resilience import RetryPolicy
+from ..obs.fleet import SHED_KEYS, AutoscaleSignal, FleetView
+from .decode import _fail_future, _resolve_future
+from .kvstate import KVStateError
+from .metrics import ServingMetrics
+from .server import (DeadlineExceededError, ReplicaDeadError,
+                     ServerClosedError, ServerOverloadedError,
+                     UnhealthyOutputError)
+
+log = logging.getLogger(__name__)
+
+__all__ = ["FleetManager", "RoundRobinSplitter", "HEALTHY", "DEGRADED",
+           "DRAINING", "DEAD"]
+
+# replica health states (the router's per-replica state machine):
+# HEALTHY and DEGRADED are routable (healthy preferred), DRAINING
+# takes no new work while its requests move out, DEAD is a tombstone
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+class RoundRobinSplitter:
+    """The PR 12 fleet front door, promoted from tools/load_sweep.py:
+    submit() rotates over N replicas. Deliberately dumb — observability
+    sweeps measure the fleet plane, not placement policy, and the
+    FleetManager's zero-added-dispatch A/B compares against exactly
+    this (a shed at one replica is a fleet shed, both arms)."""
+
+    def __init__(self, servers):
+        self._servers = list(servers)
+        self._i = 0
+
+    def submit(self, prompt, max_new, **kw):
+        srv = self._servers[self._i % len(self._servers)]
+        self._i += 1
+        return srv.submit(prompt, max_new, **kw)
+
+
+class _ParamsView:
+    """Duck-typed (aux, blocks) holder `ContinuousDecodeServer.swap`
+    accepts — the rollback snapshot and the spawn-after-rollout
+    carrier."""
+
+    __slots__ = ("aux", "blocks")
+
+    def __init__(self, aux, blocks):
+        self.aux, self.blocks = aux, blocks
+
+
+def _params_finite(lm):
+    """The canary NaN/Inf screen: every float leaf of (aux, blocks)
+    all-finite, via the SAME `rowwise_finite` helper the serving output
+    screen uses (each leaf flattened to one row). Host-side numpy on
+    weights that are about to be shipped to N replicas anyway."""
+    import numpy as np
+
+    import jax
+
+    from ..common.health import rowwise_finite
+    leaves = jax.tree_util.tree_leaves((lm.aux, lm.blocks))
+    ok = rowwise_finite([np.asarray(leaf).reshape(1, -1)
+                         for leaf in leaves])
+    return ok is None or bool(ok.all())
+
+
+class _FleetRequest:
+    """Manager-side record of one admitted request: the caller-facing
+    OUTER future plus everything a failover replay needs."""
+
+    __slots__ = ("prompt", "max_new", "deadline", "klass", "outer",
+                 "attempts", "replica")
+
+    def __init__(self, prompt, max_new, deadline, klass):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.deadline = deadline        # absolute monotonic, or None
+        self.klass = klass
+        self.outer = cf.Future()
+        self.attempts = 0               # failover resubmissions so far
+        self.replica = None             # current replica name
+
+
+class _Replica:
+    __slots__ = ("name", "server", "state", "seq", "inflight",
+                 "probe_sheds", "probe_failed")
+
+    def __init__(self, name, server, seq):
+        self.name = name
+        self.server = server
+        self.state = HEALTHY
+        self.seq = seq                  # spawn order (tie-breaks)
+        self.inflight = 0               # manager-tracked live requests
+        self.probe_sheds = 0            # health probe baselines
+        self.probe_failed = 0
+
+
+class FleetManager:
+    """N replicas, one front door, three closed loops (module
+    docstring).
+
+    `factory(name)` builds ONE replica (a `ContinuousDecodeServer`,
+    running or not — the manager starts it) under the fleet-unique
+    instance `name` the manager mints; it is called for the initial
+    `n_replicas` at `start()` and again on every scale_up/backfill.
+    `warmup(server)` (optional) runs after each spawn — compile the
+    prompt buckets off the serving clock there.
+
+    `signal` is the `AutoscaleSignal` `control_tick()` consults (None:
+    no autoscaling — the manager is a router + failover only, which is
+    exactly what the observe-only sweeps want). `policy` is
+    "least_backlog" (default) or "round_robin" (the A/B arm).
+    """
+
+    # request-level VERDICTS settle the outer future as-is; everything
+    # else is infrastructure and fails over. RequestMigratedError /
+    # RequestDrainedError are deliberately NOT verdicts here: on an
+    # inner future they only ever mean the request's state moved (the
+    # manager's own drain, or an out-of-band operator migrate racing
+    # it) — replaying on a survivor still yields the correct stream,
+    # while propagating would fail the caller with a handoff-protocol
+    # internal on e.g. a drain that completed just after its timeout.
+    _PROPAGATE = (DeadlineExceededError, ServerOverloadedError,
+                  UnhealthyOutputError, ValueError)
+
+    def __init__(self, factory, n_replicas=2, *, signal=None,
+                 policy="least_backlog", min_replicas=None,
+                 max_replicas=None, retry_policy=None,
+                 fault_injector=None, metrics=None, name="fleet",
+                 warmup=None, degrade_shed_rate=25, name_prefix="i"):
+        if policy not in ("least_backlog", "round_robin"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        if int(n_replicas) < 1:
+            raise ValueError("need n_replicas >= 1")
+        self._factory = factory
+        self._n_initial = int(n_replicas)
+        self.signal = signal
+        self._policy = policy
+        self.min_replicas = (int(min_replicas) if min_replicas is not None
+                             else self._n_initial)
+        self.max_replicas = (int(max_replicas) if max_replicas is not None
+                             else self._n_initial + 4)
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas}/{self.max_replicas}")
+        # failover budget + pacing: the policy bounds resubmissions per
+        # request; classification (what IS a failover vs a request
+        # verdict) is the manager's explicit table, not `retryable`
+        self._retry = retry_policy if retry_policy is not None else \
+            RetryPolicy(max_retries=3, base_delay=0.0, jitter=0.0)
+        self._injector = fault_injector
+        self.metrics = metrics or ServingMetrics(name=name)
+        self.name = name
+        self._warmup = warmup
+        self.degrade_shed_rate = float(degrade_shed_rate)
+        self._lock = threading.RLock()
+        self._replicas = collections.OrderedDict()   # name -> _Replica
+        self._tombstones = collections.OrderedDict()  # name -> counters
+        self._live = {}             # inner future -> _FleetRequest
+        self._name_ids = itertools.count()
+        self._name_prefix = str(name_prefix)
+        self._seq = itertools.count()
+        self._rr = 0                # round-robin rotation
+        self._running = False
+        self._rolling = False       # a rollout is mid-probation:
+        #                             control_tick holds scale actions
+        self._params = None         # (aux, blocks) spawns must carry
+        #                             (set by a rolled-forward rollout)
+        self._ctl_thread = None
+        self._ctl_stop = threading.Event()
+        self._ticks = 0
+        self._last_tick = None      # (monotonic, fleet tokens_out) —
+        #                             the utilization window
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self, control_interval_s=None):
+        """Spawn the initial replicas (idempotent) and, with
+        `control_interval_s`, a daemon control thread running
+        `control_tick()` on that cadence. Tests and the sweep drive
+        ticks manually instead."""
+        if self._running:
+            return self
+        self._running = True
+        while self.n_alive() < self._n_initial:
+            self._spawn()
+        if control_interval_s is not None:
+            self._ctl_stop.clear()
+
+            def _loop():
+                while not self._ctl_stop.wait(float(control_interval_s)):
+                    try:
+                        self.control_tick()
+                    except Exception:   # noqa: BLE001 — keep ticking
+                        log.exception("control tick failed")
+
+            self._ctl_thread = threading.Thread(
+                target=_loop, name="fleet-control", daemon=True)
+            self._ctl_thread.start()
+        return self
+
+    def stop(self, drain=True, timeout=60.0):
+        """Stop the control loop and every replica. drain=True lets
+        each replica serve what it already admitted; drain=False fails
+        queued work (`ServerClosedError`) — either way every manager
+        future resolves (the replicas' own stop contracts + the
+        failover path's not-running check)."""
+        self._running = False
+        self._ctl_stop.set()
+        t = self._ctl_thread
+        if t is not None:
+            t.join(timeout)
+            self._ctl_thread = None
+        stopped = set()
+        while True:
+            with self._lock:
+                recs = [r for r in self._replicas.values()
+                        if r.name not in stopped]
+            if not recs:
+                break       # second sweep: a spawn that was mid-flight
+            #                 when _running dropped still gets stopped
+            for rec in recs:
+                stopped.add(rec.name)
+                try:
+                    rec.server.stop(drain=drain, timeout=timeout)
+                except Exception:   # noqa: BLE001 — teardown finishes
+                    log.exception("replica %s stop failed", rec.name)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- introspection -------------------------------------------------
+    def n_alive(self):
+        with self._lock:
+            return sum(1 for r in self._replicas.values()
+                       if r.state in (HEALTHY, DEGRADED))
+
+    @property
+    def replicas(self):
+        """Alive replica names, spawn order."""
+        with self._lock:
+            return [r.name for r in self._replicas.values()
+                    if r.state in (HEALTHY, DEGRADED)]
+
+    def states(self):
+        """name -> health state, every replica ever (tombstones DEAD)."""
+        with self._lock:
+            out = {r.name: r.state for r in self._replicas.values()}
+            for name in self._tombstones:
+                out.setdefault(name, DEAD)
+            return out
+
+    def replica(self, name):
+        """The live server object (ops/test hook)."""
+        with self._lock:
+            return self._replicas[name].server
+
+    # -- client API ----------------------------------------------------
+    def submit(self, prompt, max_new_tokens, deadline_ms=None,
+               klass="default"):
+        """Enqueue one decode request on the best alive replica;
+        returns the MANAGER's future — it survives replica death,
+        drains, and rollouts (the inner replica future is an
+        implementation detail). Synchronous sheds at the chosen replica
+        propagate (a shed at one replica is a fleet shed — the caller
+        owns retry policy for overload, the manager only owns
+        failover)."""
+        if not self._running:
+            raise ServerClosedError("fleet manager is not running")
+        if self._injector is not None:
+            self._injector.fire("fleet.submit")
+        now = time.monotonic()
+        deadline = (now + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        req = _FleetRequest(prompt, max_new_tokens, deadline, klass)
+        self.metrics.count("received")
+        self._dispatch(req)         # sheds raise out of submit here
+        return req.outer
+
+    def generate(self, prompt, max_new_tokens, deadline_ms=None,
+                 timeout=None):
+        """Blocking convenience wrapper over submit()."""
+        return self.submit(prompt, max_new_tokens,
+                           deadline_ms=deadline_ms).result(timeout)
+
+    # -- routing -------------------------------------------------------
+    def _pick(self, tried=()):
+        with self._lock:
+            cands = [r for r in self._replicas.values()
+                     if r.state in (HEALTHY, DEGRADED)
+                     and r.name not in tried and r.server.alive]
+            if not cands:
+                return None
+            if self._policy == "round_robin":
+                rec = cands[self._rr % len(cands)]
+                self._rr += 1
+                return rec
+            # least backlog; healthy beats degraded; spawn order ties
+            return min(cands, key=lambda r: (r.state != HEALTHY,
+                                             r.inflight, r.seq))
+
+    def _dispatch(self, req):
+        """Route `req` to a replica. Raises on request-level sheds and
+        on a fleet with no routable replica; replica death between
+        choice and submit retries the next survivor."""
+        tried = set()
+        last = None
+        while True:
+            rec = self._pick(tried)
+            if rec is None:
+                raise last if last is not None else ReplicaDeadError(
+                    "no alive replicas to route to")
+            dl_ms = None
+            if req.deadline is not None:
+                left = (req.deadline - time.monotonic()) * 1e3
+                if left <= 0:
+                    raise DeadlineExceededError(
+                        "deadline expired before the fleet could "
+                        "place the request")
+                dl_ms = left
+            try:
+                inner = rec.server.submit(req.prompt, req.max_new,
+                                          deadline_ms=dl_ms,
+                                          klass=req.klass)
+            except (ServerClosedError, ReplicaDeadError) as e:
+                # died between choice and submit: fail it loudly, move on
+                self._crash(rec.name, reason=str(e))
+                tried.add(rec.name)
+                last = e
+                continue
+            self._register(rec, req, inner)
+            return
+
+    def _register(self, rec, req, inner):
+        with self._lock:
+            req.replica = rec.name
+            self._live[inner] = req
+            rec.inflight += 1
+        inner.add_done_callback(self._on_inner_done)
+
+    def _on_inner_done(self, fut):
+        with self._lock:
+            req = self._live.pop(fut, None)
+            if req is not None:
+                rec = self._replicas.get(req.replica)
+                if rec is not None:
+                    rec.inflight = max(0, rec.inflight - 1)
+        if req is None:
+            return      # handed off (drain) or already accounted
+        if fut.cancelled():
+            req.outer.cancel()
+            return
+        # ONE classification table (_settle_handoff) for this live
+        # path and the drain/crash handoff paths: result or a
+        # request-level PROPAGATE verdict settles the outer future;
+        # anything else is infrastructure — failover
+        if not self._settle_handoff(fut, req):
+            self._failover(req, fut.exception())
+
+    def _failover(self, req, exc):
+        """Resubmit a request whose replica failed underneath it:
+        prompt replay on a survivor (deterministic greedy decode ==
+        the uninterrupted stream), bounded by the retry policy; out of
+        budget / out of survivors / stopped manager fails the outer
+        future LOUDLY with the original error."""
+        req.attempts += 1
+        if not self._running or req.attempts > self._retry.max_retries:
+            if _fail_future(req.outer, exc):
+                self.metrics.count("failed")
+            return
+        d = self._retry.delay(req.attempts - 1)
+        if d:
+            # NEVER sleep here: this runs inside the inner future's
+            # done-callback — on the dying replica's serve/kill
+            # thread, where stacked backoffs would serially delay
+            # every other victim's failure delivery (and kill()'s
+            # join). A daemon timer pays the backoff off-thread.
+            t = threading.Timer(
+                d, self._resubmit,
+                kwargs={"count_failover": True, "cause": exc},
+                args=(req,))
+            t.daemon = True
+            t.start()
+            return
+        self._resubmit(req, count_failover=True, cause=exc)
+
+    def _settle_handoff(self, fut, req):
+        """THE verdict table, shared by the live done-callback and the
+        drain/crash handoff paths: a resolved inner future's result —
+        or its request-level PROPAGATE verdict (a deadline/overload/
+        screen verdict must never be silently retried into success) —
+        settles the outer future here. Returns True when settled
+        (False: unresolved or an infrastructure error — the caller
+        fails over / resubmits)."""
+        if not fut.done() or fut.cancelled():
+            return False
+        exc = fut.exception()
+        if exc is None:
+            if _resolve_future(req.outer, fut.result()):
+                self.metrics.count("completed")
+            return True
+        if isinstance(exc, self._PROPAGATE):
+            if _fail_future(req.outer, exc):
+                self.metrics.count("failed")
+            return True
+        return False
+
+    def _resubmit(self, req, count_failover=False, cause=None):
+        if req.deadline is not None and \
+                time.monotonic() > req.deadline:
+            if _fail_future(req.outer, DeadlineExceededError(
+                    "deadline expired during failover")):
+                self.metrics.count("failed")
+            return
+        try:
+            self._dispatch(req)
+        except BaseException as e:  # noqa: BLE001 — outer carries it
+            if _fail_future(req.outer, e):
+                self.metrics.count("failed")
+            return
+        if count_failover:
+            self.metrics.count("failover_resubmitted")
+            log.warning("request replayed on %s after %s: %s",
+                        req.replica, type(cause).__name__, cause)
+
+    # -- replica lifecycle ---------------------------------------------
+    def _mint_name(self):
+        """Fleet-unique instance id: NEVER reused, even after the
+        replica dies — a freshly spawned replica must not alias a dead
+        one's metrics series, trace process group, or request-id
+        namespace (the federation-under-churn pin)."""
+        return f"{self._name_prefix}{next(self._name_ids)}"
+
+    def _spawn(self):
+        if not self._running:
+            # a control tick racing stop() must not start a replica
+            # nobody will ever stop (stop()'s final sweep catches the
+            # narrower in-flight-spawn window)
+            raise ServerClosedError("fleet manager is not running")
+        name = self._mint_name()
+        srv = self._factory(name)
+        if not srv._running:
+            srv.start()
+        if self._params is not None and \
+                srv.current_params()[0] is not self._params[0]:
+            # the factory builds the ORIGINAL params; a rolled-forward
+            # fleet hands every new replica the current ones
+            srv.swap(_ParamsView(*self._params))
+        if self._warmup is not None:
+            self._warmup(srv)
+        with self._lock:
+            orphaned = not self._running
+            if not orphaned:
+                self._replicas[name] = _Replica(name, srv,
+                                                next(self._seq))
+        if orphaned:
+            # stop() raced the slow factory/warmup above and its sweep
+            # never saw this name: tear the orphan down HERE (outside
+            # the lock — stop joins the serve thread) instead of
+            # leaking a started serve thread nobody owns
+            srv.stop(drain=False, timeout=10.0)
+            raise ServerClosedError("fleet manager stopped during spawn")
+        self.metrics.count("replica_spawned")
+        log.info("replica %s spawned (%d alive)", name, self.n_alive())
+        return name
+
+    def _tombstone(self, rec):
+        """Counters-only snapshot of a departing replica: federated
+        counters stay MONOTONE after the instance stops existing,
+        while its stale gauges/summaries (capacity, occupancy) drop
+        out of the live read-outs the detector consumes. Written
+        ATOMICALLY with the replica's removal from `_replicas` (under
+        the lock, BEFORE the slow kill/drain) and refreshed after —
+        a concurrent fleet_view() must never observe the replica in
+        neither map, which would read as every counter dipping by its
+        whole history (a fake counter reset to the detector)."""
+        try:
+            snap = rec.server.metrics.kind_snapshot()
+        except Exception:           # noqa: BLE001 — dead is dead
+            snap = {}
+        self._tombstones[rec.name] = {
+            k: v for k, v in snap.items() if v.get("kind") == "counter"}
+
+    def _crash(self, name, reason="injected fault"):
+        """Replica death: fail it loudly, tombstone its counters, and
+        resubmit its in-flight requests to survivors via prompt
+        replay. Idempotent."""
+        with self._lock:
+            rec = self._replicas.get(name)
+            if rec is None:
+                return
+            del self._replicas[name]
+            # tombstone in the SAME critical section as the removal:
+            # no reader window where the replica is in neither map
+            self._tombstone(rec)
+            doomed = []
+            for fut, req in list(self._live.items()):
+                if req.replica == name:
+                    del self._live[fut]
+                    doomed.append((fut, req))
+        rec.state = DEAD
+        self.metrics.count("replica_dead")
+        rec.server.kill()           # fails remaining futures loudly
+        self._tombstone(rec)        # refresh: the final counter values
+        log.warning("replica %s dead (%s); %d in-flight requests "
+                    "failing over", name, reason, len(doomed))
+        for fut, req in doomed:
+            if self._settle_handoff(fut, req):
+                # finished (or reached a PROPAGATE verdict) just
+                # before the crash landed: deliver THAT outcome
+                continue
+            # ONE failover implementation (budget, accounting, pacing)
+            # for both arrival paths — here and the done-callback
+            self._failover(req, ReplicaDeadError(f"replica {name} died"))
+
+    def kill_replica(self, name):
+        """Operator/chaos verb: crash `name` now (the same path the
+        fleet.replica sever action takes)."""
+        self._crash(name, reason="killed by operator")
+
+    def scale_up(self):
+        """Spawn one replica (the scale_up actuation; also the
+        min_replicas backfill). Returns the new name."""
+        return self._spawn()
+
+    def scale_down(self, name=None, timeout=60.0):
+        """Gracefully remove one replica: drain(migrate) its live
+        decode-phase requests onto survivors (bit-identical resumed
+        streams), replay its queued/prefilling requests, stop it.
+        Default victim: fewest in-flight requests, newest spawn on
+        ties (symmetric with scale_up). Refuses to go below ONE alive
+        replica — the autoscale caller enforces min_replicas; this
+        verb only keeps the fleet routable."""
+        with self._lock:
+            alive = [r for r in self._replicas.values()
+                     if r.state in (HEALTHY, DEGRADED)]
+            if len(alive) <= 1:
+                raise ValueError("refusing to drain the last alive "
+                                 "replica")
+            if name is None:
+                rec = min(alive, key=lambda r: (r.inflight, -r.seq))
+            else:
+                rec = self._replicas[name]
+                if rec.state not in (HEALTHY, DEGRADED):
+                    raise ValueError(f"replica {name} is {rec.state}")
+            rec.state = DRAINING
+            handoff = {}
+            for fut, req in list(self._live.items()):
+                if req.replica == rec.name:
+                    del self._live[fut]
+                    handoff[fut] = req
+            rec.inflight = 0
+        try:
+            migrated, replayed = rec.server.drain(timeout=timeout)
+        except BaseException as e:  # noqa: BLE001 — degrade to crash
+            log.exception("drain of %s failed; treating as crash",
+                          rec.name)
+            with self._lock:
+                self._replicas.pop(rec.name, None)
+                self._tombstone(rec)    # atomic with the removal
+            rec.state = DEAD
+            self.metrics.count("replica_dead")
+            rec.server.kill()
+            self._tombstone(rec)        # refresh: final values
+            for fut, req in handoff.items():
+                # same settle-first rule as every handoff path: a
+                # result or PROPAGATE verdict that landed before the
+                # drain wedged must not be replayed
+                if not self._settle_handoff(fut, req):
+                    self._resubmit(req, count_failover=True, cause=e)
+            return rec.name
+        for fut, art in migrated:
+            req = handoff.pop(fut, None)
+            if req is not None:
+                self._repoint_migrated(req, art)
+        for fut, spec in replayed:
+            req = handoff.pop(fut, None)
+            if req is not None:
+                self._resubmit(req)
+        for fut, req in handoff.items():
+            # completed — or already holding a PROPAGATE verdict —
+            # before the drain swept it: deliver that outcome; only
+            # infrastructure leftovers replay
+            if not self._settle_handoff(fut, req):
+                self._resubmit(req)
+        with self._lock:
+            self._replicas.pop(rec.name, None)
+            self._tombstone(rec)        # atomic with the removal
+        rec.state = DEAD
+        self.metrics.count("replica_drained")
+        log.info("replica %s drained (%d migrated, %d replayed; %d "
+                 "alive)", rec.name, len(migrated), len(replayed),
+                 self.n_alive())
+        return rec.name
+
+    def _repoint_migrated(self, req, art):
+        """Land a drained request's artifact on a survivor
+        (`migrate_in` — the resumed stream is bit-identical); a
+        version/layout refusal or an overloaded survivor degrades to
+        prompt replay (correct bits either way — replay just pays the
+        prompt compute again)."""
+        dl_ms = None
+        if req.deadline is not None:
+            left = (req.deadline - time.monotonic()) * 1e3
+            if left <= 0:
+                if _fail_future(req.outer, DeadlineExceededError(
+                        "deadline expired during drain migration")):
+                    self.metrics.count("failed")
+                return
+            dl_ms = left
+        tried = set()
+        while True:
+            rec = self._pick(tried)
+            if rec is None or not rec.server._paged:
+                self._resubmit(req)     # no migratable destination
+                return
+            try:
+                inner = rec.server.migrate_in(art, deadline_ms=dl_ms)
+            except (KVStateError, ValueError):
+                # tag/layout mismatch (mid-rollout fleet): replay
+                self._resubmit(req)
+                return
+            except ServerOverloadedError:
+                tried.add(rec.name)
+                continue
+            except (ServerClosedError, ReplicaDeadError) as e:
+                self._crash(rec.name, reason=str(e))
+                tried.add(rec.name)
+                continue
+            self._register(rec, req, inner)
+            return
+
+    # -- health + the closed autoscale loop ----------------------------
+    def _probe_health(self):
+        """Per-replica state machine: DEAD when the serve thread is
+        gone (crash path — in-flight work fails over); DEGRADED while
+        the replica's own shed rate (per tick, all causes) or failure
+        counter is moving; back to HEALTHY on a quiet tick. Degraded
+        replicas still serve (least-backlog prefers healthy ones) —
+        the state is the canary gate's and the imbalance report's
+        signal, not a kill switch."""
+        with self._lock:
+            recs = [r for r in self._replicas.values()
+                    if r.state in (HEALTHY, DEGRADED)]
+        for rec in recs:
+            if not rec.server.alive:
+                self._crash(rec.name, reason="serve thread died")
+                continue
+            m = rec.server.metrics
+            sheds = sum(m.count_value(k) for k in SHED_KEYS)
+            failed = m.count_value("failed")
+            d_shed = sheds - rec.probe_sheds
+            d_fail = failed - rec.probe_failed
+            rec.probe_sheds, rec.probe_failed = sheds, failed
+            if d_fail > 0 or d_shed >= self.degrade_shed_rate:
+                if rec.state == HEALTHY:
+                    rec.state = DEGRADED
+                    self.metrics.count("replica_degraded")
+            elif rec.state == DEGRADED:
+                rec.state = HEALTHY
+
+    def fleet_view(self):
+        """FleetView over every ALIVE replica's kind_snapshot plus the
+        counters-only tombstones of dead/drained ones (federated
+        counters stay monotone across churn; stale gauges don't haunt
+        the detector)."""
+        fv = FleetView(signal=self.signal)
+        with self._lock:
+            recs = [r for r in self._replicas.values()
+                    if r.state in (HEALTHY, DEGRADED, DRAINING)]
+            tombs = list(self._tombstones.items())
+        for rec in recs:
+            fv.add(rec.name, rec.server.metrics)
+        for name, snap in tombs:
+            fv.add(name, snap)
+        return fv
+
+    def fleet_snapshot(self):
+        """The federated snapshot with the manager's own control-plane
+        counters overlaid (`fleet_replica_spawned`, ... — the manager
+        is the one counting its own verbs)."""
+        snap = self.fleet_view().snapshot()
+        for key in ("replica_spawned", "replica_drained", "replica_dead",
+                    "failover_resubmitted", "canary_rollbacks"):
+            snap["fleet_" + key] = self.metrics.count_value(key)
+        snap["fleet_alive"] = self.n_alive()
+        return snap
+
+    def _utilization(self, snap, now):
+        """Delivered tokens/s over the tick window divided by the
+        fleet capacity estimate — the scale_down occupancy input. The
+        per-replica occupancy reservoirs are ITERATION-weighted and no
+        iterations run at idle, so their mean never decays on a quiet
+        fleet; utilization does."""
+        toks = snap.get("fleet_tokens_out") or 0
+        rate = snap.get("fleet_service_rate_tokens_per_sec")
+        last, self._last_tick = self._last_tick, (now, toks)
+        if last is None or not rate:
+            return snap.get("fleet_occupancy_mean")
+        dt = now - last[0]
+        if dt <= 0:
+            return snap.get("fleet_occupancy_mean")
+        return min(1.0, max(0.0, (toks - last[1]) / dt / rate))
+
+    def control_tick(self):
+        """ONE observation/actuation window of the closed loop: fire
+        the crash-injection site per replica, probe health, backfill
+        to min_replicas, federate a snapshot, consult the signal, and
+        ACT on its decision (scale_up spawns; scale_down drains with
+        live-request migration). After an action the signal resets —
+        the next move is argued from the new fleet's own observations.
+        Returns the tick record the sweep logs."""
+        self._ticks += 1
+        if self._injector is not None:
+            with self._lock:
+                names = [r.name for r in self._replicas.values()
+                         if r.state in (HEALTHY, DEGRADED)]
+            for n in names:
+                self._injector.fire(
+                    "fleet.replica",
+                    on_sever=lambda name=n: self._crash(name))
+        self._probe_health()
+        backfilled = 0
+        while self._running and self.n_alive() < self.min_replicas:
+            self._spawn()
+            backfilled += 1
+        now = time.monotonic()
+        snap = self.fleet_snapshot()
+        util = self._utilization(snap, now)
+        decision = None
+        acted = None
+        if self.signal is not None:
+            decision = self.signal.observe(snap, occupancy=util)
+            if self._rolling:
+                pass        # a rollout owns the fleet shape right now
+            elif decision == AutoscaleSignal.SCALE_UP \
+                    and self._running \
+                    and self.n_alive() < self.max_replicas:
+                self._spawn()
+                acted = "scale_up"
+                self.signal.reset()
+            elif decision == AutoscaleSignal.SCALE_DOWN \
+                    and self._running \
+                    and self.n_alive() > self.min_replicas:
+                self.scale_down()
+                acted = "scale_down"
+                self.signal.reset()
+        return {"tick": self._ticks, "decision": decision,
+                "acted": acted, "backfilled": backfilled,
+                "n_replicas": self.n_alive(),
+                "replicas": self.replicas,
+                "states": self.states(), "utilization": util,
+                "fleet_shed_predicted": snap.get("fleet_shed_predicted"),
+                "fleet_tokens_out": snap.get("fleet_tokens_out")}
+
+    # -- health-gated canary rollout -----------------------------------
+    def rollout(self, new_lm, watch_ticks=2, traffic=None,
+                tick_s=0.25, min_attainment=0.5, max_failures=0,
+                shed_ratio=2.0, shed_allowance=4):
+        """Hot-swap `new_lm`'s params across the fleet behind a health
+        gate (module docstring). The NaN/Inf screen runs BEFORE any
+        replica takes the params — a poisoned checkpoint rolls back
+        with zero requests ever served under it. Then ONE canary
+        replica swaps and serves live traffic for `watch_ticks`
+        probation windows (`traffic()` is called per window when
+        given — drive load there; otherwise the window is `tick_s` of
+        wall clock); the gate trips on new failures/unhealthy outputs,
+        SLO attainment under `min_attainment`, or the canary shedding
+        more than `shed_ratio` x the survivors' mean (+
+        `shed_allowance`). Tripped -> the canary swaps BACK (in-flight
+        requests drain dual-version, zero dropped) and
+        `canary_rollbacks` counts. Passed -> every other replica swaps
+        (replica by replica, each its own dual-version drain) and
+        future spawns inherit the new params. Returns the verdict
+        record."""
+        if not self._running:
+            raise ServerClosedError("fleet manager is not running")
+        if not _params_finite(new_lm):
+            self.metrics.count("canary_rollbacks")
+            log.warning("rollout refused: new params failed the "
+                        "rowwise_finite screen")
+            return {"status": "rolled_back", "reason": "nan_screen",
+                    "canary": None}
+        with self._lock:
+            alive = [r for r in self._replicas.values()
+                     if r.state == HEALTHY] or \
+                    [r for r in self._replicas.values()
+                     if r.state in (HEALTHY, DEGRADED)]
+            if not alive:
+                raise ReplicaDeadError("no alive replica to canary")
+            canary = min(alive, key=lambda r: r.seq)
+        old = canary.server.current_params()
+        base = self._gate_counters(canary)
+        base_peers = self._peer_sheds(exclude=canary.name)
+        self._rolling = True
+        try:
+            canary.server.swap(new_lm)
+            for _ in range(int(watch_ticks)):
+                if traffic is not None:
+                    traffic()
+                else:
+                    time.sleep(float(tick_s))
+            cur = self._gate_counters(canary)
+            delta = {k: cur[k] - base[k] for k in cur}
+            peers = self._peer_sheds(exclude=canary.name)
+            # deltas keyed BY NAME over the survivors present in both
+            # samples: replica churn during probation (a background
+            # control tick crashing/backfilling a peer) must never
+            # pair one replica's before with another's after —
+            # positional pairing would produce garbage (even negative)
+            # baselines and flip the gate either way
+            peer_delta = [peers[n] - base_peers[n]
+                          for n in peers if n in base_peers] or [0]
+            peer_mean = sum(peer_delta) / len(peer_delta)
+            reason = None
+            if delta["failed"] > int(max_failures):
+                reason = f"failures: {delta['failed']}"
+            elif delta["unhealthy_outputs"] > 0:
+                reason = (f"unhealthy outputs: "
+                          f"{delta['unhealthy_outputs']}")
+            elif delta["slo_total"] > 0 and \
+                    delta["slo_met"] / delta["slo_total"] \
+                    < float(min_attainment):
+                reason = (f"SLO attainment "
+                          f"{delta['slo_met'] / delta['slo_total']:.2f}"
+                          f" < {min_attainment}")
+            elif delta["sheds"] > shed_allowance \
+                    + shed_ratio * peer_mean:
+                reason = (f"shed rate {delta['sheds']} vs survivors' "
+                          f"mean {peer_mean:.1f}")
+            if reason is not None:
+                canary.server.swap(_ParamsView(*old))
+                self.metrics.count("canary_rollbacks")
+                log.warning("canary %s rolled back: %s", canary.name,
+                            reason)
+                return {"status": "rolled_back", "reason": reason,
+                        "canary": canary.name, "delta": delta}
+            # gate passed: roll forward, replica by replica
+            with self._lock:
+                rest = [r for r in self._replicas.values()
+                        if r.state in (HEALTHY, DEGRADED)
+                        and r.name != canary.name]
+            for rec in rest:
+                rec.server.swap(new_lm)
+            self._params = (new_lm.aux, new_lm.blocks)
+            log.info("rollout complete: canary %s + %d replicas on "
+                     "new params", canary.name, len(rest))
+            return {"status": "rolled_forward", "canary": canary.name,
+                    "replicas": [canary.name] + [r.name for r in rest],
+                    "delta": delta}
+        finally:
+            self._rolling = False
+
+    def _gate_counters(self, rec):
+        m = rec.server.metrics
+        return {"failed": m.count_value("failed"),
+                "unhealthy_outputs": m.count_value("unhealthy_outputs"),
+                "slo_total": m.count_value("slo_total"),
+                "slo_met": m.count_value("slo_met"),
+                "sheds": sum(m.count_value(k) for k in SHED_KEYS)}
+
+    def _peer_sheds(self, exclude):
+        """name -> total sheds for every alive survivor (keyed so the
+        rollout gate diffs per replica across its probation window)."""
+        with self._lock:
+            recs = [r for r in self._replicas.values()
+                    if r.state in (HEALTHY, DEGRADED)
+                    and r.name != exclude]
+        return {r.name: sum(r.server.metrics.count_value(k)
+                            for k in SHED_KEYS) for r in recs}

@@ -187,6 +187,13 @@ class ServingMetrics:
         for key in ("preempted", "resumed", "migrated", "migrated_out",
                     "spill_bytes", "prefix_restore_hits"):
             self.count(key, 0)
+        # fleet-control events (serving/fleet.py FleetManager): same
+        # eager rule — a fleet that never failed over must scrape zero,
+        # not absence, on every one of its control verbs
+        for key in ("replica_spawned", "replica_drained", "replica_dead",
+                    "replica_degraded", "failover_resubmitted",
+                    "canary_rollbacks"):
+            self.count(key, 0)
 
     @property
     def instance(self):
@@ -424,6 +431,14 @@ class ServingMetrics:
         out.setdefault("migrated_out", 0)
         out.setdefault("spill_bytes", 0)
         out.setdefault("prefix_restore_hits", 0)
+        # fleet-control events (serving/fleet.py): spawn/drain/death,
+        # failover replays, canary rollbacks — always present
+        out.setdefault("replica_spawned", 0)
+        out.setdefault("replica_drained", 0)
+        out.setdefault("replica_dead", 0)
+        out.setdefault("replica_degraded", 0)
+        out.setdefault("failover_resubmitted", 0)
+        out.setdefault("canary_rollbacks", 0)
         out["service_rate_tokens_per_sec"] = self._service_rate.value
         out["prefix_hit_rate"] = (
             out["prefix_rows_hit"] / out["prefix_rows_total"]

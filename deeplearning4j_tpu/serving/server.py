@@ -83,6 +83,26 @@ class RequestMigratedError(ServingError):
     server fails loudly instead of hanging."""
 
 
+class RequestDrainedError(ServingError):
+    """This request was handed back as a REPLAY SPEC by
+    `ContinuousDecodeServer.drain()` — it was queued or still
+    prefilling at drain time, and a half-written prefill panel is never
+    an artifact (the durable-KV victim rule, enforced at the drain
+    seam). Its local future will never produce tokens; the drain caller
+    (`serving.fleet.FleetManager`) resubmits the returned spec on a
+    survivor, where deterministic greedy decode reproduces the exact
+    stream."""
+
+
+class ReplicaDeadError(ServingError):
+    """The replica serving (or chosen for) this request died: its serve
+    loop was killed mid-stream (`ContinuousDecodeServer.kill` — the
+    fleet crash-injection verb) or its thread is gone. The
+    `FleetManager` resubmits in-flight requests to survivors via prompt
+    replay; a direct caller sees this loudly instead of hanging on a
+    future nobody will resolve."""
+
+
 class _Request:
     __slots__ = ("x", "future", "deadline", "t_submit", "req_id")
 

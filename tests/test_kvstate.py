@@ -489,12 +489,24 @@ class TestMigration:
         P6 = P8[:6]                         # full block + 2-row partial
         with _paged(lm, slots=2, prompt_buckets=(8,)) as solo:
             ref8 = solo.generate(P8, 6, timeout=120)
-            ref6 = solo.generate(P6, 10, timeout=120)
+            ref6 = solo.generate(P6, 24, timeout=120)
         srv = _paged(lm, slots=2, prompt_buckets=(8,)).start()
         try:
             assert srv.generate(P8, 6, timeout=120) == ref8   # indexed
-            f2 = srv.submit(P6, 10)         # partial ride + CoW
-            _wait_tokens(srv, 8)
+            f2 = srv.submit(P6, 24)         # partial ride + CoW
+            # wait on the SLOT STATE, not the shared token counter: a
+            # counter threshold can be crossed arbitrarily close to
+            # the request's own completion on a slow box, and a
+            # completed request is (correctly) no longer exportable —
+            # observed as a rare machine-weather flake. Decode-phase
+            # occupancy plus a 24-token budget leaves ~20 tokens of
+            # runway for the export command to land.
+            t0 = time.monotonic()
+            while not any(r is not None and r.future is f2
+                          and r.pf_next is None
+                          for r in srv._slot_req):
+                assert time.monotonic() - t0 < 60, "never reached decode"
+                time.sleep(0.002)
             art = srv.migrate_out(f2)
             out2 = srv.migrate_in(art).result(120)
             assert out2 == ref6

@@ -534,3 +534,83 @@ class TestSmokeSweep:
                   if e.get("ph") == "M"
                   and e.get("name") == "process_name"}
         assert pnames == {"i0", "i1"}
+
+    def test_smoke_sweep_fleet_control(self):
+        """The CLOSED-LOOP fleet smoke (ISSUE 13): 2 -> 3 -> 2
+        replicas with one injected replica death, driven end to end by
+        the FleetManager — scale_up past the knee actually ADDS a
+        replica (and goodput does not collapse across the spawn),
+        a mid-sweep `fleet.replica` sever kills one replica with zero
+        lost requests (every admitted future resolves), and the quiet
+        tail drains back to min_replicas. Artifacts upload next to the
+        observe-only fleet smoke (tier1.yml)."""
+        from deeplearning4j_tpu.common.resilience import FaultInjector
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        mod = importlib.import_module("load_sweep")
+        out = os.path.join(
+            os.environ.get("SMOKE_REPORT_DIR") or tempfile.gettempdir(),
+            "load_sweep_smoke_fleet_control")
+        inj = FaultInjector()
+        # fleet.replica fires once per alive replica per control tick:
+        # 2/tick through rung 1 (6 ticks = calls 0-11), so call 13 is
+        # rung 2's FIRST tick — the death lands before any scale_up
+        # (the signal's window is still warming into the overload
+        # regime), the same tick's floor check backfills to min=2, and
+        # the later scale_up takes the fleet to 3 so the quiet tail
+        # has a replica to DRAIN back down
+        inj.plan("fleet.replica", on_call=13, sever=True, exc=None)
+        # the overload rung uses the observe-only fleet smoke's proven
+        # far-past-knee rate: at 800 req/s a fast freshly-warm box can
+        # absorb most of the offered load within the SLO (observed —
+        # ~100 predicted sheds over the whole rung) and the detector
+        # CORRECTLY holds; 1500 req/s saturates any machine weather
+        res = mod.run_sweep(server="decode",
+                            rates=(30.0, 1500.0, 10.0, 10.0),
+                            n_req=24, slo_ms=400.0, seed=0, trace=True,
+                            report_path=out, fleet=2,
+                            fleet_control=True, fleet_injector=inj,
+                            fleet_max=3, fleet_obs_per_rate=6,
+                            fleet_slice_s=0.15)
+        (body,) = res
+        assert body["server"] == "fleet_control"
+        ctl = body["fleet_control"]
+        # scale_up past the knee really added a replica (on a slow,
+        # noisy host the below-knee rung can shed enough to scale
+        # early — the pin is that the fleet REACHED 3 via an acted
+        # scale_up, wherever the window crossed)
+        assert ctl["scale_up_at"] is not None
+        assert any("scale_up" in pt["autoscale_acted"]
+                   for pt in body["curve"])
+        assert max(max(pt["n_replicas"]) for pt in body["curve"]) == 3
+        # the injected death: exactly one, and nothing was lost —
+        # every admitted request completed or failed LOUDLY (run_load
+        # resolves every future; a hung future would time it out)
+        assert ctl["replica_dead"] == 1
+        for pt in body["curve"]:
+            assert pt["admitted"] == pt["completed"] + pt["failed"]
+        # goodput across the spawn: the official criterion is 0.8x
+        # (recorded in the artifact); the CI assert uses the sweep's
+        # documented machine-weather slack (MONOTONE_SLACK — identical
+        # baseline runs vary >2x on shared-CPU hosts). A spawn landing
+        # on a rung's FINAL slice has no post-spawn slices to measure
+        # (recovery None) — the scale-up pin above still holds
+        rec = ctl["goodput_recovery_x"]
+        if rec is not None:
+            assert rec >= mod.MONOTONE_SLACK
+        # quiet tail: drained back to the floor
+        assert ctl["n_replicas_final"] == 2
+        assert ctl["returned_to_min"] is True
+        assert ctl["replica_drained"] >= 1
+        # artifacts: report + merged multi-instance trace (every
+        # replica that ever lived gets a process group)
+        rep = json.load(open(out + ".json"))
+        assert rep["sweep"][0]["server"] == "fleet_control"
+        assert os.path.exists(out + ".txt")
+        merged = json.load(open(out + ".trace.merged.json"))
+        pnames = {e["args"]["name"] for e in merged["traceEvents"]
+                  if e.get("ph") == "M"
+                  and e.get("name") == "process_name"}
+        assert {"i0", "i1"} <= pnames and len(pnames) >= 3

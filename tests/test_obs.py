@@ -533,6 +533,13 @@ class TestMetricsPins:
         # preempted scrapes zero, not absence)
         "preempted", "resumed", "migrated", "migrated_out",
         "spill_bytes", "prefix_restore_hits",
+        # fleet-control events (serving/fleet.py FleetManager):
+        # spawn/drain/death, failover replays, canary rollbacks —
+        # consumed by tools/fleet_report.py and the load_sweep
+        # --fleet-control record (eagerly created: a fleet that never
+        # failed over scrapes zero, not absence)
+        "replica_spawned", "replica_drained", "replica_dead",
+        "replica_degraded", "failover_resubmitted", "canary_rollbacks",
         "admission_error_ms_p50", "admission_error_ms_p99",
         "admission_error_ms_mean", "admission_error_ms_count",
         "slo_total", "slo_met", "slo_tokens_met", "slo_attainment",
@@ -551,6 +558,11 @@ class TestMetricsPins:
         "fleet_service_rate_tokens_per_sec", "fleet_shed_predicted",
         "fleet_sheds_total", "fleet_shed_share",
         "fleet_occupancy_mean", "fleet_tokens_out",
+        # fleet-control event counters (serving/fleet.py): summed like
+        # any counter; FleetManager.fleet_snapshot() overlays its own
+        "fleet_replica_spawned", "fleet_replica_drained",
+        "fleet_replica_dead", "fleet_failover_resubmitted",
+        "fleet_canary_rollbacks",
     )
 
     def test_fleet_snapshot_keys_pinned(self):

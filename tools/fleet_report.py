@@ -108,20 +108,33 @@ def build_fleet_report(members, traces=None, trace_names=None,
              "report": base}, merged)
 
 
+# the FleetManager's control-plane event counters (serving/fleet.py),
+# rendered as their own section ahead of the aggregate dump — the
+# spawn/drain/death/failover/rollback history is the first thing an
+# operator reads off a fleet that misbehaved
+CONTROL_KEYS = ("fleet_replica_spawned", "fleet_replica_drained",
+                "fleet_replica_dead", "fleet_failover_resubmitted",
+                "fleet_canary_rollbacks")
+
+
 def format_fleet_report(report, top=20):
-    """Human-readable rendering: per-instance table, fleet aggregates,
-    then the combined obs_report text (merged-trace span summary +
-    decomposition + per-instance metric sections)."""
+    """Human-readable rendering: per-instance table, fleet-control
+    events, fleet aggregates, then the combined obs_report text
+    (merged-trace span summary + decomposition + per-instance metric
+    sections)."""
     lines = _table(report["per_instance"],
                    ["instance", "completed", "tokens_out",
                     "slo_attainment", "service_rate", "sheds",
                     "shed_share", "ttft_ms_p99"],
                    "fleet instances")
-    lines.append("== fleet aggregates ==")
     fleet = report["fleet"]
+    lines.append("== fleet control ==")
+    for k in CONTROL_KEYS:
+        lines.append(f"  {k} = {fleet.get(k, 0)}")
+    lines.append("== fleet aggregates ==")
     for k in sorted(fleet):
-        if k == "fleet_shed_share":
-            continue        # already a table column
+        if k == "fleet_shed_share" or k in CONTROL_KEYS:
+            continue        # rendered above
         v = fleet[k]
         lines.append(f"  {k} = {fmt(v, 4) if isinstance(v, float) else v}")
     lines.append(format_report(report["report"], top=top))

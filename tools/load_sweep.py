@@ -23,6 +23,7 @@ Run (CPU backend, no chip needed):
         [--slo-ms 150] [--seed 0] [--report /tmp/sweep] [--no-trace] \
         [--chunked-prefill C] [--admission] [--overload-ab] \
         [--paged] [--speculate K] [--preempt] [--fleet N]
+        [--fleet-control [--fleet-min A --fleet-max B]]
 
 `--process onoff` keeps the same MEAN rate but bursts at 2x with a 50%
 duty cycle (the p99 stressor); `--process closed` reinterprets each
@@ -229,22 +230,6 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
             "curve": curve, "knee": _knee(curve)}, snap
 
 
-class _RoundRobinSplitter:
-    """Minimal fleet front door: submit() rotates over N in-process
-    replicas. Deliberately dumb — the sweep measures the fleet's
-    observability plane (federated metrics, autoscale signal), not a
-    router's placement policy; a shed at one replica is a fleet shed."""
-
-    def __init__(self, servers):
-        self._servers = list(servers)
-        self._i = 0
-
-    def submit(self, prompt, max_new, **kw):
-        srv = self._servers[self._i % len(self._servers)]
-        self._i += 1
-        return srv.submit(prompt, max_new, **kw)
-
-
 def sweep_fleet(rates, n_replicas=2, n_req=64, slo_ms=250.0, seed=0,
                 process="poisson", trace=True, slots=2, lm=None,
                 obs_per_rate=6, slice_s=0.25, signal=None):
@@ -276,6 +261,7 @@ def sweep_fleet(rates, n_replicas=2, n_req=64, slo_ms=250.0, seed=0,
                                               merge_traces)
     from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
                                             DecodeSizeMix,
+                                            RoundRobinSplitter,
                                             ServingMetrics,
                                             build_schedule, run_load)
     lm = lm if lm is not None else _lm()
@@ -305,7 +291,10 @@ def sweep_fleet(rates, n_replicas=2, n_req=64, slo_ms=250.0, seed=0,
                 metrics=ServingMetrics(slo_target_ms=slo_ms, name=n),
                 tracer=tracers[n], instance=n, admission=True,
                 default_deadline_ms=slo_ms).start())
-        splitter = _RoundRobinSplitter(servers)
+        # the PR 12 splitter, now the package's own baseline router
+        # (serving/fleet.py promoted it; the closed-loop arm below uses
+        # the full FleetManager instead)
+        splitter = RoundRobinSplitter(servers)
         # compile both prompt buckets off the clock on EVERY replica
         # (each jits its own programs), with a generous deadline so the
         # admission default (the SLO) never sheds a first-compile
@@ -370,6 +359,165 @@ def sweep_fleet(rates, n_replicas=2, n_req=64, slo_ms=250.0, seed=0,
             "unit": "generated tokens/sec (fleet)",
             "curve": curve, "knee": _knee(curve),
             "fleet": fleet_snap,
+            "autoscale_transitions": sig.transitions}
+    return body, snaps, merged
+
+
+def sweep_fleet_control(rates, n_replicas=2, n_req=64, slo_ms=250.0,
+                        seed=0, process="poisson", trace=True, slots=2,
+                        lm=None, obs_per_rate=6, slice_s=0.25,
+                        signal=None, fault_injector=None,
+                        min_replicas=None, max_replicas=None):
+    """The CLOSED-LOOP fleet arm (`--fleet-control`): the same rate
+    ladder as `sweep_fleet`, but replica count is driven by a
+    `serving.fleet.FleetManager` — each schedule slice ends in one
+    `control_tick()` that federates the fleet snapshot, consults the
+    `AutoscaleSignal`, and ACTS (scale_up spawns a warmed replica,
+    scale_down drains one with live-request migration; replica deaths
+    — injected via `fault_injector` at the `fleet.replica` site — fail
+    over in-flight requests to survivors by prompt replay).
+
+    The convergence record (`body["fleet_control"]`) carries the
+    ISSUE 13 pins: within the first rung that scaled up, mean
+    per-slice goodput AFTER the spawn vs BEFORE it
+    (`goodput_recovery_x` — the added replica must recover >= 0.8x,
+    and in practice exceeds 1x, of the saturated pre-scale goodput),
+    and the quiet-tail return to `min_replicas`
+    (`returned_to_min`). Default signal: AutoscaleSignal(window=4,
+    hysteresis=1) — the reset-after-action rule makes a short window
+    safe (one action per argued regime), and the smoke budget needs
+    decisions inside a 6-slice rung."""
+    from deeplearning4j_tpu.obs import Tracer
+    from deeplearning4j_tpu.obs.fleet import AutoscaleSignal, merge_traces
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            DecodeSizeMix, FleetManager,
+                                            ServingMetrics,
+                                            build_schedule, run_load)
+    lm = lm if lm is not None else _lm()
+    tracers = {}
+
+    def factory(name):
+        tr = tracers[name] = (
+            Tracer(capacity=1 << 15, enabled=True, instance=name)
+            if trace else Tracer(enabled=False, instance=name))
+        return ContinuousDecodeServer(
+            lm, slots=slots, prompt_buckets=(8, 16), max_queue=1024,
+            metrics=ServingMetrics(slo_target_ms=slo_ms, name=name),
+            tracer=tr, instance=name, admission=True,
+            default_deadline_ms=slo_ms)
+
+    def warmup(srv):
+        # compile both prompt buckets + the decode step off the
+        # serving clock on EVERY spawn (a cold spawned replica would
+        # blow its first requests' SLO on compiles, reading as a
+        # degraded replica the moment it joins)
+        for p in ([1, 2, 3, 4], list(range(1, 13))):
+            srv.generate(p, 4, deadline_ms=600_000, timeout=300)
+
+    sig = signal if signal is not None else AutoscaleSignal(
+        window=4, hysteresis=1)
+    mgr = FleetManager(factory, n_replicas=n_replicas, signal=sig,
+                       fault_injector=fault_injector, warmup=warmup,
+                       min_replicas=min_replicas,
+                       max_replicas=max_replicas,
+                       metrics=ServingMetrics(name="fleet"))
+    mix = DecodeSizeMix(((0.8, (3, 12), (4, 24)),
+                         (0.2, (8, 16), (24, 44))), vocab=96)
+    curve = []
+    scale_rung = None       # (rung index, slice goodputs pre/post)
+    try:
+        mgr.start()
+        for i, rate in enumerate(rates):
+            # EQUAL OFFERED DURATION per slice (the sweep_fleet rule)
+            slice_n = max(2, int(n_req) // int(obs_per_rate),
+                          min(int(rate * slice_s), 400))
+            ticks, goodputs = [], []
+            toks, dur, offered = 0, 0.0, None
+            admitted = completed = failed = 0
+            for k in range(int(obs_per_rate)):
+                sched = build_schedule(
+                    _process_for(process, rate), mix, slice_n,
+                    seed=seed + i * 1000 + k)
+                if offered is None:
+                    offered = sched.offered_tokens_per_sec()
+                g0 = mgr.fleet_view().counter("slo_tokens_met")
+                pt = run_load(mgr, sched, metrics=None)
+                toks += pt["tokens_out"]
+                dur += float(pt["duration_s"])
+                admitted += pt["admitted"]
+                completed += pt["completed"]
+                failed += pt["failed"]
+                g1 = mgr.fleet_view().counter("slo_tokens_met")
+                goodputs.append(
+                    (g1 - g0) / max(float(pt["duration_s"]), 1e-9))
+                ticks.append(mgr.control_tick())
+            if scale_rung is None and any(
+                    t["acted"] == "scale_up" for t in ticks):
+                at = next(k for k, t in enumerate(ticks)
+                          if t["acted"] == "scale_up")
+                scale_rung = {"rung": i, "slice": at,
+                              "pre": goodputs[:at + 1],
+                              "post": goodputs[at + 1:]}
+            snap = mgr.fleet_snapshot()
+            curve.append({
+                "offered_rate_target": rate,
+                "tokens_per_sec": fmt(toks / dur if dur else 0.0, 1),
+                "tokens_out": toks,
+                "admitted": admitted, "completed": completed,
+                "failed": failed,
+                "slice_goodput_tokens_per_sec": [fmt(g, 1)
+                                                 for g in goodputs],
+                "autoscale_decisions": [t["decision"] for t in ticks],
+                "autoscale_acted": [t["acted"] for t in ticks],
+                "n_replicas": [t["n_replicas"] for t in ticks],
+                "fleet_shed_predicted": snap["fleet_shed_predicted"],
+                "_offered": offered,
+                "_achieved": toks / dur if dur else 0.0,
+            })
+        final_snap = mgr.fleet_snapshot()
+        snaps = {n: mgr.replica(n).metrics.snapshot()
+                 for n in mgr.replicas}
+        states = mgr.states()
+        n_final = mgr.n_alive()
+    finally:
+        mgr.stop(timeout=120)
+    merged = (merge_traces([t.chrome_trace() for t in tracers.values()],
+                           names=list(tracers))
+              if trace and tracers else None)
+    recovery = None
+    if scale_rung and scale_rung["pre"] and scale_rung["post"]:
+        pre = sum(scale_rung["pre"]) / len(scale_rung["pre"])
+        post = sum(scale_rung["post"]) / len(scale_rung["post"])
+        recovery = (post / pre) if pre > 0 else None
+    d_model = int(lm.aux["tok"].shape[1])
+    body = {"server": "fleet_control", "n_replicas": int(n_replicas),
+            "process": process,
+            "config": f"FleetManager over {n_replicas}x TransformerLM "
+                      f"L={len(lm.blocks)} d={d_model} slots={slots}, "
+                      f"least-backlog router, admission deadline="
+                      f"{slo_ms:g}ms, {obs_per_rate} control ticks/"
+                      f"rate, min={mgr.min_replicas} "
+                      f"max={mgr.max_replicas}",
+            "unit": "generated tokens/sec (fleet)",
+            "curve": curve, "knee": _knee(curve),
+            "fleet": final_snap,
+            "fleet_control": {
+                "replica_spawned": final_snap["fleet_replica_spawned"],
+                "replica_drained": final_snap["fleet_replica_drained"],
+                "replica_dead": final_snap["fleet_replica_dead"],
+                "failover_resubmitted":
+                    final_snap["fleet_failover_resubmitted"],
+                "scale_up_at": ({"rung": scale_rung["rung"],
+                                 "slice": scale_rung["slice"]}
+                                if scale_rung else None),
+                "goodput_recovery_x": fmt(recovery, 3),
+                # the ISSUE 13 convergence criterion; captures land
+                # well above it (an added replica raises capacity ~1.5x)
+                "goodput_recovered_08": (recovery is not None
+                                         and recovery >= 0.8),
+                "n_replicas_final": n_final,
+                "returned_to_min": n_final == mgr.min_replicas,
+                "states": states},
             "autoscale_transitions": sig.transitions}
     return body, snaps, merged
 
@@ -490,7 +638,9 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
               trace=True, report_path=None, paged=False,
               chunked_prefill=None, admission=None, overload_ab=False,
               speculate_k=None, preempt=False, fleet=0,
-              fleet_obs_per_rate=6, fleet_slice_s=0.25):
+              fleet_obs_per_rate=6, fleet_slice_s=0.25,
+              fleet_control=False, fleet_injector=None,
+              fleet_min=None, fleet_max=None):
     """Drive the sweep(s) and (optionally) write the combined
     obs_report (JSON + text + Chrome trace). Returns the results list.
     The tier-1 smoke test calls this with tiny parameters (and once
@@ -509,7 +659,15 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
         raise ValueError("--fleet needs N >= 2 replicas (a fleet of "
                          "one is the plain decode sweep — drop the "
                          "flag)")
+    if fleet_control and fleet < 2:
+        raise ValueError("--fleet-control needs --fleet N (>= 2): the "
+                         "closed loop drives a replica FLEET")
     fleet_mode = fleet >= 2 and server in ("decode", "both")
+    if fleet_control and not fleet_mode:
+        raise ValueError("--fleet-control needs --server decode (or "
+                         "both): the closed loop drives DECODE "
+                         "replicas — silently running the plain "
+                         f"{server!r} ladder would discard the flag")
     if fleet_mode and overload_ab:
         raise ValueError("--fleet and --overload-ab are mutually "
                          "exclusive: the overload A/B compares one "
@@ -519,7 +677,16 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
               if trace and not fleet_mode else None)
     fleet_trace = None
     results, snaps = [], {}
-    if fleet_mode:
+    if fleet_mode and fleet_control:
+        body, inst_snaps, fleet_trace = sweep_fleet_control(
+            rates, n_replicas=fleet, n_req=n_req, slo_ms=slo_ms,
+            seed=seed, process=process, trace=trace,
+            obs_per_rate=fleet_obs_per_rate, slice_s=fleet_slice_s,
+            fault_injector=fleet_injector, min_replicas=fleet_min,
+            max_replicas=fleet_max)
+        results.append(body)
+        snaps.update({f"fleet_{n}": s for n, s in inst_snaps.items()})
+    elif fleet_mode:
         body, inst_snaps, fleet_trace = sweep_fleet(
             rates, n_replicas=fleet, n_req=n_req, slo_ms=slo_ms,
             seed=seed, process=process, trace=trace,
@@ -643,6 +810,19 @@ def main():
                          "federated metrics, one AutoscaleSignal fed "
                          "per schedule slice, clock-anchor-merged "
                          "trace) instead of one decode server")
+    ap.add_argument("--fleet-control", action="store_true",
+                    help="CLOSED-LOOP fleet arm (needs --fleet N): a "
+                         "FleetManager drives replica count — one "
+                         "control tick per schedule slice ACTS on the "
+                         "AutoscaleSignal (scale_up spawns a warmed "
+                         "replica, scale_down drains one with live-"
+                         "request migration); the record pins goodput "
+                         "recovery after the spawn and the quiet-tail "
+                         "return to min replicas")
+    ap.add_argument("--fleet-min", type=int, default=None,
+                    help="fleet-control floor (default: the initial N)")
+    ap.add_argument("--fleet-max", type=int, default=None,
+                    help="fleet-control ceiling (default: N + 4)")
     ap.add_argument("--preempt", action="store_true",
                     help="durable-KV preemption (implies --paged): the "
                          "mix's long tail submits as a spillable batch "
@@ -676,7 +856,10 @@ def main():
                         admission=args.admission,
                         overload_ab=args.overload_ab,
                         speculate_k=args.speculate,
-                        preempt=args.preempt, fleet=args.fleet)
+                        preempt=args.preempt, fleet=args.fleet,
+                        fleet_control=args.fleet_control,
+                        fleet_min=args.fleet_min,
+                        fleet_max=args.fleet_max)
     for r in results:
         print(json.dumps(r))
     print(json.dumps({"elapsed_s": fmt(time.perf_counter() - t0, 1),
