@@ -434,7 +434,9 @@ class FleetView:
         # values) — always present so tools/fleet_report.py renders
         # the control plane's activity next to the federation keys
         for key in ("replica_spawned", "replica_drained", "replica_dead",
-                    "failover_resubmitted", "canary_rollbacks"):
+                    "failover_resubmitted", "canary_rollbacks",
+                    "wire_reconnects", "wire_retries",
+                    "migrate_refused"):
             out["fleet_" + key] = counters.get(key, 0)
         # mean of per-instance occupancy statistics (summary kind:
         # recent scheduling-iteration slot occupancy) — the scale_down

@@ -76,6 +76,8 @@ from .loadgen import (ClosedLoop, DecodeSizeMix, InferenceSizeMix,
                       OnOffProcess, PoissonProcess, Schedule,
                       build_schedule, run_load)
 from .speculate import DraftSource, ModelDraft, NGramDraft, Speculator
+from .wire import (RemoteReplica, ReplicaServer, WireProtocolError,
+                   WireRemoteError, run_replica_server)
 
 __all__ = [
     "InferenceServer", "ContinuousDecodeServer", "ServingMetrics",
@@ -91,4 +93,6 @@ __all__ = [
     "PoissonProcess", "OnOffProcess", "ClosedLoop",
     "DecodeSizeMix", "InferenceSizeMix", "Schedule",
     "build_schedule", "run_load",
+    "ReplicaServer", "RemoteReplica", "WireProtocolError",
+    "WireRemoteError", "run_replica_server",
 ]

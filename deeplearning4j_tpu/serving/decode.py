@@ -966,6 +966,14 @@ class ContinuousDecodeServer(_RequestLoop):
 
     # -- fleet verbs (serving/fleet.py) --------------------------------
     @property
+    def paged(self):
+        """Whether this server runs the block-table KV cache — the
+        capability gate for migrate_in/migrate_out/drain(migrate=True)
+        (the fleet router and the wire HELLO both read it; reaching
+        for `_paged` from outside was the old way)."""
+        return self._paged
+
+    @property
     def alive(self):
         """True while the serve loop is running on a live thread — the
         fleet router's liveness probe. A killed or crashed loop reads

@@ -90,7 +90,7 @@ from .kvstate import KVStateError
 from .metrics import ServingMetrics
 from .server import (DeadlineExceededError, ReplicaDeadError,
                      ServerClosedError, ServerOverloadedError,
-                     UnhealthyOutputError)
+                     UnhealthyOutputError, _ParamsView)
 
 log = logging.getLogger(__name__)
 
@@ -121,17 +121,6 @@ class RoundRobinSplitter:
         srv = self._servers[self._i % len(self._servers)]
         self._i += 1
         return srv.submit(prompt, max_new, **kw)
-
-
-class _ParamsView:
-    """Duck-typed (aux, blocks) holder `ContinuousDecodeServer.swap`
-    accepts — the rollback snapshot and the spawn-after-rollout
-    carrier."""
-
-    __slots__ = ("aux", "blocks")
-
-    def __init__(self, aux, blocks):
-        self.aux, self.blocks = aux, blocks
 
 
 def _params_finite(lm):
@@ -212,8 +201,9 @@ class FleetManager:
     def __init__(self, factory, n_replicas=2, *, signal=None,
                  policy="least_backlog", min_replicas=None,
                  max_replicas=None, retry_policy=None,
-                 fault_injector=None, metrics=None, name="fleet",
-                 warmup=None, degrade_shed_rate=25, name_prefix="i"):
+                 heartbeat_timeout=None, fault_injector=None,
+                 metrics=None, name="fleet", warmup=None,
+                 degrade_shed_rate=25, name_prefix="i"):
         if policy not in ("least_backlog", "round_robin"):
             raise ValueError(f"unknown routing policy {policy!r}")
         if int(n_replicas) < 1:
@@ -232,9 +222,16 @@ class FleetManager:
                 f"{self.min_replicas}/{self.max_replicas}")
         # failover budget + pacing: the policy bounds resubmissions per
         # request; classification (what IS a failover vs a request
-        # verdict) is the manager's explicit table, not `retryable`
+        # verdict) is the manager's explicit table, not `retryable`.
+        # Both are PUBLIC wire config too: remote replicas
+        # (serving/wire.py RemoteReplica) inherit the retry policy for
+        # reconnect-with-resend and `heartbeat_timeout` for the
+        # ack-silence reap that feeds the healthy→degraded→dead state
+        # machine (`_spawn` pushes them through `configure_wire`).
         self._retry = retry_policy if retry_policy is not None else \
             RetryPolicy(max_retries=3, base_delay=0.0, jitter=0.0)
+        self.heartbeat_timeout = (None if heartbeat_timeout is None
+                                  else float(heartbeat_timeout))
         self._injector = fault_injector
         self.metrics = metrics or ServingMetrics(name=name)
         self.name = name
@@ -530,11 +527,24 @@ class FleetManager:
         srv = self._factory(name)
         if not srv._running:
             srv.start()
-        if self._params is not None and \
-                srv.current_params()[0] is not self._params[0]:
-            # the factory builds the ORIGINAL params; a rolled-forward
-            # fleet hands every new replica the current ones
-            srv.swap(_ParamsView(*self._params))
+        if hasattr(srv, "configure_wire"):
+            # a REMOTE replica (serving/wire.py): bind the manager's
+            # wire config — its metrics as the wire-counter sink
+            # (wire_reconnects/wire_retries land on the fleet
+            # control-plane snapshot), its retry policy, its
+            # heartbeat-timeout reap threshold
+            srv.configure_wire(heartbeat_timeout=self.heartbeat_timeout,
+                               retry_policy=self._retry,
+                               counters=self.metrics)
+        if self._params is not None:
+            try:
+                same = srv.current_params()[0] is self._params[0]
+            except NotImplementedError:
+                same = False    # remote: no params pull — always ship
+            if not same:
+                # the factory builds the ORIGINAL params; a rolled-
+                # forward fleet hands every new replica the current ones
+                srv.swap(_ParamsView(*self._params))
         if self._warmup is not None:
             self._warmup(srv)
         with self._lock:
@@ -700,16 +710,21 @@ class FleetManager:
         tried = set()
         while True:
             rec = self._pick(tried)
-            if rec is None or not rec.server._paged:
+            if rec is None or not rec.server.paged:
                 self._resubmit(req)     # no migratable destination
                 return
             try:
                 inner = rec.server.migrate_in(art, deadline_ms=dl_ms)
             except (KVStateError, ValueError):
-                # tag/layout mismatch (mid-rollout fleet): replay
+                # tag/layout mismatch (mid-rollout fleet) — the
+                # destination REFUSED the migration: degrade to prompt
+                # replay (correct bits either way), counted so a fleet
+                # silently paying replay compute is visible
+                self.metrics.count("migrate_refused")
                 self._resubmit(req)
                 return
             except ServerOverloadedError:
+                self.metrics.count("migrate_refused")
                 tried.add(rec.name)
                 continue
             except (ServerClosedError, ReplicaDeadError) as e:
@@ -770,7 +785,9 @@ class FleetManager:
         is the one counting its own verbs)."""
         snap = self.fleet_view().snapshot()
         for key in ("replica_spawned", "replica_drained", "replica_dead",
-                    "failover_resubmitted", "canary_rollbacks"):
+                    "failover_resubmitted", "canary_rollbacks",
+                    "wire_reconnects", "wire_retries",
+                    "migrate_refused"):
             snap["fleet_" + key] = self.metrics.count_value(key)
         snap["fleet_alive"] = self.n_alive()
         return snap

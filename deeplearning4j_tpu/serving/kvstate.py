@@ -59,11 +59,13 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import struct
 
 import numpy as np
 
 __all__ = ["RequestArtifact", "PrefixCacheArtifact", "KVStateError",
-           "KVStateVersionError", "FORMAT_VERSION", "artifact_kind"]
+           "KVStateVersionError", "FORMAT_VERSION", "artifact_kind",
+           "artifact_from_bytes"]
 
 # bumped on any incompatible layout change; loaders refuse unknown
 # versions loudly instead of misreading bytes
@@ -130,6 +132,60 @@ def _check_panels(panels):
     return out
 
 
+def _serialize_arrays(arrays):
+    """ONE layout for every serialization target: flatten `arrays`
+    into (descriptors, chunk generator) — descriptors carry dtype/
+    shape/offset/nbytes into the concatenation of the yielded chunks.
+    `to_bytes()` joins the chunks into one wire buffer; the disk path
+    writes them SEQUENTIALLY, holding one array's bytes at a time (a
+    multi-GB prefix-cache save must never transiently double its
+    footprint) — same bytes either way, so the wire and disk
+    serializers structurally cannot drift."""
+    norm = [np.ascontiguousarray(a) for a in arrays]
+    descs, off = [], 0
+    for a in norm:
+        descs.append({"dtype": str(a.dtype),
+                      "shape": list(a.shape),
+                      "offset": off,
+                      "nbytes": int(a.nbytes)})
+        off += int(a.nbytes)
+    return descs, (a.tobytes() for a in norm)
+
+
+def _deserialize_arrays(manifest, raw):
+    """The shared inverse: descriptors + payload bytes -> read-only
+    array views over `raw` (the buffer stays alive through each
+    array's base). A payload shorter than its descriptors promise —
+    a truncated wire buffer or half-written panels.bin — refuses as
+    KVStateError like every other corruption mode, never a bare
+    numpy ValueError (which a wire consumer would misclassify as a
+    request-level verdict)."""
+    arrays = []
+    try:
+        for d in manifest["arrays"]:
+            a = np.frombuffer(raw, dtype=np.dtype(d["dtype"]),
+                              count=int(np.prod(d["shape"],
+                                                dtype=np.int64))
+                              if d["shape"] else 1,
+                              offset=d["offset"]).reshape(d["shape"])
+            arrays.append(a)
+    except (ValueError, TypeError) as e:
+        raise KVStateError(f"corrupt artifact payload: {e}") from e
+    return arrays
+
+
+def _check_manifest(manifest, kind, where):
+    fv = manifest.get("format_version")
+    if fv != FORMAT_VERSION:
+        raise KVStateError(
+            f"{kind} artifact {where} has format_version {fv!r}; "
+            f"this build reads {FORMAT_VERSION}")
+    if kind is not None and manifest.get("kind") != kind:
+        raise KVStateError(
+            f"artifact {where} is a {manifest.get('kind')!r}, "
+            f"expected {kind!r}")
+
+
 def _write_payload(path, manifest, arrays):
     """Commit `arrays` + `manifest` under directory `path` with the
     checkpoint-manager crash ordering: the NEW artifact is fully
@@ -149,18 +205,13 @@ def _write_payload(path, manifest, arrays):
         if os.path.isdir(d):
             shutil.rmtree(d)
     os.makedirs(stage)
-    offsets = []
+    descs, chunks = _serialize_arrays(arrays)
     with open(os.path.join(stage, _PANELS), "wb") as fh:
-        for a in arrays:
-            a = np.ascontiguousarray(a)
-            offsets.append({"dtype": str(a.dtype),
-                            "shape": list(a.shape),
-                            "offset": fh.tell(),
-                            "nbytes": int(a.nbytes)})
-            fh.write(a.tobytes())
+        for chunk in chunks:        # one array's bytes at a time
+            fh.write(chunk)
     manifest = dict(manifest)
     manifest["format_version"] = FORMAT_VERSION
-    manifest["arrays"] = offsets
+    manifest["arrays"] = descs
     tmp = os.path.join(stage, _MANIFEST + ".tmp")
     with open(tmp, "w") as fh:
         json.dump(manifest, fh)
@@ -180,25 +231,62 @@ def _read_payload(path, kind):
             f"no {kind} artifact at {path!r} (missing {_MANIFEST})")
     with open(mpath) as fh:
         manifest = json.load(fh)
-    fv = manifest.get("format_version")
-    if fv != FORMAT_VERSION:
-        raise KVStateError(
-            f"{kind} artifact at {path!r} has format_version {fv!r}; "
-            f"this build reads {FORMAT_VERSION}")
-    if manifest.get("kind") != kind:
-        raise KVStateError(
-            f"artifact at {path!r} is a {manifest.get('kind')!r}, "
-            f"expected {kind!r}")
+    _check_manifest(manifest, kind, f"at {path!r}")
     with open(os.path.join(path, _PANELS), "rb") as fh:
         raw = fh.read()
-    arrays = []
-    for d in manifest["arrays"]:
-        a = np.frombuffer(raw, dtype=np.dtype(d["dtype"]),
-                          count=int(np.prod(d["shape"], dtype=np.int64))
-                          if d["shape"] else 1,
-                          offset=d["offset"]).reshape(d["shape"])
-        arrays.append(a)
-    return manifest, arrays
+    return manifest, _deserialize_arrays(manifest, raw)
+
+
+def _pack_bytes(manifest, arrays):
+    """The wire layout: `u32 manifest_len | manifest_json | payload` —
+    the manifest+panels directory layout as ONE buffer (no temp dir).
+    Shares `_serialize_arrays` with the disk path byte-for-byte."""
+    descs, chunks = _serialize_arrays(arrays)
+    manifest = dict(manifest)
+    manifest["format_version"] = FORMAT_VERSION
+    manifest["arrays"] = descs
+    hdr = json.dumps(manifest).encode()
+    return b"".join([struct.pack("<I", len(hdr)), hdr, *chunks])
+
+
+def _parse_buffer(buf):
+    """Guarded header parse of a `to_bytes()` buffer: every corruption
+    mode (truncation, overrun, bad JSON) surfaces as the KVStateError
+    family — the ONE parse behind `_unpack_bytes` and
+    `artifact_from_bytes`, so their error classification cannot
+    drift."""
+    buf = bytes(buf) if not isinstance(buf, (bytes, bytearray)) else buf
+    if len(buf) < 4:
+        raise KVStateError("truncated artifact buffer (no header)")
+    (hlen,) = struct.unpack_from("<I", buf, 0)
+    if 4 + hlen > len(buf):
+        raise KVStateError("truncated artifact buffer (header cut off)")
+    try:
+        manifest = json.loads(buf[4:4 + hlen].decode())
+    except ValueError as e:
+        raise KVStateError(f"corrupt artifact manifest: {e}") from e
+    return manifest, memoryview(buf)[4 + hlen:]
+
+
+def _unpack_bytes(buf, kind):
+    manifest, payload = _parse_buffer(buf)
+    _check_manifest(manifest, kind, "in wire buffer")
+    return manifest, _deserialize_arrays(manifest, payload)
+
+
+def artifact_from_bytes(buf):
+    """Deserialize either artifact kind from a `to_bytes()` buffer —
+    the wire consumer's one-call probe (the serving wire's MIGRATE
+    payloads carry request artifacts; a foreign producer may ship a
+    prefix cache through the same frames). ONE manifest parse through
+    the same guarded pipeline `from_bytes` uses."""
+    manifest, flat = _unpack_bytes(buf, None)   # kind checked below
+    kind = manifest.get("kind")
+    cls = {"request": RequestArtifact,
+           "prefix_cache": PrefixCacheArtifact}.get(kind)
+    if cls is None:
+        raise KVStateError(f"unknown artifact kind {kind!r} in buffer")
+    return cls._from_manifest(manifest, flat)
 
 
 def _pair_up(flat):
@@ -295,7 +383,10 @@ class RequestArtifact(_TaggedArtifact):
         `spill_bytes` accounting unit."""
         return _panels_nbytes(self.panels)
 
-    def save(self, path):
+    def _manifest_and_flat(self):
+        """ONE manifest builder behind save() and to_bytes() — the two
+        serializers share every field and the panel flattening, so the
+        wire and disk layouts cannot drift."""
         flat = [a for kv in self.panels for a in kv]
         manifest = {
             "kind": "request",
@@ -309,14 +400,31 @@ class RequestArtifact(_TaggedArtifact):
         }
         if self.trace is not None:
             manifest["trace"] = self.trace
-        return _write_payload(path, manifest, flat)
+        return manifest, flat
 
     @classmethod
-    def load(cls, path):
-        m, flat = _read_payload(path, "request")
+    def _from_manifest(cls, m, flat):
         return cls(m["prompt"], m["generated"], m["max_new"], m["tag"],
                    m["block_size"], _pair_up(flat), klass=m["klass"],
                    trace=m.get("trace"))
+
+    def save(self, path):
+        return _write_payload(path, *self._manifest_and_flat())
+
+    @classmethod
+    def load(cls, path):
+        return cls._from_manifest(*_read_payload(path, "request"))
+
+    def to_bytes(self):
+        """The whole artifact as ONE buffer (`u32 manifest_len |
+        manifest_json | panel payload`) — the serving wire's MIGRATE
+        payload. Byte-identical panel layout to `save()`'s panels.bin
+        (shared `_serialize_arrays`), no temp dir."""
+        return _pack_bytes(*self._manifest_and_flat())
+
+    @classmethod
+    def from_bytes(cls, buf):
+        return cls._from_manifest(*_unpack_bytes(buf, "request"))
 
 
 class PrefixCacheArtifact(_TaggedArtifact):
@@ -355,21 +463,20 @@ class PrefixCacheArtifact(_TaggedArtifact):
     def nbytes(self):
         return sum(_panels_nbytes(p) for _, p in self.entries)
 
-    def save(self, path):
+    def _manifest_and_flat(self):
         flat = [a for _, panels in self.entries
                 for kv in panels for a in kv]
-        return _write_payload(path, {
+        return {
             "kind": "prefix_cache",
             "tag": self.tag,
             "block_size": self.block_size,
             "prefixes": [list(p) for p, _ in self.entries],
             "n_layers": (len(self.entries[0][1])
                          if self.entries else 0),
-        }, flat)
+        }, flat
 
     @classmethod
-    def load(cls, path):
-        m, flat = _read_payload(path, "prefix_cache")
+    def _from_manifest(cls, m, flat):
         n_layers = int(m["n_layers"])
         per_entry = 2 * n_layers
         entries = []
@@ -377,3 +484,20 @@ class PrefixCacheArtifact(_TaggedArtifact):
             chunk = flat[i * per_entry:(i + 1) * per_entry]
             entries.append((prefix, _pair_up(chunk)))
         return cls(m["tag"], m["block_size"], entries)
+
+    def save(self, path):
+        return _write_payload(path, *self._manifest_and_flat())
+
+    @classmethod
+    def load(cls, path):
+        return cls._from_manifest(*_read_payload(path, "prefix_cache"))
+
+    def to_bytes(self):
+        """One-buffer serialization (see RequestArtifact.to_bytes) —
+        a restarted remote replica could warm its prefix cache straight
+        off a peer instead of disk."""
+        return _pack_bytes(*self._manifest_and_flat())
+
+    @classmethod
+    def from_bytes(cls, buf):
+        return cls._from_manifest(*_unpack_bytes(buf, "prefix_cache"))

@@ -189,10 +189,15 @@ class ServingMetrics:
             self.count(key, 0)
         # fleet-control events (serving/fleet.py FleetManager): same
         # eager rule — a fleet that never failed over must scrape zero,
-        # not absence, on every one of its control verbs
+        # not absence, on every one of its control verbs. The wire
+        # counters (serving/wire.py RemoteReplica via the manager's
+        # metrics): reconnects after a severed connection, in-flight
+        # frames re-sent under the at-most-once dedup, and migrations
+        # a destination refused (degraded to prompt replay).
         for key in ("replica_spawned", "replica_drained", "replica_dead",
                     "replica_degraded", "failover_resubmitted",
-                    "canary_rollbacks"):
+                    "canary_rollbacks", "wire_reconnects",
+                    "wire_retries", "migrate_refused"):
             self.count(key, 0)
 
     @property
@@ -432,13 +437,17 @@ class ServingMetrics:
         out.setdefault("spill_bytes", 0)
         out.setdefault("prefix_restore_hits", 0)
         # fleet-control events (serving/fleet.py): spawn/drain/death,
-        # failover replays, canary rollbacks — always present
+        # failover replays, canary rollbacks — always present; plus
+        # the serving-wire transport counters (serving/wire.py)
         out.setdefault("replica_spawned", 0)
         out.setdefault("replica_drained", 0)
         out.setdefault("replica_dead", 0)
         out.setdefault("replica_degraded", 0)
         out.setdefault("failover_resubmitted", 0)
         out.setdefault("canary_rollbacks", 0)
+        out.setdefault("wire_reconnects", 0)
+        out.setdefault("wire_retries", 0)
+        out.setdefault("migrate_refused", 0)
         out["service_rate_tokens_per_sec"] = self._service_rate.value
         out["prefix_hit_rate"] = (
             out["prefix_rows_hit"] / out["prefix_rows_total"]

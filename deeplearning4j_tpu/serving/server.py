@@ -103,6 +103,17 @@ class ReplicaDeadError(ServingError):
     future nobody will resolve."""
 
 
+class _ParamsView:
+    """Duck-typed (aux, blocks) holder every `swap()` accepts — the
+    fleet manager's rollback snapshot / spawn carrier and the serving
+    wire's SWAP deserialization target share this ONE definition."""
+
+    __slots__ = ("aux", "blocks")
+
+    def __init__(self, aux, blocks):
+        self.aux, self.blocks = aux, blocks
+
+
 class _Request:
     __slots__ = ("x", "future", "deadline", "t_submit", "req_id")
 

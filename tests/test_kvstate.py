@@ -105,6 +105,70 @@ class TestArtifacts:
         np.testing.assert_array_equal(back.entries[0][1][0][0],
                                       e_parent[1][0][0])
 
+    def test_byte_round_trip_and_disk_wire_layout_shared(self, tmp_path):
+        """The ISSUE 14 serialization satellite: `to_bytes()` carries
+        the manifest+panels layout as ONE buffer (the serving wire's
+        MIGRATE payload), round-trips byte-exactly, and its payload
+        section is BYTE-IDENTICAL to the on-disk panels.bin — the two
+        serializers share `_serialize_arrays`, so they structurally
+        cannot drift."""
+        import struct
+
+        from deeplearning4j_tpu.serving.kvstate import artifact_from_bytes
+        art = RequestArtifact([1, 2, 3], [9, 8, 7, 6], 10, "tagA", 4,
+                              _panels(rows=6), klass="batch",
+                              trace={"trace_id": "i0-3", "origin": "i0"})
+        buf = art.to_bytes()
+        back = RequestArtifact.from_bytes(buf)
+        assert back.prompt == art.prompt
+        assert back.generated == art.generated
+        assert back.max_new == art.max_new and back.tag == art.tag
+        assert back.block_size == art.block_size
+        assert back.klass == "batch" and back.trace == art.trace
+        for (k0, v0), (k1, v1) in zip(art.panels, back.panels):
+            np.testing.assert_array_equal(k0, k1)
+            np.testing.assert_array_equal(v0, v1)
+        # payload section == panels.bin, byte for byte
+        p = art.save(str(tmp_path / "req"))
+        raw = open(os.path.join(p, "panels.bin"), "rb").read()
+        (hlen,) = struct.unpack_from("<I", buf, 0)
+        assert buf[4 + hlen:] == raw
+        # the kind probe dispatches either artifact kind
+        assert isinstance(artifact_from_bytes(buf), RequestArtifact)
+        pc = PrefixCacheArtifact("tagB", 4,
+                                 [(tuple(range(4)), _panels(rows=4))])
+        pc2 = PrefixCacheArtifact.from_bytes(pc.to_bytes())
+        assert pc2.entries[0][0] == pc.entries[0][0]
+        np.testing.assert_array_equal(pc2.entries[0][1][0][0],
+                                      pc.entries[0][1][0][0])
+        assert isinstance(artifact_from_bytes(pc.to_bytes()),
+                          PrefixCacheArtifact)
+
+    def test_byte_layer_refuses_corruption_loudly(self):
+        """Truncation, kind mismatch, and format-version drift on the
+        ONE-buffer layer fail with the same loud KVStateError family
+        the disk loader uses."""
+        from deeplearning4j_tpu.serving.kvstate import artifact_from_bytes
+        art = RequestArtifact([1], [2], 4, "t", 4, _panels(rows=1))
+        buf = art.to_bytes()
+        with pytest.raises(KVStateError):
+            RequestArtifact.from_bytes(buf[:3])        # no header
+        with pytest.raises(KVStateError):
+            artifact_from_bytes(buf[:3])     # same guards on dispatch
+        with pytest.raises(KVStateError):
+            artifact_from_bytes(buf[:12])    # header cut off
+        with pytest.raises(KVStateError, match="request"):
+            PrefixCacheArtifact.from_bytes(buf)        # wrong kind
+        import json
+        import struct
+        (hlen,) = struct.unpack_from("<I", buf, 0)
+        m = json.loads(buf[4:4 + hlen].decode())
+        m["format_version"] = 999
+        h = json.dumps(m).encode()
+        bad = struct.pack("<I", len(h)) + h + buf[4 + hlen:]
+        with pytest.raises(KVStateError, match="format_version"):
+            RequestArtifact.from_bytes(bad)
+
     def test_require_tag_fails_loudly(self):
         art = RequestArtifact([1], [2], 4, "v1-fingerprint", 4,
                               _panels(rows=1))

@@ -535,6 +535,59 @@ class TestSmokeSweep:
                   and e.get("name") == "process_name"}
         assert pnames == {"i0", "i1"}
 
+    def test_smoke_sweep_fleet_procs(self):
+        """The CROSS-PROCESS fleet smoke (ISSUE 14: the serving wire):
+        `load_sweep --fleet-procs 2` — two REAL replica child
+        processes behind `serving/wire.py` RemoteReplicas, routed by
+        the FleetManager, with ONE injected socket sever mid-stream.
+        Pins the acceptance: zero lost requests (every admitted future
+        resolves), the faulted batch's streams BIT-IDENTICAL to the
+        quiet fleet's (dedup re-delivery / failover replay are
+        indistinguishable from an undisturbed run), the sever visibly
+        exercised the reconnect path (wire counters moved), and the
+        merged trace covers BOTH replica pids as distinct Perfetto
+        process groups. Artifacts upload next to the in-process fleet
+        smokes (tier1.yml)."""
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        mod = importlib.import_module("load_sweep")
+        out = os.path.join(
+            os.environ.get("SMOKE_REPORT_DIR") or tempfile.gettempdir(),
+            "load_sweep_smoke_fleet_procs")
+        res = mod.run_sweep(server="decode", rates=(40.0,), n_req=16,
+                            slo_ms=400.0, seed=0, trace=True,
+                            report_path=out, fleet_procs=2,
+                            fleet_obs_per_rate=3, fleet_slice_s=0.15)
+        (body,) = res
+        assert body["server"] == "fleet_procs"
+        assert len(body["replica_pids"]) == 2
+        assert len(set(body["replica_pids"].values())) == 2  # real procs
+        # zero lost under real arrivals: every admitted future resolved
+        for pt in body["curve"]:
+            assert pt["admitted"] == pt["completed"] + pt["failed"]
+        # the injected sever: fired once, nothing lost, bits identical
+        fault = body["wire_fault"]
+        assert fault["severed"] == 1
+        assert fault["all_futures_resolved"] is True
+        assert fault["streams_bit_identical"] is True
+        assert fault["wire_reconnects"] >= 1    # the wire really died
+        assert fault["wire_retries"] >= 1       # and really resent
+        # the wire counters ride the federated fleet snapshot
+        assert body["fleet"]["fleet_wire_reconnects"] >= 1
+        # artifacts: report + the merged trace covering BOTH pids
+        rep = json.load(open(out + ".json"))
+        assert rep["sweep"][0]["server"] == "fleet_procs"
+        assert os.path.exists(out + ".txt")
+        merged = json.load(open(out + ".trace.merged.json"))
+        xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert sorted({e["pid"] for e in xs}) == [1, 2]
+        pnames = {e["args"]["name"] for e in merged["traceEvents"]
+                  if e.get("ph") == "M"
+                  and e.get("name") == "process_name"}
+        assert pnames == {"i0", "i1"}
+
     def test_smoke_sweep_fleet_control(self):
         """The CLOSED-LOOP fleet smoke (ISSUE 13): 2 -> 3 -> 2
         replicas with one injected replica death, driven end to end by

@@ -540,6 +540,12 @@ class TestMetricsPins:
         # failed over scrapes zero, not absence)
         "replica_spawned", "replica_drained", "replica_dead",
         "replica_degraded", "failover_resubmitted", "canary_rollbacks",
+        # serving-wire transport (serving/wire.py RemoteReplica via the
+        # fleet manager's metrics): reconnects, at-most-once resends,
+        # refused migrations — consumed by tools/fleet_report.py and
+        # the load_sweep --fleet-procs record (eagerly created: a fleet
+        # that never lost a connection scrapes zero, not absence)
+        "wire_reconnects", "wire_retries", "migrate_refused",
         "admission_error_ms_p50", "admission_error_ms_p99",
         "admission_error_ms_mean", "admission_error_ms_count",
         "slo_total", "slo_met", "slo_tokens_met", "slo_attainment",
@@ -563,6 +569,10 @@ class TestMetricsPins:
         "fleet_replica_spawned", "fleet_replica_drained",
         "fleet_replica_dead", "fleet_failover_resubmitted",
         "fleet_canary_rollbacks",
+        # serving-wire transport counters (serving/wire.py): summed the
+        # same way, overlaid live by FleetManager.fleet_snapshot()
+        "fleet_wire_reconnects", "fleet_wire_retries",
+        "fleet_migrate_refused",
     )
 
     def test_fleet_snapshot_keys_pinned(self):

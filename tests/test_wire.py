@@ -1,0 +1,352 @@
+"""Serving wire-protocol pins (ISSUE 14 acceptance criteria).
+
+  (a) Transport: a request submitted over the wire resolves to the
+      exact stream the model produces in-process; request-level
+      verdicts (shed, deadline, bad input) cross the wire AS THEIR
+      REAL TYPES; the no-fault cross-process path adds ZERO device
+      dispatches per token vs the same fleet in-process
+      (dispatch-counter A/B).
+  (b) At-most-once: a seeded drop-after-ACK plan on `serve.wire.submit`
+      yields exactly ONE decoded stream and exactly one `wire_retries`
+      increment (the PS transport dedup argument, regression-pinned
+      for serving); a sever on `serve.wire.stream` drops the result
+      mid-flight and reconnect re-DELIVERS without re-decoding.
+  (c) Liveness: heartbeat-ack silence (a HUNG process — the main
+      socket still answers) decays `alive` past `heartbeat_timeout`
+      and the fleet router reaps the replica; its in-flight requests
+      fail over with streams bit-identical to solo. Retry-exhausted
+      wire death fails every pending future loudly with
+      `ReplicaDeadError` — never a hang.
+  (d) Migration: `scale_down` of a wire replica ships
+      `RequestArtifact` BYTES between endpoints and the resumed
+      stream is bit-identical to solo (the durable-KV pin exercised
+      across a real socket); a destination that REFUSES the artifact
+      (version tag mismatch) degrades to prompt replay
+      (`migrate_refused` counted) — never a lost request.
+
+Every wire endpoint here is a REAL TCP socket on loopback; the
+2-process version of (a)-(d) runs as the tier-1 smoke
+(`tools/load_sweep.py --fleet-procs`, tests/test_loadgen.py).
+"""
+import time
+
+import pytest
+
+from deeplearning4j_tpu.common.resilience import FaultInjector, RetryPolicy
+from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                        FleetManager, RemoteReplica,
+                                        ReplicaServer, ReplicaDeadError,
+                                        ServingMetrics)
+
+
+def _lm(seed=3):
+    return TransformerLM(64, d_model=16, n_heads=2, n_layers=1,
+                         max_len=64, seed=seed)
+
+
+class _WireFleet:
+    """N in-thread ReplicaServers behind RemoteReplicas — a REAL
+    loopback wire under every verb, without subprocess startup cost
+    (the 2-process arm is the tier-1 smoke)."""
+
+    def __init__(self, lm, injector=None, paged=False, **mgr_kw):
+        self.wrappers = {}
+        self._lm = lm
+        self._paged = paged
+        self._injector = injector
+        self.mgr = FleetManager(self._factory, **mgr_kw)
+
+    def _factory(self, name):
+        srv = ContinuousDecodeServer(
+            self._lm, slots=2, prompt_buckets=(8, 16),
+            paged=self._paged, block_size=8,
+            metrics=ServingMetrics(name=name), instance=name)
+        rs = ReplicaServer(srv)
+        self.wrappers[name] = rs
+        return RemoteReplica("127.0.0.1", rs.port, name=name,
+                             heartbeat_interval=0.05,
+                             fault_injector=self._injector)
+
+    def __enter__(self):
+        self.mgr.start()
+        for n in self.mgr.replicas:     # compile off the clock
+            self.mgr.replica(n).generate([1, 2, 3], 2, timeout=120)
+        return self.mgr
+
+    def __exit__(self, *exc):
+        self.mgr.stop(timeout=60)
+        for rs in self.wrappers.values():
+            rs.close(stop_server=False)
+
+    def received_total(self):
+        """Sum of the replicas' own `received` counters — the decoded-
+        stream census the at-most-once pins count."""
+        total = 0
+        for name in self.mgr.replicas:
+            snap = self.mgr.replica(name).metrics.kind_snapshot()
+            total += (snap.get("received") or {}).get("value") or 0
+        return total
+
+
+# ---------------------------------------------------------------------------
+# (a) transport
+# ---------------------------------------------------------------------------
+class TestWireTransport:
+    def test_submit_over_wire_bit_identical_and_verdicts_propagate(self):
+        from deeplearning4j_tpu.serving import (DeadlineExceededError,
+                                                ServerOverloadedError)
+        lm = _lm()
+        ref = list(lm.generate([1, 2, 3], 8))
+        srv = ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8, 16),
+                                     metrics=ServingMetrics(name="i0"),
+                                     instance="i0", max_queue=2)
+        rs = ReplicaServer(srv)
+        rr = RemoteReplica("127.0.0.1", rs.port, name="i0",
+                           heartbeat_interval=0.05)
+        try:
+            assert list(rr.generate([1, 2, 3], 8, timeout=120)) == ref
+            # request-level verdicts cross the wire as their REAL types
+            # (the fleet manager's classification table depends on it)
+            with pytest.raises(ValueError):
+                rr.generate(list(range(1, 70)), 8, timeout=60)
+            with pytest.raises(DeadlineExceededError):
+                rr.generate([1, 2, 3], 8, deadline_ms=0.0, timeout=60)
+            futs, shed = [], 0
+            for _ in range(64):
+                try:
+                    futs.append(rr.submit([1, 2, 3], 30))
+                except ServerOverloadedError:
+                    shed += 1
+            assert shed > 0             # backpressure reached the caller
+            for f in futs:
+                f.result(120)
+        finally:
+            rr.stop(drain=True)
+            rs.close(stop_server=False)
+        assert not rr.alive
+
+    def test_wire_fleet_adds_zero_dispatches_vs_inprocess_fleet(self):
+        """THE zero-added-dispatch acceptance pin: the same sequential
+        round-robin workload through (1) a fleet of wire replicas on a
+        real loopback socket and (2) the same fleet in-process —
+        per-replica dispatch and token counters IDENTICAL, results
+        bit-identical. The wire is host-side plumbing; it must never
+        buy a token with an extra device dispatch."""
+        lm = _lm()
+        prompts = [[1 + i, 2, 3] for i in range(6)]
+        counts, outs = {}, {}
+        fleet = _WireFleet(lm, n_replicas=2, policy="round_robin")
+        with fleet as mgr:
+            outs["wire"] = [mgr.generate(p, 5, timeout=120)
+                            for p in prompts]
+            counts["wire"] = []
+            for n in mgr.replicas:
+                snap = mgr.replica(n).metrics.kind_snapshot()
+                counts["wire"].append(
+                    ((snap.get("dispatches") or {}).get("value") or 0,
+                     (snap.get("tokens_out") or {}).get("value") or 0))
+
+        def local_factory(name):
+            return ContinuousDecodeServer(
+                lm, slots=2, prompt_buckets=(8, 16),
+                metrics=ServingMetrics(name=name), instance=name)
+        with FleetManager(local_factory, n_replicas=2,
+                          policy="round_robin") as mgr:
+            for n in mgr.replicas:
+                mgr.replica(n).generate([1, 2, 3], 2, timeout=120)
+            outs["local"] = [mgr.generate(p, 5, timeout=120)
+                             for p in prompts]
+            counts["local"] = [
+                (mgr.replica(n).metrics.count_value("dispatches"),
+                 mgr.replica(n).metrics.count_value("tokens_out"))
+                for n in mgr.replicas]
+        assert counts["wire"] == counts["local"]
+        assert [list(r) for r in outs["wire"]] == \
+            [list(r) for r in outs["local"]]
+
+    def test_wire_counters_always_present_on_fleet_snapshot(self):
+        """The satellite surface pin: wire_reconnects / wire_retries /
+        migrate_refused ride EVERY fleet snapshot as zeros on a fleet
+        that never lost a connection (the PINNED_KEYS twin lives in
+        test_obs)."""
+        lm = _lm()
+        with _WireFleet(lm, n_replicas=2) as mgr:
+            snap = mgr.fleet_snapshot()
+            for key in ("fleet_wire_reconnects", "fleet_wire_retries",
+                        "fleet_migrate_refused"):
+                assert snap[key] == 0
+            assert mgr.heartbeat_timeout is None    # exposed config
+
+
+# ---------------------------------------------------------------------------
+# (b) at-most-once
+# ---------------------------------------------------------------------------
+class TestAtMostOnce:
+    def test_drop_after_ack_decodes_once_one_wire_retry(self):
+        """THE at-most-once pin (ISSUE 14 satellite): a seeded sever on
+        `serve.wire.submit` fires AFTER the frame went out — the
+        replica decodes, the ack dies with the connection. The retried
+        SUBMIT must dedup: exactly one decoded stream (the replicas'
+        summed `received` moves by 1), exactly one `wire_retries`
+        increment, and the caller's future resolves bit-identically."""
+        lm = _lm()
+        ref = list(lm.generate([1, 2, 3], 24))
+        inj = FaultInjector()
+        fleet = _WireFleet(lm, injector=inj, n_replicas=2)
+        with fleet as mgr:
+            base_recv = fleet.received_total()
+            base = mgr.fleet_snapshot()
+            inj.plan("serve.wire.submit",
+                     on_call=inj.calls("serve.wire.submit"),
+                     sever=True, exc=None)
+            fut = mgr.submit([1, 2, 3], 24)
+            assert list(fut.result(120)) == ref
+            snap = mgr.fleet_snapshot()
+            assert snap["fleet_wire_retries"] \
+                - base["fleet_wire_retries"] == 1
+            assert snap["fleet_wire_reconnects"] \
+                - base["fleet_wire_reconnects"] == 1
+            assert fleet.received_total() - base_recv == 1
+
+    def test_stream_sever_redelivers_without_redecoding(self):
+        """A sever as the result frame arrives (`serve.wire.stream`)
+        drops the stream mid-flight: reconnect re-SUBMITs, the dedup
+        registry re-attaches, and the finished result is RE-DELIVERED
+        — one decode, correct bits."""
+        lm = _lm()
+        ref = list(lm.generate([4, 5], 24))
+        inj = FaultInjector()
+        fleet = _WireFleet(lm, injector=inj, n_replicas=2)
+        with fleet as mgr:
+            base_recv = fleet.received_total()
+            inj.plan("serve.wire.stream",
+                     on_call=inj.calls("serve.wire.stream"),
+                     sever=True, exc=None)
+            fut = mgr.submit([4, 5], 24)
+            assert list(fut.result(120)) == ref
+            assert fleet.received_total() - base_recv == 1
+
+
+# ---------------------------------------------------------------------------
+# (c) liveness
+# ---------------------------------------------------------------------------
+class TestHeartbeatReap:
+    def test_heartbeat_silence_reaps_and_fails_over_zero_lost(self):
+        """A HUNG replica — heartbeats go silent while the main socket
+        still answers — is reaped on `heartbeat_timeout`: `alive`
+        decays, the control tick's probe crashes it, its in-flight
+        requests fail over to survivors, every stream bit-identical
+        to solo, zero lost."""
+        lm = _lm()
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+        refs = {tuple(p): list(lm.generate(p, 32)) for p in prompts}
+        fleet = _WireFleet(lm, n_replicas=2, heartbeat_timeout=0.4)
+        with fleet as mgr:
+            victim = mgr.replicas[0]
+            futs = [mgr.submit(prompts[i % 3], 32) for i in range(6)]
+            fleet.wrappers[victim].pause_heartbeats = True
+            deadline = time.monotonic() + 10
+            while mgr.replica(victim).alive:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("alive never decayed")
+                time.sleep(0.02)
+            tick = mgr.control_tick()
+            assert tick["states"][victim] == "dead"
+            assert tick["n_replicas"] == 2          # backfilled
+            for i, f in enumerate(futs):
+                assert list(f.result(120)) == refs[tuple(prompts[i % 3])]
+            snap = mgr.fleet_snapshot()
+            assert snap["fleet_replica_dead"] == 1
+
+    def test_retry_exhaustion_fails_pending_loudly(self):
+        """The wire dies for good (listener closed, replica gone):
+        bounded reconnect exhausts and every pending future fails
+        LOUDLY with ReplicaDeadError — never a silent hang."""
+        lm = _lm()
+        srv = ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8, 16),
+                                     metrics=ServingMetrics(name="i0"),
+                                     instance="i0")
+        rs = ReplicaServer(srv)
+        rr = RemoteReplica(
+            "127.0.0.1", rs.port, name="i0", heartbeat_interval=None,
+            retry_policy=RetryPolicy(max_retries=1, base_delay=0.01,
+                                     jitter=0.0))
+        try:
+            rr.generate([1, 2, 3], 2, timeout=120)      # warm + healthy
+            fut = rr.submit([1, 2, 3], 56)
+            # the wire dies mid-stream AND the listener is gone, so
+            # reconnect gets ECONNREFUSED until the budget exhausts
+            rs.close(stop_server=False)
+            rr._sever_main()
+            with pytest.raises(Exception) as ei:
+                fut.result(30)
+            assert isinstance(ei.value, ReplicaDeadError)
+            assert not rr.alive
+            with pytest.raises(ReplicaDeadError):
+                rr.submit([1, 2, 3], 2)
+        finally:
+            rr.kill()
+            srv.kill()
+            rs.close(stop_server=False)
+
+
+# ---------------------------------------------------------------------------
+# (d) migration over the wire
+# ---------------------------------------------------------------------------
+class TestWireMigration:
+    def _inflight_victim(self, mgr, timeout=0.5):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with mgr._lock:
+                for r in mgr._replicas.values():
+                    if r.inflight:
+                        return r.name
+            time.sleep(0.002)
+        raise TimeoutError("no in-flight replica found")
+
+    def test_scale_down_ships_artifact_bytes_bit_identical(self):
+        """The PR 11 bit-identity pin across a REAL socket: scale_down
+        drains a wire replica, the decode-phase request leaves as
+        `RequestArtifact` BYTES (`to_bytes` over the DRAIN frame),
+        lands on the survivor via `migrate_in`, and the caller's one
+        future resolves to exactly the uninterrupted stream."""
+        lm = _lm()
+        refs = {tuple(p): list(lm.generate(p, 56))
+                for p in ([1, 2, 3], [4, 5])}
+        fleet = _WireFleet(lm, paged=True, n_replicas=2, min_replicas=1)
+        with fleet as mgr:
+            futs = [mgr.submit([1, 2, 3], 56), mgr.submit([4, 5], 56)]
+            victim = self._inflight_victim(mgr)
+            mgr.scale_down(victim)
+            for f, p in zip(futs, ([1, 2, 3], [4, 5])):
+                assert list(f.result(120)) == refs[tuple(p)]
+            # at least one request really moved as an artifact (the
+            # other may have been queued/prefilling -> replayed)
+            migrated = 0
+            for n in mgr.replicas:
+                snap = mgr.replica(n).metrics.kind_snapshot()
+                migrated += (snap.get("migrated") or {}).get("value") or 0
+            assert migrated >= 1
+            assert mgr.fleet_snapshot()["fleet_replica_drained"] == 1
+
+    def test_refused_migration_degrades_to_replay_never_lost(self):
+        """Mid-rollout fleet: the survivor runs NEW params, so the
+        drained artifact's version tag is refused at `migrate_in`
+        (KVStateVersionError over the wire). The manager counts
+        `migrate_refused` and degrades to prompt replay on the
+        survivor — the caller's future resolves with the survivor's
+        (new-params) solo stream; nothing is lost."""
+        lm = _lm()
+        lm2 = _lm(seed=11)
+        ref_new = list(lm2.generate([1, 2, 3], 56))
+        fleet = _WireFleet(lm, paged=True, n_replicas=2, min_replicas=1)
+        with fleet as mgr:
+            fut = mgr.submit([1, 2, 3], 56)
+            victim = self._inflight_victim(mgr)
+            survivor = next(n for n in mgr.replicas if n != victim)
+            mgr.replica(survivor).swap(lm2)     # SWAP over the wire
+            mgr.scale_down(victim)
+            assert list(fut.result(120)) == ref_new
+            snap = mgr.fleet_snapshot()
+            assert snap["fleet_migrate_refused"] >= 1

@@ -114,7 +114,8 @@ def build_fleet_report(members, traces=None, trace_names=None,
 # operator reads off a fleet that misbehaved
 CONTROL_KEYS = ("fleet_replica_spawned", "fleet_replica_drained",
                 "fleet_replica_dead", "fleet_failover_resubmitted",
-                "fleet_canary_rollbacks")
+                "fleet_canary_rollbacks", "fleet_wire_reconnects",
+                "fleet_wire_retries", "fleet_migrate_refused")
 
 
 def format_fleet_report(report, top=20):

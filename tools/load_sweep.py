@@ -24,6 +24,7 @@ Run (CPU backend, no chip needed):
         [--chunked-prefill C] [--admission] [--overload-ab] \
         [--paged] [--speculate K] [--preempt] [--fleet N]
         [--fleet-control [--fleet-min A --fleet-max B]]
+        [--fleet-procs N]
 
 `--process onoff` keeps the same MEAN rate but bursts at 2x with a 50%
 duty cycle (the p99 stressor); `--process closed` reinterprets each
@@ -42,6 +43,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import sys
 import time
 
@@ -522,6 +524,240 @@ def sweep_fleet_control(rates, n_replicas=2, n_req=64, slo_ms=250.0,
     return body, snaps, merged
 
 
+def _replica_serve_main(argv):
+    """Child-process entry for `--fleet-procs` (hidden flag
+    `--replica-serve`): build the SAME deterministic model the parent
+    knows (fixed seed ⇒ identical weights ⇒ identical param
+    fingerprint across processes — migrations tag-check against it),
+    wrap one decode server in a `ReplicaServer`, publish the bound
+    port, and serve until the parent's STOP/KILL/DRAIN. A graceful
+    exit saves this process's own Chrome trace — the parent stitches
+    every replica's file into ONE merged timeline."""
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replica-serve", action="store_true")
+    ap.add_argument("--instance", required=True)
+    ap.add_argument("--port-file", required=True)
+    ap.add_argument("--trace-out", default=None)
+    ap.add_argument("--slo-ms", type=float, default=250.0)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--paged", action="store_true")
+    args = ap.parse_args(argv)
+    from deeplearning4j_tpu.obs import Tracer
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            ServingMetrics,
+                                            run_replica_server)
+    lm = _lm()
+    tr = Tracer(capacity=1 << 15, enabled=args.trace_out is not None,
+                instance=args.instance)
+    srv = ContinuousDecodeServer(
+        lm, slots=args.slots, prompt_buckets=(8, 16), max_queue=1024,
+        metrics=ServingMetrics(slo_target_ms=args.slo_ms,
+                               name=args.instance),
+        tracer=tr, instance=args.instance, admission=True,
+        default_deadline_ms=args.slo_ms, paged=args.paged, block_size=8)
+    run_replica_server(srv, port_file=args.port_file, tracer=tr,
+                       trace_out=args.trace_out)
+
+
+def sweep_fleet_procs(rates, n_replicas=2, n_req=64, slo_ms=250.0,
+                      seed=0, process="poisson", trace=True, slots=2,
+                      obs_per_rate=4, slice_s=0.2, fault_injector=None,
+                      inject_sever=True, paged=False,
+                      sever_site="serve.wire.stream"):
+    """The CROSS-PROCESS fleet arm (`--fleet-procs N`): every replica
+    is a REAL child process (`--replica-serve`) behind a
+    `serving.wire.RemoteReplica`, routed by the same `FleetManager`
+    the in-process sweeps use — the whole wire path (SUBMIT/STREAM
+    frames, SNAPSHOT-federated metrics, heartbeat liveness,
+    reconnect-with-dedup) under real arrivals.
+
+    After the rate rungs, the FAULT PHASE injects one socket sever at
+    `sever_site` (default: the result frame mid-stream) while a batch
+    of requests is in flight and pins the ISSUE 14 acceptance: every
+    admitted future resolves, and the faulted prompt's stream is
+    BIT-IDENTICAL to the same prompt served on the quiet fleet
+    (deterministic greedy ⇒ dedup re-delivery and failover replay are
+    indistinguishable from an undisturbed run). The record carries the
+    wire counters (`wire_reconnects`/`wire_retries`) so the sever is
+    visibly exercised, and the merged trace covers every replica
+    PROCESS (distinct pids in Perfetto).
+
+    Returns (body, per_instance_snaps, merged_trace_or_None)."""
+    import subprocess
+    import tempfile
+
+    from deeplearning4j_tpu.common.resilience import (FaultInjector,
+                                                      RetryPolicy)
+    from deeplearning4j_tpu.obs.fleet import merge_traces
+    from deeplearning4j_tpu.serving import (DecodeSizeMix, FleetManager,
+                                            RemoteReplica,
+                                            ServingMetrics,
+                                            build_schedule, run_load)
+    if fault_injector is None and inject_sever:
+        fault_injector = FaultInjector()
+    tmpdir = tempfile.mkdtemp(prefix="fleet_procs_")
+    here = os.path.abspath(__file__)
+    procs, trace_files = {}, {}
+
+    def launch(name):
+        port_file = os.path.join(tmpdir, f"{name}.port")
+        trace_out = (os.path.join(tmpdir, f"{name}.trace.json")
+                     if trace else None)
+        cmd = [sys.executable, here, "--replica-serve",
+               "--instance", name, "--port-file", port_file,
+               "--slo-ms", str(slo_ms), "--slots", str(slots)]
+        if paged:
+            # paged children make drains MIGRATE artifact bytes over
+            # the wire (non-paged replicas degrade drains to replay)
+            cmd.append("--paged")
+        if trace_out:
+            cmd += ["--trace-out", trace_out]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs[name] = subprocess.Popen(cmd, env=env)
+        trace_files[name] = trace_out
+        return port_file
+
+    def wait_port(name, port_file, timeout=300.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if os.path.exists(port_file):
+                return int(open(port_file).read().strip())
+            if procs[name].poll() is not None:
+                raise RuntimeError(
+                    f"replica process {name} exited rc="
+                    f"{procs[name].returncode} before binding")
+            time.sleep(0.05)
+        raise TimeoutError(f"replica {name} never published its port")
+
+    names = [f"i{k}" for k in range(int(n_replicas))]
+    # pre-launch every expected replica so the N jax imports + compiles
+    # overlap instead of serializing through the factory
+    ports = {n: launch(n) for n in names}
+
+    def factory(name):
+        port_file = ports.pop(name, None)
+        if port_file is None:
+            port_file = launch(name)        # backfill beyond the batch
+        port = wait_port(name, port_file)
+        return RemoteReplica(
+            "127.0.0.1", port, name=name,
+            retry_policy=RetryPolicy(max_retries=4, base_delay=0.05,
+                                     max_delay=0.5, jitter=0.0),
+            heartbeat_interval=0.1, fault_injector=fault_injector,
+            process=procs[name])
+
+    def warmup(srv):
+        # compile the child's prompt buckets + decode step off the
+        # serving clock, over the wire
+        for p in ([1, 2, 3, 4], list(range(1, 13))):
+            srv.generate(p, 4, deadline_ms=600_000, timeout=300)
+
+    mgr = FleetManager(factory, n_replicas=n_replicas, warmup=warmup,
+                       heartbeat_timeout=2.0,
+                       metrics=ServingMetrics(name="fleet"))
+    mix = DecodeSizeMix(((0.8, (3, 12), (4, 24)),
+                         (0.2, (8, 16), (24, 44))), vocab=96)
+    curve = []
+    try:
+        mgr.start()
+        for i, rate in enumerate(rates):
+            slice_n = max(2, int(n_req) // int(obs_per_rate),
+                          min(int(rate * slice_s), 400))
+            toks, dur, offered = 0, 0.0, None
+            admitted = completed = failed = 0
+            for k in range(int(obs_per_rate)):
+                sched = build_schedule(
+                    _process_for(process, rate), mix, slice_n,
+                    seed=seed + i * 1000 + k)
+                if offered is None:
+                    offered = sched.offered_tokens_per_sec()
+                pt = run_load(mgr, sched, metrics=None)
+                toks += pt["tokens_out"]
+                dur += float(pt["duration_s"])
+                admitted += pt["admitted"]
+                completed += pt["completed"]
+                failed += pt["failed"]
+                mgr.control_tick()          # the health/liveness probe
+            curve.append({
+                "offered_rate_target": rate,
+                "tokens_per_sec": fmt(toks / dur if dur else 0.0, 1),
+                "tokens_out": toks,
+                "admitted": admitted, "completed": completed,
+                "failed": failed,
+                "_offered": offered,
+                "_achieved": toks / dur if dur else 0.0,
+            })
+        # -- FAULT PHASE: one injected socket sever mid-stream --------
+        fault_rec = None
+        if inject_sever and fault_injector is not None:
+            # quiet-fleet references first: deterministic greedy on
+            # identical weights makes every replica's stream for a
+            # prompt THE stream, so the fault batch must reproduce
+            # them bit-for-bit no matter which request the sever hits
+            prompts = [[1, 2, 3]] + [[4 + j, 5, 6] for j in range(5)]
+            refs = [list(mgr.generate(p, 24, deadline_ms=600_000,
+                                      timeout=300)) for p in prompts]
+            base = mgr.fleet_snapshot()
+            fault_injector.plan(sever_site,
+                                on_call=fault_injector.calls(sever_site),
+                                sever=True, exc=None)
+            futs = [mgr.submit(p, 24, deadline_ms=600_000)
+                    for p in prompts]
+            results = [list(f.result(300)) for f in futs]  # ALL resolve
+            snap = mgr.fleet_snapshot()
+            fault_rec = {
+                "site": sever_site,
+                "severed": len(fault_injector.fired(sever_site)),
+                "all_futures_resolved": True,
+                "streams_bit_identical": results == refs,
+                "wire_reconnects": snap["fleet_wire_reconnects"]
+                - base["fleet_wire_reconnects"],
+                "wire_retries": snap["fleet_wire_retries"]
+                - base["fleet_wire_retries"],
+            }
+        final_snap = mgr.fleet_snapshot()
+        snaps = {n: mgr.replica(n).metrics.snapshot()
+                 for n in mgr.replicas}
+        pids = {n: procs[n].pid for n in procs}
+    finally:
+        mgr.stop(timeout=120)
+        for p in procs.values():        # belt and braces
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=30)
+            except Exception:   # noqa: BLE001
+                p.kill()
+    merged = None
+    if trace:
+        saved = []
+        tnames = []
+        for n, path in trace_files.items():
+            if path and os.path.exists(path):
+                with open(path) as fh:
+                    saved.append(json.load(fh))
+                tnames.append(n)
+        if saved:
+            merged = merge_traces(saved, names=tnames)
+    # the scratch dir (port files + per-replica traces) is spent once
+    # the traces are merged — repeated sweeps must not accumulate it
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    body = {"server": "fleet_procs", "n_replicas": int(n_replicas),
+            "process": process, "paged": bool(paged),
+            "config": f"FleetManager over {n_replicas} replica "
+                      f"PROCESSES (serving/wire.py), slots={slots}, "
+                      f"cache={'paged bs=8' if paged else 'fixed-slot'}"
+                      f", admission deadline={slo_ms:g}ms, heartbeat "
+                      f"timeout 2s, {obs_per_rate} slices/rate",
+            "unit": "generated tokens/sec (fleet)",
+            "curve": curve, "knee": _knee(curve),
+            "fleet": final_snap,
+            "replica_pids": pids,
+            "wire_fault": fault_rec}
+    return body, snaps, merged
+
+
 def sweep_microbatch(rates, n_req=96, slo_ms=50.0, seed=0,
                      process="poisson", tracer=None):
     """Rate ladder over the InferenceServer (requests/s domain)."""
@@ -640,7 +876,7 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
               speculate_k=None, preempt=False, fleet=0,
               fleet_obs_per_rate=6, fleet_slice_s=0.25,
               fleet_control=False, fleet_injector=None,
-              fleet_min=None, fleet_max=None):
+              fleet_min=None, fleet_max=None, fleet_procs=0):
     """Drive the sweep(s) and (optionally) write the combined
     obs_report (JSON + text + Chrome trace). Returns the results list.
     The tier-1 smoke test calls this with tiny parameters (and once
@@ -655,6 +891,18 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
     every rate rung carries the autoscale decision sequence."""
     from deeplearning4j_tpu.obs import Tracer, decompose
     fleet = int(fleet or 0)
+    fleet_procs = int(fleet_procs or 0)
+    if fleet_procs == 1:
+        raise ValueError("--fleet-procs needs N >= 2 replica processes "
+                         "(a fleet of one is the plain decode sweep — "
+                         "drop the flag)")
+    if fleet_procs and (fleet or fleet_control or overload_ab):
+        raise ValueError("--fleet-procs is its own scenario: drop "
+                         "--fleet/--fleet-control/--overload-ab")
+    if fleet_procs and server not in ("decode", "both"):
+        raise ValueError("--fleet-procs needs --server decode (or "
+                         "both): the wire fleet drives DECODE replica "
+                         "processes")
     if fleet == 1:
         raise ValueError("--fleet needs N >= 2 replicas (a fleet of "
                          "one is the plain decode sweep — drop the "
@@ -674,10 +922,18 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
                          "controlled server against one baseline — "
                          "run them as separate sweeps")
     tracer = (Tracer(capacity=1 << 16, enabled=True)
-              if trace and not fleet_mode else None)
+              if trace and not (fleet_mode or fleet_procs) else None)
     fleet_trace = None
     results, snaps = [], {}
-    if fleet_mode and fleet_control:
+    if fleet_procs >= 2:
+        body, inst_snaps, fleet_trace = sweep_fleet_procs(
+            rates, n_replicas=fleet_procs, n_req=n_req, slo_ms=slo_ms,
+            seed=seed, process=process, trace=trace, paged=paged,
+            obs_per_rate=fleet_obs_per_rate, slice_s=fleet_slice_s,
+            fault_injector=fleet_injector)
+        results.append(body)
+        snaps.update({f"fleet_{n}": s for n, s in inst_snaps.items()})
+    elif fleet_mode and fleet_control:
         body, inst_snaps, fleet_trace = sweep_fleet_control(
             rates, n_replicas=fleet, n_req=n_req, slo_ms=slo_ms,
             seed=seed, process=process, trace=trace,
@@ -778,6 +1034,9 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
 
 
 def main():
+    if "--replica-serve" in sys.argv:
+        # child-process mode: this invocation IS one wire replica
+        return _replica_serve_main(sys.argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--server", default="both",
                     choices=("decode", "microbatch", "both"))
@@ -823,6 +1082,15 @@ def main():
                     help="fleet-control floor (default: the initial N)")
     ap.add_argument("--fleet-max", type=int, default=None,
                     help="fleet-control ceiling (default: N + 4)")
+    ap.add_argument("--fleet-procs", type=int, default=0, metavar="N",
+                    help="drive N replica PROCESSES behind the serving "
+                         "wire (serving/wire.py): each replica is a "
+                         "real child process serving the socket "
+                         "protocol, routed by the FleetManager; after "
+                         "the rate rungs one socket sever is injected "
+                         "mid-stream and the record pins zero lost "
+                         "requests + bit-identical streams + the "
+                         "merged trace covering every replica pid")
     ap.add_argument("--preempt", action="store_true",
                     help="durable-KV preemption (implies --paged): the "
                          "mix's long tail submits as a spillable batch "
@@ -859,7 +1127,8 @@ def main():
                         preempt=args.preempt, fleet=args.fleet,
                         fleet_control=args.fleet_control,
                         fleet_min=args.fleet_min,
-                        fleet_max=args.fleet_max)
+                        fleet_max=args.fleet_max,
+                        fleet_procs=args.fleet_procs)
     for r in results:
         print(json.dumps(r))
     print(json.dumps({"elapsed_s": fmt(time.perf_counter() - t0, 1),
