@@ -405,6 +405,7 @@ class PSServer:
                                     "ps server: duplicate push from worker"
                                     " %d (seq %d) — already applied, "
                                     "acking without re-applying", wid, seq)
+                                # graftlint: disable=lock-discipline -- the dup-ack stays inside the per-worker lock on purpose: releasing before acking would let a THIRD retry interleave between dedup-check and ack, and per-worker serialization is exactly what makes the seq dedup sound
                                 _send_msg(conn, OP_PUSH, _ACK)
                                 continue
                             leaves, off = unpack_leaves(payload, 32)

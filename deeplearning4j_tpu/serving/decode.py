@@ -215,30 +215,10 @@ def _param_fingerprint(aux, blocks):
     return h.hexdigest()[:16]
 
 
-def _fail_future(fut, exc):
-    """set_exception unless the caller already resolved/cancelled it.
-    The done() pre-check alone races a concurrent cancel() — and several
-    call sites run OUTSIDE _loop_once's try, where an InvalidStateError
-    would kill the serve thread permanently. Returns True when the
-    exception was delivered (callers count metrics only then)."""
-    try:
-        if not fut.done():
-            fut.set_exception(exc)
-            return True
-    except cf.InvalidStateError:
-        pass
-    return False
-
-
-def _resolve_future(fut, result):
-    """set_result, tolerating a concurrently cancel()ed future."""
-    try:
-        if not fut.done():
-            fut.set_result(result)
-            return True
-    except cf.InvalidStateError:
-        pass
-    return False
+# cancel-race-safe future delivery: the ONE implementation now lives
+# in server.py (the base loop's raced-stop paths need it too); the
+# names stay importable from here (serving/fleet.py does)
+from .server import _fail_future, _resolve_future  # noqa: E402
 
 
 class _Wake:
@@ -853,9 +833,9 @@ class ContinuousDecodeServer(_RequestLoop):
             tr.instant("serve.enqueue", cat="serve",
                        track=f"req-{req.req_id}", trace_id=req.req_id)
         if not self._running:
-            if not req.future.done():
-                req.future.set_exception(
-                    ServerClosedError("server stopped during submit"))
+            # _fail_future: cancel-race-safe (the base _enqueue rule)
+            _fail_future(req.future, ServerClosedError(
+                "server stopped during submit"))
             raise ServerClosedError("server stopped during submit")
         return req.future
 
@@ -922,9 +902,9 @@ class ContinuousDecodeServer(_RequestLoop):
             tr.instant("serve.enqueue", cat="serve",
                        track=f"req-{req.req_id}", trace_id=req.req_id)
         if not self._running:
-            if not req.future.done():
-                req.future.set_exception(
-                    ServerClosedError("server stopped during submit"))
+            # _fail_future: cancel-race-safe (the base _enqueue rule)
+            _fail_future(req.future, ServerClosedError(
+                "server stopped during submit"))
             raise ServerClosedError("server stopped during submit")
         return req.future
 

@@ -85,12 +85,12 @@ import time
 
 from ..common.resilience import RetryPolicy
 from ..obs.fleet import SHED_KEYS, AutoscaleSignal, FleetView
-from .decode import _fail_future, _resolve_future
 from .kvstate import KVStateError
 from .metrics import ServingMetrics
 from .server import (DeadlineExceededError, ReplicaDeadError,
                      ServerClosedError, ServerOverloadedError,
-                     UnhealthyOutputError, _ParamsView)
+                     UnhealthyOutputError, _fail_future, _ParamsView,
+                     _resolve_future)
 
 log = logging.getLogger(__name__)
 
@@ -563,22 +563,30 @@ class FleetManager:
         log.info("replica %s spawned (%d alive)", name, self.n_alive())
         return name
 
-    def _tombstone(self, rec):
+    def _tombstone_counters(self, rec):
         """Counters-only snapshot of a departing replica: federated
         counters stay MONOTONE after the instance stops existing,
         while its stale gauges/summaries (capacity, occupancy) drop
-        out of the live read-outs the detector consumes. Written
-        ATOMICALLY with the replica's removal from `_replicas` (under
-        the lock, BEFORE the slow kill/drain) and refreshed after —
-        a concurrent fleet_view() must never observe the replica in
-        neither map, which would read as every counter dipping by its
-        whole history (a fake counter reset to the detector)."""
+        out of the live read-outs the detector consumes. FETCH-ONLY —
+        and always called OUTSIDE `self._lock`: a REMOTE replica's
+        `kind_snapshot()` is a wire round-trip (serving/wire.py
+        `_fetch_snapshot`, seconds on a wedged wire), and holding the
+        manager lock through it would stall every router/probe/
+        federation path on one dead replica's socket (the graftlint
+        lock-discipline finding this split fixed)."""
         try:
             snap = rec.server.metrics.kind_snapshot()
         except Exception:           # noqa: BLE001 — dead is dead
             snap = {}
-        self._tombstones[rec.name] = {
-            k: v for k, v in snap.items() if v.get("kind") == "counter"}
+        return {k: v for k, v in snap.items()
+                if v.get("kind") == "counter"}
+
+    def _install_tombstone(self, rec, counters):
+        """Write half of the tombstone (the `_tombstones` map is only
+        ever touched under the lock — a reader iterating it must
+        never race a bare-dict write from a crash path)."""
+        with self._lock:
+            self._tombstones[rec.name] = counters
 
     def _crash(self, name, reason="injected fault"):
         """Replica death: fail it loudly, tombstone its counters, and
@@ -586,12 +594,22 @@ class FleetManager:
         replay. Idempotent."""
         with self._lock:
             rec = self._replicas.get(name)
-            if rec is None:
-                return
+        if rec is None:
+            return
+        # counters fetched BEFORE the removal and OUTSIDE the lock:
+        # the replica stays visible in `_replicas` while the (possibly
+        # wire-crossing) snapshot runs, so a concurrent fleet_view()
+        # still federates it live — never in neither map, which would
+        # read as every counter dipping by its whole history (a fake
+        # counter reset to the detector)
+        counters = self._tombstone_counters(rec)
+        with self._lock:
+            if self._replicas.get(name) is not rec:
+                return              # raced another crash/drain
             del self._replicas[name]
-            # tombstone in the SAME critical section as the removal:
-            # no reader window where the replica is in neither map
-            self._tombstone(rec)
+            # tombstone installed in the SAME critical section as the
+            # removal: no reader window between the two maps
+            self._tombstones[name] = counters
             doomed = []
             for fut, req in list(self._live.items()):
                 if req.replica == name:
@@ -600,7 +618,10 @@ class FleetManager:
         rec.state = DEAD
         self.metrics.count("replica_dead")
         rec.server.kill()           # fails remaining futures loudly
-        self._tombstone(rec)        # refresh: the final counter values
+        # refresh with the final post-kill values (counters only grow
+        # — and a remote's snapshot falls back to its last good cache
+        # — so the refresh keeps monotonicity)
+        self._install_tombstone(rec, self._tombstone_counters(rec))
         log.warning("replica %s dead (%s); %d in-flight requests "
                     "failing over", name, reason, len(doomed))
         for fut, req in doomed:
@@ -654,13 +675,24 @@ class FleetManager:
         except BaseException as e:  # noqa: BLE001 — degrade to crash
             log.exception("drain of %s failed; treating as crash",
                           rec.name)
+            # fetch outside the lock, install atomically with the
+            # removal (the _crash rule — see _tombstone_counters); a
+            # concurrent _crash that already removed + killed this
+            # replica OWNS the tombstone: overwriting its final
+            # post-kill counters with this path's (possibly stale)
+            # fetch would read as a counter dip to the detector
+            counters = self._tombstone_counters(rec)
             with self._lock:
-                self._replicas.pop(rec.name, None)
-                self._tombstone(rec)    # atomic with the removal
+                raced = self._replicas.get(rec.name) is not rec
+                if not raced:
+                    del self._replicas[rec.name]
+                    self._tombstones[rec.name] = counters
             rec.state = DEAD
-            self.metrics.count("replica_dead")
-            rec.server.kill()
-            self._tombstone(rec)        # refresh: final values
+            if not raced:
+                self.metrics.count("replica_dead")
+                rec.server.kill()
+                self._install_tombstone(    # refresh: final values
+                    rec, self._tombstone_counters(rec))
             for fut, req in handoff.items():
                 # same settle-first rule as every handoff path: a
                 # result or PROPAGATE verdict that landed before the
@@ -682,9 +714,16 @@ class FleetManager:
             # infrastructure leftovers replay
             if not self._settle_handoff(fut, req):
                 self._resubmit(req)
+        # the drained replica is stopped: its snapshot is a local (or
+        # stale-cached) read, but the fetch still runs outside the
+        # lock — the _crash rule, uniformly; and like the crash-
+        # degrade path above, a _crash that raced the drain already
+        # owns the removal AND the (newer, post-kill) tombstone
+        counters = self._tombstone_counters(rec)
         with self._lock:
-            self._replicas.pop(rec.name, None)
-            self._tombstone(rec)        # atomic with the removal
+            if self._replicas.get(rec.name) is rec:
+                del self._replicas[rec.name]
+                self._tombstones[rec.name] = counters
         rec.state = DEAD
         self.metrics.count("replica_drained")
         log.info("replica %s drained (%d migrated, %d replayed; %d "

@@ -103,6 +103,32 @@ class ReplicaDeadError(ServingError):
     future nobody will resolve."""
 
 
+def _fail_future(fut, exc):
+    """set_exception unless the caller already resolved/cancelled it.
+    The done() pre-check alone races a concurrent cancel() — and several
+    call sites run OUTSIDE a serve loop's try, where an InvalidStateError
+    would kill the serve thread permanently. Returns True when the
+    exception was delivered (callers count metrics only then)."""
+    try:
+        if not fut.done():
+            fut.set_exception(exc)
+            return True
+    except cf.InvalidStateError:
+        pass
+    return False
+
+
+def _resolve_future(fut, result):
+    """set_result, tolerating a concurrently cancel()ed future."""
+    try:
+        if not fut.done():
+            fut.set_result(result)
+            return True
+    except cf.InvalidStateError:
+        pass
+    return False
+
+
 class _ParamsView:
     """Duck-typed (aux, blocks) holder every `swap()` accepts — the
     fleet manager's rollback snapshot / spawn carrier and the serving
@@ -245,9 +271,11 @@ class _RequestLoop:
             # raced stop(): the loop's final drain may already have run,
             # leaving this request in a dead queue — fail it HERE so no
             # caller ever blocks on a future nobody will resolve
-            if not req.future.done():
-                req.future.set_exception(
-                    ServerClosedError("server stopped during submit"))
+            # (_fail_future: a concurrent cancel() must not turn the
+            # loud shed into an InvalidStateError)
+            _fail_future(req.future,
+                         ServerClosedError("server stopped during "
+                                           "submit"))
             raise ServerClosedError("server stopped during submit")
         return req.future
 
@@ -258,8 +286,7 @@ class _RequestLoop:
                 r = self._q.get_nowait()
             except queue.Empty:
                 return
-            if not r.future.done():
-                r.future.set_exception(exc)
+            if _fail_future(r.future, exc):
                 self.metrics.count("failed")
 
     def _serve_loop(self):

@@ -106,7 +106,8 @@ from .kvstate import (KVStateError, KVStateVersionError, RequestArtifact)
 from .server import (DeadlineExceededError, ReplicaDeadError,
                      RequestDrainedError, RequestMigratedError,
                      ServerClosedError, ServerOverloadedError,
-                     ServingError, UnhealthyOutputError, _ParamsView)
+                     ServingError, UnhealthyOutputError, _fail_future,
+                     _ParamsView)
 
 log = logging.getLogger(__name__)
 
@@ -235,6 +236,7 @@ class _Conn:
 
     def send(self, op, hdr, blob=b""):
         with self.wlock:
+            # graftlint: disable=lock-discipline -- wlock is this connection's dedicated write mutex (frame interleaving guard); it never nests another lock and a stalled peer blocks only this connection's writers
             _send_frame(self.sock, op, hdr, blob)
 
 
@@ -449,6 +451,7 @@ class ReplicaServer:
         if frame is None:
             return False
         with conn.wlock:
+            # graftlint: disable=lock-discipline -- the _Conn.send write-mutex rule: cached frames bypass the header re-encode but must still serialize with the sender thread's frames on this socket
             conn.sock.sendall(frame)
         return True
 
@@ -935,8 +938,16 @@ class RemoteReplica:
         oid = self._mint()
         p = _PendingOp(oid, OP_MIGRATE_OUT,
                        {"id": oid, "rid": rid, "timeout": timeout})
-        self._send_op(p, site="serve.wire.migrate")
-        hdr, blob = self._await_ack(p, timeout + self._op_timeout)
+        try:
+            self._send_op(p, site="serve.wire.migrate")
+            hdr, blob = self._await_ack(p, timeout + self._op_timeout)
+        except BaseException:
+            # a failed op must leave the registry: an unresolved
+            # pending entry is excluded from the done-op prune AND
+            # re-sent on every later reconnect, forever (graftlint
+            # future-hygiene triage, ISSUE 15)
+            self._forget(oid)
+            raise
         return RequestArtifact.from_bytes(blob)
 
     def drain(self, migrate=None, timeout=60.0):
@@ -950,8 +961,12 @@ class RemoteReplica:
         rid = self._mint()
         p = _PendingOp(rid, OP_DRAIN,
                        {"id": rid, "migrate": migrate, "timeout": timeout})
-        self._send_op(p, site="serve.wire.migrate")
-        hdr, blob = self._await_ack(p, timeout + self._op_timeout)
+        try:
+            self._send_op(p, site="serve.wire.migrate")
+            hdr, blob = self._await_ack(p, timeout + self._op_timeout)
+        except BaseException:
+            self._forget(rid)   # the migrate_out rule: a failed op
+            raise               # must never linger for resend
         migrated, replayed = [], []
         off = 0
         for m in hdr.get("migrated", ()):
@@ -993,8 +1008,12 @@ class RemoteReplica:
         rid = self._mint()
         p = _PendingOp(rid, OP_SWAP, {"id": rid},
                        blob=pack_leaves(leaves))
-        self._send_op(p)
-        self._await_ack(p)
+        try:
+            self._send_op(p)
+            self._await_ack(p)
+        except BaseException:
+            self._forget(rid)   # the migrate_out rule: a failed op
+            raise               # must never linger for resend
 
     def kill(self):
         """Abrupt replica death from this side: best-effort KILL frame
@@ -1008,7 +1027,15 @@ class RemoteReplica:
             with self._conn_lock:
                 sock = self._sock
             if sock is not None:
+                # BOUND the best-effort frame: a peer with a full TCP
+                # buffer would otherwise block this sendall forever —
+                # kill() is the fleet's crash verb and must never
+                # wedge on the replica it is crashing. Disturbing the
+                # reader thread with the timeout is fine: the local
+                # teardown below severs this socket anyway.
+                sock.settimeout(5.0)
                 with self._wlock:
+                    # graftlint: disable=lock-discipline -- best-effort frame on the shared write mutex, bounded by the settimeout above; the teardown below severs the socket regardless
                     _send_frame(sock, OP_KILL, {"id": self._mint()})
         except OSError:
             pass
@@ -1065,10 +1092,12 @@ class RemoteReplica:
     # -- internals -----------------------------------------------------
     def _fetch_snapshot(self):
         """The SNAPSHOT op: one kind snapshot off the replica (the
-        `_RemoteMetrics` refresh path). TIGHT timeout: the crash
-        path's tombstone refresh runs under the manager lock, and a
-        wedged wire must cost seconds there, not the op default —
-        the stale-cache fallback makes a miss harmless."""
+        `_RemoteMetrics` refresh path). TIGHT timeout: the fleet
+        manager's tombstone fetches call this on the crash/drain-
+        handling thread — outside the manager lock since ISSUE 15,
+        but failover delivery still waits behind it — so a wedged
+        wire must cost seconds, not the op default; the stale-cache
+        fallback makes a miss harmless."""
         self._check_usable()
         rid = self._mint()
         p = _PendingOp(rid, OP_SNAPSHOT, {"id": rid})
@@ -1080,12 +1109,37 @@ class RemoteReplica:
         return hdr.get("snapshot") or {}
 
     def _check_usable(self):
+        exc = self._usable_exc()
+        if exc is not None:
+            raise exc
+
+    def _usable_exc(self):
+        """The named error a dead/closed replica owes its callers
+        (None while usable) — shared by the submit-time check and the
+        raced-teardown delivery paths, so the two can never drift."""
         if self._dead:
-            raise ReplicaDeadError(
+            return ReplicaDeadError(
                 f"remote replica {self.instance!r} is dead"
                 + (f" ({self._dead_exc})" if self._dead_exc else ""))
         if self._closed:
-            raise ServerClosedError("remote replica is closed")
+            return ServerClosedError("remote replica is closed")
+        return None
+
+    def _fail_op(self, p, exc):
+        """Resolve one pending op's futures with `exc` (idempotent,
+        cancel-race-safe via the shared `_fail_future`): the loud-
+        failure delivery every teardown path funnels through — a
+        registered op must NEVER be left for its caller to time out
+        on."""
+        for fut in (p.ack, p.stream):
+            if fut is not None:
+                _fail_future(fut, exc)
+
+    def _fail_pending(self, exc):
+        with self._plock:
+            pend = list(self._pending.values())
+        for p in pend:
+            self._fail_op(p, exc)
 
     def _mint(self):
         return f"{self._client_id or 'c?'}:{next(self._ids)}"
@@ -1129,13 +1183,16 @@ class RemoteReplica:
                 return
             if self._closed or self._dead:
                 raise ServerClosedError("remote replica is closed")
+            # graftlint: disable=lock-discipline -- the dial runs under _conn_lock BY DESIGN: the socket must not publish until HELLO + resends complete, and every contender (reconnector, lazy dials) needs the dialed socket anyway; the connect itself is bounded by connect_timeout
             sock = socket.create_connection(
                 (self._host, self._port), timeout=self._connect_timeout)
             sock.settimeout(None)
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
             try:
+                # graftlint: disable=lock-discipline -- the dial-under-_conn_lock rule above: HELLO must complete before the socket publishes
                 _send_frame(sock, OP_HELLO,
                             {"client_id": self._client_id})
+                # graftlint: disable=lock-discipline -- the dial-under-_conn_lock rule above: HELLO must complete before the socket publishes
                 op, hdr, _ = _recv_frame(sock)
                 if op != OP_HELLO:
                     raise WireProtocolError(
@@ -1165,6 +1222,7 @@ class RemoteReplica:
                     # never steal the result back to the dead socket
                     p.attempt += 1
                     p.hdr["attempt"] = p.attempt
+                    # graftlint: disable=lock-discipline -- the dial-under-_conn_lock rule above: in-flight frames must resend before the socket publishes, or a racing op could interleave ahead of them
                     _send_frame(sock, p.op, p.hdr, p.blob)
             except BaseException:
                 _close_sock(sock)
@@ -1215,6 +1273,10 @@ class RemoteReplica:
             attempt = 0
             while True:
                 if self._closed or self._dead:
+                    # ops that registered after _shutdown_local's
+                    # sweep would otherwise wait out their timeouts —
+                    # the teardown owes them the loud failure
+                    self._fail_pending(self._usable_exc())
                     return
                 with self._plock:
                     waiting = any(not p.done
@@ -1223,6 +1285,7 @@ class RemoteReplica:
                     # nothing in flight: dial lazily at the next op
                     return
                 try:
+                    # graftlint: disable=lock-discipline -- _rc_lock is the single-reconnector latch (acquired non-blocking: a contender returns instantly rather than waiting); serializing the dial IS its job
                     self._dial_once()
                     return
                 except (ConnectionError, OSError) as e:
@@ -1235,6 +1298,7 @@ class RemoteReplica:
                     log.warning(
                         "wire to %s broken (%s) — reconnect attempt %d "
                         "in %.2fs", self.instance, cause, attempt, d)
+                    # graftlint: disable=lock-discipline -- the reconnect backoff sleeps inside the single-reconnector latch on purpose: contenders never block on it (non-blocking acquire), and exactly one thread may pace the retries
                     time.sleep(d)
         finally:
             self._rc_lock.release()
@@ -1251,19 +1315,7 @@ class RemoteReplica:
         self._dead = self._dead or dead
         self._hb_stop.set()
         self._sever_main()
-        with self._plock:
-            pend = list(self._pending.values())
-        for p in pend:
-            if not p.ack.done():
-                try:
-                    p.ack.set_exception(exc)
-                except cf.InvalidStateError:
-                    pass
-            if p.stream is not None and not p.stream.done():
-                try:
-                    p.stream.set_exception(exc)
-                except cf.InvalidStateError:
-                    pass
+        self._fail_pending(exc)
 
     def _reap_process(self, timeout):
         proc = self._process
@@ -1301,9 +1353,11 @@ class RemoteReplica:
                     # lazy dial: resends skip this op (p.sent False),
                     # so the frame below is its FIRST copy — never a
                     # double-send with a spurious wire_retries
+                    # graftlint: disable=lock-discipline -- the dial-under-_conn_lock rule (see _dial_once): every path that needs the socket must wait for the dial regardless
                     self._dial_once()
                 sock = self._sock
             with self._wlock:
+                # graftlint: disable=lock-discipline -- _wlock is the main socket's dedicated write mutex (the _Conn.send rule, client side); it never nests another lock
                 _send_frame(sock, p.op, p.hdr, p.blob)
             p.sent = True
             if site is not None and self._injector is not None:
@@ -1313,6 +1367,16 @@ class RemoteReplica:
             # eligible for resend (dedup absorbs the may-have-arrived
             # half) and let the reconnector take it from here
             p.sent = True
+            dead_exc = self._usable_exc()
+            if dead_exc is not None:
+                # a stop()/kill() raced past the submit-time check:
+                # no reconnector is coming (it exits on closed/dead),
+                # and _shutdown_local's sweep may have run BEFORE this
+                # op registered — fail it loudly HERE instead of
+                # stranding the caller until its op timeout
+                # (graftlint future-hygiene triage, ISSUE 15)
+                self._fail_op(p, dead_exc)
+                return
             t = threading.Thread(target=self._maybe_reconnect, args=(e,),
                                  name="wire-reconnect", daemon=True)
             t.start()

@@ -1,0 +1,55 @@
+"""graftlint fixture: blocking calls under a held lock (seeded bad).
+
+Never imported — tests/test_analyze.py parses it and asserts the
+lock-discipline pass reports exactly the seeded findings.
+"""
+import queue
+import socket
+import threading
+import time
+
+
+class BlockingUnderLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue(4)
+        self._sock = socket.socket()
+
+    def bad_send_under_lock(self):
+        with self._lock:
+            self._sock.sendall(b"payload")
+
+    def bad_sleep_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)
+
+    def bad_join_under_lock(self):
+        with self._lock:
+            self._q.join()
+
+    def bad_transitive_under_lock(self):
+        with self._lock:
+            self.blocking_helper()
+
+    def blocking_helper(self):
+        self._q.get(timeout=1.0)
+
+    def ok_send_outside_lock(self):
+        with self._lock:
+            depth = self._q.qsize()
+        self._sock.sendall(str(depth).encode())
+
+    def ok_callback_not_scanned(self):
+        with self._lock:
+            cb = lambda: self._sock.sendall(b"later")  # noqa: E731
+        return cb
+
+    def suppressed_send(self):
+        with self._lock:
+            # graftlint: disable=lock-discipline -- fixture: the justified-suppression round-trip case
+            self._sock.sendall(b"x")
+
+    def suppressed_without_reason(self):
+        with self._lock:
+            # graftlint: disable=lock-discipline
+            self._sock.sendall(b"y")

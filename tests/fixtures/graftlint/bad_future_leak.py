@@ -1,0 +1,48 @@
+"""graftlint fixture: leaked / exception-swallowed Futures (seeded
+bad) next to clean controls."""
+import concurrent.futures as cf
+
+
+def leaky_branch(cond):
+    fut = cf.Future()
+    if cond:
+        fut.set_result(1)
+    # fall-through with `fut` possibly pending, never handed off
+
+
+def leaky_return(cond):
+    fut = cf.Future()
+    if cond:
+        return fut
+    return None          # pending future dropped on this path
+
+
+def swallowed(registry, work):
+    fut = cf.Future()
+    try:
+        fut.set_result(work())
+    except ValueError:
+        pass             # swallowed while `fut` may be pending...
+    registry.append(fut)  # ...and it still escapes to a waiter
+
+
+def clean_resolved(cond):
+    fut = cf.Future()
+    if cond:
+        fut.set_result(1)
+    else:
+        fut.set_exception(RuntimeError("no"))
+    return fut
+
+
+def clean_escapes(sink):
+    fut = cf.Future()
+    sink.append(fut)     # ownership transferred at birth
+    return fut
+
+
+def clean_raise_path(work):
+    fut = cf.Future()
+    value = work()       # a raise here exits WITHOUT stranding anyone
+    fut.set_result(value)
+    return fut

@@ -1,0 +1,34 @@
+"""graftlint fixture: a lock acquisition-order cycle (seeded bad)."""
+import threading
+
+
+class LockCycle:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                return 2
+
+
+class NoCycle:
+    def __init__(self):
+        self._x = threading.Lock()
+        self._y = threading.Lock()
+
+    def xy_only(self):
+        with self._x:
+            with self._y:
+                return 3
+
+    def xy_again(self):
+        with self._x:
+            with self._y:
+                return 4

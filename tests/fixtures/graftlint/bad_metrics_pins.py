@@ -1,0 +1,7 @@
+"""graftlint fixture: the pin tuple, with one key no source
+registers ('ghost_key' — the seeded unregistered-pin drift)."""
+
+
+class TestPins:
+    PINNED_KEYS = ("alpha_total", "beta_total", "gamma_last", "delta",
+                   "ghost_key")

@@ -1,0 +1,19 @@
+"""graftlint fixture: metrics source with an eager-creation loop, a
+snapshot surface, and (vs bad_metrics_pins.py) seeded drift both
+ways."""
+
+
+class ServingMetrics:
+    def __init__(self):
+        for key in ("alpha_total", "beta_total"):
+            self.count(key, 0)
+
+    def count(self, key, n=1):
+        pass
+
+    def snapshot(self):
+        out = {}
+        out["gamma_last"] = 1
+        out.setdefault("delta", 0)
+        out.setdefault("epsilon", 0)    # always-present but unpinned
+        return out

@@ -1,0 +1,9 @@
+"""graftlint fixture: a module in a declared stdlib-only layer that
+imports device code (seeded layering violation)."""
+import threading  # noqa: F401
+
+import jax  # noqa: F401  -- the seeded violation
+
+
+def measure():
+    return threading.active_count()

@@ -591,14 +591,21 @@ class TestAutoscaleSignal:
 # ---------------------------------------------------------------------------
 class TestFleetCostPins:
     def test_fleet_module_never_imports_device_code(self):
+        """Thin wrapper over the graftlint layering pass since ISSUE
+        15: layers.toml's 'obs-stdlib-only' rule (which covers
+        obs/fleet.py) is the single source of truth — the pass
+        resolves relative and function-local imports the old regex
+        pin could only approximate. The module-lives-in-obs assert
+        stays: the rule matches by path, so moving the file out of
+        obs/ would silently drop it from the layer."""
         import os
-        import re
         import deeplearning4j_tpu.obs.fleet as fleet_mod
-        src = open(fleet_mod.__file__.replace(".pyc", ".py")).read()
-        bad = re.compile(r"^\s*(?:import|from)\s+(?:jax|numpy)\b",
-                         re.MULTILINE)
-        assert bad.search(src) is None
+        from tools.analyze import check_layer_rules
         assert os.path.dirname(fleet_mod.__file__).endswith("obs")
+        findings = check_layer_rules(["obs-stdlib-only"])
+        assert not findings, \
+            "\n".join(f"{f.path}:{f.line}: {f.message}"
+                      for f in findings)
 
     def test_federation_adds_zero_device_dispatches(self):
         """Same sequential workload twice: bare server vs a server

@@ -552,3 +552,114 @@ class TestFleetReportSurface:
         snap = FleetView().add("i0", m).snapshot()
         assert snap["fleet_failover_resubmitted"] == 3
         assert snap["fleet_replica_drained"] == 0
+
+
+# ---------------------------------------------------------------------------
+# (f) graftlint regression: tombstone fetch runs OUTSIDE the manager
+#     lock (ISSUE 15 — a REMOTE replica's kind_snapshot is a wire
+#     round-trip; holding _lock through it stalled every router/
+#     probe/federation path on one dead replica's socket)
+# ---------------------------------------------------------------------------
+class _LockProbeMetrics:
+    """ServingMetrics-shaped probe: kind_snapshot() records whether
+    the calling thread holds the manager lock at fetch time — the
+    crash/drain paths fetch on the caller's thread, so RLock's
+    _is_owned() is exactly the question."""
+
+    def __init__(self, name):
+        self.name = name
+        self.instance = name
+        self.mgr = None
+        self.lock_held_at_fetch = []
+
+    def kind_snapshot(self):
+        if self.mgr is not None:
+            self.lock_held_at_fetch.append(
+                self.mgr._lock._is_owned())
+        return {"completed": {"kind": "counter", "value": 3}}
+
+    def count_value(self, key):
+        return 0
+
+
+class _FakeReplica:
+    """The minimal FleetManager-pluggable surface (no device work)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.instance = name
+        self.metrics = _LockProbeMetrics(name)
+        self._running = True
+        self.paged = False
+        self.killed = False
+
+    @property
+    def alive(self):
+        return not self.killed
+
+    def start(self):
+        self._running = True
+        return self
+
+    def submit(self, prompt, max_new, **kw):
+        fut = cf.Future()
+        fut.set_result(list(prompt) + [0] * int(max_new))
+        return fut
+
+    def kill(self):
+        self.killed = True
+        self._running = False
+
+    def stop(self, drain=True, timeout=None):
+        self._running = False
+
+    def drain(self, migrate=None, timeout=60.0):
+        self._running = False
+        return [], []
+
+
+class TestTombstoneLockDiscipline:
+    def _mgr(self):
+        replicas = {}
+
+        def factory(name):
+            r = _FakeReplica(name)
+            replicas[name] = r
+            return r
+
+        mgr = FleetManager(factory, n_replicas=2).start()
+        for r in replicas.values():
+            r.metrics.mgr = mgr
+        return mgr, replicas
+
+    def test_crash_fetches_tombstone_outside_manager_lock(self):
+        mgr, replicas = self._mgr()
+        try:
+            victim = mgr.replicas[0]
+            mgr.kill_replica(victim)
+            probe = replicas[victim].metrics
+            # both fetches happened (pre-removal + post-kill refresh)
+            # and NEITHER ran while this thread held the manager lock
+            assert len(probe.lock_held_at_fetch) >= 2
+            assert not any(probe.lock_held_at_fetch)
+            # the tombstone still landed atomically with the removal:
+            # counters survive the instance, state reads dead
+            assert mgr.states()[victim] == "dead"
+            with mgr._lock:
+                assert mgr._tombstones[victim]["completed"][
+                    "value"] == 3
+        finally:
+            mgr.stop()
+
+    def test_scale_down_fetches_tombstone_outside_manager_lock(self):
+        mgr, replicas = self._mgr()
+        try:
+            victim = mgr.scale_down(timeout=10.0)
+            probe = replicas[victim].metrics
+            assert probe.lock_held_at_fetch
+            assert not any(probe.lock_held_at_fetch)
+            assert mgr.states()[victim] == "dead"
+            with mgr._lock:
+                assert victim in mgr._tombstones
+        finally:
+            mgr.stop()

@@ -466,19 +466,19 @@ class TestCostPins:
     def test_obs_package_never_imports_device_code(self):
         """Structural zero-device-dispatch pin: recording a span or a
         metric can never touch jax/numpy because the obs package does
-        not import them (a regression here fails loudly)."""
-        import re
-        import deeplearning4j_tpu.obs as obs_pkg
-        pkg_dir = os.path.dirname(obs_pkg.__file__)
-        # both spellings: `import jax[.x]` and `from jax[.x] import y`
-        bad = re.compile(r"^\s*(?:import|from)\s+(?:jax|numpy)\b",
-                         re.MULTILINE)
-        for fn in os.listdir(pkg_dir):
-            if not fn.endswith(".py"):
-                continue
-            src = open(os.path.join(pkg_dir, fn)).read()
-            m = bad.search(src)
-            assert m is None, f"{fn} imports device code: {m.group(0)!r}"
+        not import them. Since ISSUE 15 this is a thin wrapper over
+        the graftlint layering pass — tools/analyze/layers.toml's
+        'obs-stdlib-only' rule is the single source of truth (the
+        pass resolves relative AND function-local imports, which the
+        old regex pin could only approximate); check_layer_rules
+        raises if the rule is renamed away, so this cannot pass
+        vacuously."""
+        from tools.analyze import check_layer_rules
+        findings = check_layer_rules(["obs-stdlib-only",
+                                      "obs-below-serving"])
+        assert not findings, \
+            "\n".join(f"{f.path}:{f.line}: {f.message}"
+                      for f in findings)
 
     def test_tracing_adds_zero_device_dispatches(self):
         """Same sequential workload through a traced and an untraced
@@ -526,6 +526,10 @@ class TestMetricsPins:
         # load_sweep/serve_ab overload A/Bs and the Prometheus route
         "shed_predicted", "shed_brownout", "deferred",
         "chunk_dispatches", "service_rate_tokens_per_sec",
+        # prefix-hit priority admission (serving/decode.py, PR 10):
+        # always-present since then but never pinned — surfaced by
+        # the graftlint metrics-keys reverse check (ISSUE 15)
+        "admitted_prefix_priority",
         # durable KV state (serving/kvstate.py): preempt/resume/migrate
         # event counts, host bytes spilled, restored-prefix hits —
         # consumed by tools/serve_ab.py's preempt_vs_shed arm and the

@@ -350,3 +350,79 @@ class TestWireMigration:
             assert list(fut.result(120)) == ref_new
             snap = mgr.fleet_snapshot()
             assert snap["fleet_migrate_refused"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# (e) graftlint regressions (ISSUE 15): future-hygiene at the wire —
+#     a registered op must NEVER be left for its caller to time out on
+# ---------------------------------------------------------------------------
+class TestWireFutureHygiene:
+    def test_send_failure_after_close_fails_op_immediately(self):
+        """A stop()/kill() racing past the submit-time usable check
+        used to spawn a reconnector that exits on closed/dead without
+        failing the just-registered op — stranding the caller for the
+        full op timeout (120s). The op must fail LOUDLY the moment
+        the send fails."""
+        from deeplearning4j_tpu.serving.wire import OP_SUBMIT, _PendingOp
+        from deeplearning4j_tpu.serving import ServerClosedError
+        lm = _lm()
+        srv = ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                     metrics=ServingMetrics(name="i0"),
+                                     instance="i0")
+        rs = ReplicaServer(srv)
+        rr = RemoteReplica("127.0.0.1", rs.port, name="i0",
+                           heartbeat_interval=None, op_timeout=120.0)
+        try:
+            # the race, made deterministic: close lands AFTER
+            # _check_usable would have passed, BEFORE the send
+            rr._closed = True
+            rr._sock.close()        # raw close: next sendall raises
+            p = _PendingOp("race:0", OP_SUBMIT,
+                           {"id": "race:0", "prompt": [1],
+                            "max_new": 1}, stream=True)
+            t0 = time.monotonic()
+            rr._send_op(p)
+            with pytest.raises(ServerClosedError):
+                p.ack.result(5.0)
+            assert p.stream.done()
+            with pytest.raises(ServerClosedError):
+                p.stream.result(0)
+            assert time.monotonic() - t0 < 5.0, \
+                "op stranded until its timeout instead of failing"
+        finally:
+            rr._closed = False
+            rr.kill()
+            rs.close()
+
+    def test_failed_op_is_forgotten_not_resent_forever(self):
+        """An op whose ack never arrives (timeout -> ReplicaDeadError)
+        used to stay in `_pending` forever: excluded from the done-op
+        prune AND re-sent on every later reconnect. swap/migrate_out/
+        drain now forget the op on failure."""
+        from deeplearning4j_tpu.serving.wire import OP_SWAP
+        lm = _lm()
+        srv = ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                     metrics=ServingMetrics(name="i0"),
+                                     instance="i0")
+        rs = ReplicaServer(srv)
+        orig = rs._dispatch
+
+        def blackhole(conn, op, hdr, blob):
+            if op == OP_SWAP:
+                return True          # swallow: the lost-ack scenario
+            return orig(conn, op, hdr, blob)
+
+        rs._dispatch = blackhole
+        rr = RemoteReplica("127.0.0.1", rs.port, name="i0",
+                           heartbeat_interval=None, op_timeout=1.0)
+        try:
+            with pytest.raises(ReplicaDeadError):
+                rr.swap(_lm(seed=3))
+            with rr._plock:
+                leftover = [p for p in rr._pending.values()
+                            if p.op == OP_SWAP]
+            assert not leftover, \
+                "failed SWAP lingered in _pending (resent forever)"
+        finally:
+            rr.kill()
+            rs.close()

@@ -1,0 +1,38 @@
+"""graftlint — stdlib-only static analysis for the repo's concurrency,
+layering, and metrics invariants.
+
+Four passes (see each module's docstring for the precise rules and
+their documented heuristics):
+
+    lock-discipline   blocking calls under a held lock; lock-order
+                      cycles (tools/analyze/lockcheck.py)
+    future-hygiene    locally-created Futures must resolve/escape on
+                      every path (tools/analyze/futures.py)
+    layering          the declared import-layer map, layers.toml
+                      (tools/analyze/layering.py)
+    metrics-keys      PINNED_KEYS/FLEET_PINNED_KEYS vs the code's
+                      producible names (tools/analyze/metrics_keys.py)
+
+Plus the suppression-policy check: every inline
+``# graftlint: disable=<pass> -- <justification>`` must carry its
+justification.
+
+Usage:
+
+    python -m tools.analyze             # human-readable, exit 1 on
+                                        # any unsuppressed finding
+    python -m tools.analyze --json      # machine-readable (CI artifact)
+    python -m tools.analyze path.py ... # restrict the analyzed set
+
+In-process (the tier-1 test and the layering-pin wrappers):
+
+    from tools.analyze import run
+    report = run()                      # Report: .active/.suppressed/
+                                        # .baselined
+"""
+from .core import (Config, Finding, Report, load_config, repo_root,
+                   run)
+from .layering import check_rules as check_layer_rules
+
+__all__ = ["Config", "Finding", "Report", "load_config", "repo_root",
+           "run", "check_layer_rules"]

@@ -1,0 +1,70 @@
+"""graftlint CLI: ``python -m tools.analyze [--json] [paths...]``.
+
+Exit code 0 when every finding is inline-suppressed (with a
+justification) or baselined; 1 otherwise. ``--json`` emits the full
+report (active + suppressed + baselined, with fingerprints) — the CI
+artifact tier1.yml uploads per run.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import load_baseline, load_config, run, write_baseline
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="graftlint: the repo's concurrency/layering/"
+                    "metrics invariants, machine-checked")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the whole "
+                         "package)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: the checked-in "
+                         "tools/analyze/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report grandfathered "
+                         "findings as active")
+    ap.add_argument("--write-baseline", metavar="PATH",
+                    help="write the current ACTIVE findings as a new "
+                         "baseline to PATH and exit 0")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    default=None, metavar="NAME",
+                    help="run only the named pass (repeatable): "
+                         "lock-discipline, future-hygiene, layering, "
+                         "metrics-keys, suppression")
+    args = ap.parse_args(argv)
+
+    config = load_config()
+    baseline = {} if args.no_baseline else load_baseline(
+        args.baseline)
+    report = run(config=config, paths=args.paths or None,
+                 baseline=baseline, passes=args.passes)
+
+    if args.write_baseline:
+        write_baseline(report.active, args.write_baseline)
+        print(f"wrote {len(report.active)} fingerprints to "
+              f"{args.write_baseline}")
+        return 0
+
+    if args.json:
+        json.dump(report.as_dict(), sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for f in report.active:
+            print(f"{f.path}:{f.line}: [{f.severity}] "
+                  f"{f.pass_name}: {f.message}")
+        print(f"graftlint: {len(report.files)} files, "
+              f"{len(report.active)} finding(s) "
+              f"({len(report.suppressed)} suppressed, "
+              f"{len(report.baselined)} baselined)")
+    return 1 if report.active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

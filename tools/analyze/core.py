@@ -1,0 +1,392 @@
+"""graftlint core: findings, suppressions, baseline, config, runner.
+
+The repo's load-bearing concurrency/layering/metrics conventions used
+to live only in scattered test pins and docstring promises ("never
+block while holding the dispatch lock", "obs/ never imports jax",
+"every admitted future resolves", "the pinned snapshot keys exist").
+This package machine-checks them: four stdlib-only AST passes over the
+`deeplearning4j_tpu` package, run as a tier-1 test
+(tests/test_analyze.py) and as a CLI (`python -m tools.analyze`).
+
+Model
+-----
+* A `Finding` is one violation: pass name, severity, file, line, a
+  STABLE `key` (identity that survives line moves — used for the
+  baseline), and a human message.
+* Inline suppression: a ``# graftlint: disable=<pass>[,<pass>] --
+  <justification>`` comment on the offending line (or the line
+  directly above it) suppresses that pass there. The justification is
+  MANDATORY: a disable comment without one is itself a finding
+  (pass ``suppression``) — the acceptance rule "every suppression
+  carries a one-line justification", machine-enforced.
+* Baseline: ``tools/analyze/baseline.json`` holds fingerprints of
+  grandfathered findings (each with a reason). Baselined findings are
+  reported separately and do not fail the run; NEW findings do. The
+  shipped baseline is empty — everything real was fixed or
+  inline-suppressed in the PR that introduced the suite — but the
+  mechanism exists so a future pass can be landed strict-for-new-code
+  before the backlog is paid down.
+
+Config lives in ``tools/analyze/layers.toml`` (the layer map plus the
+per-pass module scopes). Python 3.10 has no tomllib, so `_read_toml`
+parses the small TOML subset the config uses (tables, arrays of
+tables, string/bool/int scalars, arrays of strings) — stdlib-only is a
+hard requirement here: the analyzer must run in any environment that
+can parse the source, including ones without jax/numpy.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import re
+
+__all__ = ["Finding", "Config", "SourceFile", "load_config", "run",
+           "Report", "repo_root"]
+
+SEVERITIES = ("error", "warning", "info")
+
+# the suppression marker: `# graftlint: disable=pass-a,pass-b -- why`
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([a-z0-9_,\-\s]+?)"
+    r"(?:--\s*(.*?))?\s*$")
+
+
+class Finding:
+    """One violation. `key` is the line-number-free identity used for
+    baseline fingerprints; `fingerprint` prefixes it with pass + path
+    so identical keys in different files never collide."""
+
+    __slots__ = ("pass_name", "severity", "path", "line", "key",
+                 "message")
+
+    def __init__(self, pass_name, severity, path, line, key, message):
+        assert severity in SEVERITIES, severity
+        self.pass_name = pass_name
+        self.severity = severity
+        self.path = path
+        self.line = int(line)
+        self.key = key
+        self.message = message
+
+    @property
+    def fingerprint(self):
+        return f"{self.pass_name}:{self.path}:{self.key}"
+
+    def as_dict(self):
+        return {"pass": self.pass_name, "severity": self.severity,
+                "path": self.path, "line": self.line, "key": self.key,
+                "fingerprint": self.fingerprint,
+                "message": self.message}
+
+    def __repr__(self):
+        return (f"<{self.severity} {self.pass_name} "
+                f"{self.path}:{self.line} {self.key}>")
+
+
+class SourceFile:
+    """One parsed module: path (repo-relative, '/'-separated), source,
+    AST, and the per-line suppression map."""
+
+    def __init__(self, relpath, source, root=""):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.root = root
+        self.tree = ast.parse(source, filename=relpath)
+        # line -> (set of pass names or {"all"}, has_justification)
+        self.suppressions = {}
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            passes = {p.strip() for p in m.group(1).split(",")
+                      if p.strip()}
+            reason = (m.group(2) or "").strip()
+            self.suppressions[i] = (passes, bool(reason))
+
+    def suppressed(self, pass_name, line):
+        """True when `pass_name` is disabled at `line` — a marker on
+        the line itself or on the (comment) line directly above."""
+        for ln in (line, line - 1):
+            entry = self.suppressions.get(ln)
+            if entry and (pass_name in entry[0] or "all" in entry[0]):
+                return True
+        return False
+
+    def suppression_findings(self):
+        """Every disable marker missing its `-- justification` is a
+        finding: the suppression policy is part of the contract."""
+        out = []
+        for line, (passes, has_reason) in sorted(
+                self.suppressions.items()):
+            if not has_reason:
+                out.append(Finding(
+                    "suppression", "error", self.relpath, line,
+                    f"missing-justification:L{line}",
+                    f"graftlint disable={','.join(sorted(passes))} "
+                    f"has no '-- <justification>' — every suppression "
+                    f"must say why"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# config (layers.toml) — minimal TOML subset reader
+# ---------------------------------------------------------------------------
+def _parse_value(raw):
+    raw = raw.strip()
+    if raw.startswith("[") and raw.endswith("]"):
+        inner = raw[1:-1].strip()
+        if not inner:
+            return []
+        out, cur, in_str, quote = [], "", False, ""
+        for ch in inner:
+            if in_str:
+                if ch == quote:
+                    in_str = False
+                else:
+                    cur += ch
+            elif ch in "\"'":
+                in_str, quote = True, ch
+            elif ch == ",":
+                if cur.strip() or cur:
+                    out.append(cur)
+                cur = ""
+            else:
+                if ch.strip():
+                    raise ValueError(f"bad array element near {raw!r}")
+        if cur:
+            out.append(cur)
+        return out
+    if raw.startswith(("\"", "'")) and raw.endswith(raw[0]):
+        return raw[1:-1]
+    if raw in ("true", "false"):
+        return raw == "true"
+    return int(raw)
+
+
+def _read_toml(text):
+    """The TOML subset layers.toml uses: `[table]`, `[[array-table]]`,
+    `key = value` with string/bool/int/array-of-string values; arrays
+    may span lines until the closing bracket. Comments start with #
+    outside strings."""
+    root = {}
+    current = root
+    pending_key, pending_buf = None, ""
+    for rawline in text.splitlines():
+        line = _strip_comment(rawline)
+        if pending_key is not None:
+            pending_buf += " " + line.strip()
+            if _array_closed(pending_buf):
+                current[pending_key] = _parse_value(pending_buf)
+                pending_key, pending_buf = None, ""
+            continue
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("[["):
+            name = line[2:line.index("]]")].strip()
+            current = {}
+            root.setdefault(name, []).append(current)
+        elif line.startswith("["):
+            name = line[1:line.index("]")].strip()
+            current = root.setdefault(name, {})
+        else:
+            key, _, val = line.partition("=")
+            key, val = key.strip(), val.strip()
+            if val.startswith("[") and not _array_closed(val):
+                pending_key, pending_buf = key, val
+            else:
+                current[key] = _parse_value(val)
+    if pending_key is not None:
+        raise ValueError(f"unterminated array for key {pending_key!r}")
+    return root
+
+
+def _strip_comment(line):
+    out, in_str, quote = "", False, ""
+    for ch in line:
+        if in_str:
+            out += ch
+            if ch == quote:
+                in_str = False
+        elif ch in "\"'":
+            in_str, quote = True, ch
+            out += ch
+        elif ch == "#":
+            break
+        else:
+            out += ch
+    return out
+
+
+def _array_closed(buf):
+    depth, in_str, quote = 0, False, ""
+    for ch in buf:
+        if in_str:
+            if ch == quote:
+                in_str = False
+        elif ch in "\"'":
+            in_str, quote = True, ch
+        elif ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+    return depth == 0
+
+
+class Config:
+    """Parsed layers.toml plus resolved paths. `package` is the
+    repo-relative package dir every `modules =` glob is rooted at."""
+
+    def __init__(self, data, root):
+        self.root = root
+        meta = data.get("meta", {})
+        self.package = meta.get("package", "deeplearning4j_tpu")
+        self.layers = data.get("layer", [])
+        self.lock_modules = data.get("lock_discipline", {}).get(
+            "modules", [])
+        self.future_modules = data.get("future_hygiene", {}).get(
+            "modules", [])
+        self.metrics = data.get("metrics_keys", {})
+
+    def package_glob(self, patterns, files):
+        """Files (SourceFile list) whose package-relative path matches
+        any of `patterns` (globs rooted at the package dir)."""
+        prefix = self.package + "/"
+        out = []
+        for f in files:
+            if not f.relpath.startswith(prefix):
+                continue
+            rel = f.relpath[len(prefix):]
+            if any(fnmatch.fnmatch(rel, p) for p in patterns):
+                out.append(f)
+        return out
+
+
+def repo_root():
+    """The repository root: two levels above this file (tools/analyze)."""
+    return os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def load_config(path=None, root=None):
+    root = root if root is not None else repo_root()
+    path = path if path is not None else os.path.join(
+        os.path.dirname(__file__), "layers.toml")
+    with open(path) as fh:
+        return Config(_read_toml(fh.read()), root)
+
+
+# ---------------------------------------------------------------------------
+# source collection + runner
+# ---------------------------------------------------------------------------
+def collect_sources(root, paths=None, package="deeplearning4j_tpu"):
+    """SourceFile list for the analysis set: every .py under the
+    package (skipping __pycache__), or exactly `paths` when given."""
+    files = []
+    if paths:
+        for p in paths:
+            ap = p if os.path.isabs(p) else os.path.join(root, p)
+            if os.path.isdir(ap):
+                for dirpath, dirnames, names in os.walk(ap):
+                    dirnames[:] = [d for d in dirnames
+                                   if d != "__pycache__"]
+                    files.extend(os.path.join(dirpath, n)
+                                 for n in sorted(names)
+                                 if n.endswith(".py"))
+            else:
+                files.append(ap)
+    else:
+        pkg = os.path.join(root, package)
+        for dirpath, dirnames, names in os.walk(pkg):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            files.extend(os.path.join(dirpath, n)
+                         for n in sorted(names) if n.endswith(".py"))
+    out = []
+    for ap in sorted(set(files)):
+        rel = os.path.relpath(ap, root)
+        with open(ap, encoding="utf-8") as fh:
+            out.append(SourceFile(rel, fh.read(), root=root))
+    return out
+
+
+class Report:
+    """One analyzer run: active findings (fail the build), inline-
+    suppressed, baselined, and the counts the CLI/CI artifact needs."""
+
+    def __init__(self, active, suppressed, baselined, files):
+        self.active = active
+        self.suppressed = suppressed
+        self.baselined = baselined
+        self.files = files
+
+    def as_dict(self):
+        return {
+            "files_checked": len(self.files),
+            "active": [f.as_dict() for f in self.active],
+            "suppressed": [f.as_dict() for f in self.suppressed],
+            "baselined": [f.as_dict() for f in self.baselined],
+            "counts": {"active": len(self.active),
+                       "suppressed": len(self.suppressed),
+                       "baselined": len(self.baselined)},
+        }
+
+
+def load_baseline(path=None):
+    path = path if path is not None else os.path.join(
+        os.path.dirname(__file__), "baseline.json")
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        data = json.load(fh)
+    return {e["fingerprint"]: e.get("reason", "")
+            for e in data.get("findings", [])}
+
+
+def write_baseline(findings, path):
+    data = {"findings": [
+        {"fingerprint": f.fingerprint,
+         "reason": "grandfathered at baseline creation"}
+        for f in sorted(findings, key=lambda f: f.fingerprint)]}
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def run(config=None, paths=None, baseline=None, passes=None):
+    """One full analysis. `baseline` is a fingerprint->reason dict ({}
+    disables), None loads the checked-in file. `passes` filters by
+    pass name (None = all four + the suppression policy check)."""
+    from . import futures, layering, lockcheck, metrics_keys
+    config = config if config is not None else load_config()
+    files = collect_sources(config.root, paths=paths,
+                            package=config.package)
+    baseline = baseline if baseline is not None else load_baseline()
+    by_path = {f.relpath: f for f in files}
+
+    all_findings = []
+    if passes is None or "lock-discipline" in passes:
+        all_findings += lockcheck.check(config, files)
+    if passes is None or "future-hygiene" in passes:
+        all_findings += futures.check(config, files)
+    if passes is None or "layering" in passes:
+        all_findings += layering.check(config, files)
+    if passes is None or "metrics-keys" in passes:
+        all_findings += metrics_keys.check(config, files)
+    if passes is None or "suppression" in passes:
+        for f in files:
+            all_findings += f.suppression_findings()
+
+    active, suppressed, baselined = [], [], []
+    for f in sorted(all_findings, key=lambda f: (f.path, f.line,
+                                                 f.key)):
+        src = by_path.get(f.path)
+        if src is not None and f.pass_name != "suppression" \
+                and src.suppressed(f.pass_name, f.line):
+            suppressed.append(f)
+        elif f.fingerprint in baseline:
+            baselined.append(f)
+        else:
+            active.append(f)
+    return Report(active, suppressed, baselined, files)

@@ -1,0 +1,271 @@
+"""graftlint pass 2 — future-hygiene.
+
+The repo's worst failure class is a STRANDED CALLER: a
+`concurrent.futures.Future` someone is waiting on that nobody will
+ever resolve. This pass checks every function that creates a Future
+locally (``fut = cf.Future()`` — attribute-stored creations like
+``self.ack = cf.Future()`` escape at birth and are out of scope):
+
+* **future-leak** (error): on every control-flow path from creation
+  to a NORMAL function exit (fall-through or `return` of something
+  else), the future must be RESOLVED (`set_result` / `set_exception`
+  / `cancel`) or ESCAPE — returned, stored into an attribute/
+  container, or passed to a call (ownership transfer: whoever
+  received it is now responsible). A path that exits via `raise` is
+  fine: the caller got the exception, nobody holds the future.
+* **future-swallowed-exception** (warning): an `except` handler that
+  can be entered while the future is pending, swallows the exception
+  (no re-raise, no return/resolution of the future), after which the
+  future still escapes — the classic shape where the success path
+  resolves but the error path parks a forever-pending future in a
+  registry. This is the "including exception paths" half of the
+  check, scoped to where it is decidable.
+
+The analysis is a statement-level abstract interpretation over a
+two-point lattice per tracked future ({pending, safe}), with branch
+join = pending-if-any-branch-pending, proper try/except/finally
+modeling (handler entry state = the pessimistic join over the try
+body), and loops processed twice (enough for a monotone two-point
+lattice to reach fixpoint). Generators and async functions are
+skipped — their suspension points make "exit" a different concept.
+"""
+from __future__ import annotations
+
+import ast
+
+PASS = "future-hygiene"
+
+_RESOLVERS = {"set_result", "set_exception", "cancel",
+              "set_running_or_notify_cancel"}
+_FUTURE_CTORS = {"Future"}
+
+PENDING, SAFE = 0, 1
+
+
+def _finding(severity, path, line, key, message):
+    from .core import Finding
+    return Finding(PASS, severity, path, line, key, message)
+
+
+def _is_future_ctor(value):
+    if not isinstance(value, ast.Call):
+        return False
+    node = value.func
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return bool(parts) and parts[0] in _FUTURE_CTORS
+
+
+def _name_used(node, name):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == name:
+            return True
+    return False
+
+
+class _Tracker:
+    """Abstract interpretation for ONE tracked future variable in one
+    function. `state` is PENDING/SAFE/None (None: not yet created).
+    Exit states at normal exits are recorded with their line."""
+
+    def __init__(self, fn, var, create_line, src, findings, where):
+        self.fn = fn
+        self.var = var
+        self.create_line = create_line
+        self.src = src
+        self.findings = findings
+        self.where = where
+        self.bad_exits = []      # (line, kind) pending at normal exit
+        self.swallows = []       # handler lines that swallow pending
+        self.escapes_anywhere = self._any_escape(fn)
+
+    # -- event classification ------------------------------------------
+    def _any_escape(self, fn):
+        for node in ast.walk(fn):
+            if self._escape_event(node):
+                return True
+        return False
+
+    def _resolve_event(self, stmt):
+        """var.set_result/set_exception/cancel anywhere in stmt."""
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _RESOLVERS and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == self.var:
+                return True
+        return False
+
+    def _escape_event(self, node):
+        """The future leaves this function's ownership: stored into an
+        attribute/subscript, passed as a call argument (append, wait,
+        a resolver helper like `_fail_future(fut, exc)`), or part of
+        a returned/stored tuple/list/dict."""
+        if isinstance(node, ast.Call):
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                if _name_used(arg, self.var):
+                    return True
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)) \
+                        and _name_used(node.value, self.var):
+                    return True
+            # rebinding another NAME to the future aliases it; treat
+            # as escape (tracking aliases is out of scope — absorbing
+            # the imprecision as "safe" avoids false leaks)
+            if any(isinstance(t, ast.Name) and t.id != self.var
+                   for t in node.targets) \
+                    and _name_used(node.value, self.var):
+                return True
+        return False
+
+    def _stmt_makes_safe(self, stmt):
+        if self._resolve_event(stmt):
+            return True
+        for node in ast.walk(stmt):
+            if self._escape_event(node):
+                return True
+        return False
+
+    # -- interpretation ------------------------------------------------
+    def run(self):
+        state = self._block(self.fn.body, None)
+        if state == PENDING:
+            last = self.fn.body[-1]
+            self.bad_exits.append((last.lineno, "fall-through"))
+        for line, kind in self.bad_exits:
+            self.findings.append(_finding(
+                "error", self.src.relpath, line,
+                f"future-leak:{self.where}:{self.var}",
+                f"Future `{self.var}` (created at line "
+                f"{self.create_line} in {self.where}) can reach the "
+                f"{kind} exit at line {line} unresolved and "
+                f"unreturned — a caller holding it would wait "
+                f"forever; resolve it, return it, or hand it off on "
+                f"every path"))
+        for line in self.swallows:
+            self.findings.append(_finding(
+                "warning", self.src.relpath, line,
+                f"future-swallowed-exception:{self.where}:{self.var}",
+                f"except handler at line {line} swallows an "
+                f"exception while Future `{self.var}` may be "
+                f"pending, and the future escapes this function — "
+                f"the error path must fail the future loudly "
+                f"(set_exception) or re-raise"))
+
+    def _block(self, body, state):
+        """Returns the state after `body` (None = not created yet;
+        'exit' states from return/raise are recorded eagerly)."""
+        for stmt in body:
+            state = self._stmt(stmt, state)
+            if state == "dead":
+                return "dead"
+        return state
+
+    def _stmt(self, stmt, state):
+        # creation site
+        if isinstance(stmt, ast.Assign) and \
+                len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                stmt.targets[0].id == self.var and \
+                _is_future_ctor(stmt.value):
+            return PENDING
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None and \
+                    _name_used(stmt.value, self.var):
+                return "dead"            # returned: caller owns it
+            if state == PENDING:
+                self.bad_exits.append((stmt.lineno, "return"))
+            return "dead"
+        if isinstance(stmt, ast.Raise):
+            return "dead"                # caller gets the exception
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return state                 # loop-local; approximated
+        if isinstance(stmt, ast.If):
+            s_then = self._block(stmt.body, state)
+            s_else = self._block(stmt.orelse, state)
+            return self._join(s_then, s_else)
+        if isinstance(stmt, (ast.While, ast.For)):
+            # two passes reach fixpoint on a two-point lattice; the
+            # zero-iteration path keeps the incoming state
+            s1 = self._block(stmt.body, state)
+            s2 = self._block(stmt.body, self._join(state, s1))
+            out = self._join(state, s2)
+            return self._block(stmt.orelse, out)
+        if isinstance(stmt, ast.With):
+            return self._block(stmt.body, state)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, state)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return state
+        # simple statement: resolve/escape events apply
+        if state == PENDING and self._stmt_makes_safe(stmt):
+            return SAFE
+        return state
+
+    def _try(self, stmt, state):
+        body_state = self._block(stmt.body, state)
+        # a handler can be entered from ANY point in the body: its
+        # entry state is the pessimistic join over the whole region
+        handler_entry = self._join(state, body_state)
+        out_states = []
+        if body_state != "dead":
+            out_states.append(self._block(stmt.orelse, body_state))
+        for handler in stmt.handlers:
+            h_state = self._block(handler.body, handler_entry)
+            if h_state == "dead":
+                continue
+            if handler_entry == PENDING and h_state == PENDING \
+                    and self.escapes_anywhere:
+                self.swallows.append(handler.lineno)
+            out_states.append(h_state)
+        merged = "dead"
+        for s in out_states:
+            merged = self._join(merged, s)
+        final = self._block(stmt.finalbody, merged)
+        return final
+
+    @staticmethod
+    def _join(a, b):
+        if a == "dead":
+            return b
+        if b == "dead":
+            return a
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return min(a, b)         # PENDING wins
+
+
+def check(config, files):
+    scoped = config.package_glob(config.future_modules, files)
+    if not scoped:
+        scoped = files
+    findings = []
+    for src in scoped:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if any(isinstance(n, (ast.Yield, ast.YieldFrom))
+                   for n in ast.walk(node)):
+                continue         # generators: "exit" means suspension
+            created = {}
+            for stmt in ast.walk(node):
+                if isinstance(stmt, ast.Assign) and \
+                        len(stmt.targets) == 1 and \
+                        isinstance(stmt.targets[0], ast.Name) and \
+                        _is_future_ctor(stmt.value):
+                    var = stmt.targets[0].id
+                    created.setdefault(var, stmt.lineno)
+            for var, line in sorted(created.items()):
+                where = node.name
+                _Tracker(node, var, line, src, findings, where).run()
+    return findings

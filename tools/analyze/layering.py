@@ -1,0 +1,149 @@
+"""graftlint pass 3 — layering.
+
+The repo's import-layering conventions used to live as per-test regex
+pins (tests/test_obs.py and tests/test_fleet.py each grepped their
+module for ``import jax``). This pass replaces them with ONE declared
+contract: ``tools/analyze/layers.toml`` lists layer rules —
+
+    [[layer]]
+    name    = "obs-stdlib-only"
+    modules = ["obs/*.py"]            # globs, package-relative
+    deny    = ["jax", "numpy"]        # absolute module prefixes
+    allow   = ["trace.py = numpy"]    # per-file exceptions
+    reason  = "why this layer exists"
+
+and the pass resolves EVERY import in every matched file — top-level
+and function-local, `import x` and `from x import y`, relative
+imports resolved against the file's package path — and flags any that
+lands under a denied prefix without a matching allow entry. The old
+test names survive as thin wrappers over this pass (layers.toml is
+the single source of truth; see tests/test_obs.py / test_fleet.py).
+
+Deny prefixes match on dotted-path boundaries: deny "jax" matches
+"jax" and "jax.numpy", never "jaxtyping". Relative imports inside the
+package resolve to their absolute names first, so deny
+"deeplearning4j_tpu.parallel" catches ``from ..parallel import x``
+too.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+PASS = "layering"
+
+
+def _finding(path, line, key, message, severity="error"):
+    from .core import Finding
+    return Finding(PASS, severity, path, line, key, message)
+
+
+def resolve_imports(relpath, tree):
+    """Yield (line, absolute_module_name) for every import statement
+    in `tree` — top-level and function-local. `relpath` is the repo-
+    relative file path ('/'-separated) relative imports resolve
+    against. `from X import y` yields both X and X.y (y may be a
+    submodule — the prefix match must see it either way)."""
+    pkg_parts = relpath.split("/")[:-1]     # the file's package dirs
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield node.lineno, alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                base = node.module or ""
+            else:
+                # level=1 is the file's own package, each extra level
+                # climbs one package up (same for modules and
+                # __init__.py given pkg_parts is the DIRECTORY path)
+                anchor = pkg_parts[:len(pkg_parts)
+                                   - (node.level - 1)]
+                base = ".".join(anchor + ([node.module]
+                                          if node.module else []))
+            if not base:
+                continue
+            yield node.lineno, base
+            for alias in node.names:
+                if alias.name != "*":
+                    yield node.lineno, f"{base}.{alias.name}"
+
+
+def _denied(module, deny):
+    for prefix in deny:
+        if module == prefix or module.startswith(prefix + "."):
+            return prefix
+    return None
+
+
+def _parse_allow(entries):
+    """['file-glob = module-prefix', ...] -> [(glob, prefix)]."""
+    out = []
+    for e in entries:
+        left, _, right = e.partition("=")
+        out.append((left.strip(), right.strip()))
+    return out
+
+
+def check(config, files):
+    findings = []
+    prefix = config.package + "/"
+    for rule in config.layers:
+        name = rule.get("name", "unnamed")
+        patterns = rule.get("modules", [])
+        deny = rule.get("deny", [])
+        allow = _parse_allow(rule.get("allow", []))
+        reason = rule.get("reason", "")
+        for src in config.package_glob(patterns, files):
+            rel = src.relpath[len(prefix):] \
+                if src.relpath.startswith(prefix) else src.relpath
+            for line, module in resolve_imports(src.relpath,
+                                                src.tree):
+                hit = _denied(module, deny)
+                if hit is None:
+                    continue
+                if any(fnmatch.fnmatch(rel, g)
+                       and (module == p
+                            or module.startswith(p + "."))
+                       for g, p in allow):
+                    continue
+                why = f" ({reason})" if reason else ""
+                findings.append(_finding(
+                    src.relpath, line,
+                    f"layer:{name}:{module}",
+                    f"layer rule '{name}': {src.relpath} imports "
+                    f"`{module}` (denied prefix `{hit}`){why} — "
+                    f"either the import moves, or layers.toml "
+                    f"grows an explicit allow entry"))
+    return findings
+
+
+def check_rules(rule_names, config=None):
+    """Run ONLY the named layer rules over the repo and return their
+    findings — the hook tests/test_obs.py and tests/test_fleet.py
+    wrap so the old no-jax-import pins stay as named tests while
+    layers.toml is the single source of truth. Raises KeyError when a
+    named rule does not exist (a renamed rule must fail the wrapper
+    test loudly, not pass vacuously)."""
+    from .core import collect_sources, load_config
+    config = config if config is not None else load_config()
+    have = {r.get("name") for r in config.layers}
+    missing = set(rule_names) - have
+    if missing:
+        raise KeyError(
+            f"layer rule(s) {sorted(missing)} not found in "
+            f"layers.toml (have: {sorted(have)})")
+    sub = Subset(config, [r for r in config.layers
+                          if r.get("name") in set(rule_names)])
+    files = collect_sources(config.root, package=config.package)
+    return check(sub, files)
+
+
+class Subset:
+    """Config view exposing only a subset of layer rules."""
+
+    def __init__(self, config, layers):
+        self._config = config
+        self.layers = layers
+
+    def __getattr__(self, name):
+        return getattr(self._config, name)

@@ -1,0 +1,519 @@
+"""graftlint pass 1 — lock-discipline.
+
+Two rules over the threaded modules (scoped by
+``[lock_discipline] modules`` in layers.toml):
+
+* **blocking-under-lock** — a call that can block on IO, a peer
+  thread, or the clock must not run while a lock is held: the wedge
+  class behind every "faulthandler dump of a hung run" bug. Direct
+  primitives (socket send/recv/accept/connect, ``Future.result``,
+  ``Queue.join``/blocking ``get``, ``Thread.join``, ``Event.wait``,
+  ``cf.wait``, ``time.sleep``) are errors; calls that reach a
+  primitive TRANSITIVELY through a helper/method (resolved by name
+  across the analyzed set — conservative on purpose) are warnings.
+* **lock-order-cycle** — the union lock-acquisition graph (edges:
+  lock B acquired — lexically or through a called method — while lock
+  A is held) must be acyclic, or two threads taking the locks in
+  opposite orders can deadlock.
+
+How types are known (all heuristic, all documented here because a
+linter that cannot explain its verdicts teaches nobody):
+
+* ``self.X = threading.Lock()/RLock()`` (and Queue/Thread/Event/
+  socket/Future constructors) in any class body of the analyzed set
+  binds attribute name X to that type — and the attribute NAME is
+  then trusted globally, so ``conn.wlock`` is a lock because `_Conn`
+  declares ``wlock`` as one. Collisions resolve conservatively (a
+  lock-typed declaration wins).
+* Local variables assigned from a typed constructor or a typed
+  attribute inherit the type inside that function; parameters named
+  ``sock``/``conn`` are assumed sockets (the module convention).
+* A module function or method whose body contains a blocking
+  primitive is itself blocking; one fixpoint propagates this through
+  same-set calls BY NAME (``self._await_ack`` blocks wherever it
+  resolves, because the one definition that exists blocks on
+  ``Future.result``). By-name resolution over-approximates — the
+  right direction for a deadlock linter; the inline suppression
+  mechanism absorbs the deliberate cases (per-connection write
+  mutexes, the single-reconnector latch).
+
+Held-region modeling: ``with self.X:`` blocks; explicit
+``lock.acquire()`` holds from the next statement until the first
+statement containing the matching ``release()`` (the try/finally
+idiom); ``with self._foo_lock(key):`` — a method call whose name
+contains "lock" — is treated as acquiring a synthetic per-call lock
+(the parameter-server per-worker lock pattern). Lambda and nested-def
+bodies are NOT scanned at the call site — they run later, usually not
+under the lock.
+"""
+from __future__ import annotations
+
+import ast
+import itertools
+
+PASS = "lock-discipline"
+
+_TYPE_CTORS = {
+    "Lock": "lock", "RLock": "lock", "Queue": "queue",
+    "LifoQueue": "queue", "PriorityQueue": "queue",
+    "SimpleQueue": "queue", "Thread": "thread", "Event": "event",
+    "Condition": "lock", "Semaphore": "lock", "Future": "future",
+    "socket": "socket", "create_connection": "socket",
+}
+
+# receiver-type -> method names that block. `put` is deliberately
+# absent: the repo's bounded queues only ever put_nowait, and an
+# unbounded queue's put never blocks — flagging every put would be
+# noise without a boundedness analysis.
+_BLOCKING_METHODS = {
+    "socket": {"send", "sendall", "recv", "accept", "connect",
+               "recv_into", "makefile"},
+    "queue": {"join", "get"},
+    "thread": {"join"},
+    "event": {"wait"},
+    "future": {"result", "exception"},
+}
+_NONBLOCKING = {"get_nowait", "put_nowait", "task_done", "qsize",
+                "empty", "full", "done", "cancel", "set", "clear",
+                "is_set", "locked"}
+_SOCKET_PARAM_NAMES = ("sock", "conn")
+
+
+def _calls_in(node):
+    """Every Call that executes when `node` does: walks the tree but
+    prunes Lambda and nested function/class bodies (they run later)."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (ast.Lambda, ast.FunctionDef,
+                            ast.AsyncFunctionDef, ast.ClassDef)) \
+                and cur is not node:
+            continue
+        if isinstance(cur, ast.Call):
+            yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def _call_name(func):
+    parts = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    parts.reverse()
+    return parts
+
+
+def _ctor_type(value):
+    if not isinstance(value, ast.Call):
+        return None
+    parts = _call_name(value.func)
+    return _TYPE_CTORS.get(parts[-1]) if parts else None
+
+
+def _recv_chain(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return tuple(parts)
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, module, name):
+        self.module = module
+        self.name = name
+        self.attr_types = {}     # attr name -> type tag
+        self.methods = {}        # method name -> ast def
+
+
+def _scan_classes(files):
+    classes, attr_types = [], {}
+    mod_funcs = {}               # (relpath, name) -> def
+    for f in files:
+        for node in f.tree.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                mod_funcs[(f.relpath, node.name)] = node
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = _ClassInfo(f.relpath, node.name)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    ci.methods[item.name] = item
+                    for sub in ast.walk(item):
+                        if isinstance(sub, ast.Assign):
+                            t = _ctor_type(sub.value)
+                            if t is None:
+                                continue
+                            for tgt in sub.targets:
+                                ch = _recv_chain(tgt)
+                                if ch and len(ch) == 2 \
+                                        and ch[0] == "self":
+                                    ci.attr_types[ch[1]] = t
+            classes.append(ci)
+            for attr, t in ci.attr_types.items():
+                if attr_types.get(attr) is None or t == "lock":
+                    attr_types[attr] = t
+    return classes, attr_types, mod_funcs
+
+
+def _local_types(fn, attr_types):
+    out = {}
+    for arg in list(fn.args.args) + list(fn.args.kwonlyargs):
+        if arg.arg in _SOCKET_PARAM_NAMES:
+            out[arg.arg] = "socket"
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            t = _ctor_type(node.value)
+            if t is None:
+                ch = _recv_chain(node.value)
+                if ch and len(ch) >= 2:
+                    t = attr_types.get(ch[-1])
+            if t is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = t
+    return out
+
+
+def _receiver_type(func, attr_types, local_types):
+    if not isinstance(func, ast.Attribute):
+        return None
+    ch = _recv_chain(func.value)
+    if ch is None:
+        return None
+    if len(ch) == 1:
+        return local_types.get(ch[0])
+    t = attr_types.get(ch[-1])
+    if t is None and ch[-1] in ("sock", "_sock"):
+        t = "socket"
+    return t
+
+
+def _is_blocking_call(call, attr_types, local_types, blocking_names):
+    """('direct'|'transitive'|None, label)."""
+    parts = _call_name(call.func)
+    if not parts:
+        return None, None
+    last = parts[-1]
+    if last in _NONBLOCKING:
+        return None, None
+    if last == "sleep" and (len(parts) == 1 or parts[-2] == "time"):
+        return "direct", "time.sleep"
+    if last == "wait" and len(parts) >= 2 \
+            and parts[-2] in ("cf", "futures"):
+        return "direct", "futures.wait"
+    if last == "create_connection":
+        return "direct", "socket.create_connection"
+    rt = _receiver_type(call.func, attr_types, local_types)
+    if rt is not None:
+        # a typed receiver is authoritative: socket.close / thread
+        # .start / queue.qsize never block even when some class in
+        # the set defines a blocking method of the same name
+        if last in _BLOCKING_METHODS.get(rt, ()):
+            return "direct", f"{rt}.{last}"
+        return None, None
+    if last in blocking_names:
+        return "transitive", last
+    return None, None
+
+
+def _blocking_fixpoint(classes, mod_funcs, attr_types):
+    defs = []
+    for ci in classes:
+        defs.extend(ci.methods.items())
+    for (_, name), fn in mod_funcs.items():
+        defs.append((name, fn))
+    blocking = set()
+    while True:
+        grew = False
+        for name, fn in defs:
+            if name in blocking:
+                continue
+            local_types = _local_types(fn, attr_types)
+            for call in _calls_in(fn):
+                kind, _ = _is_blocking_call(call, attr_types,
+                                            local_types, blocking)
+                if kind is not None:
+                    blocking.add(name)
+                    grew = True
+                    break
+        if not grew:
+            return blocking
+
+
+def _lock_id(node, ci, attr_types, classes):
+    """'Class.attr' for a known lock expression, else None. A method
+    call whose name contains 'lock' (`self._worker_lock(wid)`) gets a
+    synthetic per-call id — the keyed-mutex-factory pattern."""
+    if isinstance(node, ast.Call):
+        parts = _call_name(node.func)
+        if parts and "lock" in parts[-1].lower():
+            owner = ci.name if ci is not None else "?"
+            return f"{owner}.{parts[-1]}()"
+        return None
+    ch = _recv_chain(node)
+    if ch is None or len(ch) < 2:
+        return None
+    attr = ch[-1]
+    if attr_types.get(attr) != "lock":
+        return None
+    if ch[0] == "self" and len(ch) == 2 and ci is not None \
+            and attr in ci.attr_types:
+        return f"{ci.name}.{attr}"
+    owners = [c.name for c in classes
+              if c.attr_types.get(attr) == "lock"]
+    if len(owners) == 1:
+        return f"{owners[0]}.{attr}"
+    return f"?.{attr}"
+
+
+def _finding(severity, path, line, key, message):
+    from .core import Finding
+    return Finding(PASS, severity, path, line, key, message)
+
+
+def _method_lock_sets(classes, attr_types):
+    """name -> set of lock ids its body acquires (one level)."""
+    out = {}
+    for ci in classes:
+        for name, fn in ci.methods.items():
+            locks = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lid = _lock_id(item.context_expr, ci,
+                                       attr_types, classes)
+                        if lid:
+                            locks.add(lid)
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "acquire":
+                    lid = _lock_id(node.func.value, ci, attr_types,
+                                   classes)
+                    if lid:
+                        locks.add(lid)
+            if locks:
+                out.setdefault(name, set()).update(locks)
+    return out
+
+
+class _FnChecker:
+    def __init__(self, src, ci, fn, attr_types, classes,
+                 blocking_names, method_locks, findings, edges):
+        self.src = src
+        self.ci = ci
+        self.fn = fn
+        self.attr_types = attr_types
+        self.classes = classes
+        self.blocking = blocking_names
+        self.method_locks = method_locks
+        self.findings = findings
+        self.edges = edges       # (lockA, lockB) -> (path, line, fn)
+        self.local_types = _local_types(fn, attr_types)
+        self.held = []           # lock-id stack
+        self.explicit = []       # explicitly acquire()d lock ids
+
+    def run(self):
+        self._stmts(self.fn.body)
+
+    # -- lock bookkeeping ----------------------------------------------
+    def _acquired(self, lock_id, line):
+        where = (f"{self.ci.name if self.ci else '<module>'}"
+                 f".{self.fn.name}")
+        for h in self.held:
+            if h != lock_id:
+                self.edges.setdefault(
+                    (h, lock_id), (self.src.relpath, line, where))
+
+    def _scan_expr(self, node):
+        """Check every call executed by `node` (lambdas pruned)."""
+        for call in _calls_in(node):
+            self._check_call(call)
+
+    def _check_call(self, call):
+        kind, label = _is_blocking_call(
+            call, self.attr_types, self.local_types, self.blocking)
+        parts = _call_name(call.func)
+        if self.held and parts and parts[-1] in self.method_locks:
+            for lid in self.method_locks[parts[-1]]:
+                if lid not in self.held:
+                    self._acquired(lid, call.lineno)
+        if kind is None or not self.held:
+            return
+        where = (f"{self.ci.name + '.' if self.ci else ''}"
+                 f"{self.fn.name}")
+        sev = "error" if kind == "direct" else "warning"
+        verb = ("blocking call" if kind == "direct"
+                else "call that can block (via its definition)")
+        self.findings.append(_finding(
+            sev, self.src.relpath, call.lineno,
+            f"blocking-under-lock:{where}:{label}",
+            f"{verb} `{label}` while holding {self.held[-1]} in "
+            f"{where}() — hoist it out of the critical section or "
+            f"suppress with a justification"))
+
+    # -- statement walk ------------------------------------------------
+    def _stmts(self, body):
+        for stmt in body:
+            simple = not isinstance(
+                stmt, (ast.With, ast.If, ast.For, ast.While, ast.Try,
+                       ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef))
+            if simple and self.explicit:
+                released = [l for l in self.explicit
+                            if _contains_release(stmt, l)]
+            else:
+                released = []
+            self._stmt(stmt)
+            for lid in released:
+                self.explicit.remove(lid)
+                if lid in self.held:
+                    self.held.remove(lid)
+            acq = (_explicit_acquire(stmt, self.ci, self.attr_types,
+                                     self.classes)
+                   if isinstance(stmt, (ast.If, ast.Expr, ast.Assign,
+                                        ast.AugAssign, ast.Return))
+                   else None)
+            if acq is not None and acq not in self.held:
+                self._acquired(acq, stmt.lineno)
+                self.held.append(acq)
+                self.explicit.append(acq)
+
+    def _stmt(self, stmt):
+        if isinstance(stmt, ast.With):
+            self._with(stmt)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            pass                 # nested defs run later
+        elif isinstance(stmt, ast.If):
+            self._scan_expr(stmt.test)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self._scan_expr(stmt.test)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, ast.For):
+            self._scan_expr(stmt.iter)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for handler in stmt.handlers:
+                self._stmts(handler.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+        else:
+            self._scan_expr(stmt)
+
+    def _with(self, stmt):
+        pushed = []
+        for item in stmt.items:
+            expr = item.context_expr
+            lid = _lock_id(expr, self.ci, self.attr_types,
+                           self.classes)
+            if lid is not None:
+                if isinstance(expr, ast.Call):
+                    self._scan_expr(expr)   # the factory call itself
+                if lid not in self.held:
+                    self._acquired(lid, stmt.lineno)
+                    self.held.append(lid)
+                    pushed.append(lid)
+            else:
+                self._scan_expr(expr)       # tracer span, socket, ...
+        self._stmts(stmt.body)
+        for lid in pushed:
+            self.held.remove(lid)
+
+
+def _contains_release(stmt, lock_id):
+    attr = lock_id.split(".")[-1]
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "release":
+            ch = _recv_chain(node.func.value)
+            if ch and ch[-1] == attr:
+                return True
+    return False
+
+
+def _explicit_acquire(stmt, ci, attr_types, classes):
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire":
+            return _lock_id(node.func.value, ci, attr_types, classes)
+    return None
+
+
+def _find_cycles(edges):
+    graph = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    seen_sets = set()
+    cycles = []
+
+    def dfs(start, node, path, visited):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                key = frozenset(path)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    cycles.append(list(path))
+            elif nxt not in visited and len(path) < 8:
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def check(config, files):
+    scoped = config.package_glob(config.lock_modules, files)
+    if not scoped:
+        scoped = files           # fixture runs pass files directly
+    classes, attr_types, mod_funcs = _scan_classes(scoped)
+    blocking_names = _blocking_fixpoint(classes, mod_funcs,
+                                        attr_types)
+    method_locks = _method_lock_sets(classes, attr_types)
+    findings, edges = [], {}
+    for src in scoped:
+        for ci in [c for c in classes if c.module == src.relpath]:
+            for fn in ci.methods.values():
+                _FnChecker(src, ci, fn, attr_types, classes,
+                           blocking_names, method_locks, findings,
+                           edges).run()
+        for (rel, _name), fn in mod_funcs.items():
+            if rel == src.relpath:
+                _FnChecker(src, None, fn, attr_types, classes,
+                           blocking_names, method_locks, findings,
+                           edges).run()
+    for cycle in _find_cycles(edges):
+        loop = " -> ".join(cycle + [cycle[0]])
+        site = None
+        for a, b in itertools.pairwise(cycle + [cycle[0]]):
+            if (a, b) in edges:
+                site = edges[(a, b)]
+                break
+        path, line, where = site if site else ("?", 1, "?")
+        findings.append(_finding(
+            "error", path, line,
+            f"lock-order-cycle:{'>'.join(sorted(set(cycle)))}",
+            f"lock acquisition order cycle {loop} (an edge is taken "
+            f"in {where}) — two threads taking these locks in "
+            f"opposite orders can deadlock"))
+    return findings

@@ -1,0 +1,243 @@
+"""graftlint pass 4 — metrics-keys.
+
+The serving snapshot surface is pinned by two tuples in
+tests/test_obs.py (``PINNED_KEYS`` / ``FLEET_PINNED_KEYS``): every
+dashboard, sweep tool, and A/B reads those names. The pin test proves
+the keys EXIST at runtime; nothing proved the lists and the code
+could not drift structurally — a key added to the pin tuple with a
+typo'd registration would only fail when some runtime path happened
+to exercise it. This pass closes that statically:
+
+* extract every metric name the code can produce from the configured
+  source files: ``.count("name")`` call sites (including the eager
+  for-loop-over-literal-tuple creation idiom), registry registrations
+  (``res(prefix + "name")`` / ``hist(prefix + "name")`` — the
+  BinOp's literal suffix), snapshot-dict writes (``out["name"] =`` /
+  ``out.setdefault("name", ...)``), and prefix-composed writes
+  (``snap["fleet_" + key]`` with ``key`` looping over a literal
+  tuple);
+* histogram/reservoir base names combine with the derived-quantile
+  suffixes (``_p50``/``_p99``/``_mean``/``_count``/``_last``/
+  ``_max``) snapshot() emits for them;
+* **unregistered-pin** (error): a pinned key with NO producing site.
+* **unpinned-stable-key** (warning): an always-present
+  ``out.setdefault("k", ...)`` key in ``ServingMetrics.snapshot``
+  missing from PINNED_KEYS — the surface grew without growing the
+  contract (the reverse drift).
+
+Configured in layers.toml ``[metrics_keys]``: `sources` (files the
+names are extracted from), `pins_file` + `pins` (where the tuples
+live).
+"""
+from __future__ import annotations
+
+import ast
+
+PASS = "metrics-keys"
+
+_SUFFIXES = ("_p50", "_p99", "_mean", "_count", "_last", "_max")
+_REGISTER_FUNCS = {"res", "hist", "counter", "gauge", "histogram",
+                   "reservoir"}
+
+
+def _finding(path, line, key, message, severity="error"):
+    from .core import Finding
+    return Finding(PASS, severity, path, line, key, message)
+
+
+def _const_str(node):
+    return node.value if isinstance(node, ast.Constant) and \
+        isinstance(node.value, str) else None
+
+
+def _loop_values(fn):
+    """var name -> tuple of literal strings, for every `for var in
+    ("a", "b", ...)` in `fn` — resolves the eager-creation and
+    prefix-overlay idioms."""
+    out = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.For) and \
+                isinstance(node.target, ast.Name) and \
+                isinstance(node.iter, (ast.Tuple, ast.List)):
+            vals = [_const_str(e) for e in node.iter.elts]
+            if all(v is not None for v in vals):
+                out[node.target.id] = tuple(vals)
+    return out
+
+
+def _key_values(node, loops):
+    """Literal string value(s) of a dict-key / call-arg expression:
+    a Constant, a Name bound by a literal loop, or a BinOp
+    concatenation of those. Returns a list (possibly empty)."""
+    s = _const_str(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, ast.Name) and node.id in loops:
+        return list(loops[node.id])
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        lefts = _key_values(node.left, loops)
+        rights = _key_values(node.right, loops)
+        return [a + b for a in lefts for b in rights]
+    return []
+
+
+def extract_names(files):
+    """(direct_names, base_names): every producible metric/snapshot
+    key, and the histogram/reservoir bases that imply derived
+    suffix keys."""
+    direct, bases = set(), set()
+    for src in files:
+        for fn in [n for n in ast.walk(src.tree)
+                   if isinstance(n, ast.FunctionDef)]:
+            loops = _loop_values(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    parts = []
+                    f = node.func
+                    while isinstance(f, ast.Attribute):
+                        parts.append(f.attr)
+                        f = f.value
+                    name = parts[0] if parts else (
+                        f.id if isinstance(f, ast.Name) else None)
+                    if name == "count" and node.args:
+                        direct.update(_key_values(node.args[0],
+                                                  loops))
+                    elif name == "setdefault" and node.args:
+                        direct.update(_key_values(node.args[0],
+                                                  loops))
+                    elif name in _REGISTER_FUNCS and node.args:
+                        # res(p + "latency_ms") — the literal suffix
+                        # of the BinOp is the base name
+                        arg = node.args[0]
+                        if isinstance(arg, ast.BinOp) and \
+                                isinstance(arg.op, ast.Add):
+                            s = _const_str(arg.right)
+                            if s is not None:
+                                bases.add(s)
+                        else:
+                            s = _const_str(arg)
+                            if s is not None:
+                                bases.add(s)
+                elif isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Subscript):
+                            direct.update(_key_values(tgt.slice,
+                                                      loops))
+                # dict literals: snapshot dicts built in one
+                # expression contribute their keys directly
+                # (FleetView.snapshot's `out = {"fleet_instances":
+                # ...}`), and histogram-handle dicts
+                # (latency_histograms) contribute them as bases for
+                # the derived _p50/_p99/... keys
+                elif isinstance(node, ast.Dict):
+                    for k in node.keys:
+                        s = _const_str(k) if k is not None else None
+                        if s is not None:
+                            direct.add(s)
+                            bases.add(s)
+    return direct, bases
+
+
+def extract_pins(pins_src, pin_names):
+    """pin tuple name -> (line, tuple of keys) from the pins file."""
+    out = {}
+    for node in ast.walk(pins_src.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id in pin_names \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            vals = [_const_str(e) for e in node.value.elts]
+            if all(v is not None for v in vals):
+                out[node.targets[0].id] = (node.lineno, tuple(vals))
+    return out
+
+
+def _stable_setdefault_keys(files):
+    """Keys from `out.setdefault("k", <const>)` inside
+    ServingMetrics.snapshot — the always-present surface the reverse
+    check compares against PINNED_KEYS."""
+    keys = set()
+    for src in files:
+        for cls in [n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)
+                    and n.name == "ServingMetrics"]:
+            for fn in [n for n in cls.body
+                       if isinstance(n, ast.FunctionDef)
+                       and n.name == "snapshot"]:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "setdefault" and \
+                            node.args:
+                        s = _const_str(node.args[0])
+                        if s is not None:
+                            keys.add(s)
+    return keys
+
+
+def producible(key, direct, bases):
+    if key in direct:
+        return True
+    for suf in _SUFFIXES:
+        if key.endswith(suf) and key[:-len(suf)] in bases:
+            return True
+    return False
+
+
+def check(config, files):
+    cfg = config.metrics
+    sources = cfg.get("sources", ["serving/metrics.py",
+                                  "serving/fleet.py",
+                                  "obs/fleet.py",
+                                  "obs/registry.py"])
+    pins_file = cfg.get("pins_file", "tests/test_obs.py")
+    pin_names = cfg.get("pins", ["PINNED_KEYS", "FLEET_PINNED_KEYS"])
+    scoped = config.package_glob(sources, files)
+    if not scoped:
+        return []                # fixture runs configure explicitly
+    from .core import SourceFile
+    import os
+    pins_path = os.path.join(config.root, pins_file)
+    with open(pins_path, encoding="utf-8") as fh:
+        pins_src = SourceFile(os.path.relpath(pins_path, config.root),
+                              fh.read(), root=config.root)
+    return check_extracted(scoped, pins_src, pin_names)
+
+
+def check_extracted(source_files, pins_src, pin_names):
+    """The testable core: sources + a parsed pins file -> findings."""
+    direct, bases = extract_names(source_files)
+    pins = extract_pins(pins_src, pin_names)
+    findings = []
+    for pin_name in pin_names:
+        if pin_name not in pins:
+            findings.append(_finding(
+                pins_src.relpath, 1, f"missing-pin-tuple:{pin_name}",
+                f"pin tuple {pin_name} not found in "
+                f"{pins_src.relpath} — the metrics-keys contract "
+                f"lost its anchor"))
+            continue
+        line, keys = pins[pin_name]
+        for key in keys:
+            if not producible(key, direct, bases):
+                findings.append(_finding(
+                    pins_src.relpath, line,
+                    f"unregistered-pin:{key}",
+                    f"pinned snapshot key '{key}' ({pin_name}) has "
+                    f"no producing site in the metrics sources — "
+                    f"the pin list and the code drifted"))
+    # reverse drift: always-present snapshot keys not pinned
+    if "PINNED_KEYS" in pins:
+        _, keys = pins["PINNED_KEYS"]
+        pinned = set(keys)
+        for key in sorted(_stable_setdefault_keys(source_files)):
+            if key not in pinned:
+                findings.append(_finding(
+                    pins_src.relpath, pins["PINNED_KEYS"][0],
+                    f"unpinned-stable-key:{key}",
+                    f"always-present snapshot key '{key}' "
+                    f"(setdefault in ServingMetrics.snapshot) is "
+                    f"missing from PINNED_KEYS — the export surface "
+                    f"grew without growing the contract",
+                    severity="warning"))
+    return findings
