@@ -436,7 +436,9 @@ class FleetView:
         for key in ("replica_spawned", "replica_drained", "replica_dead",
                     "failover_resubmitted", "canary_rollbacks",
                     "wire_reconnects", "wire_retries",
-                    "migrate_refused"):
+                    "migrate_refused", "manager_epoch",
+                    "replicas_adopted", "fenced_ops",
+                    "journal_records"):
             out["fleet_" + key] = counters.get(key, 0)
         # mean of per-instance occupancy statistics (summary kind:
         # recent scheduling-iteration slot occupancy) — the scale_down

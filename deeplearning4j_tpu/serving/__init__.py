@@ -69,15 +69,19 @@ from .server import (DeadlineExceededError, InferenceServer,
                      UnhealthyOutputError)
 from .decode import ContinuousDecodeServer
 from .fleet import FleetManager, RoundRobinSplitter
+from .fleetjournal import (FleetJournal, JournalCorruptError,
+                           fold_records, replay_journal)
 from .kvpool import BlockPool, PagedAllocation
 from .kvstate import (KVStateError, KVStateVersionError,
                       PrefixCacheArtifact, RequestArtifact)
-from .loadgen import (ClosedLoop, DecodeSizeMix, InferenceSizeMix,
-                      OnOffProcess, PoissonProcess, Schedule,
+from .loadgen import (CHAOS_ACTIONS, ChaosSchedule, ClosedLoop,
+                      DecodeSizeMix, InferenceSizeMix, OnOffProcess,
+                      PoissonProcess, Schedule, build_chaos_schedule,
                       build_schedule, run_load)
 from .speculate import DraftSource, ModelDraft, NGramDraft, Speculator
-from .wire import (RemoteReplica, ReplicaServer, WireProtocolError,
-                   WireRemoteError, run_replica_server)
+from .wire import (RemoteReplica, ReplicaServer, StaleEpochError,
+                   WireProtocolError, WireRemoteError,
+                   run_replica_server)
 
 __all__ = [
     "InferenceServer", "ContinuousDecodeServer", "ServingMetrics",
@@ -93,6 +97,9 @@ __all__ = [
     "PoissonProcess", "OnOffProcess", "ClosedLoop",
     "DecodeSizeMix", "InferenceSizeMix", "Schedule",
     "build_schedule", "run_load",
+    "ChaosSchedule", "CHAOS_ACTIONS", "build_chaos_schedule",
     "ReplicaServer", "RemoteReplica", "WireProtocolError",
-    "WireRemoteError", "run_replica_server",
+    "WireRemoteError", "run_replica_server", "StaleEpochError",
+    "FleetJournal", "JournalCorruptError", "fold_records",
+    "replay_journal",
 ]

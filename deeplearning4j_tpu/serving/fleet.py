@@ -80,11 +80,13 @@ import collections
 import concurrent.futures as cf
 import itertools
 import logging
+import os
 import threading
 import time
 
 from ..common.resilience import RetryPolicy
 from ..obs.fleet import SHED_KEYS, AutoscaleSignal, FleetView
+from .fleetjournal import FleetJournal, fold_records, replay_journal
 from .kvstate import KVStateError
 from .metrics import ServingMetrics
 from .server import (DeadlineExceededError, ReplicaDeadError,
@@ -203,7 +205,8 @@ class FleetManager:
                  max_replicas=None, retry_policy=None,
                  heartbeat_timeout=None, fault_injector=None,
                  metrics=None, name="fleet", warmup=None,
-                 degrade_shed_rate=25, name_prefix="i"):
+                 degrade_shed_rate=25, name_prefix="i",
+                 journal=None):
         if policy not in ("least_backlog", "round_robin"):
             raise ValueError(f"unknown routing policy {policy!r}")
         if int(n_replicas) < 1:
@@ -255,6 +258,43 @@ class FleetManager:
         self._ticks = 0
         self._last_tick = None      # (monotonic, fleet tokens_out) —
         #                             the utilization window
+        # durable control plane (serving/fleetjournal.py): `journal`
+        # (a path) makes every state transition a fsync'd WAL record.
+        # Each manager GENERATION bumps the monotone epoch past
+        # whatever the journal already holds — a successor recovering
+        # from the same file outranks (and fences out) its
+        # predecessor; minted names resume PAST the journaled ones so
+        # instance ids stay fleet-unique across generations.
+        self._journal = None
+        self._params_version = 0
+        self.epoch = 0
+        if journal is not None:
+            prior = fold_records(replay_journal(journal),
+                                 name_prefix=self._name_prefix)
+            self.epoch = prior["epoch"] + 1
+            self._params_version = prior["params_version"] or 0
+            if prior["max_id"] >= 0:
+                self._name_ids = itertools.count(prior["max_id"] + 1)
+            self._journal = FleetJournal(journal, counters=self.metrics)
+            self._journal.append("epoch", epoch=self.epoch)
+            # counter == this manager's generation (bumped by delta so
+            # a reused metrics sink stays monotone)
+            cur = self.metrics.count_value("manager_epoch")
+            if self.epoch > cur:
+                self.metrics.count("manager_epoch", self.epoch - cur)
+
+    def _journal_append(self, kind, **fields):
+        """Best-effort durable record of one state transition: journal
+        failures must never take a crash/drain path down with them
+        (several run on done-callback threads) — they log loudly and
+        the fleet keeps serving."""
+        j = self._journal
+        if j is None:
+            return
+        try:
+            j.append(kind, epoch=self.epoch, **fields)
+        except Exception:   # noqa: BLE001 — the WAL is not the fleet
+            log.exception("fleet journal append failed (%s)", kind)
 
     # -- lifecycle -----------------------------------------------------
     def start(self, control_interval_s=None):
@@ -308,12 +348,178 @@ class FleetManager:
                     rec.server.stop(drain=drain, timeout=timeout)
                 except Exception:   # noqa: BLE001 — teardown finishes
                     log.exception("replica %s stop failed", rec.name)
+                # a cleanly stopped replica leaves the durable roster:
+                # a successor recovering this journal must not re-dial
+                # (or backfill-count) what this generation shut down
+                self._journal_append("replica_drained", name=rec.name,
+                                     reason="manager stop")
+        j, self._journal = self._journal, None
+        if j is not None:
+            try:
+                j.append("manager_stop", epoch=self.epoch)
+                j.close()
+            except Exception:   # noqa: BLE001 — teardown finishes
+                log.exception("fleet journal close failed")
 
     def __enter__(self):
         return self.start()
 
     def __exit__(self, *exc):
         self.stop()
+
+    @classmethod
+    def recover(cls, factory, journal_path, *, redial=None,
+                params_lm=None, identity_dir=None, backfill=True,
+                **kwargs):
+        """Build a SUCCESSOR manager from a predecessor's journal: the
+        durable-control-plane recovery path (module docstring; the
+        reconcile rules live in ARCHITECTURE.md).
+
+        Replays `journal_path` (a mid-file corruption refuses loudly
+        with `JournalCorruptError`; a torn final record is a crash
+        artifact and drops silently), folds it to the intended roster,
+        then reconciles against reality:
+
+          * every listed replica is re-dialed (`redial(name, ident)` —
+            default: a fresh `RemoteReplica` to the journaled
+            host:port) and its HELLO identity VERIFIED against the
+            journal (instance name, pid, process start-time): a
+            recycled port owned by an unrelated process is refused
+            loudly (`adopt_identity_mismatch` counted, local-only
+            teardown — never a KILL frame at a stranger) instead of
+            adopted;
+          * verified replicas are RE-ADOPTED (`replicas_adopted`
+            counted): router, health probe, federation and in-flight
+            accounting resume, and the new generation's epoch is
+            announced so the predecessor is fenced out;
+          * with `identity_dir`, a listed replica whose identity file
+            is GONE exited cleanly (`run_replica_server` removes it on
+            graceful exit) and is skipped without a dial;
+          * a replica mid-drain (`drain_begin` with no completion) is
+            never re-adopted — its predecessor was emptying it; it is
+            put down best-effort and backfilled;
+          * a half-finished canary (`canary_begin` with no verdict)
+            rolls back DETERMINISTICALLY: the canary alone holds
+            unvetted params, so it is crashed (`canary_rollbacks`
+            counted) and the backfill rebuilds it on known-good
+            factory params;
+          * dead/unreachable listed replicas — and, with `backfill`
+            (default), any capacity shortfall — are backfilled to
+            `min_replicas` through the normal spawn path.
+
+        `params_lm` (optional) restores the rolled-forward parameter
+        set for FUTURE spawns when the journal records a fleet-wide
+        roll-forward (`kwargs` pass through to the constructor).
+        Returns the running successor — its epoch is the journal's
+        highest + 1, its minted names resume past the journal's."""
+        records = replay_journal(journal_path)
+        intent = fold_records(records,
+                              name_prefix=kwargs.get("name_prefix", "i"))
+        mgr = cls(factory, journal=journal_path, **kwargs)
+        mgr._running = True
+        if redial is None:
+            def redial(name, ident):
+                from .wire import RemoteReplica
+                if not ident.get("port"):
+                    raise ConnectionError(
+                        f"no wire identity journaled for {name!r}")
+                return RemoteReplica(ident.get("host") or "127.0.0.1",
+                                     ident["port"])
+        roster = sorted(intent["roster"].items(),
+                        key=lambda kv: (kv[1].get("seq") or 0, kv[0]))
+        for name, ident in roster:
+            if ident.get("draining"):
+                # the predecessor was emptying it: routing new work
+                # there would resurrect a replica mid-goodbye — put it
+                # down best-effort and let the backfill replace it
+                try:
+                    srv = redial(name, ident)
+                    srv.kill()
+                except Exception:   # noqa: BLE001 — already gone
+                    pass
+                mgr._journal_append("replica_dead", name=name,
+                                    reason="mid-drain at recovery")
+                continue
+            if identity_dir is not None and not os.path.exists(
+                    os.path.join(str(identity_dir), f"{name}.json")):
+                # graceful exits remove their identity file: nothing
+                # crashed, nothing to re-adopt, nothing to put down
+                mgr._journal_append("replica_drained", name=name,
+                                    reason="clean exit before recovery")
+                continue
+            try:
+                srv = redial(name, ident)
+            except Exception as e:  # noqa: BLE001 — dead is dead
+                mgr._journal_append(
+                    "replica_dead", name=name,
+                    reason=f"unreachable at recovery: {e}")
+                continue
+            inst = getattr(srv, "instance", None)
+            pid = getattr(srv, "pid", None)
+            st = getattr(srv, "start_time", None)
+            mismatch = (
+                (inst is not None and inst != name)
+                or (ident.get("pid") is not None and pid is not None
+                    and pid != ident["pid"])
+                or (ident.get("start_time") is not None
+                    and st is not None
+                    and st != ident["start_time"]))
+            if mismatch:
+                # a recycled port: whoever answered is NOT the replica
+                # the journal listed. Refuse loudly, tear down the
+                # local wire half ONLY — a KILL/STOP frame would drive
+                # an unrelated process
+                mgr.metrics.count("adopt_identity_mismatch")
+                log.error(
+                    "re-adoption of %s refused: identity mismatch "
+                    "(instance %r pid %r start %r vs journaled "
+                    "%r/%r/%r)", name, inst, pid, st, name,
+                    ident.get("pid"), ident.get("start_time"))
+                if hasattr(srv, "_shutdown_local"):
+                    srv._shutdown_local(ServerClosedError(
+                        "identity mismatch at re-adoption"), dead=False)
+                mgr._journal_append("replica_dead", name=name,
+                                    reason="identity mismatch")
+                continue
+            if hasattr(srv, "configure_wire"):
+                # announcing the successor's epoch HERE is what fences
+                # the predecessor out of this replica
+                srv.configure_wire(
+                    heartbeat_timeout=mgr.heartbeat_timeout,
+                    retry_policy=mgr._retry, counters=mgr.metrics,
+                    epoch=mgr.epoch or None)
+            with mgr._lock:
+                rec = _Replica(name, srv, next(mgr._seq))
+                mgr._replicas[name] = rec
+            mgr.metrics.count("replicas_adopted")
+            mgr._journal_append(
+                "adopt", name=name, seq=rec.seq,
+                host=ident.get("host"), port=ident.get("port"),
+                pid=pid if pid is not None else ident.get("pid"),
+                start_time=st if st is not None
+                else ident.get("start_time"))
+            log.info("replica %s re-adopted (epoch %d)", name,
+                     mgr.epoch)
+        can = intent["canary"]
+        if can is not None:
+            # mid-probation death: the canary alone holds params no
+            # gate ever vetted — deterministic rollback by crash (the
+            # backfill below rebuilds on known-good factory params)
+            mgr.metrics.count("canary_rollbacks")
+            mgr._journal_append("canary_rolled_back",
+                                name=can.get("name"),
+                                reason="manager died mid-probation")
+            with mgr._lock:
+                adopted_canary = can.get("name") in mgr._replicas
+            if adopted_canary:
+                mgr._crash(can["name"],
+                           reason="canary rollback at recovery")
+        if intent["params_version"] and params_lm is not None:
+            mgr._params = (params_lm.aux, params_lm.blocks)
+        if backfill:
+            while mgr.n_alive() < mgr.min_replicas:
+                mgr._spawn()
+        return mgr
 
     # -- introspection -------------------------------------------------
     def n_alive(self):
@@ -532,10 +738,12 @@ class FleetManager:
             # wire config — its metrics as the wire-counter sink
             # (wire_reconnects/wire_retries land on the fleet
             # control-plane snapshot), its retry policy, its
-            # heartbeat-timeout reap threshold
+            # heartbeat-timeout reap threshold, and (when journaling)
+            # this generation's epoch for stale-manager fencing
             srv.configure_wire(heartbeat_timeout=self.heartbeat_timeout,
                                retry_policy=self._retry,
-                               counters=self.metrics)
+                               counters=self.metrics,
+                               epoch=self.epoch or None)
         if self._params is not None:
             try:
                 same = srv.current_params()[0] is self._params[0]
@@ -550,8 +758,8 @@ class FleetManager:
         with self._lock:
             orphaned = not self._running
             if not orphaned:
-                self._replicas[name] = _Replica(name, srv,
-                                                next(self._seq))
+                rec = _Replica(name, srv, next(self._seq))
+                self._replicas[name] = rec
         if orphaned:
             # stop() raced the slow factory/warmup above and its sweep
             # never saw this name: tear the orphan down HERE (outside
@@ -560,6 +768,15 @@ class FleetManager:
             srv.stop(drain=False, timeout=10.0)
             raise ServerClosedError("fleet manager stopped during spawn")
         self.metrics.count("replica_spawned")
+        # wire identity rides the spawn record (remote replicas carry
+        # host/port/pid/start_time off their HELLO; in-process ones
+        # journal None — recovery re-adopts only what it can re-dial)
+        self._journal_append(
+            "spawn", name=name, seq=rec.seq,
+            host=getattr(srv, "_host", None),
+            port=getattr(srv, "_port", None),
+            pid=getattr(srv, "pid", None),
+            start_time=getattr(srv, "start_time", None))
         log.info("replica %s spawned (%d alive)", name, self.n_alive())
         return name
 
@@ -617,6 +834,7 @@ class FleetManager:
                     doomed.append((fut, req))
         rec.state = DEAD
         self.metrics.count("replica_dead")
+        self._journal_append("replica_dead", name=name, reason=reason)
         rec.server.kill()           # fails remaining futures loudly
         # refresh with the final post-kill values (counters only grow
         # — and a remote's snapshot falls back to its last good cache
@@ -670,6 +888,10 @@ class FleetManager:
                     del self._live[fut]
                     handoff[fut] = req
             rec.inflight = 0
+        # intent BEFORE action (WAL discipline): a successor must know
+        # this replica was being emptied — a drain_begin without its
+        # replica_drained marks the replica non-re-adoptable
+        self._journal_append("drain_begin", name=rec.name)
         try:
             migrated, replayed = rec.server.drain(timeout=timeout)
         except BaseException as e:  # noqa: BLE001 — degrade to crash
@@ -690,6 +912,8 @@ class FleetManager:
             rec.state = DEAD
             if not raced:
                 self.metrics.count("replica_dead")
+                self._journal_append("replica_dead", name=rec.name,
+                                     reason="drain failed")
                 rec.server.kill()
                 self._install_tombstone(    # refresh: final values
                     rec, self._tombstone_counters(rec))
@@ -726,6 +950,7 @@ class FleetManager:
                 self._tombstones[rec.name] = counters
         rec.state = DEAD
         self.metrics.count("replica_drained")
+        self._journal_append("replica_drained", name=rec.name)
         log.info("replica %s drained (%d migrated, %d replayed; %d "
                  "alive)", rec.name, len(migrated), len(replayed),
                  self.n_alive())
@@ -823,10 +1048,15 @@ class FleetManager:
         counters overlaid (`fleet_replica_spawned`, ... — the manager
         is the one counting its own verbs)."""
         snap = self.fleet_view().snapshot()
+        # fenced_ops stays FEDERATED: the replica hosting the fence is
+        # the one counting refusals — a successor manager overlaying
+        # its own (necessarily zero) count would erase the very events
+        # the fence pin reads
         for key in ("replica_spawned", "replica_drained", "replica_dead",
                     "failover_resubmitted", "canary_rollbacks",
                     "wire_reconnects", "wire_retries",
-                    "migrate_refused"):
+                    "migrate_refused", "manager_epoch",
+                    "replicas_adopted", "journal_records"):
             snap["fleet_" + key] = self.metrics.count_value(key)
         snap["fleet_alive"] = self.n_alive()
         return snap
@@ -890,6 +1120,12 @@ class FleetManager:
                 self.scale_down()
                 acted = "scale_down"
                 self.signal.reset()
+            if acted is not None:
+                # the roster change itself is already journaled by
+                # _spawn/scale_down; this records WHY (the autoscale
+                # decision history a post-mortem replays)
+                self._journal_append("autoscale", action=acted,
+                                     tick=self._ticks)
         return {"tick": self._ticks, "decision": decision,
                 "acted": acted, "backfilled": backfilled,
                 "n_replicas": self.n_alive(),
@@ -938,6 +1174,12 @@ class FleetManager:
         base = self._gate_counters(canary)
         base_peers = self._peer_sheds(exclude=canary.name)
         self._rolling = True
+        # intent before action: a canary_begin with no matching
+        # canary_rolled_* means the manager died mid-probation — the
+        # recovery path rolls the orphaned canary back
+        # deterministically (it alone holds unvetted params)
+        self._journal_append("canary_begin", name=canary.name,
+                             version=self._params_version + 1)
         try:
             canary.server.swap(new_lm)
             for _ in range(int(watch_ticks)):
@@ -976,6 +1218,8 @@ class FleetManager:
             if reason is not None:
                 canary.server.swap(_ParamsView(*old))
                 self.metrics.count("canary_rollbacks")
+                self._journal_append("canary_rolled_back",
+                                     name=canary.name, reason=reason)
                 log.warning("canary %s rolled back: %s", canary.name,
                             reason)
                 return {"status": "rolled_back", "reason": reason,
@@ -988,6 +1232,12 @@ class FleetManager:
             for rec in rest:
                 rec.server.swap(new_lm)
             self._params = (new_lm.aux, new_lm.blocks)
+            self._params_version += 1
+            self._journal_append("canary_rolled_forward",
+                                 name=canary.name,
+                                 version=self._params_version)
+            self._journal_append("params",
+                                 version=self._params_version)
             log.info("rollout complete: canary %s + %d replicas on "
                      "new params", canary.name, len(rest))
             return {"status": "rolled_forward", "canary": canary.name,

@@ -1,0 +1,208 @@
+"""Durable fleet-control journal: the manager's write-ahead intent log.
+
+`FleetManager` (serving/fleet.py) keeps the whole control plane — the
+replica roster, canary state machine, autoscale history, drains in
+flight — in process memory. This module makes that state durable with
+the smallest possible machinery: an append-only, fsync'd,
+length-prefixed + checksummed record log of fleet *intent*, one JSON
+record per state transition.
+
+Record framing (little-endian, `_HDR`)::
+
+    u32 payload-len | u32 crc32(payload) | payload (UTF-8 JSON)
+
+Every record is a flat JSON object with at least a ``"kind"`` field;
+the rest of the fields are kind-specific (see ARCHITECTURE.md's
+"Durable control plane" table). `append()` flushes and fsyncs before
+returning, so a record the manager acted on is on disk before the
+action's effects can be observed.
+
+Replay follows the kvstate crash-safety discipline
+(serving/kvstate.py): the *final* record may be torn — the process
+died mid-write — and is dropped silently; any malformed record with
+bytes after it means the file was corrupted at rest, and replay
+refuses loudly with `JournalCorruptError` (a `KVStateError`) rather
+than hand the manager a roster with a hole in the middle.
+
+`fold_records()` reduces a replayed record list to the recovery
+intent `FleetManager.recover()` reconciles against: current epoch,
+live roster (with wire identity: host/port/pid/start_time), the
+highest minted replica ordinal (so minted names stay unique across
+manager generations), the shipped parameter version, and any canary
+rollout that was in flight when the journal stopped.
+
+Stdlib-only on purpose: the journal must be writable and replayable
+from a process that never imports jax (tools/analyze/layers.toml pins
+this module into the stdlib-only layer).
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+
+from .kvstate import KVStateError
+
+__all__ = ["FleetJournal", "JournalCorruptError", "replay_journal",
+           "fold_records"]
+
+# u32 payload length | u32 crc32 of the payload bytes
+_HDR = struct.Struct("<II")
+
+
+class JournalCorruptError(KVStateError):
+    """A journal record *before* the final one failed its length or
+    checksum: the file was damaged at rest, not torn by a crash.
+    Recovery must not guess at the missing history."""
+
+
+class FleetJournal:
+    """Append-only writer. Opens in append mode so a recovered manager
+    continues the same file its predecessor wrote; every `append()` is
+    flushed + fsync'd before it returns. Counts each durable record
+    into the optional counters sink (``journal_records``) so the
+    journal's activity shows up in the fleet federation."""
+
+    def __init__(self, path, counters=None):
+        self.path = str(path)
+        self._counters = counters
+        self._fh = open(self.path, "ab")
+
+    def append(self, kind, **fields):
+        rec = {"kind": str(kind), **fields}
+        payload = json.dumps(rec, sort_keys=True).encode("utf-8")
+        self._fh.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+        self._fh.write(payload)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        if self._counters is not None:
+            try:
+                self._counters.count("journal_records")
+            except Exception:       # pragma: no cover - sink is best-effort
+                pass
+        return rec
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def replay_journal(path):
+    """Read every intact record from `path`, in order. A missing file
+    replays as an empty journal (a manager that never journaled). A
+    torn final record — short header, short payload, or a checksum /
+    JSON failure that extends exactly to end-of-file — is dropped
+    silently: the writer died mid-append and the record never took
+    effect. The same failure with bytes *after* it raises
+    `JournalCorruptError`."""
+    try:
+        with open(str(path), "rb") as fh:
+            data = fh.read()
+    except FileNotFoundError:
+        return []
+    records = []
+    n = len(data)
+    off = 0
+    while off < n:
+        if off + _HDR.size > n:
+            break               # torn header at EOF: mid-append crash
+        length, crc = _HDR.unpack_from(data, off)
+        start = off + _HDR.size
+        end = start + length
+        if end > n:
+            break               # torn payload at EOF: mid-append crash
+        payload = data[start:end]
+        if zlib.crc32(payload) != crc:
+            if end == n:
+                break           # final record torn mid-write
+            raise JournalCorruptError(
+                f"fleet journal {path}: checksum mismatch in record "
+                f"{len(records)} at byte {off} with "
+                f"{n - end} bytes after it")
+        try:
+            rec = json.loads(payload.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            if end == n:
+                break           # final record torn mid-write
+            raise JournalCorruptError(
+                f"fleet journal {path}: undecodable record "
+                f"{len(records)} at byte {off} with "
+                f"{n - end} bytes after it")
+        records.append(rec)
+        off = end
+    return records
+
+
+def _ordinal(name, prefix):
+    """Numeric suffix of a minted replica name (``i7`` -> 7), or None
+    for names outside the mint pattern."""
+    if not isinstance(name, str) or not name.startswith(prefix):
+        return None
+    tail = name[len(prefix):]
+    return int(tail) if tail.isdigit() else None
+
+
+def fold_records(records, name_prefix="i"):
+    """Reduce a replayed record list to the recovery intent:
+
+    ``epoch``
+        highest manager epoch journaled (0 if never).
+    ``roster``
+        name -> identity dict (``host``/``port``/``pid``/
+        ``start_time``/``seq``) for every replica the journal says
+        should still be alive. ``spawn`` and ``adopt`` add;
+        ``replica_dead`` and ``replica_drained`` remove;
+        ``drain_begin`` marks the entry non-re-adoptable (a successor
+        must not route to a replica its predecessor was emptying).
+    ``max_id``
+        highest numeric suffix ever minted under `name_prefix`, so a
+        recovered manager resumes its name counter past it.
+    ``params_version``
+        version tag of the last parameter set rolled forward fleet
+        wide (None if never swapped).
+    ``canary``
+        the in-flight rollout record if a ``canary_begin`` has no
+        matching ``canary_rolled_forward``/``canary_rolled_back``,
+        else None.
+    """
+    epoch = 0
+    roster = {}
+    max_id = -1
+    params_version = None
+    canary = None
+    for rec in records:
+        kind = rec.get("kind")
+        name = rec.get("name")
+        ordinal = _ordinal(name, name_prefix)
+        if ordinal is not None and ordinal > max_id:
+            max_id = ordinal
+        if kind == "epoch":
+            epoch = max(epoch, int(rec.get("epoch") or 0))
+        elif kind in ("spawn", "adopt"):
+            roster[name] = {
+                "host": rec.get("host"), "port": rec.get("port"),
+                "pid": rec.get("pid"),
+                "start_time": rec.get("start_time"),
+                "seq": rec.get("seq"), "draining": False}
+        elif kind == "drain_begin":
+            if name in roster:
+                roster[name]["draining"] = True
+        elif kind in ("replica_dead", "replica_drained"):
+            roster.pop(name, None)
+        elif kind == "params":
+            params_version = rec.get("version")
+        elif kind == "canary_begin":
+            canary = dict(rec)
+        elif kind in ("canary_rolled_forward", "canary_rolled_back"):
+            canary = None
+    return {"epoch": epoch, "roster": roster, "max_id": max_id,
+            "params_version": params_version, "canary": canary}

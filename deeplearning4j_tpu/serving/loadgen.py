@@ -35,6 +35,15 @@ arrival process does to the queue. This module generates that traffic:
     NOT slow the generator down. Avoiding that feedback — coordinated
     omission — is the entire point of open loop, and the no-coordination
     behavior is pinned by test against a stalling fake server.
+  * `build_chaos_schedule(duration_s, n_events, seed)` ->
+    `ChaosSchedule`: the FAULT-side twin of `build_schedule` — a
+    deterministic, string-seeded timeline of fault actions over the
+    existing injection sites (`serve.wire.*` severs, `fleet.replica`
+    crash, `pause_heartbeats`) plus `manager_kill` (the durable-
+    control-plane restart, guaranteed present by default so every
+    seeded run exercises recovery). Same (duration, n, seed) =>
+    byte-identical timeline (`digest()` pinned); the executor lives in
+    `tools/load_sweep.py --chaos`.
 
 Everything here is host-side scheduling (stdlib; numpy only lazily for
 the micro-batch payload path). Driving a server adds ZERO device
@@ -52,7 +61,8 @@ from .server import ServerOverloadedError, ServingError
 
 __all__ = ["PoissonProcess", "OnOffProcess", "ClosedLoop",
            "DecodeSizeMix", "InferenceSizeMix", "Schedule",
-           "build_schedule", "run_load"]
+           "ChaosSchedule", "CHAOS_ACTIONS", "build_schedule",
+           "build_chaos_schedule", "run_load"]
 
 
 class PoissonProcess:
@@ -222,6 +232,81 @@ def build_schedule(process, mix, n, seed=0):
     return Schedule(process.kind, arrivals, items,
                     concurrency=getattr(process, "concurrency", None),
                     meta={"seed": seed})
+
+
+# the chaos-action alphabet, each mapped to the machinery that executes
+# it (tools/load_sweep.py --chaos): the four wire fault-injection sites
+# (sever = the named failure scenario, see serving/wire.py's site
+# table), the fleet crash site, the hung-process hook, and the durable-
+# control-plane restart
+CHAOS_ACTIONS = {
+    "sever_submit": "serve.wire.submit",
+    "sever_stream": "serve.wire.stream",
+    "sever_migrate": "serve.wire.migrate",
+    "sever_heartbeat": "serve.wire.heartbeat",
+    "replica_crash": "fleet.replica",
+    "pause_heartbeats": None,       # ReplicaServer.pause_heartbeats
+    "manager_kill": None,           # kill + FleetManager.recover()
+}
+
+
+class ChaosSchedule:
+    """The deterministic fault timeline: (offset-seconds, action)
+    events, time-sorted. Two schedules built from the same
+    (duration_s, n_events, seed, actions) are byte-identical —
+    `digest()` pins it, exactly like `Schedule.digest()` pins the
+    offered load. A chaos run is therefore REPLAYABLE: the same seed
+    re-fires the same faults at the same offsets."""
+
+    __slots__ = ("events", "duration_s", "meta")
+
+    def __init__(self, events, duration_s, meta=None):
+        self.events = tuple(
+            dict(e) for e in sorted(events, key=lambda e: e["t"]))
+        self.duration_s = float(duration_s)
+        self.meta = dict(meta or {})
+        for e in self.events:
+            if "t" not in e or "action" not in e:
+                raise ValueError("each chaos event needs 't' and "
+                                 "'action'")
+
+    @property
+    def n(self):
+        return len(self.events)
+
+    def actions(self):
+        return tuple(e["action"] for e in self.events)
+
+    def digest(self):
+        payload = repr(tuple(tuple(sorted(e.items()))
+                             for e in self.events)).encode()
+        return hashlib.sha256(payload).hexdigest()
+
+
+def build_chaos_schedule(duration_s, n_events, seed=0, actions=None,
+                         require_manager_kill=True):
+    """Materialize a seeded chaos timeline: `n_events` actions drawn
+    uniformly from `actions` (default: the full `CHAOS_ACTIONS`
+    alphabet), at offsets inside the middle 80% of `duration_s` — the
+    chaos must land while load is actually flowing, not before the
+    first arrival or after the last. String-seeded
+    (``loadgen.chaos:{seed}``) like `build_schedule`, never `hash()`.
+    With `require_manager_kill` (default), a schedule that drew no
+    manager kill has its middle event rewritten to one — every seeded
+    run exercises journal recovery, not just wire churn."""
+    rng = random.Random(f"loadgen.chaos:{seed}")
+    duration_s = float(duration_s)
+    n = int(n_events)
+    if n < 1:
+        raise ValueError("need n_events >= 1")
+    pool = tuple(actions if actions is not None else CHAOS_ACTIONS)
+    events = [{"t": round(duration_s * (0.1 + 0.8 * rng.random()), 6),
+               "action": pool[rng.randrange(len(pool))]}
+              for _ in range(n)]
+    if require_manager_kill and \
+            not any(e["action"] == "manager_kill" for e in events):
+        events[n // 2]["action"] = "manager_kill"
+    return ChaosSchedule(events, duration_s, meta={"seed": seed})
 
 
 def _default_submit(server, item):

@@ -199,6 +199,14 @@ class ServingMetrics:
                     "canary_rollbacks", "wire_reconnects",
                     "wire_retries", "migrate_refused"):
             self.count(key, 0)
+        # durable control plane (serving/fleetjournal.py + recovery/
+        # fencing in serving/fleet.py + serving/wire.py): same eager
+        # rule — a fleet that never restarted its manager must scrape
+        # zero, not absence, on its epoch, adoptions, fenced control
+        # ops, and journal records
+        for key in ("manager_epoch", "replicas_adopted", "fenced_ops",
+                    "journal_records"):
+            self.count(key, 0)
 
     @property
     def instance(self):
@@ -448,6 +456,13 @@ class ServingMetrics:
         out.setdefault("wire_reconnects", 0)
         out.setdefault("wire_retries", 0)
         out.setdefault("migrate_refused", 0)
+        # durable control plane (serving/fleetjournal.py): manager
+        # generation, recovery re-adoptions, fenced stale-manager ops,
+        # journal records — always present
+        out.setdefault("manager_epoch", 0)
+        out.setdefault("replicas_adopted", 0)
+        out.setdefault("fenced_ops", 0)
+        out.setdefault("journal_records", 0)
         out["service_rate_tokens_per_sec"] = self._service_rate.value
         out["prefix_hit_rate"] = (
             out["prefix_rows_hit"] / out["prefix_rows_total"]

@@ -111,8 +111,8 @@ from .server import (DeadlineExceededError, ReplicaDeadError,
 
 log = logging.getLogger(__name__)
 
-__all__ = ["ReplicaServer", "RemoteReplica", "WireProtocolError",
-           "WireRemoteError", "run_replica_server"]
+__all__ = ["ReplicaServer", "RemoteReplica", "StaleEpochError",
+           "WireProtocolError", "WireRemoteError", "run_replica_server"]
 
 OP_HELLO = 1
 OP_SUBMIT = 2
@@ -126,6 +126,14 @@ OP_SWAP = 9
 OP_HEARTBEAT = 10
 OP_STOP = 11
 OP_KILL = 12
+
+# control-plane ops a stale-epoch manager is fenced out of (tentpole
+# piece 3, ISSUE 16): everything that mutates the replica's lifecycle
+# or params. Data-plane ops (SUBMIT/CANCEL/SNAPSHOT/MIGRATE_IN) stay
+# open — a zombie manager's in-flight REQUESTS still resolve; only
+# its authority over the replica is revoked.
+_FENCED_OPS = frozenset((OP_DRAIN, OP_MIGRATE_OUT, OP_SWAP,
+                         OP_STOP, OP_KILL))
 
 
 class WireProtocolError(ConnectionError):
@@ -142,6 +150,15 @@ class WireRemoteError(ServingError):
     silently delivered as the request's outcome."""
 
 
+class StaleEpochError(ServingError):
+    """A control-plane op (DRAIN/SWAP/MIGRATE_OUT/STOP/KILL) arrived
+    from a manager whose HELLO epoch is OLDER than the highest this
+    replica has seen: a zombie predecessor trying to drive a fleet its
+    successor owns. The replica refuses loudly (and counts
+    `fenced_ops`) instead of obeying — the split-brain guard of the
+    durable control plane (serving/fleetjournal.py)."""
+
+
 # the exception types that survive a wire round-trip AS THEMSELVES —
 # the fleet manager's verdict table depends on real types, so the
 # ERROR header carries the class name and the client re-raises it
@@ -149,7 +166,7 @@ _WIRE_EXCEPTIONS = {cls.__name__: cls for cls in (
     ServingError, ServerOverloadedError, DeadlineExceededError,
     UnhealthyOutputError, ServerClosedError, ReplicaDeadError,
     RequestMigratedError, RequestDrainedError,
-    KVStateError, KVStateVersionError)}
+    KVStateError, KVStateVersionError, StaleEpochError)}
 _WIRE_EXCEPTIONS["ValueError"] = ValueError
 
 
@@ -224,11 +241,14 @@ def _recv_frame(sock):
 # server side
 # ---------------------------------------------------------------------------
 class _Conn:
-    __slots__ = ("sock", "wlock", "peer")
+    __slots__ = ("sock", "wlock", "peer", "epoch")
 
     def __init__(self, sock):
         self.sock = sock
         self.wlock = threading.Lock()
+        self.epoch = None    # manager epoch announced by this
+        #                      connection's HELLO (None = legacy
+        #                      client, unfenced)
         try:
             self.peer = sock.getpeername()
         except OSError:
@@ -285,6 +305,11 @@ class ReplicaServer:
         self._client_ids = itertools.count()
         self._closed = False
         self.killed = False
+        self.epoch_seen = 0   # highest manager epoch HELLO'd to this
+        #   replica; control frames from an older epoch are fenced
+        self._start_time = time.time()   # wire-front-end birth: the
+        #   identity re-adoption verifies alongside pid, so a recycled
+        #   port owned by a DIFFERENT incarnation is refused
         self.pause_heartbeats = False    # chaos hook: a HUNG process —
         #   the main socket still answers but liveness goes silent, and
         #   the client's heartbeat-timeout reap is the only way out
@@ -485,15 +510,46 @@ class ReplicaServer:
             cid = hdr.get("client_id")
             if not cid:
                 cid = f"c{next(self._client_ids)}"
+            epoch = hdr.get("epoch")
+            if epoch is not None:
+                conn.epoch = int(epoch)
+                with self._lock:
+                    delta = max(0, conn.epoch - self.epoch_seen)
+                    self.epoch_seen = max(self.epoch_seen, conn.epoch)
+                if delta:
+                    # the manager_epoch counter IS the highest manager
+                    # generation served (bumped by delta: monotone,
+                    # fleet-summable, equals epoch_seen)
+                    try:
+                        srv.metrics.count("manager_epoch", delta)
+                    except Exception:  # noqa: BLE001 — counting never
+                        pass           # breaks the handshake
             conn.send(OP_HELLO, {
                 "client_id": cid,
                 "instance": getattr(srv, "instance", None),
+                "pid": os.getpid(),
+                "start_time": self._start_time,
+                "epoch": self.epoch_seen,
                 "paged": bool(getattr(srv, "paged", False)),
                 "block_size": getattr(srv, "_block_size", None)})
             return True
         if op == OP_HEARTBEAT:
             if not self.pause_heartbeats:
                 conn.send(OP_HEARTBEAT, {"id": rid, "ok": True})
+            return True
+        if op in _FENCED_OPS and conn.epoch is not None \
+                and conn.epoch < self.epoch_seen:
+            # epoch fence: refuse loudly with the typed error — the
+            # stale manager's caller re-raises StaleEpochError and its
+            # degrade paths (replay, crash accounting) keep every
+            # request; obeying would hand the replica to a zombie
+            try:
+                srv.metrics.count("fenced_ops")
+            except Exception:   # noqa: BLE001 — counting never fences
+                pass
+            conn.send(op, dict(_exc_to_hdr(StaleEpochError(
+                f"op {op} refused: connection epoch {conn.epoch} < "
+                f"highest seen {self.epoch_seen}")), id=rid))
             return True
         if op == OP_SUBMIT:
             attempt = int(hdr.get("attempt", 0))
@@ -678,24 +734,44 @@ class ReplicaServer:
 
 
 def run_replica_server(server, host="127.0.0.1", port=0, port_file=None,
-                       tracer=None, trace_out=None):
+                       tracer=None, trace_out=None, identity_file=None):
     """The cross-process child's main: wrap `server` in a
     `ReplicaServer`, publish the bound port (atomically — a parent
     polls for the file), serve until STOP/KILL/DRAIN, and save the
     tracer's Chrome trace on a GRACEFUL exit (a KILLed replica
-    persists nothing — a real crash would not). Returns the wrapper."""
+    persists nothing — a real crash would not). Returns the wrapper.
+
+    `identity_file` additionally publishes the replica's wire identity
+    (host/port/pid/instance/start_time/epoch) as atomic JSON and
+    REMOVES it on a graceful exit — so a recovering manager can tell a
+    cleanly-stopped replica (file gone: nothing to re-adopt) from a
+    crashed or orphaned one (file present: dial and verify)."""
     rs = ReplicaServer(server, host=host, port=port)
     if port_file:
         tmp = str(port_file) + ".tmp"
         with open(tmp, "w") as fh:
             fh.write(str(rs.port))
         os.replace(tmp, str(port_file))
+    if identity_file:
+        tmp = str(identity_file) + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"host": rs.host, "port": rs.port,
+                       "pid": os.getpid(),
+                       "instance": getattr(server, "instance", None),
+                       "start_time": rs._start_time,
+                       "epoch": rs.epoch_seen}, fh)
+        os.replace(tmp, str(identity_file))
     graceful = rs.serve_forever()
     if graceful and tracer is not None and trace_out:
         try:
             tracer.save(str(trace_out))
         except Exception:   # noqa: BLE001 — trace is best-effort
             log.exception("trace save failed at replica shutdown")
+    if graceful and identity_file:
+        try:
+            os.remove(str(identity_file))
+        except OSError:
+            pass    # already gone: the distinguishing bit is absence
     return rs
 
 
@@ -806,6 +882,11 @@ class RemoteReplica:
         self._client_id = None
         self._paged = False
         self._block_size = None
+        self._epoch = None    # manager epoch announced in HELLO once
+        #                       configure_wire(epoch=) sets it
+        self.pid = None       # replica identity off the HELLO reply:
+        self.start_time = None   # recovery verifies these against the
+        #                       journal before re-adopting a port
         self._ids = itertools.count()
         self._pending = {}               # rid -> _PendingOp
         self._plock = threading.Lock()
@@ -842,11 +923,12 @@ class RemoteReplica:
         return self
 
     def configure_wire(self, heartbeat_timeout=None, retry_policy=None,
-                       counters=None):
+                       counters=None, epoch=None):
         """Fleet-manager hook (`FleetManager._spawn`): fill in
         fleet-level wire config the factory left unset — the manager's
-        `heartbeat_timeout`, its failover `RetryPolicy`, and its
-        `ServingMetrics` as the wire-counter sink."""
+        `heartbeat_timeout`, its failover `RetryPolicy`, its
+        `ServingMetrics` as the wire-counter sink, and its `epoch`
+        (announced to the replica so stale-manager fencing engages)."""
         if counters is not None:
             self._counters = counters
         if retry_policy is not None and self._retry_is_default:
@@ -856,7 +938,29 @@ class RemoteReplica:
         if heartbeat_timeout is not None and \
                 self.heartbeat_timeout is None:
             self.heartbeat_timeout = float(heartbeat_timeout)
+        if epoch is not None and epoch != self._epoch:
+            self._epoch = int(epoch)
+            self._announce_epoch()
         return self
+
+    def _announce_epoch(self):
+        """Best-effort re-HELLO on the LIVE main connection with the
+        newly configured epoch (future dials carry it in their opening
+        HELLO). The reply matches no pending op and falls through
+        `_on_reply` harmlessly — only the server-side `epoch_seen`
+        bump matters."""
+        try:
+            with self._conn_lock:
+                sock = self._sock
+            if sock is None:
+                return    # the next dial's HELLO announces it
+            with self._wlock:
+                # graftlint: disable=lock-discipline -- _wlock is the main socket's dedicated write mutex (the _send_op rule); it never nests another lock
+                _send_frame(sock, OP_HELLO,
+                            {"client_id": self._client_id,
+                             "epoch": self._epoch})
+        except OSError:
+            pass    # broken wire: the reconnect dial re-announces
 
     @property
     def paged(self):
@@ -1189,9 +1293,11 @@ class RemoteReplica:
             sock.settimeout(None)
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
             try:
+                hello = {"client_id": self._client_id}
+                if self._epoch is not None:
+                    hello["epoch"] = self._epoch
                 # graftlint: disable=lock-discipline -- the dial-under-_conn_lock rule above: HELLO must complete before the socket publishes
-                _send_frame(sock, OP_HELLO,
-                            {"client_id": self._client_id})
+                _send_frame(sock, OP_HELLO, hello)
                 # graftlint: disable=lock-discipline -- the dial-under-_conn_lock rule above: HELLO must complete before the socket publishes
                 op, hdr, _ = _recv_frame(sock)
                 if op != OP_HELLO:
@@ -1206,6 +1312,8 @@ class RemoteReplica:
                 self.name = self.instance
             self._paged = bool(hdr.get("paged"))
             self._block_size = hdr.get("block_size")
+            self.pid = hdr.get("pid")
+            self.start_time = hdr.get("start_time")
             # resend in-flight frames BEFORE publishing the socket: a
             # failure here must leave self._sock None so the retry
             # loop re-dials — publishing first would install a broken
@@ -1438,6 +1546,11 @@ class RemoteReplica:
         try:
             if "error" in hdr:
                 exc = _exc_from_hdr(hdr)
+                if isinstance(exc, StaleEpochError):
+                    # the fenced manager's OWN overlay shows the
+                    # refusal too (the replica counted it as well —
+                    # federation sums the replica side)
+                    self._count("fenced_ops")
                 p.ack.set_exception(exc)
                 if p.stream is not None and not p.stream.done():
                     p.stream.set_exception(exc)
@@ -1471,9 +1584,11 @@ class RemoteReplica:
                     sock.settimeout(
                         max(self._hb_interval * 2.0,
                             min(self.heartbeat_timeout or 2.0, 2.0)))
-                    _send_frame(sock, OP_HELLO,
-                                {"client_id": self._client_id,
-                                 "heartbeat": True})
+                    hello = {"client_id": self._client_id,
+                             "heartbeat": True}
+                    if self._epoch is not None:
+                        hello["epoch"] = self._epoch
+                    _send_frame(sock, OP_HELLO, hello)
                     op, _h, _b = _recv_frame(sock)
                     if op != OP_HELLO:
                         raise WireProtocolError(

@@ -1,0 +1,386 @@
+"""Durable control plane pins (ISSUE 16 acceptance criteria).
+
+  (a) Recovery + re-adoption: kill a journaled manager mid-fleet
+      (journal handle gone, replica servers untouched) and
+      `FleetManager.recover` rebuilds the successor from the journal —
+      every live listed replica re-adopted over an identity-verified
+      HELLO (`replicas_adopted` counted), streams across the restart
+      bit-identical to the pre-kill references, federated counters
+      monotone.
+  (b) Epoch fencing: the successor's epoch announcement fences the
+      predecessor out — its next control-plane op is refused with a
+      TYPED `StaleEpochError` (`fenced_ops` counted on the replica AND
+      the stale client) while the predecessor's in-flight data-plane
+      work still resolves: zero requests lost to the fence.
+  (c) Reconcile rules: an absent/empty journal is an empty fleet
+      (backfill respawns, nothing adopted); a replica journaled
+      mid-drain is never re-adopted; a half-finished canary rolls back
+      deterministically (`canary_rollbacks` counted); a recycled port
+      answering with the WRONG identity is refused
+      (`adopt_identity_mismatch`) with local-only teardown — the
+      unrelated process is never sent a control frame.
+  (d) Zero-added-dispatch A/B: journaling + epoch plumbing on the
+      wire fleet dispatches exactly what the journal-less PR 14 fleet
+      dispatches, streams bit-identical (host-side durability must
+      never buy a token with a device dispatch).
+  (e) Chaos smoke: the seeded `load_sweep --chaos` arm (replica
+      PROCESSES, one manager kill+recover inside the schedule) —
+      tier1.yml uploads its report as the CI artifact.
+"""
+import importlib
+import os
+import sys
+import tempfile
+
+import pytest
+
+from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                        FleetJournal, FleetManager,
+                                        RemoteReplica, ReplicaServer,
+                                        ServingMetrics, StaleEpochError,
+                                        replay_journal)
+
+
+def _lm(seed=3):
+    return TransformerLM(64, d_model=16, n_heads=2, n_layers=1,
+                         max_len=64, seed=seed)
+
+
+class _JournaledFleet:
+    """N in-thread ReplicaServers behind RemoteReplicas with the
+    manager JOURNALING — the test_wire `_WireFleet` idiom plus the
+    durable control plane. `abandon()` simulates the manager process
+    dying (journal handle vanishes with it; replica servers and the
+    zombie's sockets stay up); `recover()` builds the successor from
+    the journal through the same factory."""
+
+    def __init__(self, lm, jpath, **mgr_kw):
+        self.wrappers = {}
+        self.stales = []
+        self._lm = lm
+        self.jpath = jpath
+        self.mgr = FleetManager(self._factory, journal=jpath, **mgr_kw)
+
+    def _factory(self, name):
+        srv = ContinuousDecodeServer(
+            self._lm, slots=2, prompt_buckets=(8, 16),
+            metrics=ServingMetrics(name=name), instance=name)
+        rs = ReplicaServer(srv)
+        self.wrappers[name] = rs
+        return RemoteReplica("127.0.0.1", rs.port, name=name,
+                             heartbeat_interval=0.05)
+
+    def start(self):
+        self.mgr.start()
+        for n in self.mgr.replicas:     # compile off the clock
+            self.mgr.replica(n).generate([1, 2, 3], 2, timeout=120)
+        return self.mgr
+
+    def abandon(self):
+        """The manager 'dies': drop its journal handle the way process
+        death would, keep the object as the zombie predecessor."""
+        stale = self.mgr
+        j, stale._journal = stale._journal, None
+        if j is not None:
+            j.close()
+        self.stales.append(stale)
+        return stale
+
+    def recover(self, **kw):
+        self.mgr = FleetManager.recover(self._factory, self.jpath, **kw)
+        return self.mgr
+
+    def close(self):
+        from deeplearning4j_tpu.serving import ServerClosedError
+        try:
+            self.mgr.stop(timeout=60)
+        finally:
+            for stale in self.stales:
+                for n in list(stale.replicas):
+                    try:
+                        stale.replica(n)._shutdown_local(
+                            ServerClosedError("test teardown"),
+                            dead=False)
+                    except Exception:   # noqa: BLE001
+                        pass
+                stale._running = False
+            for rs in self.wrappers.values():
+                rs.close(stop_server=False)
+
+
+@pytest.fixture
+def jpath(tmp_path):
+    return str(tmp_path / "fleet.journal")
+
+
+# ---------------------------------------------------------------------------
+# (a) recovery + re-adoption, (b) epoch fencing
+# ---------------------------------------------------------------------------
+class TestRecovery:
+    def test_kill_recover_readopts_and_fences(self, jpath):
+        lm = _lm()
+        prompts = [[1 + i, 2, 3] for i in range(4)]
+        fleet = _JournaledFleet(lm, jpath, n_replicas=2,
+                                policy="round_robin")
+        try:
+            mgr = fleet.start()
+            assert mgr.epoch == 1
+            refs = [list(mgr.generate(p, 6, timeout=120))
+                    for p in prompts]
+            fv = mgr.fleet_view()
+            pre_done = {n: fv.flat(n).get("completed") or 0
+                        for n in fv.instances}
+            listed = set(mgr.replicas)
+            stale = fleet.abandon()
+            # the predecessor still has DATA-PLANE work in flight when
+            # the successor takes over — the fence must not touch it
+            inflight = [stale.submit(p, 6, deadline_ms=600_000)
+                        for p in prompts]
+            mgr2 = fleet.recover(n_replicas=2, policy="round_robin")
+            assert mgr2.epoch == 2
+            assert set(mgr2.replicas) == listed     # re-adopted, not
+            assert mgr2.metrics.count_value(        # respawned
+                "replicas_adopted") == 2
+            assert mgr2.fleet_snapshot()["fleet_replica_spawned"] == 0
+            # streams across the restart: bit-identical to pre-kill
+            assert [list(mgr2.generate(p, 6, timeout=120))
+                    for p in prompts] == refs
+            # federated counters monotone across the manager restart
+            fv2 = mgr2.fleet_view()
+            for n in fv2.instances:
+                assert (fv2.flat(n).get("completed") or 0) \
+                    >= pre_done.get(n, 0)
+            # FENCING: the zombie's next control op gets the typed
+            # refusal, counted replica-side AND on the stale client
+            victim = next(iter(listed))
+            with pytest.raises(StaleEpochError):
+                stale.replica(victim).drain(timeout=10.0)
+            assert mgr2.fleet_snapshot()["fleet_fenced_ops"] >= 1
+            assert stale.metrics.count_value("fenced_ops") >= 1
+            # zero requests lost: the zombie's in-flight futures all
+            # resolved bit-identically through the fence
+            assert [list(f.result(120)) for f in inflight] == refs
+        finally:
+            fleet.close()
+
+    def test_empty_journal_recovers_empty_then_backfills(self, jpath):
+        lm = _lm()
+        fleet = _JournaledFleet(lm, jpath, n_replicas=2)
+        try:
+            # never started: the journal on disk holds only this
+            # manager's epoch record — no roster to adopt
+            mgr = fleet.recover(n_replicas=2)
+            assert mgr.metrics.count_value("replicas_adopted") == 0
+            assert mgr.n_alive() == 2           # backfilled, fresh
+        finally:
+            fleet.close()
+
+    def test_empty_journal_no_backfill_is_empty_fleet(self, tmp_path):
+        mgr = FleetManager.recover(
+            lambda name: (_ for _ in ()).throw(AssertionError(
+                "no spawn may happen with backfill=False")),
+            str(tmp_path / "absent.journal"), backfill=False,
+            n_replicas=2)
+        assert mgr.n_alive() == 0
+        assert mgr.metrics.count_value("replicas_adopted") == 0
+        mgr._running = False
+
+
+# ---------------------------------------------------------------------------
+# (c) reconcile rules
+# ---------------------------------------------------------------------------
+class TestReconcile:
+    def test_mid_drain_replica_never_readopted(self, jpath):
+        lm = _lm()
+        fleet = _JournaledFleet(lm, jpath, n_replicas=2)
+        try:
+            mgr = fleet.start()
+            doomed = mgr.replicas[0]
+            # the predecessor journaled drain INTENT and died before
+            # the completion record — resurrection would route new
+            # work at a replica mid-goodbye
+            mgr._journal_append("drain_begin", name=doomed)
+            fleet.abandon()
+            mgr2 = fleet.recover(n_replicas=2)
+            assert doomed not in mgr2.replicas
+            assert mgr2.n_alive() == 2          # backfilled past it
+        finally:
+            fleet.close()
+
+    def test_half_finished_canary_rolls_back(self, jpath):
+        lm = _lm()
+        fleet = _JournaledFleet(lm, jpath, n_replicas=2)
+        try:
+            mgr = fleet.start()
+            canary = mgr.replicas[0]
+            mgr._journal_append("canary_begin", name=canary, version=1)
+            fleet.abandon()
+            mgr2 = fleet.recover(n_replicas=2)
+            # the canary alone held unvetted params: deterministic
+            # rollback by crash, backfill rebuilt on factory params
+            assert mgr2.metrics.count_value("canary_rollbacks") == 1
+            assert canary not in mgr2.replicas
+            assert mgr2.n_alive() == 2
+        finally:
+            fleet.close()
+
+    def test_recycled_port_identity_mismatch_refused(self, tmp_path):
+        lm = _lm()
+        jp = str(tmp_path / "fleet.journal")
+        # an UNRELATED server now owns the journaled port: its HELLO
+        # claims a different instance (and pid/start-time would also
+        # miss) — adoption must refuse without sending it a control
+        # frame
+        srv = ContinuousDecodeServer(
+            lm, slots=2, prompt_buckets=(8, 16),
+            metrics=ServingMetrics(name="imposter"),
+            instance="imposter")
+        rs = ReplicaServer(srv)
+        try:
+            with FleetJournal(jp) as j:
+                j.append("epoch", epoch=1)
+                j.append("spawn", name="i0", seq=0, host="127.0.0.1",
+                         port=rs.port, pid=999999, start_time=1.0)
+            mgr = FleetManager.recover(
+                lambda name: (_ for _ in ()).throw(AssertionError(
+                    "mismatch must refuse, not respawn here")),
+                jp, backfill=False, n_replicas=1)
+            assert mgr.metrics.count_value(
+                "adopt_identity_mismatch") == 1
+            assert mgr.n_alive() == 0
+            mgr._running = False
+            # local-only teardown: the imposter was NEVER stopped — it
+            # still serves its own clients
+            rr = RemoteReplica("127.0.0.1", rs.port, name="imposter",
+                               heartbeat_interval=0.05)
+            try:
+                assert list(rr.generate([1, 2, 3], 4, timeout=120)) \
+                    == list(lm.generate([1, 2, 3], 4))
+            finally:
+                rr.stop(drain=True)
+        finally:
+            rs.close(stop_server=False)
+
+    def test_clean_exit_identity_file_skips_dial(self, tmp_path):
+        jp = str(tmp_path / "fleet.journal")
+        with FleetJournal(jp) as j:
+            j.append("epoch", epoch=1)
+            # journaled at a port nobody listens on; identity_dir has
+            # no i0.json -> clean exit, skipped WITHOUT a dial (a dial
+            # would raise/yield replica_dead, not replica_drained)
+            j.append("spawn", name="i0", seq=0, host="127.0.0.1",
+                     port=1, pid=1, start_time=1.0)
+        mgr = FleetManager.recover(
+            lambda name: (_ for _ in ()).throw(AssertionError(
+                "backfill off: no spawn")),
+            jp, backfill=False, identity_dir=str(tmp_path),
+            n_replicas=1)
+        assert mgr.n_alive() == 0
+        assert mgr.metrics.count_value("replicas_adopted") == 0
+        recs = [r for r in replay_journal(jp)
+                if r.get("name") == "i0" and r["kind"] != "spawn"]
+        assert [r["kind"] for r in recs] == ["replica_drained"]
+        mgr._running = False
+
+
+# ---------------------------------------------------------------------------
+# (d) zero-added-dispatch A/B
+# ---------------------------------------------------------------------------
+class TestDispatchAB:
+    def test_journal_and_epoch_add_zero_dispatches(self, jpath):
+        """THE no-fault A/B: the SAME sequential workload through the
+        journaled+epoch-fenced wire fleet and the journal-less PR 14
+        wire fleet — per-replica (dispatches, tokens_out) IDENTICAL,
+        streams bit-identical. Journal appends and epoch HELLOs are
+        host-side; they must never buy a token with a dispatch."""
+        lm = _lm()
+        prompts = [[1 + i, 2, 3] for i in range(6)]
+        counts, outs = {}, {}
+        fleet = _JournaledFleet(lm, jpath, n_replicas=2,
+                                policy="round_robin")
+        try:
+            mgr = fleet.start()
+            assert mgr.epoch == 1       # epoch plumbing really on
+            outs["journaled"] = [mgr.generate(p, 5, timeout=120)
+                                 for p in prompts]
+            counts["journaled"] = [
+                (mgr.replica(n).metrics.count_value("dispatches"),
+                 mgr.replica(n).metrics.count_value("tokens_out"))
+                for n in mgr.replicas]
+        finally:
+            fleet.close()
+
+        wrappers = {}
+
+        def plain_factory(name):
+            srv = ContinuousDecodeServer(
+                lm, slots=2, prompt_buckets=(8, 16),
+                metrics=ServingMetrics(name=name), instance=name)
+            rs = ReplicaServer(srv)
+            wrappers[name] = rs
+            return RemoteReplica("127.0.0.1", rs.port, name=name,
+                                 heartbeat_interval=0.05)
+        try:
+            with FleetManager(plain_factory, n_replicas=2,
+                              policy="round_robin") as mgr:
+                for n in mgr.replicas:
+                    mgr.replica(n).generate([1, 2, 3], 2, timeout=120)
+                assert mgr.epoch == 0   # no journal -> no epoch
+                outs["plain"] = [mgr.generate(p, 5, timeout=120)
+                                 for p in prompts]
+                counts["plain"] = [
+                    (mgr.replica(n).metrics.count_value("dispatches"),
+                     mgr.replica(n).metrics.count_value("tokens_out"))
+                    for n in mgr.replicas]
+        finally:
+            for rs in wrappers.values():
+                rs.close(stop_server=False)
+        assert counts["journaled"] == counts["plain"]
+        assert [list(r) for r in outs["journaled"]] == \
+            [list(r) for r in outs["plain"]]
+
+
+# ---------------------------------------------------------------------------
+# (e) chaos smoke — the CI artifact producer
+# ---------------------------------------------------------------------------
+class TestSmokeChaos:
+    def test_smoke_chaos_sweep(self):
+        """`load_sweep --chaos --fleet-procs 2` at smoke scale: one
+        seeded schedule (3 events, one guaranteed manager kill) over 2
+        replica PROCESSES. Pins: recovery re-adopted both replicas,
+        the stale manager was epoch-fenced with the typed refusal,
+        admitted == completed + failed, every future resolved, every
+        disturbed replay bit-identical. tier1.yml uploads the report
+        (`load_sweep_smoke_chaos.json`/`.txt`)."""
+        out = os.path.join(
+            os.environ.get("SMOKE_REPORT_DIR") or tempfile.gettempdir(),
+            "load_sweep_smoke_chaos")
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        mod = importlib.import_module("load_sweep")
+        results = mod.run_sweep(
+            server="decode", rates=(40.0,), n_req=12, slo_ms=400.0,
+            seed=0, trace=False, report_path=out, fleet_procs=2,
+            chaos=True, chaos_events=3)
+        body = next(r for r in results if r["server"] == "fleet_chaos")
+        rec = body["recovery"]
+        assert rec["replicas_adopted"] == 2
+        assert rec["fenced_op_refused"] is True
+        assert rec["fenced_ops_counted"] >= 1
+        assert rec["counters_monotone_across_restart"] is True
+        assert body["accounting"]["balanced"] is True
+        for entry in body["chaos"]["log"]:
+            assert entry["all_resolved"] is True
+            assert entry["bit_identical"] is True
+        # the digest pins the schedule: seed 0 must replay THIS run
+        from deeplearning4j_tpu.serving import build_chaos_schedule
+        again = build_chaos_schedule(
+            duration_s=3.0, n_events=3, seed=0,
+            actions=("sever_submit", "sever_stream", "sever_heartbeat",
+                     "replica_crash", "manager_kill"))
+        assert body["chaos"]["digest"] == again.digest()
+        assert os.path.exists(out + ".json")
+        assert os.path.exists(out + ".txt")

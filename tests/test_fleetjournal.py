@@ -1,0 +1,230 @@
+"""Fleet journal + chaos schedule pins (ISSUE 16, stdlib-only half).
+
+  (a) Round trip: append N records -> replay returns them in order,
+      every field JSON-faithful, `journal_records` counted per append.
+  (b) Torn tail is a CRASH ARTIFACT: a final record cut anywhere (mid
+      header, mid payload, corrupted checksum extending to EOF) drops
+      SILENTLY — the kvstate discipline for a write that died with the
+      process.
+  (c) Mid-file damage is CORRUPTION: the same byte-flip with intact
+      records after it refuses LOUDLY with `JournalCorruptError` (a
+      `KVStateError` — same family every durable-artifact refusal in
+      the repo raises).
+  (d) Empty/absent journal -> empty record list -> empty fold (a new
+      fleet, not an error).
+  (e) fold_records: epoch is the max seen, spawn/adopt build the
+      roster, drain_begin poisons a replica (mid-drain at recovery is
+      never re-adopted), replica_dead/_drained remove, canary_begin
+      with no verdict survives the fold (the recovery rollback
+      trigger), params tracks the rolled-forward version, minted name
+      ordinals resume past the journal's max, unknown kinds are
+      ignored (forward compatibility).
+  (f) build_chaos_schedule: string-seeded determinism (same seed ==
+      same events AND same sha256 digest; different seed differs),
+      `require_manager_kill` guarantees at least one manager kill,
+      offsets stay inside the middle 80% of the duration.
+"""
+import struct
+
+import pytest
+
+from deeplearning4j_tpu.serving import (ChaosSchedule, FleetJournal,
+                                        JournalCorruptError, KVStateError,
+                                        ServingMetrics,
+                                        build_chaos_schedule,
+                                        fold_records, replay_journal)
+
+
+@pytest.fixture
+def jpath(tmp_path):
+    return str(tmp_path / "fleet.journal")
+
+
+def _write(jpath, *recs):
+    with FleetJournal(jpath) as j:
+        for kind, fields in recs:
+            j.append(kind, **fields)
+    return open(jpath, "rb").read()
+
+
+class TestRoundTrip:
+    def test_append_replay_order_and_fields(self, jpath):
+        _write(jpath,
+               ("epoch", {"epoch": 1}),
+               ("spawn", {"name": "i0", "seq": 0, "host": "127.0.0.1",
+                          "port": 4242, "pid": 77,
+                          "start_time": 1723.456789}),
+               ("drain_begin", {"name": "i0"}))
+        recs = replay_journal(jpath)
+        assert [r["kind"] for r in recs] == ["epoch", "spawn",
+                                             "drain_begin"]
+        # floats survive the JSON round trip EXACTLY — the identity
+        # check at re-adoption compares start_time by equality
+        assert recs[1]["start_time"] == 1723.456789
+        assert recs[1]["port"] == 4242
+
+    def test_journal_records_counted_per_append(self, jpath):
+        m = ServingMetrics()
+        with FleetJournal(jpath, counters=m) as j:
+            for k in range(3):
+                j.append("epoch", epoch=k)
+        assert m.count_value("journal_records") == 3
+
+    def test_append_survives_reopen(self, jpath):
+        _write(jpath, ("epoch", {"epoch": 1}))
+        with FleetJournal(jpath) as j:
+            j.append("epoch", epoch=2)
+        assert [r["epoch"] for r in replay_journal(jpath)] == [1, 2]
+
+
+class TestTornTail:
+    """A damaged FINAL record is the signature of dying mid-write:
+    every cut point must drop it silently and keep the prefix."""
+
+    def _cut(self, jpath, data, keep):
+        with open(jpath, "wb") as fh:
+            fh.write(data[:keep])
+
+    @pytest.mark.parametrize("cut_from_end", [1, 3, 7])
+    def test_truncated_payload_dropped(self, jpath, cut_from_end):
+        data = _write(jpath, ("epoch", {"epoch": 1}),
+                      ("spawn", {"name": "i0", "seq": 0}))
+        self._cut(jpath, data, len(data) - cut_from_end)
+        recs = replay_journal(jpath)
+        assert [r["kind"] for r in recs] == ["epoch"]
+
+    def test_truncated_header_dropped(self, jpath):
+        data = _write(jpath, ("epoch", {"epoch": 1}),
+                      ("spawn", {"name": "i0", "seq": 0}))
+        hdr = struct.Struct("<II")
+        first_end = hdr.size + hdr.unpack_from(data, 0)[0]
+        self._cut(jpath, data, first_end + 4)   # half the next header
+        assert [r["kind"] for r in replay_journal(jpath)] == ["epoch"]
+
+    def test_corrupt_final_record_dropped(self, jpath):
+        data = bytearray(_write(jpath, ("epoch", {"epoch": 1}),
+                                ("spawn", {"name": "i0", "seq": 0})))
+        data[-2] ^= 0xFF                        # CRC mismatch at EOF
+        with open(jpath, "wb") as fh:
+            fh.write(bytes(data))
+        assert [r["kind"] for r in replay_journal(jpath)] == ["epoch"]
+
+
+class TestCorruption:
+    def test_mid_file_flip_refuses_loudly(self, jpath):
+        data = bytearray(_write(jpath, ("epoch", {"epoch": 1}),
+                                ("spawn", {"name": "i0", "seq": 0})))
+        hdr = struct.Struct("<II")
+        first_len = hdr.unpack_from(bytes(data), 0)[0]
+        data[hdr.size + 2] ^= 0xFF      # inside record 0's payload,
+        assert first_len > 2            # records after it intact
+        with open(jpath, "wb") as fh:
+            fh.write(bytes(data))
+        with pytest.raises(JournalCorruptError):
+            replay_journal(jpath)
+
+    def test_corrupt_error_is_kvstate_family(self, jpath):
+        data = bytearray(_write(jpath, ("epoch", {"epoch": 1}),
+                                ("spawn", {"name": "i0", "seq": 0})))
+        data[10] ^= 0xFF
+        with open(jpath, "wb") as fh:
+            fh.write(bytes(data))
+        with pytest.raises(KVStateError):
+            replay_journal(jpath)
+
+    def test_oversized_length_with_intact_tail_is_torn(self, jpath):
+        # a header whose length runs past EOF IS a torn write — the
+        # length prefix itself never got its payload
+        data = _write(jpath, ("epoch", {"epoch": 1}))
+        with open(jpath, "ab") as fh:
+            fh.write(struct.pack("<II", 1 << 20, 0))
+        assert [r["kind"] for r in replay_journal(jpath)] == ["epoch"]
+
+
+class TestEmpty:
+    def test_absent_file_is_empty_fleet(self, tmp_path):
+        recs = replay_journal(str(tmp_path / "never_written"))
+        assert recs == []
+        intent = fold_records(recs)
+        assert intent["roster"] == {} and intent["epoch"] == 0
+
+    def test_empty_file_is_empty_fleet(self, jpath):
+        open(jpath, "wb").close()
+        assert replay_journal(jpath) == []
+
+
+class TestFold:
+    def test_roster_lifecycle(self):
+        recs = [
+            {"kind": "epoch", "epoch": 2},
+            {"kind": "spawn", "name": "i0", "seq": 0, "port": 1},
+            {"kind": "spawn", "name": "i1", "seq": 1, "port": 2},
+            {"kind": "spawn", "name": "i2", "seq": 2, "port": 3},
+            {"kind": "drain_begin", "name": "i1"},
+            {"kind": "replica_dead", "name": "i2"},
+            {"kind": "autoscale", "action": "hold", "tick": 9},
+            {"kind": "wholly_unknown_kind", "x": 1},
+        ]
+        intent = fold_records(recs)
+        assert intent["epoch"] == 2
+        assert set(intent["roster"]) == {"i0", "i1"}
+        assert intent["roster"]["i1"]["draining"] is True
+        assert intent["roster"]["i0"]["draining"] is False
+        assert intent["max_id"] == 2    # minted names resume past i2
+
+    def test_drained_removes_and_adopt_rebuilds(self):
+        recs = [
+            {"kind": "spawn", "name": "i0", "seq": 0},
+            {"kind": "drain_begin", "name": "i0"},
+            {"kind": "replica_drained", "name": "i0"},
+            {"kind": "adopt", "name": "i0", "seq": 5, "port": 9},
+        ]
+        roster = fold_records(recs)["roster"]
+        assert roster["i0"]["draining"] is False
+        assert roster["i0"]["seq"] == 5
+
+    def test_canary_verdict_clears(self):
+        begin = {"kind": "canary_begin", "name": "i1", "version": 2}
+        assert fold_records([begin])["canary"] is not None
+        for verdict in ("canary_rolled_forward", "canary_rolled_back"):
+            recs = [begin, {"kind": verdict, "name": "i1"}]
+            assert fold_records(recs)["canary"] is None
+
+    def test_params_version_tracked(self):
+        recs = [{"kind": "params", "version": 3}]
+        assert fold_records(recs)["params_version"] == 3
+
+
+class TestChaosSchedule:
+    ACTIONS = ("sever_submit", "sever_stream", "replica_crash",
+               "manager_kill")
+
+    def test_seed_determinism_and_digest(self):
+        a = build_chaos_schedule(10.0, 6, seed=42, actions=self.ACTIONS)
+        b = build_chaos_schedule(10.0, 6, seed=42, actions=self.ACTIONS)
+        c = build_chaos_schedule(10.0, 6, seed=43, actions=self.ACTIONS)
+        assert a.events == b.events
+        assert a.digest() == b.digest()
+        assert a.digest() != c.digest()
+
+    def test_manager_kill_guaranteed(self):
+        for seed in range(20):
+            sched = build_chaos_schedule(5.0, 3, seed=seed,
+                                         actions=self.ACTIONS)
+            assert "manager_kill" in sched.actions()
+
+    def test_offsets_inside_middle_band(self):
+        sched = build_chaos_schedule(10.0, 16, seed=0,
+                                     actions=self.ACTIONS)
+        assert sched.n == 16
+        ts = [e["t"] for e in sched.events]
+        assert ts == sorted(ts)
+        assert all(1.0 <= t <= 9.0 for t in ts)
+
+    def test_rejects_empty_schedule(self):
+        with pytest.raises(ValueError):
+            build_chaos_schedule(5.0, 0)
+
+    def test_schedule_validates_events(self):
+        with pytest.raises(ValueError):
+            ChaosSchedule([{"t": 1.0}], duration_s=5.0)

@@ -550,6 +550,15 @@ class TestMetricsPins:
         # the load_sweep --fleet-procs record (eagerly created: a fleet
         # that never lost a connection scrapes zero, not absence)
         "wire_reconnects", "wire_retries", "migrate_refused",
+        # durable control plane (serving/fleetjournal.py + recovery
+        # and epoch fencing in serving/fleet.py / serving/wire.py):
+        # manager generation, recovery re-adoptions, fenced stale-
+        # manager control ops, journal records — consumed by
+        # tools/fleet_report.py's control section and the load_sweep
+        # --chaos record (eagerly created: a fleet whose manager never
+        # restarted scrapes zero, not absence)
+        "manager_epoch", "replicas_adopted", "fenced_ops",
+        "journal_records",
         "admission_error_ms_p50", "admission_error_ms_p99",
         "admission_error_ms_mean", "admission_error_ms_count",
         "slo_total", "slo_met", "slo_tokens_met", "slo_attainment",
@@ -577,6 +586,11 @@ class TestMetricsPins:
         # same way, overlaid live by FleetManager.fleet_snapshot()
         "fleet_wire_reconnects", "fleet_wire_retries",
         "fleet_migrate_refused",
+        # durable-control-plane counters (serving/fleetjournal.py and
+        # the recovery/fencing paths): summed the same way, overlaid
+        # live by FleetManager.fleet_snapshot()
+        "fleet_manager_epoch", "fleet_replicas_adopted",
+        "fleet_fenced_ops", "fleet_journal_records",
     )
 
     def test_fleet_snapshot_keys_pinned(self):

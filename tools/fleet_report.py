@@ -115,7 +115,9 @@ def build_fleet_report(members, traces=None, trace_names=None,
 CONTROL_KEYS = ("fleet_replica_spawned", "fleet_replica_drained",
                 "fleet_replica_dead", "fleet_failover_resubmitted",
                 "fleet_canary_rollbacks", "fleet_wire_reconnects",
-                "fleet_wire_retries", "fleet_migrate_refused")
+                "fleet_wire_retries", "fleet_migrate_refused",
+                "fleet_manager_epoch", "fleet_replicas_adopted",
+                "fleet_fenced_ops", "fleet_journal_records")
 
 
 def format_fleet_report(report, top=20):

@@ -24,7 +24,7 @@ Run (CPU backend, no chip needed):
         [--chunked-prefill C] [--admission] [--overload-ab] \
         [--paged] [--speculate K] [--preempt] [--fleet N]
         [--fleet-control [--fleet-min A --fleet-max B]]
-        [--fleet-procs N]
+        [--fleet-procs N [--chaos [--chaos-events E]]]
 
 `--process onoff` keeps the same MEAN rate but bursts at 2x with a 50%
 duty cycle (the p99 stressor); `--process closed` reinterprets each
@@ -538,6 +538,7 @@ def _replica_serve_main(argv):
     ap.add_argument("--instance", required=True)
     ap.add_argument("--port-file", required=True)
     ap.add_argument("--trace-out", default=None)
+    ap.add_argument("--identity-file", default=None)
     ap.add_argument("--slo-ms", type=float, default=250.0)
     ap.add_argument("--slots", type=int, default=2)
     ap.add_argument("--paged", action="store_true")
@@ -556,7 +557,8 @@ def _replica_serve_main(argv):
         tracer=tr, instance=args.instance, admission=True,
         default_deadline_ms=args.slo_ms, paged=args.paged, block_size=8)
     run_replica_server(srv, port_file=args.port_file, tracer=tr,
-                       trace_out=args.trace_out)
+                       trace_out=args.trace_out,
+                       identity_file=args.identity_file)
 
 
 def sweep_fleet_procs(rates, n_replicas=2, n_req=64, slo_ms=250.0,
@@ -758,6 +760,289 @@ def sweep_fleet_procs(rates, n_replicas=2, n_req=64, slo_ms=250.0,
     return body, snaps, merged
 
 
+def sweep_fleet_chaos(rates, n_replicas=2, n_req=48, slo_ms=250.0,
+                      seed=0, process="poisson", trace=False, slots=2,
+                      chaos_events=5, slice_s=0.2):
+    """The DURABLE-CONTROL-PLANE arm (`--chaos`, needs
+    `--fleet-procs N`): the same replica-process fleet as
+    `sweep_fleet_procs`, but the manager journals every state
+    transition (`serving/fleetjournal.py`) and a SEEDED chaos schedule
+    (`serving.loadgen.build_chaos_schedule`) fires between load slices:
+    socket severs at the wire fault sites, one injected replica crash,
+    and — always — one MANAGER KILL. The kill abandons the live
+    `FleetManager` mid-fleet exactly the way a dead process would
+    (journal handle gone, sockets half-open) and `FleetManager.recover`
+    builds the successor from the journal: live replicas are re-adopted
+    over identity-verified HELLOs, the new epoch fences the predecessor
+    out (its next control op gets a typed `StaleEpochError`), and any
+    shortfall is backfilled.
+
+    The record pins the ISSUE 16 acceptance: every admitted future
+    resolves (bit-identical to the quiet-fleet references or failed
+    loudly), admitted == completed + failed globally, re-adopted
+    replicas' counters stay monotone across the restart, and the
+    fenced op is refused with the typed error while zero requests are
+    lost. The schedule digest makes the whole run replayable from
+    (seed, chaos_events) alone.
+
+    Returns (body, per_instance_snaps, merged_trace_or_None)."""
+    import concurrent.futures as cf
+    import subprocess
+    import tempfile
+
+    from deeplearning4j_tpu.common.resilience import (FaultInjector,
+                                                      RetryPolicy)
+    from deeplearning4j_tpu.obs.fleet import merge_traces
+    from deeplearning4j_tpu.serving import (CHAOS_ACTIONS, DecodeSizeMix,
+                                            FleetManager, RemoteReplica,
+                                            ServerClosedError,
+                                            ServingMetrics,
+                                            StaleEpochError,
+                                            build_chaos_schedule,
+                                            build_schedule, run_load)
+    injector = FaultInjector()
+    retry = RetryPolicy(max_retries=4, base_delay=0.05, max_delay=0.5,
+                        jitter=0.0)
+    tmpdir = tempfile.mkdtemp(prefix="fleet_chaos_")
+    jpath = os.path.join(tmpdir, "fleet.journal")
+    here = os.path.abspath(__file__)
+    procs, trace_files = {}, {}
+
+    def launch(name):
+        port_file = os.path.join(tmpdir, f"{name}.port")
+        trace_out = (os.path.join(tmpdir, f"{name}.trace.json")
+                     if trace else None)
+        cmd = [sys.executable, here, "--replica-serve",
+               "--instance", name, "--port-file", port_file,
+               "--identity-file", os.path.join(tmpdir, f"{name}.json"),
+               "--slo-ms", str(slo_ms), "--slots", str(slots)]
+        if trace_out:
+            cmd += ["--trace-out", trace_out]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs[name] = subprocess.Popen(cmd, env=env)
+        trace_files[name] = trace_out
+        return port_file
+
+    def wait_port(name, port_file, timeout=300.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if os.path.exists(port_file):
+                return int(open(port_file).read().strip())
+            if procs[name].poll() is not None:
+                raise RuntimeError(
+                    f"replica process {name} exited rc="
+                    f"{procs[name].returncode} before binding")
+            time.sleep(0.05)
+        raise TimeoutError(f"replica {name} never published its port")
+
+    names = [f"i{k}" for k in range(int(n_replicas))]
+    ports = {n: launch(n) for n in names}
+
+    def factory(name):
+        port_file = ports.pop(name, None)
+        if port_file is None:
+            port_file = launch(name)        # backfill / crash respawn
+        port = wait_port(name, port_file)
+        return RemoteReplica("127.0.0.1", port, name=name,
+                             retry_policy=retry, heartbeat_interval=0.1,
+                             fault_injector=injector,
+                             process=procs[name])
+
+    def redial(name, ident):
+        # recovery re-dial: NO name= — the identity check must read the
+        # instance the replica CLAIMS in its HELLO, not our expectation
+        return RemoteReplica(ident.get("host") or "127.0.0.1",
+                             ident["port"], retry_policy=retry,
+                             heartbeat_interval=0.1,
+                             fault_injector=injector,
+                             process=procs.get(name))
+
+    def warmup(srv):
+        for p in ([1, 2, 3, 4], list(range(1, 13))):
+            srv.generate(p, 4, deadline_ms=600_000, timeout=300)
+
+    schedule = build_chaos_schedule(
+        duration_s=max(1.0, float(chaos_events)),
+        n_events=int(chaos_events), seed=seed,
+        actions=("sever_submit", "sever_stream", "sever_heartbeat",
+                 "replica_crash", "manager_kill"))
+    mix = DecodeSizeMix(((0.8, (3, 12), (4, 24)),
+                         (0.2, (8, 16), (24, 44))), vocab=96)
+    prompts = [[1, 2, 3]] + [[4 + j, 5, 6] for j in range(5)]
+    mgr = FleetManager(factory, n_replicas=n_replicas, warmup=warmup,
+                       heartbeat_timeout=2.0, fault_injector=injector,
+                       metrics=ServingMetrics(name="fleet"),
+                       journal=jpath)
+    stale = None
+    admitted = completed = failed = 0
+    chaos_log = []
+    recovery_rec = None
+
+    def fault_batch(tag):
+        # plant-then-drive: a planted sever only matters to traffic
+        # that crosses the site, so every fault event drives the SAME
+        # reference prompts through the disturbed fleet and pins them
+        # bit-identical (dedup re-delivery, retry, and failover replay
+        # are invisible under deterministic greedy) — or failed LOUDLY
+        nonlocal admitted, completed, failed
+        futs = [mgr.submit(p, 24, deadline_ms=600_000) for p in prompts]
+        admitted += len(futs)
+        results, resolved, loud = [], 0, 0
+        for f in futs:
+            try:
+                results.append(list(f.result(300)))
+                resolved += 1
+            except (cf.TimeoutError, TimeoutError):
+                results.append(None)        # the one unacceptable end
+            except Exception:   # noqa: BLE001 — loud failure resolves
+                results.append(None)
+                resolved += 1
+                loud += 1
+        completed += resolved - loud
+        failed += loud
+        return {"tag": tag, "all_resolved": resolved == len(futs),
+                "loud_failures": loud,
+                "bit_identical": results == refs}
+    try:
+        mgr.start()
+        # quiet-fleet references: THE streams every disturbed replay
+        # must reproduce (fixed-seed weights ⇒ fleet-wide determinism)
+        refs = [list(mgr.generate(p, 24, deadline_ms=600_000,
+                                  timeout=300)) for p in prompts]
+        slice_n = max(2, int(n_req) // max(1, schedule.n))
+        for ev_i, ev in enumerate(schedule.events):
+            # real arrivals between faults: one seeded schedule slice
+            rate = rates[ev_i % len(rates)]
+            sched = build_schedule(_process_for(process, rate), mix,
+                                   slice_n, seed=seed + ev_i * 1000)
+            pt = run_load(mgr, sched, metrics=None)
+            admitted += pt["admitted"]
+            completed += pt["completed"]
+            failed += pt["failed"]
+            action = ev["action"]
+            rec = {"t": ev["t"], "action": action}
+            if action == "manager_kill":
+                pre_fv = mgr.fleet_view()
+                pre_done = {n: pre_fv.flat(n).get("completed") or 0
+                            for n in pre_fv.instances}
+                stale, mgr = mgr, None
+                # simulate the manager process dying mid-fleet: its
+                # journal handle vanishes with it; its replica sockets
+                # stay half-open (the zombie the fencing exists for)
+                j, stale._journal = stale._journal, None
+                if j is not None:
+                    j.close()
+                mgr = FleetManager.recover(
+                    factory, jpath, redial=redial, identity_dir=tmpdir,
+                    n_replicas=n_replicas, warmup=warmup,
+                    heartbeat_timeout=2.0, fault_injector=injector,
+                    metrics=ServingMetrics(name="fleet"))
+                snap = mgr.fleet_snapshot()
+                post_fv = mgr.fleet_view()
+                monotone = all(
+                    (post_fv.flat(n).get("completed") or 0)
+                    >= pre_done.get(n, 0)
+                    for n in post_fv.instances if n in pre_done)
+                # fencing pin: the predecessor's next control op must
+                # be refused with the TYPED error, not half-obeyed
+                fenced = None
+                victims = [n for n in stale.replicas
+                           if n in mgr.replicas]
+                if victims:
+                    try:
+                        stale.replica(victims[0]).drain(timeout=5.0)
+                        fenced = False
+                    except StaleEpochError:
+                        fenced = True
+                    except Exception as e:  # noqa: BLE001
+                        fenced = f"wrong error: {type(e).__name__}"
+                # the zombie's wire halves close LOCALLY only — a
+                # STOP/KILL frame from it at live replicas is exactly
+                # what the epoch fence forbids
+                for n in list(stale.replicas):
+                    try:
+                        stale.replica(n)._shutdown_local(
+                            ServerClosedError(
+                                "superseded by recovered manager"),
+                            dead=False)
+                    except Exception:   # noqa: BLE001
+                        pass
+                stale._running = False
+                recovery_rec = {
+                    "epoch": mgr.epoch,
+                    "replicas_adopted": snap["fleet_replicas_adopted"],
+                    "fenced_op_refused": fenced,
+                    "fenced_ops_counted": mgr.fleet_snapshot()[
+                        "fleet_fenced_ops"],
+                    "counters_monotone_across_restart": monotone,
+                }
+                rec["recovery"] = recovery_rec
+                rec.update(fault_batch("post_recovery"))
+            elif action == "replica_crash":
+                injector.plan("fleet.replica",
+                              on_call=injector.calls("fleet.replica"),
+                              sever=True, exc=None)
+                mgr.control_tick()      # fires the crash + backfills
+                rec["n_alive_after"] = mgr.n_alive()
+                rec.update(fault_batch("post_crash"))
+            else:
+                site = CHAOS_ACTIONS[action]
+                injector.plan(site, on_call=injector.calls(site),
+                              sever=True, exc=None)
+                rec["site"] = site
+                rec.update(fault_batch(action))
+            chaos_log.append(rec)
+        # the closing wave: the recovered fleet, quiet again, must
+        # still serve the reference streams bit-for-bit
+        chaos_log.append(fault_batch("final_quiet"))
+        final_snap = mgr.fleet_snapshot()
+        snaps = {n: mgr.replica(n).metrics.snapshot()
+                 for n in mgr.replicas}
+        pids = {n: procs[n].pid for n in procs}
+    finally:
+        if mgr is not None:
+            mgr.stop(timeout=120)
+        if stale is not None:
+            stale._running = False
+        for p in procs.values():        # belt and braces
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=30)
+            except Exception:   # noqa: BLE001
+                p.kill()
+    merged = None
+    if trace:
+        saved, tnames = [], []
+        for n, path in trace_files.items():
+            if path and os.path.exists(path):
+                with open(path) as fh:
+                    saved.append(json.load(fh))
+                tnames.append(n)
+        if saved:
+            merged = merge_traces(saved, names=tnames)
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    body = {"server": "fleet_chaos", "n_replicas": int(n_replicas),
+            "process": process,
+            "config": f"journaled FleetManager over {n_replicas} "
+                      f"replica PROCESSES, slots={slots}, seeded chaos "
+                      f"schedule ({schedule.n} events, digest "
+                      f"{schedule.digest()[:12]}), one manager "
+                      f"kill+recover, admission deadline={slo_ms:g}ms",
+            "unit": "resolved futures under chaos",
+            "chaos": {"seed": seed, "n_events": schedule.n,
+                      "digest": schedule.digest(),
+                      "events": schedule.events, "log": chaos_log},
+            "accounting": {"admitted": admitted, "completed": completed,
+                           "failed": failed,
+                           "balanced": admitted == completed + failed},
+            "recovery": recovery_rec,
+            "fleet": final_snap,
+            "replica_pids": pids}
+    return body, snaps, merged
+
+
 def sweep_microbatch(rates, n_req=96, slo_ms=50.0, seed=0,
                      process="poisson", tracer=None):
     """Rate ladder over the InferenceServer (requests/s domain)."""
@@ -876,7 +1161,8 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
               speculate_k=None, preempt=False, fleet=0,
               fleet_obs_per_rate=6, fleet_slice_s=0.25,
               fleet_control=False, fleet_injector=None,
-              fleet_min=None, fleet_max=None, fleet_procs=0):
+              fleet_min=None, fleet_max=None, fleet_procs=0,
+              chaos=False, chaos_events=5):
     """Drive the sweep(s) and (optionally) write the combined
     obs_report (JSON + text + Chrome trace). Returns the results list.
     The tier-1 smoke test calls this with tiny parameters (and once
@@ -899,6 +1185,12 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
     if fleet_procs and (fleet or fleet_control or overload_ab):
         raise ValueError("--fleet-procs is its own scenario: drop "
                          "--fleet/--fleet-control/--overload-ab")
+    if chaos and fleet_procs < 2:
+        raise ValueError("--chaos needs --fleet-procs N (>= 2): the "
+                         "chaos schedule kills and recovers the "
+                         "manager of a replica-PROCESS fleet — "
+                         "silently running without it would discard "
+                         "the flag")
     if fleet_procs and server not in ("decode", "both"):
         raise ValueError("--fleet-procs needs --server decode (or "
                          "both): the wire fleet drives DECODE replica "
@@ -925,7 +1217,14 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
               if trace and not (fleet_mode or fleet_procs) else None)
     fleet_trace = None
     results, snaps = [], {}
-    if fleet_procs >= 2:
+    if fleet_procs >= 2 and chaos:
+        body, inst_snaps, fleet_trace = sweep_fleet_chaos(
+            rates, n_replicas=fleet_procs, n_req=n_req, slo_ms=slo_ms,
+            seed=seed, process=process, trace=trace,
+            chaos_events=chaos_events)
+        results.append(body)
+        snaps.update({f"fleet_{n}": s for n, s in inst_snaps.items()})
+    elif fleet_procs >= 2:
         body, inst_snaps, fleet_trace = sweep_fleet_procs(
             rates, n_replicas=fleet_procs, n_req=n_req, slo_ms=slo_ms,
             seed=seed, process=process, trace=trace, paged=paged,
@@ -1091,6 +1390,20 @@ def main():
                          "mid-stream and the record pins zero lost "
                          "requests + bit-identical streams + the "
                          "merged trace covering every replica pid")
+    ap.add_argument("--chaos", action="store_true",
+                    help="DURABLE-CONTROL-PLANE arm (needs "
+                         "--fleet-procs N): journal every fleet state "
+                         "transition, fire a seeded chaos schedule "
+                         "(socket severs, a replica crash, one MANAGER "
+                         "kill) between load slices, recover the "
+                         "manager from the journal with replica "
+                         "re-adoption, and pin: every admitted future "
+                         "resolves (bit-identical or loudly failed), "
+                         "admitted == completed + failed, the stale "
+                         "manager's next control op is epoch-fenced")
+    ap.add_argument("--chaos-events", type=int, default=5, metavar="E",
+                    help="chaos schedule length (>= 1; one is always "
+                         "a manager kill)")
     ap.add_argument("--preempt", action="store_true",
                     help="durable-KV preemption (implies --paged): the "
                          "mix's long tail submits as a spillable batch "
@@ -1128,7 +1441,9 @@ def main():
                         fleet_control=args.fleet_control,
                         fleet_min=args.fleet_min,
                         fleet_max=args.fleet_max,
-                        fleet_procs=args.fleet_procs)
+                        fleet_procs=args.fleet_procs,
+                        chaos=args.chaos,
+                        chaos_events=args.chaos_events)
     for r in results:
         print(json.dumps(r))
     print(json.dumps({"elapsed_s": fmt(time.perf_counter() - t0, 1),
