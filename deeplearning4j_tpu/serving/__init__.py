@@ -69,8 +69,9 @@ from .server import (DeadlineExceededError, InferenceServer,
                      UnhealthyOutputError)
 from .decode import ContinuousDecodeServer
 from .fleet import FleetManager, RoundRobinSplitter
-from .fleetjournal import (FleetJournal, JournalCorruptError,
-                           fold_records, replay_journal)
+from .fleetjournal import (FleetJournal, JournalBrokenError,
+                           JournalCorruptError, fold_records,
+                           replay_journal)
 from .kvpool import BlockPool, PagedAllocation
 from .kvstate import (KVStateError, KVStateVersionError,
                       PrefixCacheArtifact, RequestArtifact)
@@ -100,6 +101,6 @@ __all__ = [
     "ChaosSchedule", "CHAOS_ACTIONS", "build_chaos_schedule",
     "ReplicaServer", "RemoteReplica", "WireProtocolError",
     "WireRemoteError", "run_replica_server", "StaleEpochError",
-    "FleetJournal", "JournalCorruptError", "fold_records",
-    "replay_journal",
+    "FleetJournal", "JournalBrokenError", "JournalCorruptError",
+    "fold_records", "replay_journal",
 ]

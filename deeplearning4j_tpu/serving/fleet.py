@@ -301,13 +301,15 @@ class FleetManager:
         """Spawn the initial replicas (idempotent) and, with
         `control_interval_s`, a daemon control thread running
         `control_tick()` on that cadence. Tests and the sweep drive
-        ticks manually instead."""
-        if self._running:
-            return self
-        self._running = True
-        while self.n_alive() < self._n_initial:
-            self._spawn()
-        if control_interval_s is not None:
+        ticks manually instead. Each guard is independent: a manager
+        that is already running (e.g. built by `recover()`, which
+        reconciles its own roster) still gets its control thread here,
+        but never a second one."""
+        if not self._running:
+            self._running = True
+            while self.n_alive() < self._n_initial:
+                self._spawn()
+        if control_interval_s is not None and self._ctl_thread is None:
             self._ctl_stop.clear()
 
             def _loop():
@@ -370,7 +372,7 @@ class FleetManager:
     @classmethod
     def recover(cls, factory, journal_path, *, redial=None,
                 params_lm=None, identity_dir=None, backfill=True,
-                **kwargs):
+                control_interval_s=None, **kwargs):
         """Build a SUCCESSOR manager from a predecessor's journal: the
         durable-control-plane recovery path (module docstring; the
         reconcile rules live in ARCHITECTURE.md).
@@ -409,9 +411,13 @@ class FleetManager:
 
         `params_lm` (optional) restores the rolled-forward parameter
         set for FUTURE spawns when the journal records a fleet-wide
-        roll-forward (`kwargs` pass through to the constructor).
-        Returns the running successor — its epoch is the journal's
-        highest + 1, its minted names resume past the journal's."""
+        roll-forward; `control_interval_s` (optional) starts the
+        periodic control thread exactly as `start()` would — and a
+        later `mgr.start(control_interval_s=...)` on the recovered
+        manager does the same (`kwargs` pass through to the
+        constructor). Returns the running successor — its epoch is the
+        journal's highest + 1, its minted names resume past the
+        journal's."""
         records = replay_journal(journal_path)
         intent = fold_records(records,
                               name_prefix=kwargs.get("name_prefix", "i"))
@@ -519,6 +525,8 @@ class FleetManager:
         if backfill:
             while mgr.n_alive() < mgr.min_replicas:
                 mgr._spawn()
+        if control_interval_s is not None:
+            mgr.start(control_interval_s=control_interval_s)
         return mgr
 
     # -- introspection -------------------------------------------------

@@ -40,12 +40,13 @@ from __future__ import annotations
 import json
 import os
 import struct
+import threading
 import zlib
 
 from .kvstate import KVStateError
 
-__all__ = ["FleetJournal", "JournalCorruptError", "replay_journal",
-           "fold_records"]
+__all__ = ["FleetJournal", "JournalBrokenError", "JournalCorruptError",
+           "replay_journal", "fold_records"]
 
 # u32 payload length | u32 crc32 of the payload bytes
 _HDR = struct.Struct("<II")
@@ -57,25 +58,66 @@ class JournalCorruptError(KVStateError):
     Recovery must not guess at the missing history."""
 
 
+class JournalBrokenError(KVStateError):
+    """A previous `append()` failed mid-record AND the truncate back to
+    the last good record boundary also failed: the tail of the file may
+    hold torn bytes. Writing more records after them would convert a
+    recoverable torn tail into mid-file corruption, so the writer
+    refuses every further append."""
+
+
 class FleetJournal:
     """Append-only writer. Opens in append mode so a recovered manager
     continues the same file its predecessor wrote; every `append()` is
-    flushed + fsync'd before it returns. Counts each durable record
-    into the optional counters sink (``journal_records``) so the
-    journal's activity shows up in the fleet federation."""
+    fsync'd before it returns. Appends are serialized under an internal
+    lock and each record is written as ONE contiguous unbuffered write
+    — crash/drain paths journal from done-callback and heartbeat-reap
+    threads while the control thread journals spawns, and interleaving
+    two records' bytes would corrupt the file mid-stream. If a write
+    fails partway (e.g. ENOSPC), the file is truncated back to the last
+    known-good record boundary so the tear stays at EOF where replay
+    tolerates it; if even the truncate fails, the journal marks itself
+    broken and refuses further appends (`JournalBrokenError`). Counts
+    each durable record into the optional counters sink
+    (``journal_records``) so the journal's activity shows up in the
+    fleet federation."""
 
     def __init__(self, path, counters=None):
         self.path = str(path)
         self._counters = counters
-        self._fh = open(self.path, "ab")
+        self._lock = threading.Lock()
+        self._broken = False
+        # unbuffered: a record's single write() goes straight to the
+        # fd, so there is never a buffer holding half a record that a
+        # later truncate/flush could tear differently
+        self._fh = open(self.path, "ab", buffering=0)
+        self._fh.seek(0, os.SEEK_END)
+        self._good = self._fh.tell()    # last known-good record boundary
 
     def append(self, kind, **fields):
         rec = {"kind": str(kind), **fields}
         payload = json.dumps(rec, sort_keys=True).encode("utf-8")
-        self._fh.write(_HDR.pack(len(payload), zlib.crc32(payload)))
-        self._fh.write(payload)
-        self._fh.flush()
-        os.fsync(self._fh.fileno())
+        frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            if self._fh is None:
+                raise JournalBrokenError(
+                    f"fleet journal {self.path}: append after close()")
+            if self._broken:
+                raise JournalBrokenError(
+                    f"fleet journal {self.path}: refusing append after "
+                    f"an unrecovered write failure at byte {self._good}")
+            try:
+                mv = memoryview(frame)
+                while mv:
+                    mv = mv[self._fh.write(mv):]
+                os.fsync(self._fh.fileno())
+            except Exception:
+                try:
+                    os.ftruncate(self._fh.fileno(), self._good)
+                except Exception:   # pragma: no cover - disk truly gone
+                    self._broken = True
+                raise
+            self._good += len(frame)
         if self._counters is not None:
             try:
                 self._counters.count("journal_records")
@@ -84,9 +126,10 @@ class FleetJournal:
         return rec
 
     def close(self):
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def __enter__(self):
         return self

@@ -261,14 +261,14 @@ class ChaosSchedule:
     __slots__ = ("events", "duration_s", "meta")
 
     def __init__(self, events, duration_s, meta=None):
-        self.events = tuple(
-            dict(e) for e in sorted(events, key=lambda e: e["t"]))
-        self.duration_s = float(duration_s)
-        self.meta = dict(meta or {})
-        for e in self.events:
+        events = [dict(e) for e in events]
+        for e in events:        # validate BEFORE the sort key reads "t"
             if "t" not in e or "action" not in e:
                 raise ValueError("each chaos event needs 't' and "
                                  "'action'")
+        self.events = tuple(sorted(events, key=lambda e: e["t"]))
+        self.duration_s = float(duration_s)
+        self.meta = dict(meta or {})
 
     @property
     def n(self):

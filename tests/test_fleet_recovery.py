@@ -186,6 +186,37 @@ class TestRecovery:
         assert mgr.metrics.count_value("replicas_adopted") == 0
         mgr._running = False
 
+    def test_recovered_manager_still_gets_control_thread(self, tmp_path):
+        # recover() marks the manager running; the public
+        # start(control_interval_s=...) must still attach the control
+        # thread — and never a second one
+        mgr = FleetManager.recover(
+            lambda name: (_ for _ in ()).throw(AssertionError(
+                "no spawn may happen with backfill=False")),
+            str(tmp_path / "absent.journal"), backfill=False,
+            n_replicas=2)
+        try:
+            assert mgr._ctl_thread is None
+            mgr.start(control_interval_s=30.0)
+            t = mgr._ctl_thread
+            assert t is not None and t.is_alive()
+            mgr.start(control_interval_s=30.0)
+            assert mgr._ctl_thread is t
+        finally:
+            mgr.stop(timeout=10)
+
+    def test_recover_accepts_control_interval(self, tmp_path):
+        mgr = FleetManager.recover(
+            lambda name: (_ for _ in ()).throw(AssertionError(
+                "no spawn may happen with backfill=False")),
+            str(tmp_path / "absent.journal"), backfill=False,
+            n_replicas=2, control_interval_s=30.0)
+        try:
+            assert mgr._ctl_thread is not None
+            assert mgr._ctl_thread.is_alive()
+        finally:
+            mgr.stop(timeout=10)
+
 
 # ---------------------------------------------------------------------------
 # (c) reconcile rules

@@ -25,10 +25,12 @@
       offsets stay inside the middle 80% of the duration.
 """
 import struct
+import threading
 
 import pytest
 
 from deeplearning4j_tpu.serving import (ChaosSchedule, FleetJournal,
+                                        JournalBrokenError,
                                         JournalCorruptError, KVStateError,
                                         ServingMetrics,
                                         build_chaos_schedule,
@@ -75,6 +77,97 @@ class TestRoundTrip:
         with FleetJournal(jpath) as j:
             j.append("epoch", epoch=2)
         assert [r["epoch"] for r in replay_journal(jpath)] == [1, 2]
+
+    def test_concurrent_appends_never_interleave(self, jpath):
+        # crash/drain paths journal from done-callback and heartbeat
+        # threads while the control thread journals spawns: records
+        # written from many threads must each land contiguous, or
+        # replay refuses the whole file exactly when recovery needs it
+        n_threads, per_thread = 8, 50
+        with FleetJournal(jpath) as j:
+            def hammer(tid):
+                for k in range(per_thread):
+                    j.append("spawn", name=f"t{tid}", seq=k,
+                             pad="x" * (17 * (k % 7)))
+            threads = [threading.Thread(target=hammer, args=(t,))
+                       for t in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        recs = replay_journal(jpath)    # would raise on interleaving
+        assert len(recs) == n_threads * per_thread
+        # per-thread order preserved, no record lost or duplicated
+        for tid in range(n_threads):
+            seqs = [r["seq"] for r in recs if r["name"] == f"t{tid}"]
+            assert seqs == list(range(per_thread))
+
+
+class TestAppendFailure:
+    """A failed append must leave the file replayable: truncate back
+    to the last good record boundary, or refuse all further writes."""
+
+    def test_failed_append_truncates_to_good_boundary(self, jpath,
+                                                      monkeypatch):
+        import deeplearning4j_tpu.serving.fleetjournal as fj
+
+        def boom(fd):
+            raise OSError("disk full")
+        with FleetJournal(jpath) as j:
+            j.append("epoch", epoch=1)
+            good = j._good
+            monkeypatch.setattr(fj.os, "fsync", boom)
+            with pytest.raises(OSError):
+                j.append("spawn", name="i0", seq=0)
+            monkeypatch.undo()
+            # the unsynced record was truncated away: the journal is
+            # NOT broken and the next append lands at the good boundary
+            assert j._good == good
+            j.append("spawn", name="i1", seq=1)
+        recs = replay_journal(jpath)
+        assert [r["kind"] for r in recs] == ["epoch", "spawn"]
+        assert recs[1]["name"] == "i1"
+
+    def test_broken_journal_refuses_further_appends(self, jpath,
+                                                    monkeypatch):
+        import deeplearning4j_tpu.serving.fleetjournal as fj
+        j = FleetJournal(jpath)
+        j.append("epoch", epoch=1)
+        real_fh = j._fh
+
+        class TornFile:         # dies 5 bytes into the record
+            def write(self, mv):
+                real_fh.write(bytes(mv[:5]))
+                raise OSError("disk full mid-record")
+
+            def fileno(self):
+                return real_fh.fileno()
+
+            def close(self):
+                real_fh.close()
+
+        def boom(*a):
+            raise OSError("disk gone")
+        monkeypatch.setattr(fj.os, "ftruncate", boom)
+        j._fh = TornFile()
+        with pytest.raises(OSError):
+            j.append("spawn", name="i0", seq=0)
+        monkeypatch.undo()
+        j._fh = real_fh
+        # the write tore mid-record AND the truncate failed: writing
+        # after the torn bytes would corrupt the file mid-stream, so
+        # every further append refuses
+        with pytest.raises(JournalBrokenError):
+            j.append("spawn", name="i1", seq=1)
+        j.close()
+        # the tear stayed at EOF: replay still recovers the prefix
+        assert [r["kind"] for r in replay_journal(jpath)] == ["epoch"]
+
+    def test_broken_error_is_kvstate_family(self, jpath):
+        with FleetJournal(jpath) as j:
+            j._broken = True
+            with pytest.raises(KVStateError):
+                j.append("epoch", epoch=1)
 
 
 class TestTornTail:
@@ -228,3 +321,9 @@ class TestChaosSchedule:
     def test_schedule_validates_events(self):
         with pytest.raises(ValueError):
             ChaosSchedule([{"t": 1.0}], duration_s=5.0)
+
+    def test_schedule_validates_missing_t_before_sorting(self):
+        # validation must run before the time sort reads e["t"], or a
+        # missing offset surfaces as a KeyError from the sort key
+        with pytest.raises(ValueError):
+            ChaosSchedule([{"action": "manager_kill"}], duration_s=5.0)
