@@ -14,6 +14,11 @@ semantics made explicit. This module is the one place they are defined:
     retried, regardless of the policy's `retryable` tuple (e.g. a push the
     server REFUSED is a terminal condition, while a dropped connection is
     not, even though both subclass ConnectionError).
+  * `RetryBudget` — fleet-wide token-bucket retry budget (Google-SRE
+    style: retries refill as a fraction of successes), shared between
+    every retrying path via `RetryPolicy(budget=...)`; exhaustion turns
+    a retry into a loud `RetryBudgetExhaustedError` instead of load
+    amplification.
   * `FaultInjector` — deterministic, seeded fault schedules keyed by call
     site. Production code exposes named sites (`client.push.sent`,
     `master.round`, `data.batch`, ...) and the injector decides per call
@@ -48,8 +53,66 @@ class NonRetryableError(Exception):
     concrete type also matches the policy's `retryable` classes."""
 
 
+class RetryBudgetExhaustedError(NonRetryableError, RuntimeError):
+    """The shared fleet-wide retry budget denied this retry: the
+    failure is delivered LOUDLY instead of amplified into another
+    replay/resend. Carries the NonRetryableError marker so no nested
+    RetryPolicy ever retries the refusal itself."""
+
+
 class FaultInjected(ConnectionError):
     """Default exception raised at an injected fault site."""
+
+
+class RetryBudget:
+    """Fleet-wide token-bucket retry budget (the Google-SRE "retry
+    budget": retries are paid for by SUCCESSES, so past the saturation
+    knee the recovery machinery cannot amplify offered load — the
+    metastable-failure regime).
+
+    One instance is SHARED by every path that retries on the fleet's
+    behalf: the manager's failover replays (serving/fleet.py) and the
+    wire transport's reconnect/resend loops (serving/wire.py, via
+    `RetryPolicy.budget`). `take()` spends one token per retry and
+    returns False when the bucket is dry — the caller converts the
+    denial into a loud typed failure (`RetryBudgetExhaustedError`),
+    never a silent drop. `on_success()` refills `refill_fraction`
+    tokens per successful completion, capped at `capacity`, so a
+    healthy fleet always has budget and a melting one starves its own
+    retry storm. A fleet that never retries never touches the bucket —
+    the no-fault A/B is byte-identical with or without a budget."""
+
+    def __init__(self, capacity=64, refill_fraction=0.1, initial=None):
+        self.capacity = float(capacity)
+        self.refill_fraction = float(refill_fraction)
+        if self.capacity < 0 or self.refill_fraction < 0:
+            raise ValueError("need capacity >= 0 and "
+                             "refill_fraction >= 0")
+        self._tokens = (self.capacity if initial is None
+                        else min(float(initial), self.capacity))
+        self._lock = threading.Lock()
+        self.denied = 0         # lifetime denial count (observability)
+
+    @property
+    def tokens(self):
+        with self._lock:
+            return self._tokens
+
+    def take(self, n=1):
+        """Spend `n` tokens for a retry; False = budget exhausted (the
+        caller must fail loudly, not wait)."""
+        with self._lock:
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            self.denied += 1
+            return False
+
+    def on_success(self, n=1):
+        """Refill from `n` successful completions."""
+        with self._lock:
+            self._tokens = min(self.capacity,
+                               self._tokens + self.refill_fraction * n)
 
 
 class RetryPolicy:
@@ -70,13 +133,19 @@ class RetryPolicy:
     def __init__(self, max_retries=5, base_delay=0.05, max_delay=2.0,
                  multiplier=2.0, jitter=0.25, deadline=None,
                  retryable=(ConnectionError, TimeoutError, OSError),
-                 seed=0, sleep=None, clock=None, metric=None):
+                 seed=0, sleep=None, clock=None, metric=None,
+                 budget=None):
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
         # `metric`: optional name suffix for the registry counter, so a
         # PS client's reconnect retries and a serving dispatch's retries
         # are distinguishable on the /metrics route
         self.metric = metric
+        # `budget`: optional shared RetryBudget — the fleet-wide retry
+        # gate every holder of this policy consults via grant_retry()
+        # before spending an attempt. None (default) = unbudgeted, the
+        # exact legacy behavior.
+        self.budget = budget
         self.max_retries = int(max_retries)
         self.base_delay = float(base_delay)
         self.max_delay = float(max_delay)
@@ -93,6 +162,12 @@ class RetryPolicy:
         if isinstance(exc, NonRetryableError):
             return False
         return isinstance(exc, self.retryable)
+
+    def grant_retry(self, n=1):
+        """Consult the shared retry budget (True when unbudgeted). One
+        call per retry ATTEMPT, made at the spend site — the policy
+        itself stays stateless across holders."""
+        return self.budget is None or self.budget.take(n)
 
     def delay(self, attempt):
         """Backoff before retry number `attempt` (0-based). Consumes one
@@ -117,6 +192,10 @@ class RetryPolicy:
             except BaseException as e:  # noqa: BLE001 — classified below
                 if not self.is_retryable(e) or attempt >= self.max_retries:
                     raise
+                if not self.grant_retry():
+                    raise RetryBudgetExhaustedError(
+                        f"retry budget exhausted after "
+                        f"{type(e).__name__}: {e}") from e
                 d = self.delay(attempt)
                 if self.deadline is not None:
                     remaining = self.deadline - (self._clock() - start)

@@ -438,8 +438,16 @@ class FleetView:
                     "wire_reconnects", "wire_retries",
                     "migrate_refused", "manager_epoch",
                     "replicas_adopted", "fenced_ops",
-                    "journal_records"):
+                    "journal_records", "requests_quarantined",
+                    "breaker_open_total", "retry_budget_exhausted",
+                    "degraded_mode_ticks", "infant_deaths"):
             out["fleet_" + key] = counters.get(key, 0)
+        # the breaker's live state is a GAUGE — federation can't sum
+        # it; the manager's fleet_snapshot() overlays its own. Here the
+        # per-instance max stands in (any open breaker reads open).
+        states = [v for v in self.gauge_view(
+            "breaker_state")["per_instance"].values() if v is not None]
+        out["fleet_breaker_state"] = max(states) if states else 0.0
         # mean of per-instance occupancy statistics (summary kind:
         # recent scheduling-iteration slot occupancy) — the scale_down
         # input. A PARSED exposition carries no window mean (summaries

@@ -63,10 +63,10 @@ from .admission import (AdmissionController, BrownoutPolicy,
                         ServiceRateEstimator)
 from .metrics import ServingMetrics
 from .server import (DeadlineExceededError, InferenceServer,
-                     ReplicaDeadError, RequestDrainedError,
-                     RequestMigratedError, ServerClosedError,
-                     ServerOverloadedError, ServingError,
-                     UnhealthyOutputError)
+                     PoisonPillError, ReplicaDeadError,
+                     RequestDrainedError, RequestMigratedError,
+                     ServerClosedError, ServerOverloadedError,
+                     ServingError, UnhealthyOutputError)
 from .decode import ContinuousDecodeServer
 from .fleet import FleetManager, RoundRobinSplitter
 from .fleetjournal import (FleetJournal, JournalBrokenError,
@@ -92,7 +92,7 @@ __all__ = [
     "RequestArtifact", "PrefixCacheArtifact", "KVStateError",
     "KVStateVersionError", "RequestMigratedError",
     "FleetManager", "RoundRobinSplitter", "ReplicaDeadError",
-    "RequestDrainedError",
+    "RequestDrainedError", "PoisonPillError",
     "AdmissionController", "BrownoutPolicy", "ServiceRateEstimator",
     "Speculator", "DraftSource", "NGramDraft", "ModelDraft",
     "PoissonProcess", "OnOffProcess", "ClosedLoop",

@@ -66,38 +66,66 @@ behind a router and ACTS on what the sensors say.
     capacity: `control_tick()` re-spawns up to `min_replicas` before
     consulting the signal.
 
+  * **Blast-radius containment** (ARCHITECTURE.md has the full rules):
+    three disciplines that keep one bad request, one bad config, or
+    one overload wave from taking the whole fleet down. (1) POISON-
+    PILL QUARANTINE: the manager records which replica deaths each
+    in-flight request was aboard for; a request implicated in
+    `_QUARANTINE_DEATHS` distinct deaths is the probable killer — its
+    outer future fails with `PoisonPillError` (never replayed again),
+    its prompt fingerprint enters a bounded quarantine set that sheds
+    re-submissions at admission, and the event is journaled so
+    `recover()` doesn't resurrect it. (2) SPAWN CIRCUIT BREAKER:
+    a replica dying within `infant_mortality_s` of spawn is a strike;
+    K consecutive strikes OPEN the breaker — backfill stops crash-
+    looping and probes with ONE spawn per exponential-backoff window
+    (half-open) until a probe survives infancy. While open the fleet
+    runs DEGRADED: it serves on the replicas it has and sheds the
+    lowest request classes via the `BrownoutPolicy` seam, so
+    accounting (admitted == completed + failed) holds with less
+    capacity. (3) FLEET-WIDE RETRY BUDGET: failover replays and wire
+    resends share one `RetryBudget` token bucket (refilled as a
+    fraction of completions); exhaustion converts the retry into a
+    loud `RetryBudgetExhaustedError` instead of amplifying load — the
+    metastable-failure guard.
+
 The manager itself publishes the fleet-control event counters —
 `replica_spawned` / `replica_drained` / `replica_dead` /
-`failover_resubmitted` / `canary_rollbacks` — through its own
-`ServingMetrics` (always-present snapshot keys, on the Prometheus
-route like every other endpoint) and overlays them onto
-`fleet_snapshot()` as `fleet_*` keys next to the PR 12 federation
-read-outs.
+`failover_resubmitted` / `canary_rollbacks` — plus the containment
+counters (`requests_quarantined` / `breaker_open_total` /
+`retry_budget_exhausted` / `degraded_mode_ticks` / `infant_deaths`
+and the `breaker_state` gauge) — through its own `ServingMetrics`
+(always-present snapshot keys, on the Prometheus route like every
+other endpoint) and overlays them onto `fleet_snapshot()` as
+`fleet_*` keys next to the PR 12 federation read-outs.
 """
 from __future__ import annotations
 
 import collections
 import concurrent.futures as cf
+import hashlib
 import itertools
 import logging
 import os
 import threading
 import time
 
-from ..common.resilience import RetryPolicy
+from ..common.resilience import (RetryBudgetExhaustedError, RetryPolicy)
 from ..obs.fleet import SHED_KEYS, AutoscaleSignal, FleetView
+from .admission import SHED as BROWNOUT_SHED
 from .fleetjournal import FleetJournal, fold_records, replay_journal
 from .kvstate import KVStateError
 from .metrics import ServingMetrics
-from .server import (DeadlineExceededError, ReplicaDeadError,
-                     ServerClosedError, ServerOverloadedError,
-                     UnhealthyOutputError, _fail_future, _ParamsView,
-                     _resolve_future)
+from .server import (DeadlineExceededError, PoisonPillError,
+                     ReplicaDeadError, ServerClosedError,
+                     ServerOverloadedError, UnhealthyOutputError,
+                     _fail_future, _ParamsView, _resolve_future)
 
 log = logging.getLogger(__name__)
 
 __all__ = ["FleetManager", "RoundRobinSplitter", "HEALTHY", "DEGRADED",
-           "DRAINING", "DEAD"]
+           "DRAINING", "DEAD", "BREAKER_CLOSED", "BREAKER_OPEN",
+           "BREAKER_HALF_OPEN"]
 
 # replica health states (the router's per-replica state machine):
 # HEALTHY and DEGRADED are routable (healthy preferred), DRAINING
@@ -106,6 +134,29 @@ HEALTHY = "healthy"
 DEGRADED = "degraded"
 DRAINING = "draining"
 DEAD = "dead"
+
+# spawn circuit-breaker states: CLOSED spawns freely, OPEN refuses
+# (degraded mode), HALF_OPEN has exactly one probe spawn in flight.
+# The `breaker_state` gauge publishes them as 0 / 1 / 0.5.
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+_BREAKER_GAUGE = {BREAKER_CLOSED: 0.0, BREAKER_HALF_OPEN: 0.5,
+                  BREAKER_OPEN: 1.0}
+
+# distinct replica deaths that convict an in-flight request as the
+# poison pill (one death has too many innocent co-victims; two
+# distinct replicas dying under the same request is the signature)
+_QUARANTINE_DEATHS = 2
+
+
+def _fingerprint(prompt, params_version):
+    """Quarantine identity of a request: sha256 over the prompt tokens
+    + the params version they would decode under (the same prompt is a
+    DIFFERENT request against different weights)."""
+    payload = repr((tuple(int(t) for t in prompt),
+                    int(params_version or 0))).encode()
+    return hashlib.sha256(payload).hexdigest()
 
 
 class RoundRobinSplitter:
@@ -146,9 +197,9 @@ class _FleetRequest:
     OUTER future plus everything a failover replay needs."""
 
     __slots__ = ("prompt", "max_new", "deadline", "klass", "outer",
-                 "attempts", "replica")
+                 "attempts", "replica", "deaths", "fp")
 
-    def __init__(self, prompt, max_new, deadline, klass):
+    def __init__(self, prompt, max_new, deadline, klass, fp=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
         self.deadline = deadline        # absolute monotonic, or None
@@ -156,13 +207,15 @@ class _FleetRequest:
         self.outer = cf.Future()
         self.attempts = 0               # failover resubmissions so far
         self.replica = None             # current replica name
+        self.deaths = set()             # replica deaths it was aboard for
+        self.fp = fp                    # quarantine fingerprint
 
 
 class _Replica:
     __slots__ = ("name", "server", "state", "seq", "inflight",
-                 "probe_sheds", "probe_failed")
+                 "probe_sheds", "probe_failed", "born")
 
-    def __init__(self, name, server, seq):
+    def __init__(self, name, server, seq, born=None):
         self.name = name
         self.server = server
         self.state = HEALTHY
@@ -170,6 +223,10 @@ class _Replica:
         self.inflight = 0               # manager-tracked live requests
         self.probe_sheds = 0            # health probe baselines
         self.probe_failed = 0
+        self.born = born                # spawn monotonic (None: adopted
+        #                                 — an adoptee's age is unknown,
+        #                                 so it can never strike the
+        #                                 spawn breaker as an infant)
 
 
 class FleetManager:
@@ -198,7 +255,8 @@ class FleetManager:
     # while propagating would fail the caller with a handoff-protocol
     # internal on e.g. a drain that completed just after its timeout.
     _PROPAGATE = (DeadlineExceededError, ServerOverloadedError,
-                  UnhealthyOutputError, ValueError)
+                  UnhealthyOutputError, RetryBudgetExhaustedError,
+                  ValueError)
 
     def __init__(self, factory, n_replicas=2, *, signal=None,
                  policy="least_backlog", min_replicas=None,
@@ -206,11 +264,17 @@ class FleetManager:
                  heartbeat_timeout=None, fault_injector=None,
                  metrics=None, name="fleet", warmup=None,
                  degrade_shed_rate=25, name_prefix="i",
-                 journal=None):
+                 journal=None, retry_budget=None, brownout=None,
+                 kill_hook=None, infant_mortality_s=5.0,
+                 breaker_strikes=3, breaker_backoff_s=0.5,
+                 breaker_max_backoff_s=30.0, quarantine_capacity=256,
+                 journal_compact_bytes=None):
         if policy not in ("least_backlog", "round_robin"):
             raise ValueError(f"unknown routing policy {policy!r}")
         if int(n_replicas) < 1:
             raise ValueError("need n_replicas >= 1")
+        if int(breaker_strikes) < 1:
+            raise ValueError("need breaker_strikes >= 1")
         self._factory = factory
         self._n_initial = int(n_replicas)
         self.signal = signal
@@ -233,6 +297,12 @@ class FleetManager:
         # machine (`_spawn` pushes them through `configure_wire`).
         self._retry = retry_policy if retry_policy is not None else \
             RetryPolicy(max_retries=3, base_delay=0.0, jitter=0.0)
+        # the fleet-wide retry budget rides ON the retry policy (the
+        # shared hook): the same object `configure_wire` hands every
+        # remote replica, so wire resends and failover replays spend
+        # from ONE bucket
+        if retry_budget is not None:
+            self._retry.budget = retry_budget
         self.heartbeat_timeout = (None if heartbeat_timeout is None
                                   else float(heartbeat_timeout))
         self._injector = fault_injector
@@ -258,6 +328,33 @@ class FleetManager:
         self._ticks = 0
         self._last_tick = None      # (monotonic, fleet tokens_out) —
         #                             the utilization window
+        # blast-radius containment state (module docstring):
+        # quarantine — bounded ordered set of poison fingerprints
+        self._quarantine = collections.OrderedDict()
+        self._quarantine_cap = int(quarantine_capacity)
+        # spawn circuit breaker — strike counter + state machine
+        self.infant_mortality_s = float(infant_mortality_s)
+        self.breaker_strikes = int(breaker_strikes)     # K
+        self._breaker = BREAKER_CLOSED
+        self._strikes = 0
+        self._last_strike = 0.0     # monotonic of the latest strike:
+        #                             a spawn born after it that
+        #                             survives infancy breaks the
+        #                             CONSECUTIVE-strike chain
+        self._breaker_backoff0 = float(breaker_backoff_s)
+        self._breaker_backoff = float(breaker_backoff_s)
+        self._breaker_max_backoff = float(breaker_max_backoff_s)
+        self._breaker_until = 0.0   # monotonic: next half-open probe
+        self._probe_name = None     # the one in-flight probe replica
+        # degraded-mode brownout (None: degraded mode serves what it
+        # can but sheds nothing — the legacy behavior)
+        self._brownout = brownout
+        # chaos seam: kill_hook(prompt, replica_name) -> truthy crashes
+        # the replica the request just landed on (a poison decode)
+        self._kill_hook = kill_hook
+        self._journal_compact_bytes = (
+            None if journal_compact_bytes is None
+            else int(journal_compact_bytes))
         # durable control plane (serving/fleetjournal.py): `journal`
         # (a path) makes every state transition a fsync'd WAL record.
         # Each manager GENERATION bumps the monotone epoch past
@@ -275,6 +372,28 @@ class FleetManager:
             self._params_version = prior["params_version"] or 0
             if prior["max_id"] >= 0:
                 self._name_ids = itertools.count(prior["max_id"] + 1)
+            # containment state survives the manager: quarantined
+            # fingerprints keep shedding (recover() must not resurrect
+            # the killer) and an OPEN breaker stays open (the successor
+            # must not resume the spawn crash-loop its predecessor
+            # escaped — it probes after a fresh backoff instead)
+            for fp in prior.get("quarantine") or ():
+                self._quarantine[fp] = True
+            while len(self._quarantine) > self._quarantine_cap:
+                self._quarantine.popitem(last=False)
+            br = prior.get("breaker")
+            if br and br.get("state") in (BREAKER_OPEN,
+                                          BREAKER_HALF_OPEN):
+                self._breaker = BREAKER_OPEN
+                self._strikes = int(br.get("strikes") or
+                                    self.breaker_strikes)
+                self._breaker_backoff = min(
+                    self._breaker_max_backoff,
+                    float(br.get("backoff_s") or self._breaker_backoff))
+                self._breaker_until = (time.monotonic()
+                                       + self._breaker_backoff)
+                self.metrics.record_breaker_state(
+                    _BREAKER_GAUGE[BREAKER_OPEN])
             self._journal = FleetJournal(journal, counters=self.metrics)
             self._journal.append("epoch", epoch=self.epoch)
             # counter == this manager's generation (bumped by delta so
@@ -519,12 +638,27 @@ class FleetManager:
                 adopted_canary = can.get("name") in mgr._replicas
             if adopted_canary:
                 mgr._crash(can["name"],
-                           reason="canary rollback at recovery")
+                           reason="canary rollback at recovery",
+                           convict=False)
         if intent["params_version"] and params_lm is not None:
             mgr._params = (params_lm.aux, params_lm.blocks)
         if backfill:
-            while mgr.n_alive() < mgr.min_replicas:
-                mgr._spawn()
+            # BOUNDED: spawns that succeed but die before the next
+            # n_alive() read (an infant-death factory) must not loop
+            # this path forever — cap at min_replicas + K attempts,
+            # respect the (possibly inherited-open) breaker, and fall
+            # through to degraded mode with a warning
+            for _ in range(mgr.min_replicas + mgr.breaker_strikes):
+                if mgr.n_alive() >= mgr.min_replicas:
+                    break
+                if not mgr._spawn_allowed():
+                    break
+                mgr._spawn_guarded()
+            if mgr.n_alive() < mgr.min_replicas:
+                log.warning(
+                    "recovery backfill stopped at %d/%d alive "
+                    "replicas (breaker %s): degraded mode",
+                    mgr.n_alive(), mgr.min_replicas, mgr._breaker)
         if control_interval_s is not None:
             mgr.start(control_interval_s=control_interval_s)
         return mgr
@@ -569,10 +703,36 @@ class FleetManager:
             raise ServerClosedError("fleet manager is not running")
         if self._injector is not None:
             self._injector.fire("fleet.submit")
+        fp = _fingerprint(prompt, self._params_version)
+        with self._lock:
+            quarantined = fp in self._quarantine
+        if quarantined:
+            # a re-submission of a convicted poison pill: shed at the
+            # door — it must never reach (and kill) another replica
+            self.metrics.count("requests_quarantined")
+            raise PoisonPillError(
+                f"prompt fingerprint {fp[:12]} is quarantined "
+                f"(implicated in >= {_QUARANTINE_DEATHS} replica "
+                f"deaths)")
+        if self._breaker != BREAKER_CLOSED and \
+                self._brownout is not None:
+            # degraded mode: the breaker says capacity cannot be
+            # rebuilt right now, so the brownout seam sheds the lowest
+            # classes first — pressure is the missing-capacity
+            # fraction, standing in for the queue fraction the
+            # per-server policy uses
+            pressure = max(0.0, 1.0 - self.n_alive()
+                           / max(1, self.min_replicas))
+            if self._brownout.decide(klass, pressure) == BROWNOUT_SHED:
+                self.metrics.count("shed_brownout")
+                raise ServerOverloadedError(
+                    f"degraded mode (spawn breaker {self._breaker}): "
+                    f"class {klass!r} shed by fleet brownout")
         now = time.monotonic()
         deadline = (now + float(deadline_ms) / 1e3
                     if deadline_ms is not None else None)
-        req = _FleetRequest(prompt, max_new_tokens, deadline, klass)
+        req = _FleetRequest(prompt, max_new_tokens, deadline, klass,
+                            fp=fp)
         self.metrics.count("received")
         self._dispatch(req)         # sheds raise out of submit here
         return req.outer
@@ -629,6 +789,20 @@ class FleetManager:
                 last = e
                 continue
             self._register(rec, req, inner)
+            if self._kill_hook is not None:
+                # the poison chaos seam: a truthy hook verdict models
+                # a decode that deterministically kills its replica —
+                # the crash sweep below fails this request over (or
+                # quarantines it on its second kill)
+                try:
+                    poisoned = bool(self._kill_hook(req.prompt,
+                                                    rec.name))
+                except Exception:   # noqa: BLE001 — chaos stays chaos
+                    log.exception("kill hook raised; ignoring")
+                    poisoned = False
+                if poisoned:
+                    self._crash(rec.name,
+                                reason="poison decode killed replica")
             return
 
     def _register(self, rec, req, inner):
@@ -657,15 +831,34 @@ class FleetManager:
         if not self._settle_handoff(fut, req):
             self._failover(req, fut.exception())
 
-    def _failover(self, req, exc):
+    def _failover(self, req, exc, blame=True):
         """Resubmit a request whose replica failed underneath it:
         prompt replay on a survivor (deterministic greedy decode ==
         the uninterrupted stream), bounded by the retry policy; out of
         budget / out of survivors / stopped manager fails the outer
-        future LOUDLY with the original error."""
+        future LOUDLY with the original error. Before replaying, two
+        containment gates: a request aboard its second distinct
+        SPONTANEOUS replica death is the probable KILLER — quarantined,
+        never replayed (`blame=False` excludes operator-initiated
+        kills: the operator chose that victim, the request did not) —
+        and a replay the fleet-wide retry budget refuses fails loudly
+        instead of amplifying load."""
+        if blame and isinstance(exc, ReplicaDeadError) \
+                and req.replica is not None:
+            req.deaths.add(req.replica)
+            if len(req.deaths) >= _QUARANTINE_DEATHS:
+                self._quarantine_req(req, exc)
+                return
         req.attempts += 1
         if not self._running or req.attempts > self._retry.max_retries:
             if _fail_future(req.outer, exc):
+                self.metrics.count("failed")
+            return
+        if not self._retry.grant_retry():
+            self.metrics.count("retry_budget_exhausted")
+            if _fail_future(req.outer, RetryBudgetExhaustedError(
+                    f"fleet retry budget exhausted; not replaying "
+                    f"after {type(exc).__name__}: {exc}")):
                 self.metrics.count("failed")
             return
         d = self._retry.delay(req.attempts - 1)
@@ -698,12 +891,40 @@ class FleetManager:
         if exc is None:
             if _resolve_future(req.outer, fut.result()):
                 self.metrics.count("completed")
+                budget = self._retry.budget
+                if budget is not None:
+                    # successes are what pay for retries (SRE retry-
+                    # budget discipline): refill a fraction per
+                    # completion
+                    budget.on_success()
             return True
         if isinstance(exc, self._PROPAGATE):
             if _fail_future(req.outer, exc):
                 self.metrics.count("failed")
             return True
         return False
+
+    def _quarantine_req(self, req, exc):
+        """Convict one in-flight request as the poison pill: journal
+        the fingerprint (a recovered manager keeps shedding it), add
+        it to the bounded quarantine set, and fail the outer future
+        with the typed verdict — this request is NEVER replayed."""
+        fp = req.fp or _fingerprint(req.prompt, self._params_version)
+        with self._lock:
+            self._quarantine[fp] = True
+            while len(self._quarantine) > self._quarantine_cap:
+                self._quarantine.popitem(last=False)
+        self.metrics.count("requests_quarantined")
+        self._journal_append("quarantine", fingerprint=fp,
+                             deaths=sorted(req.deaths))
+        log.warning("request quarantined after %d replica deaths "
+                    "(%s): fingerprint %s", len(req.deaths),
+                    ", ".join(sorted(req.deaths)), fp[:12])
+        if _fail_future(req.outer, PoisonPillError(
+                f"request aboard {len(req.deaths)} replica deaths "
+                f"({', '.join(sorted(req.deaths))}); fingerprint "
+                f"{fp[:12]} quarantined")):
+            self.metrics.count("failed")
 
     def _resubmit(self, req, count_failover=False, cause=None):
         if req.deadline is not None and \
@@ -766,8 +987,14 @@ class FleetManager:
         with self._lock:
             orphaned = not self._running
             if not orphaned:
-                rec = _Replica(name, srv, next(self._seq))
+                rec = _Replica(name, srv, next(self._seq),
+                               born=time.monotonic())
                 self._replicas[name] = rec
+                if self._breaker == BREAKER_HALF_OPEN \
+                        and self._probe_name is None:
+                    # this spawn IS the half-open probe: the breaker
+                    # closes only if it survives infant_mortality_s
+                    self._probe_name = name
         if orphaned:
             # stop() raced the slow factory/warmup above and its sweep
             # never saw this name: tear the orphan down HERE (outside
@@ -787,6 +1014,124 @@ class FleetManager:
             start_time=getattr(srv, "start_time", None))
         log.info("replica %s spawned (%d alive)", name, self.n_alive())
         return name
+
+    # -- spawn circuit breaker -----------------------------------------
+    @property
+    def breaker_state(self):
+        """closed / open / half_open (the `breaker_state` gauge is the
+        numeric twin: 0 / 1 / 0.5)."""
+        with self._lock:
+            return self._breaker
+
+    def _breaker_strike(self, name=None):
+        """One spawn-path strike: a factory/warmup raise or an infant
+        death. K consecutive strikes OPEN the breaker; a failed
+        half-open probe re-opens it with DOUBLED backoff."""
+        now = time.monotonic()
+        opened = False
+        with self._lock:
+            self._strikes += 1
+            self._last_strike = now
+            if self._breaker == BREAKER_HALF_OPEN and \
+                    (name is None or name == self._probe_name
+                     or self._probe_name is None):
+                self._breaker = BREAKER_OPEN
+                self._probe_name = None
+                self._breaker_backoff = min(
+                    self._breaker_max_backoff,
+                    self._breaker_backoff * 2.0)
+                self._breaker_until = now + self._breaker_backoff
+                opened = True
+            elif self._breaker == BREAKER_CLOSED and \
+                    self._strikes >= self.breaker_strikes:
+                self._breaker = BREAKER_OPEN
+                self._breaker_until = now + self._breaker_backoff
+                opened = True
+            backoff = self._breaker_backoff
+            strikes = self._strikes
+        if opened:
+            self.metrics.count("breaker_open_total")
+            self.metrics.record_breaker_state(
+                _BREAKER_GAUGE[BREAKER_OPEN])
+            self._journal_append("breaker", state=BREAKER_OPEN,
+                                 strikes=strikes, backoff_s=backoff)
+            log.warning("spawn circuit breaker OPEN after %d strikes "
+                        "(next probe in %.2fs); fleet degraded",
+                        strikes, backoff)
+
+    def _spawn_allowed(self):
+        """The breaker gate every backfill/autoscale spawn passes:
+        True while CLOSED; while OPEN, True exactly once per elapsed
+        backoff window (that spawn becomes the half-open probe); False
+        while a probe is pending or the backoff hasn't elapsed."""
+        probing = False
+        with self._lock:
+            if self._breaker == BREAKER_CLOSED:
+                return True
+            if self._breaker == BREAKER_OPEN and \
+                    time.monotonic() >= self._breaker_until:
+                self._breaker = BREAKER_HALF_OPEN
+                probing = True
+        if probing:
+            self.metrics.record_breaker_state(
+                _BREAKER_GAUGE[BREAKER_HALF_OPEN])
+            log.info("spawn breaker half-open: probing with one spawn")
+            return True
+        return False
+
+    def _spawn_guarded(self):
+        """Backfill/probe spawn with strike accounting: a raising
+        factory (the spawn_fail chaos action) is a breaker STRIKE, not
+        an unhandled control-loop error. Returns the name, or None on
+        a strike. A stopping manager's refusal propagates — that is
+        lifecycle, not a spawn-path failure."""
+        try:
+            return self._spawn()
+        except ServerClosedError:
+            raise
+        except Exception:   # noqa: BLE001 — the strike IS the handling
+            log.exception("spawn failed (breaker strike)")
+            self._breaker_strike()
+            return None
+
+    def _breaker_probe_check(self):
+        """Per-tick breaker bookkeeping: close the breaker when the
+        half-open probe replica survives `infant_mortality_s`, and
+        while CLOSED, reset the strike counter when any spawn born
+        AFTER the last strike survives infancy (strikes are
+        CONSECUTIVE spawn failures, not lifetime ones)."""
+        closed = False
+        now = time.monotonic()
+        with self._lock:
+            if self._breaker == BREAKER_HALF_OPEN and self._probe_name:
+                rec = self._replicas.get(self._probe_name)
+                if rec is not None \
+                        and rec.state in (HEALTHY, DEGRADED) \
+                        and rec.server.alive \
+                        and rec.born is not None \
+                        and now - rec.born >= self.infant_mortality_s:
+                    self._breaker = BREAKER_CLOSED
+                    self._strikes = 0
+                    self._probe_name = None
+                    self._breaker_backoff = self._breaker_backoff0
+                    closed = True
+            elif self._breaker == BREAKER_CLOSED and self._strikes:
+                for rec in self._replicas.values():
+                    if rec.born is not None \
+                            and rec.born > self._last_strike \
+                            and rec.state in (HEALTHY, DEGRADED) \
+                            and now - rec.born \
+                            >= self.infant_mortality_s:
+                        self._strikes = 0
+                        break
+        if closed:
+            self.metrics.record_breaker_state(
+                _BREAKER_GAUGE[BREAKER_CLOSED])
+            self._journal_append("breaker", state=BREAKER_CLOSED,
+                                 strikes=0,
+                                 backoff_s=self._breaker_backoff0)
+            log.info("spawn circuit breaker CLOSED: probe survived "
+                     "infancy")
 
     def _tombstone_counters(self, rec):
         """Counters-only snapshot of a departing replica: federated
@@ -813,10 +1158,13 @@ class FleetManager:
         with self._lock:
             self._tombstones[rec.name] = counters
 
-    def _crash(self, name, reason="injected fault"):
+    def _crash(self, name, reason="injected fault", convict=True):
         """Replica death: fail it loudly, tombstone its counters, and
         resubmit its in-flight requests to survivors via prompt
-        replay. Idempotent."""
+        replay. Idempotent. `convict=False` marks an ADMINISTRATIVE
+        death (operator kill, canary rollback): requests aboard it do
+        not accrue a poison-pill strike — only spontaneous deaths are
+        evidence a request's own decode is the killer."""
         with self._lock:
             rec = self._replicas.get(name)
         if rec is None:
@@ -843,6 +1191,15 @@ class FleetManager:
         rec.state = DEAD
         self.metrics.count("replica_dead")
         self._journal_append("replica_dead", name=name, reason=reason)
+        if convict and rec.born is not None and \
+                time.monotonic() - rec.born < self.infant_mortality_s:
+            # died within infancy of its own spawn: a spawn-path
+            # failure (bad factory/params/config), not a serving one —
+            # strike the breaker. Administrative kills don't strike:
+            # an operator putting down a young replica says nothing
+            # about the factory
+            self.metrics.count("infant_deaths")
+            self._breaker_strike(name)
         rec.server.kill()           # fails remaining futures loudly
         # refresh with the final post-kill values (counters only grow
         # — and a remote's snapshot falls back to its last good cache
@@ -857,12 +1214,16 @@ class FleetManager:
                 continue
             # ONE failover implementation (budget, accounting, pacing)
             # for both arrival paths — here and the done-callback
-            self._failover(req, ReplicaDeadError(f"replica {name} died"))
+            self._failover(req,
+                           ReplicaDeadError(f"replica {name} died"),
+                           blame=convict)
 
     def kill_replica(self, name):
         """Operator/chaos verb: crash `name` now (the same path the
-        fleet.replica sever action takes)."""
-        self._crash(name, reason="killed by operator")
+        fleet.replica sever action takes). An operator kill is
+        administrative — requests aboard it fail over without accruing
+        a poison-pill strike."""
+        self._crash(name, reason="killed by operator", convict=False)
 
     def scale_up(self):
         """Spawn one replica (the scale_up actuation; also the
@@ -1064,8 +1425,14 @@ class FleetManager:
                     "failover_resubmitted", "canary_rollbacks",
                     "wire_reconnects", "wire_retries",
                     "migrate_refused", "manager_epoch",
-                    "replicas_adopted", "journal_records"):
+                    "replicas_adopted", "journal_records",
+                    "requests_quarantined", "breaker_open_total",
+                    "retry_budget_exhausted", "degraded_mode_ticks",
+                    "infant_deaths"):
             snap["fleet_" + key] = self.metrics.count_value(key)
+        # the breaker gauge overlays LIVE manager state (a gauge, not a
+        # counter — federation can't sum it; the manager owns it)
+        snap["fleet_breaker_state"] = _BREAKER_GAUGE[self._breaker]
         snap["fleet_alive"] = self.n_alive()
         return snap
 
@@ -1103,10 +1470,24 @@ class FleetManager:
                     "fleet.replica",
                     on_sever=lambda name=n: self._crash(name))
         self._probe_health()
+        self._breaker_probe_check()
         backfilled = 0
         while self._running and self.n_alive() < self.min_replicas:
-            self._spawn()
-            backfilled += 1
+            if not self._spawn_allowed():
+                # breaker open (or probe pending): DEGRADED mode — no
+                # tick-rate spawn crash-loop; serve on what's alive
+                break
+            if self._spawn_guarded() is not None:
+                backfilled += 1
+        if self._breaker != BREAKER_CLOSED:
+            self.metrics.count("degraded_mode_ticks")
+        if self._journal is not None and \
+                self._journal_compact_bytes is not None:
+            try:
+                if self._journal.size() > self._journal_compact_bytes:
+                    self._journal.compact(name_prefix=self._name_prefix)
+            except Exception:   # noqa: BLE001 — the WAL is not the fleet
+                log.exception("journal compaction failed")
         now = time.monotonic()
         snap = self.fleet_snapshot()
         util = self._utilization(snap, now)
@@ -1118,10 +1499,11 @@ class FleetManager:
                 pass        # a rollout owns the fleet shape right now
             elif decision == AutoscaleSignal.SCALE_UP \
                     and self._running \
-                    and self.n_alive() < self.max_replicas:
-                self._spawn()
-                acted = "scale_up"
-                self.signal.reset()
+                    and self.n_alive() < self.max_replicas \
+                    and self._spawn_allowed():
+                if self._spawn_guarded() is not None:
+                    acted = "scale_up"
+                    self.signal.reset()
             elif decision == AutoscaleSignal.SCALE_DOWN \
                     and self._running \
                     and self.n_alive() > self.min_replicas:
@@ -1136,6 +1518,7 @@ class FleetManager:
                                      tick=self._ticks)
         return {"tick": self._ticks, "decision": decision,
                 "acted": acted, "backfilled": backfilled,
+                "breaker": self._breaker,
                 "n_replicas": self.n_alive(),
                 "replicas": self.replicas,
                 "states": self.states(), "utilization": util,

@@ -87,12 +87,25 @@ class FleetJournal:
         self._counters = counters
         self._lock = threading.Lock()
         self._broken = False
+        # a stale .compacting sibling is a compaction that crashed
+        # BEFORE its rename commit point: the original file is intact
+        # and authoritative, the half-written snapshot is garbage
+        try:
+            os.unlink(self.path + ".compacting")
+        except OSError:
+            pass
         # unbuffered: a record's single write() goes straight to the
         # fd, so there is never a buffer holding half a record that a
         # later truncate/flush could tear differently
         self._fh = open(self.path, "ab", buffering=0)
         self._fh.seek(0, os.SEEK_END)
         self._good = self._fh.tell()    # last known-good record boundary
+
+    def size(self):
+        """Bytes of known-good records on disk (the compaction
+        trigger's cheap read — no stat round-trip)."""
+        with self._lock:
+            return self._good
 
     def append(self, kind, **fields):
         rec = {"kind": str(kind), **fields}
@@ -122,6 +135,54 @@ class FleetJournal:
             try:
                 self._counters.count("journal_records")
             except Exception:       # pragma: no cover - sink is best-effort
+                pass
+        return rec
+
+    def compact(self, name_prefix="i"):
+        """Fold the whole journal into ONE ``snapshot`` record and
+        rotate the file atomically. The snapshot carries the complete
+        fold state (epoch, roster, max_id, params_version, canary,
+        quarantine, breaker), so `fold_records(replay_journal(path))`
+        is IDENTICAL before and after compaction — compaction changes
+        the file's size, never its meaning.
+
+        Crash-safety is the kvstate rename-last discipline: the
+        snapshot is written + fsync'd into a ``.compacting`` sibling
+        first, and `os.replace` over the live path is the single
+        atomic commit point. A crash before it leaves the old journal
+        authoritative (the stale sibling is removed at next open); a
+        crash after it leaves the compacted journal, which replays to
+        the same fold. Returns the snapshot record."""
+        with self._lock:
+            if self._fh is None:
+                raise JournalBrokenError(
+                    f"fleet journal {self.path}: compact after close()")
+            if self._broken:
+                raise JournalBrokenError(
+                    f"fleet journal {self.path}: refusing compact "
+                    f"after an unrecovered write failure")
+            state = fold_records(replay_journal(self.path),
+                                 name_prefix=name_prefix)
+            rec = {"kind": "snapshot", **state}
+            payload = json.dumps(rec, sort_keys=True).encode("utf-8")
+            frame = _HDR.pack(len(payload),
+                              zlib.crc32(payload)) + payload
+            tmp = self.path + ".compacting"
+            with open(tmp, "wb") as fh:
+                fh.write(frame)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)      # THE commit point
+            # the old append handle points at the unlinked inode:
+            # reopen on the compacted file before any further append
+            self._fh.close()
+            self._fh = open(self.path, "ab", buffering=0)
+            self._fh.seek(0, os.SEEK_END)
+            self._good = self._fh.tell()
+        if self._counters is not None:
+            try:
+                self._counters.count("journal_records")
+            except Exception:   # pragma: no cover - sink is best-effort
                 pass
         return rec
 
@@ -216,19 +277,42 @@ def fold_records(records, name_prefix="i"):
         the in-flight rollout record if a ``canary_begin`` has no
         matching ``canary_rolled_forward``/``canary_rolled_back``,
         else None.
+    ``quarantine``
+        ordered poison-pill fingerprints (``quarantine`` records): a
+        recovered manager must keep shedding a quarantined prompt, not
+        resurrect it onto the fresh fleet.
+    ``breaker``
+        the last journaled spawn-breaker state
+        (``{"state", "strikes", "backoff_s"}``) or None: a manager
+        that died with the breaker OPEN must not resume the spawn
+        crash-loop its predecessor escaped.
+
+    A ``snapshot`` record (written by `FleetJournal.compact()`) seeds
+    ALL of the above at once; records after it fold on top.
     """
     epoch = 0
     roster = {}
     max_id = -1
     params_version = None
     canary = None
+    quarantine = []
+    breaker = None
     for rec in records:
         kind = rec.get("kind")
         name = rec.get("name")
         ordinal = _ordinal(name, name_prefix)
         if ordinal is not None and ordinal > max_id:
             max_id = ordinal
-        if kind == "epoch":
+        if kind == "snapshot":
+            epoch = max(epoch, int(rec.get("epoch") or 0))
+            roster = {k: dict(v)
+                      for k, v in (rec.get("roster") or {}).items()}
+            max_id = max(max_id, int(rec.get("max_id", -1)))
+            params_version = rec.get("params_version")
+            canary = rec.get("canary")
+            quarantine = list(rec.get("quarantine") or ())
+            breaker = rec.get("breaker")
+        elif kind == "epoch":
             epoch = max(epoch, int(rec.get("epoch") or 0))
         elif kind in ("spawn", "adopt"):
             roster[name] = {
@@ -247,5 +331,14 @@ def fold_records(records, name_prefix="i"):
             canary = dict(rec)
         elif kind in ("canary_rolled_forward", "canary_rolled_back"):
             canary = None
+        elif kind == "quarantine":
+            fp = rec.get("fingerprint")
+            if fp and fp not in quarantine:
+                quarantine.append(fp)
+        elif kind == "breaker":
+            breaker = {"state": rec.get("state"),
+                       "strikes": rec.get("strikes"),
+                       "backoff_s": rec.get("backoff_s")}
     return {"epoch": epoch, "roster": roster, "max_id": max_id,
-            "params_version": params_version, "canary": canary}
+            "params_version": params_version, "canary": canary,
+            "quarantine": quarantine, "breaker": breaker}

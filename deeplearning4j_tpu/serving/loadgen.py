@@ -52,6 +52,7 @@ tests/test_loadgen.py with the PR 6 dispatch-counter A/B protocol.
 """
 from __future__ import annotations
 
+import collections
 import concurrent.futures as cf
 import hashlib
 import random
@@ -247,6 +248,12 @@ CHAOS_ACTIONS = {
     "replica_crash": "fleet.replica",
     "pause_heartbeats": None,       # ReplicaServer.pause_heartbeats
     "manager_kill": None,           # kill + FleetManager.recover()
+    "poison": None,                 # poison-pill request: its decode
+    #                                 kills the replica it lands on
+    #                                 (FleetManager kill_hook) — drives
+    #                                 the quarantine verdict
+    "spawn_fail": None,             # replica factory failure window —
+    #                                 drives the spawn circuit breaker
 }
 
 
@@ -266,6 +273,10 @@ class ChaosSchedule:
             if "t" not in e or "action" not in e:
                 raise ValueError("each chaos event needs 't' and "
                                  "'action'")
+            if e["action"] not in CHAOS_ACTIONS:
+                raise ValueError(
+                    f"unknown chaos action {e['action']!r} (known: "
+                    f"{', '.join(sorted(CHAOS_ACTIONS))})")
         self.events = tuple(sorted(events, key=lambda e: e["t"]))
         self.duration_s = float(duration_s)
         self.meta = dict(meta or {})
@@ -284,7 +295,7 @@ class ChaosSchedule:
 
 
 def build_chaos_schedule(duration_s, n_events, seed=0, actions=None,
-                         require_manager_kill=True):
+                         require_manager_kill=True, require=None):
     """Materialize a seeded chaos timeline: `n_events` actions drawn
     uniformly from `actions` (default: the full `CHAOS_ACTIONS`
     alphabet), at offsets inside the middle 80% of `duration_s` — the
@@ -293,7 +304,13 @@ def build_chaos_schedule(duration_s, n_events, seed=0, actions=None,
     (``loadgen.chaos:{seed}``) like `build_schedule`, never `hash()`.
     With `require_manager_kill` (default), a schedule that drew no
     manager kill has its middle event rewritten to one — every seeded
-    run exercises journal recovery, not just wire churn."""
+    run exercises journal recovery, not just wire churn. `require`
+    generalizes that: a tuple of actions that must each appear at
+    least once, filled in DETERMINISTICALLY (middle slot first) when
+    the draw missed them — the cascade arm requires poison +
+    spawn_fail + manager_kill, and the rewrite is part of the builder
+    so `digest()` still pins the whole timeline from (duration_s,
+    n_events, seed, actions, require) alone."""
     rng = random.Random(f"loadgen.chaos:{seed}")
     duration_s = float(duration_s)
     n = int(n_events)
@@ -303,9 +320,34 @@ def build_chaos_schedule(duration_s, n_events, seed=0, actions=None,
     events = [{"t": round(duration_s * (0.1 + 0.8 * rng.random()), 6),
                "action": pool[rng.randrange(len(pool))]}
               for _ in range(n)]
-    if require_manager_kill and \
-            not any(e["action"] == "manager_kill" for e in events):
-        events[n // 2]["action"] = "manager_kill"
+    if require is None:
+        require = ("manager_kill",) if require_manager_kill else ()
+    required = tuple(require)
+    if len(required) > n:
+        raise ValueError(
+            f"n_events={n} cannot fit the {len(required)} required "
+            f"actions {sorted(required)}")
+    have = collections.Counter(e["action"] for e in events)
+    slots = [n // 2] + [i for i in range(n) if i != n // 2]
+    rewritten = set()
+    for action in required:
+        if have[action]:
+            continue
+        for s in slots:
+            cur = events[s]["action"]
+            # a slot is rewritable unless it holds the ONLY copy of
+            # another required action
+            if s not in rewritten and \
+                    (cur not in required or have[cur] > 1):
+                have[cur] -= 1
+                events[s]["action"] = action
+                have[action] += 1
+                rewritten.add(s)
+                break
+        else:
+            raise ValueError(
+                f"n_events={n} too small to fit required action "
+                f"{action!r} alongside {sorted(required)}")
     return ChaosSchedule(events, duration_s, meta={"seed": seed})
 
 

@@ -207,6 +207,17 @@ class ServingMetrics:
         for key in ("manager_epoch", "replicas_adopted", "fenced_ops",
                     "journal_records"):
             self.count(key, 0)
+        # blast-radius containment (serving/fleet.py): poison-pill
+        # quarantine verdicts + admission sheds, spawn-breaker opens,
+        # fleet retry-budget denials, degraded-mode ticks, and
+        # infant deaths — same eager rule; the breaker's live state is
+        # the `breaker_state` gauge (0 closed / 0.5 half-open / 1 open)
+        for key in ("requests_quarantined", "breaker_open_total",
+                    "retry_budget_exhausted", "degraded_mode_ticks",
+                    "infant_deaths"):
+            self.count(key, 0)
+        self._breaker_state = self.registry.gauge(p + "breaker_state")
+        self._breaker_state.set(0.0)    # a fresh endpoint reads CLOSED
 
     @property
     def instance(self):
@@ -289,6 +300,13 @@ class ServingMetrics:
         published once per scheduling iteration — the live capacity
         number predictions divide by."""
         self._service_rate.set(float(tokens_per_sec))
+
+    def record_breaker_state(self, state):
+        """The spawn circuit breaker's live state (serving/fleet.py):
+        0 closed, 0.5 half-open, 1 open — a gauge, because the breaker
+        is a condition, not an event stream (its event twin is
+        `breaker_open_total`)."""
+        self._breaker_state.set(float(state))
 
     def record_queue_depth(self, depth):
         """Depth sample OUTSIDE batch formation (enqueue / shed time) —
@@ -463,6 +481,15 @@ class ServingMetrics:
         out.setdefault("replicas_adopted", 0)
         out.setdefault("fenced_ops", 0)
         out.setdefault("journal_records", 0)
+        # blast-radius containment (serving/fleet.py): quarantine/
+        # breaker/retry-budget/degraded-mode events — always present,
+        # plus the live breaker-state gauge
+        out.setdefault("requests_quarantined", 0)
+        out.setdefault("breaker_open_total", 0)
+        out.setdefault("retry_budget_exhausted", 0)
+        out.setdefault("degraded_mode_ticks", 0)
+        out.setdefault("infant_deaths", 0)
+        out["breaker_state"] = self._breaker_state.value
         out["service_rate_tokens_per_sec"] = self._service_rate.value
         out["prefix_hit_rate"] = (
             out["prefix_rows_hit"] / out["prefix_rows_total"]

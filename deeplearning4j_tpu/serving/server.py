@@ -94,6 +94,16 @@ class RequestDrainedError(ServingError):
     stream."""
 
 
+class PoisonPillError(ServingError):
+    """This request was QUARANTINED by the fleet's blast-radius
+    containment (serving/fleet.py): it was aboard for two or more
+    distinct replica deaths, which marks it the probable killer — its
+    outer future fails with this error instead of being replayed onto
+    yet another survivor, and its prompt fingerprint sheds future
+    re-submissions at admission. Innocent co-victims of the same
+    replica deaths still fail over normally."""
+
+
 class ReplicaDeadError(ServingError):
     """The replica serving (or chosen for) this request died: its serve
     loop was killed mid-stream (`ContinuousDecodeServer.kill` — the

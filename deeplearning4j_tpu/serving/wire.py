@@ -101,7 +101,8 @@ import struct
 import threading
 import time
 
-from ..common.resilience import FaultInjected, RetryPolicy
+from ..common.resilience import (FaultInjected, RetryBudgetExhaustedError,
+                                 RetryPolicy)
 from .kvstate import (KVStateError, KVStateVersionError, RequestArtifact)
 from .server import (DeadlineExceededError, ReplicaDeadError,
                      RequestDrainedError, RequestMigratedError,
@@ -1281,7 +1282,35 @@ class RemoteReplica:
     # -- connection management -----------------------------------------
     def _dial_once(self):
         """One dial attempt: connect, HELLO, start the reader, resend
-        every unresolved in-flight frame (the server dedups)."""
+        every unresolved in-flight frame (the server dedups). Each
+        resent op spends one fleet retry-budget token; denied ops fail
+        LOUDLY with `RetryBudgetExhaustedError` instead of riding the
+        fresh socket — under a sever storm the budget bounds total
+        resends fleet-wide."""
+        denied = []
+        try:
+            self._dial_locked(denied)
+        finally:
+            # outside _conn_lock: failing a future runs its done
+            # callbacks inline, and a fleet-manager callback may
+            # re-enter submit -> lazy dial -> _conn_lock (not
+            # re-entrant)
+            for p in denied:
+                self._forget(p.rid)
+                self._count("retry_budget_exhausted")
+                self._fail_op(p, RetryBudgetExhaustedError(
+                    f"fleet retry budget exhausted; not resending wire "
+                    f"op {p.op} ({p.rid}) to {self.instance!r}"))
+
+    def _grant_retry(self, n=1):
+        """Consult the shared fleet retry budget through the manager's
+        RetryPolicy (configure_wire installed it). A policy without the
+        hook — or one with no budget — always grants: the budget is an
+        opt-in fleet-level brake, never a default behavior change."""
+        grant = getattr(self._retry, "grant_retry", None)
+        return grant is None or grant(n)
+
+    def _dial_locked(self, denied):
         with self._conn_lock:
             if self._sock is not None:
                 return
@@ -1322,6 +1351,10 @@ class RemoteReplica:
             with self._plock:
                 resend = [p for p in self._pending.values()
                           if p.resend and p.sent and not p.done]
+            granted = []
+            for p in resend:
+                (granted if self._grant_retry() else denied).append(p)
+            resend = granted
             try:
                 for p in resend:
                     # attempt-stamped: the server re-points delivery
@@ -1400,6 +1433,16 @@ class RemoteReplica:
                     cause = e
                     if attempt >= self._retry.max_retries:
                         self._mark_dead(cause)
+                        return
+                    if not self._grant_retry():
+                        # budget exhausted: stop hammering the endpoint
+                        # — dead-replica delivery fails the pending ops
+                        # loudly and the manager's failover path (its
+                        # own budget gate) decides what survives
+                        self._count("retry_budget_exhausted")
+                        self._mark_dead(RetryBudgetExhaustedError(
+                            f"fleet retry budget exhausted reconnecting "
+                            f"to {self.instance!r} (last error: {cause})"))
                         return
                     d = self._retry.delay(attempt)
                     attempt += 1

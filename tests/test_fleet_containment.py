@@ -1,0 +1,493 @@
+"""Blast-radius containment pins (ISSUE 17 acceptance criteria).
+
+  (a) Poison-pill quarantine: a request aboard TWO distinct
+      spontaneous replica deaths is convicted — its outer future fails
+      with the typed `PoisonPillError`, it is NEVER replayed a second
+      time, its fingerprint sheds re-submissions at the door, and the
+      cascade stops at exactly two deaths while innocent co-victims
+      fail over normally and complete. Operator kills are
+      administrative and never convict (regression for the
+      all-replicas-killed path).
+  (b) Quarantine durability: the conviction is journaled; a successor
+      manager folding the same journal keeps shedding the fingerprint
+      without the request ever touching a fresh replica.
+  (c) Spawn circuit breaker: K consecutive spawn-path strikes OPEN
+      the breaker — ONE control tick against an always-failing
+      factory costs exactly K factory calls, not one per tick; while
+      open the fleet serves degraded (brownout sheds the configured
+      classes, `degraded_mode_ticks` counts) and half-open probes
+      retry on exponential backoff until a probe survives infancy.
+      A recovered manager INHERITS the open breaker and bounds its
+      backfill loop instead of resuming the crash-loop.
+  (d) Fleet-wide retry budget: failover replays spend from one token
+      bucket; exhaustion fails LOUDLY (`RetryBudgetExhaustedError` +
+      `retry_budget_exhausted`) instead of amplifying load; successes
+      refill a fraction per completion; and the no-fault A/B shows
+      zero behavior change — same dispatch count, bit-identical
+      streams, zero tokens spent.
+"""
+import concurrent.futures as cf
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.common.resilience import (RetryBudget,
+                                                  RetryBudgetExhaustedError,
+                                                  RetryPolicy)
+from deeplearning4j_tpu.serving import (FleetManager, PoisonPillError,
+                                        ReplicaDeadError, ServingMetrics,
+                                        fold_records, replay_journal)
+from deeplearning4j_tpu.serving.admission import BrownoutPolicy
+from deeplearning4j_tpu.serving.fleet import (BREAKER_CLOSED,
+                                              BREAKER_OPEN)
+from deeplearning4j_tpu.serving.server import ServerOverloadedError
+
+
+class _HoldReplica:
+    """Fake replica whose submits stay IN FLIGHT until the test says
+    otherwise: `kill()` fails the held futures with ReplicaDeadError
+    (the real server contract), `resolve_all()` completes them with
+    the deterministic greedy stream. The conviction/failover paths
+    only engage against requests that are genuinely aboard."""
+
+    def __init__(self, name):
+        self.name = name
+        self.instance = name
+        self.metrics = ServingMetrics(name=name)
+        self._running = True
+        self.paged = False
+        self.killed = False
+        self._lock = threading.Lock()
+        self.held = []          # (future, prompt, max_new)
+        self.n_submits = 0
+
+    @property
+    def alive(self):
+        return not self.killed
+
+    def start(self):
+        self._running = True
+        return self
+
+    def submit(self, prompt, max_new, **kw):
+        fut = cf.Future()
+        with self._lock:
+            self.n_submits += 1
+            self.held.append((fut, list(prompt), int(max_new)))
+        return fut
+
+    def resolve_all(self):
+        with self._lock:
+            held, self.held = self.held, []
+        for fut, prompt, max_new in held:
+            if not fut.done():
+                fut.set_result(prompt + [0] * max_new)
+
+    def kill(self):
+        self.killed = True
+        self._running = False
+        with self._lock:
+            held, self.held = self.held, []
+        for fut, _, _ in held:
+            if not fut.done():
+                fut.set_exception(ReplicaDeadError(
+                    f"replica {self.name} killed"))
+
+    def stop(self, drain=True, timeout=None):
+        self._running = False
+
+    def drain(self, migrate=None, timeout=60.0):
+        self._running = False
+        return [], []
+
+
+class _InstantReplica(_HoldReplica):
+    """Fake replica that completes every submit synchronously — the
+    no-fault / refill arms, where nothing is ever in flight."""
+
+    def submit(self, prompt, max_new, **kw):
+        fut = super().submit(prompt, max_new, **kw)
+        fut.set_result(list(prompt) + [0] * int(max_new))
+        return fut
+
+
+def _factory(cls, made=None):
+    def make(name):
+        r = cls(name)
+        if made is not None:
+            made[name] = r
+        return r
+    return make
+
+
+POISON = [13, 13, 13]
+
+
+def _poison_hook(prompt, replica_name):
+    return list(prompt) == POISON
+
+
+# ---------------------------------------------------------------------------
+# (a) poison-pill quarantine
+# ---------------------------------------------------------------------------
+class TestQuarantine:
+    def test_poison_convicted_after_exactly_two_deaths(self):
+        made = {}
+        with FleetManager(_factory(_HoldReplica, made), n_replicas=3,
+                          kill_hook=_poison_hook) as mgr:
+            pre_alive = mgr.n_alive()
+            fut = mgr.submit(POISON, 4)
+            with pytest.raises(PoisonPillError):
+                fut.result(10)
+            # the cascade stopped at the conviction threshold: two
+            # replicas died, the third never saw the poison
+            assert mgr.metrics.count_value("replica_dead") == 2
+            assert mgr.n_alive() == pre_alive - 2
+            assert mgr.metrics.count_value(
+                "requests_quarantined") == 1
+
+    def test_resubmission_shed_at_the_door(self):
+        made = {}
+        with FleetManager(_factory(_HoldReplica, made), n_replicas=3,
+                          kill_hook=_poison_hook) as mgr:
+            with pytest.raises(PoisonPillError):
+                mgr.submit(POISON, 4).result(10)
+            dead_before = mgr.metrics.count_value("replica_dead")
+            submits_before = sum(r.n_submits for r in made.values())
+            with pytest.raises(PoisonPillError):
+                mgr.submit(POISON, 4)       # raises AT submit
+            # the shed never reached a replica, let alone killed one
+            assert sum(r.n_submits
+                       for r in made.values()) == submits_before
+            assert mgr.metrics.count_value(
+                "replica_dead") == dead_before
+            assert mgr.metrics.count_value(
+                "requests_quarantined") == 2
+
+    def test_innocent_co_victims_fail_over_and_complete(self):
+        made = {}
+        with FleetManager(_factory(_HoldReplica, made), n_replicas=3,
+                          kill_hook=_poison_hook) as mgr:
+            # innocents land on i0 and i1 (least backlog), so the
+            # poison takes i2 first, then replays onto a loaded
+            # survivor and kills it too — one innocent rides a death
+            inn_a = mgr.submit([1, 2, 3], 2)
+            inn_b = mgr.submit([4, 5, 6], 2)
+            poison = mgr.submit(POISON, 4)
+            with pytest.raises(PoisonPillError):
+                poison.result(10)
+            assert mgr.metrics.count_value("replica_dead") == 2
+            assert mgr.n_alive() == 1
+            # the survivor serves everything that failed over onto it
+            for r in made.values():
+                r.resolve_all()
+            assert inn_a.result(10) == [1, 2, 3, 0, 0]
+            assert inn_b.result(10) == [4, 5, 6, 0, 0]
+            # exactly the poison was lost
+            assert mgr.metrics.count_value("completed") == 2
+            assert mgr.metrics.count_value("failed") == 1
+
+    def test_operator_kill_never_convicts(self):
+        made = {}
+        with FleetManager(_factory(_HoldReplica, made),
+                          n_replicas=2) as mgr:
+            f1 = mgr.submit([1, 2, 3], 2)
+            f2 = mgr.submit([1, 2, 3], 2)
+            for name in list(mgr.replicas):
+                mgr.kill_replica(name)
+            # an administrative kill of every replica is an outage,
+            # not evidence: both requests fail with the infrastructure
+            # error, neither is branded a poison pill
+            for fut in (f1, f2):
+                with pytest.raises(ReplicaDeadError):
+                    fut.result(10)
+            assert mgr.metrics.count_value(
+                "requests_quarantined") == 0
+
+
+# ---------------------------------------------------------------------------
+# (b) quarantine durability across manager generations
+# ---------------------------------------------------------------------------
+class TestQuarantineDurability:
+    def test_conviction_is_journaled(self, tmp_path):
+        jpath = str(tmp_path / "fleet.journal")
+        with FleetManager(_factory(_HoldReplica), n_replicas=3,
+                          kill_hook=_poison_hook,
+                          journal=jpath) as mgr:
+            with pytest.raises(PoisonPillError):
+                mgr.submit(POISON, 4).result(10)
+        folded = fold_records(replay_journal(jpath))
+        assert len(folded["quarantine"]) == 1
+
+    def test_successor_keeps_shedding(self, tmp_path):
+        jpath = str(tmp_path / "fleet.journal")
+        with FleetManager(_factory(_HoldReplica), n_replicas=3,
+                          kill_hook=_poison_hook,
+                          journal=jpath) as mgr:
+            with pytest.raises(PoisonPillError):
+                mgr.submit(POISON, 4).result(10)
+        made = {}
+        with FleetManager(_factory(_HoldReplica, made), n_replicas=2,
+                          journal=jpath) as mgr2:
+            # no kill_hook on the successor: only the inherited
+            # quarantine set stands between the poison and the fleet
+            with pytest.raises(PoisonPillError):
+                mgr2.submit(POISON, 4)
+            assert sum(r.n_submits for r in made.values()) == 0
+            assert mgr2.metrics.count_value(
+                "requests_quarantined") == 1
+            # innocents still flow
+            ok = mgr2.submit([7, 8, 9], 2)
+            for r in made.values():
+                r.resolve_all()
+            assert ok.result(10) == [7, 8, 9, 0, 0]
+
+
+# ---------------------------------------------------------------------------
+# (c) spawn circuit breaker + degraded mode
+# ---------------------------------------------------------------------------
+def _flaky_factory(made, arm):
+    """Factory that refuses to spawn while `arm["on"]` (counting every
+    attempt) — the spawn_fail chaos window in unit form."""
+    calls = {"n": 0}
+
+    def make(name):
+        calls["n"] += 1
+        if arm["on"]:
+            raise RuntimeError("spawn_fail window: factory refused")
+        r = _InstantReplica(name)
+        made[name] = r
+        return r
+    return make, calls
+
+
+class TestSpawnBreaker:
+    def _mgr(self, **kw):
+        made, arm = {}, {"on": False}
+        factory, calls = _flaky_factory(made, arm)
+        mgr = FleetManager(factory, n_replicas=2, breaker_strikes=3,
+                           breaker_backoff_s=0.2,
+                           infant_mortality_s=0.1, **kw).start()
+        return mgr, made, arm, calls
+
+    def test_one_tick_costs_exactly_k_strikes(self):
+        mgr, made, arm, calls = self._mgr()
+        try:
+            mgr.kill_replica(mgr.replicas[0])
+            arm["on"] = True
+            base = calls["n"]
+            mgr.control_tick()
+            # the backfill loop stopped AT the breaker, not at the
+            # tick boundary: exactly K attempts, then OPEN
+            assert calls["n"] - base == mgr.breaker_strikes
+            assert mgr.breaker_state == BREAKER_OPEN
+            assert mgr.metrics.count_value("breaker_open_total") == 1
+            assert mgr.metrics.count_value("degraded_mode_ticks") == 1
+            # ticks inside the backoff window spawn NOTHING
+            mgr.control_tick()
+            assert calls["n"] - base == mgr.breaker_strikes
+            assert mgr.metrics.count_value("degraded_mode_ticks") == 2
+        finally:
+            arm["on"] = False
+            mgr.stop()
+
+    def test_half_open_probe_backoff_doubles_then_heals(self):
+        mgr, made, arm, calls = self._mgr()
+        try:
+            mgr.kill_replica(mgr.replicas[0])
+            arm["on"] = True
+            mgr.control_tick()
+            base = calls["n"]
+            time.sleep(0.25)            # past the first backoff
+            mgr.control_tick()
+            # ONE half-open probe, it failed, the breaker re-opened
+            # with doubled backoff
+            assert calls["n"] - base == 1
+            assert mgr.breaker_state == BREAKER_OPEN
+            assert mgr.metrics.count_value("breaker_open_total") == 2
+            arm["on"] = False
+            time.sleep(0.45)            # past the doubled backoff
+            mgr.control_tick()          # probe spawn succeeds
+            assert mgr.n_alive() == 2
+            time.sleep(0.15)            # probe survives infancy
+            mgr.control_tick()
+            assert mgr.breaker_state == BREAKER_CLOSED
+        finally:
+            arm["on"] = False
+            mgr.stop()
+
+    def test_degraded_mode_brownout_sheds_low_classes(self):
+        mgr, made, arm, calls = self._mgr(
+            brownout=BrownoutPolicy(classes={"batch": (0.0, 0.0)}))
+        try:
+            mgr.kill_replica(mgr.replicas[0])
+            arm["on"] = True
+            mgr.control_tick()          # opens the breaker
+            with pytest.raises(ServerOverloadedError):
+                mgr.submit([1, 2, 3], 2, klass="batch")
+            assert mgr.metrics.count_value("shed_brownout") == 1
+            # the default class still serves on what is alive
+            assert mgr.submit([1, 2, 3], 2).result(10) == \
+                [1, 2, 3, 0, 0]
+        finally:
+            arm["on"] = False
+            mgr.stop()
+
+    def test_infant_death_strikes_the_breaker(self):
+        made = {}
+        with FleetManager(_factory(_InstantReplica, made),
+                          n_replicas=1, breaker_strikes=1,
+                          infant_mortality_s=30.0) as mgr:
+            name = mgr.replicas[0]
+            # dies well inside infant_mortality_s of its spawn
+            mgr._crash(name, reason="died at startup")
+            assert mgr.metrics.count_value("infant_deaths") == 1
+            assert mgr.breaker_state == BREAKER_OPEN
+
+
+class TestBreakerRecovery:
+    def _crashloop_journal(self, tmp_path):
+        """A journal left by a manager that died with the breaker
+        OPEN (its roster has no wire identity, so a successor cannot
+        re-adopt anything)."""
+        jpath = str(tmp_path / "fleet.journal")
+        made, arm = {}, {"on": False}
+        factory, calls = _flaky_factory(made, arm)
+        mgr = FleetManager(factory, n_replicas=2, breaker_strikes=3,
+                           breaker_backoff_s=0.2,
+                           infant_mortality_s=0.1,
+                           journal=jpath).start()
+        mgr.kill_replica(mgr.replicas[0])
+        arm["on"] = True
+        mgr.control_tick()
+        assert mgr.breaker_state == BREAKER_OPEN
+        # abandon WITHOUT stop(): the manager "crashed" mid-outage
+        return jpath
+
+    def test_recovered_manager_inherits_open_breaker(self, tmp_path):
+        jpath = self._crashloop_journal(tmp_path)
+        made2, arm2 = {}, {"on": False}
+        factory2, calls2 = _flaky_factory(made2, arm2)
+        mgr2 = FleetManager.recover(factory2, jpath, n_replicas=2,
+                                    breaker_strikes=3,
+                                    breaker_backoff_s=0.2,
+                                    infant_mortality_s=0.05)
+        try:
+            # the successor did NOT resume the spawn crash-loop: the
+            # inherited open breaker held the backfill to zero spawns
+            assert mgr2.breaker_state == BREAKER_OPEN
+            assert calls2["n"] == 0
+            assert mgr2.n_alive() == 0
+            # after the inherited backoff it probes and heals
+            time.sleep(0.3)
+            mgr2.control_tick()
+            assert mgr2.n_alive() >= 1
+        finally:
+            mgr2.stop()
+
+    def test_recovery_backfill_is_bounded(self, tmp_path):
+        # a CLOSED-breaker journal + an infant-death factory: the
+        # recovery backfill must strike out and fall through to
+        # degraded mode, not loop forever
+        jpath = str(tmp_path / "fleet.journal")
+        mgr = FleetManager(_factory(_InstantReplica), n_replicas=2,
+                           journal=jpath).start()
+        mgr._journal.close()            # abandon mid-flight
+        made2, arm2 = {}, {"on": True}
+        factory2, calls2 = _flaky_factory(made2, arm2)
+        mgr2 = FleetManager.recover(factory2, jpath, n_replicas=2,
+                                    breaker_strikes=3)
+        try:
+            assert calls2["n"] == mgr2.breaker_strikes
+            assert calls2["n"] <= mgr2.min_replicas \
+                + mgr2.breaker_strikes
+            assert mgr2.breaker_state == BREAKER_OPEN
+            assert mgr2.n_alive() == 0
+        finally:
+            mgr2.stop()
+        mgr._running = False
+
+
+# ---------------------------------------------------------------------------
+# (d) fleet-wide retry budget
+# ---------------------------------------------------------------------------
+class TestRetryBudget:
+    def test_replays_bounded_by_budget(self):
+        budget = RetryBudget(capacity=8, initial=2)
+        made = {}
+        with FleetManager(_factory(_HoldReplica, made), n_replicas=2,
+                          retry_budget=budget,
+                          retry_policy=RetryPolicy(
+                              max_retries=10, base_delay=0.0,
+                              jitter=0.0)) as mgr:
+            futs = [mgr.submit([1, 2, 3], 2) for _ in range(4)]
+            for name in list(mgr.replicas):
+                mgr.kill_replica(name)
+            for fut in futs:
+                with pytest.raises((ReplicaDeadError,
+                                    RetryBudgetExhaustedError)):
+                    fut.result(10)
+            # total replays never exceeded the two tokens the bucket
+            # held; everything past them failed LOUDLY, typed + counted
+            assert mgr.metrics.count_value(
+                "failover_resubmitted") <= 2
+            assert mgr.metrics.count_value(
+                "retry_budget_exhausted") >= 1
+            assert budget.denied >= 1
+            assert budget.tokens == 0.0
+
+    def test_exhaustion_is_typed_and_counted(self):
+        budget = RetryBudget(capacity=4, initial=0)
+        made = {}
+        with FleetManager(_factory(_HoldReplica, made), n_replicas=2,
+                          retry_budget=budget) as mgr:
+            fut = mgr.submit([1, 2, 3], 2)
+            victim = next(r.name for r in made.values() if r.held)
+            mgr.kill_replica(victim)
+            with pytest.raises(RetryBudgetExhaustedError):
+                fut.result(10)
+            assert mgr.metrics.count_value(
+                "retry_budget_exhausted") == 1
+            assert mgr.metrics.count_value("failed") == 1
+
+    def test_successes_refill_the_bucket(self):
+        budget = RetryBudget(capacity=8, initial=0,
+                             refill_fraction=0.5)
+        with FleetManager(_factory(_InstantReplica), n_replicas=2,
+                          retry_budget=budget) as mgr:
+            for _ in range(4):
+                assert mgr.submit([1, 2, 3], 2).result(10) == \
+                    [1, 2, 3, 0, 0]
+            # four completions at 0.5 token each
+            assert budget.tokens == 2.0
+            assert budget.take()
+            assert budget.take()
+            assert not budget.take()
+
+    def test_no_fault_ab_zero_behavior_change(self):
+        prompts = [[1, 2, 3], [4, 5, 6], [7, 8, 9]]
+
+        def run(retry_budget):
+            made = {}
+            with FleetManager(_factory(_InstantReplica, made),
+                              n_replicas=2,
+                              retry_budget=retry_budget) as mgr:
+                out = [mgr.submit(p, 3).result(10) for p in prompts]
+            return out, sum(r.n_submits for r in made.values())
+
+        budget = RetryBudget(capacity=64)
+        with_budget, dispatches_b = run(budget)
+        without, dispatches = run(None)
+        # bit-identical streams, ZERO added dispatches, zero spend
+        assert with_budget == without
+        assert dispatches_b == dispatches == len(prompts)
+        assert budget.tokens == float(budget.capacity)
+        assert budget.denied == 0
+
+    def test_policy_without_budget_always_grants(self):
+        pol = RetryPolicy(max_retries=3, base_delay=0.0, jitter=0.0)
+        assert pol.grant_retry()
+        pol.budget = RetryBudget(capacity=1, initial=1)
+        assert pol.grant_retry()
+        assert not pol.grant_retry()

@@ -415,3 +415,72 @@ class TestSmokeChaos:
         assert body["chaos"]["digest"] == again.digest()
         assert os.path.exists(out + ".json")
         assert os.path.exists(out + ".txt")
+
+    def test_smoke_cascade_sweep(self):
+        """`load_sweep --chaos --cascade --fleet-procs 3` at smoke
+        scale: the blast-radius-containment arm (ISSUE 17). One seeded
+        schedule whose required actions (poison + spawn_fail +
+        manager_kill) compose with the wire severs. Pins: the poison
+        pill is convicted after EXACTLY two replica deaths and its
+        re-submission sheds at the door; the spawn_fail window costs
+        at most K spawn attempts (breaker opens, fleet serves
+        degraded, then heals to full strength); the recovered manager
+        inherits the quarantine; accounting balances; every disturbed
+        replay is bit-identical. tier1.yml uploads the report
+        (`load_sweep_smoke_cascade.json`/`.txt`)."""
+        out = os.path.join(
+            os.environ.get("SMOKE_REPORT_DIR") or tempfile.gettempdir(),
+            "load_sweep_smoke_cascade")
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        mod = importlib.import_module("load_sweep")
+        results = mod.run_sweep(
+            server="decode", rates=(40.0,), n_req=12, slo_ms=400.0,
+            seed=0, trace=False, report_path=out, fleet_procs=3,
+            chaos=True, cascade=True, chaos_events=4)
+        body = next(r for r in results if r["server"] == "fleet_chaos")
+        # poison: convicted on its second death, never a third
+        poison = next(e["poison"] for e in body["chaos"]["log"]
+                      if e.get("action") == "poison")
+        assert poison["verdict"] == "poison_pill"
+        assert poison["deaths"] <= 2
+        assert poison["resubmission_shed"] is True
+        assert poison["quarantined_counter"] >= 2
+        # spawn_fail window: bounded attempts, breaker opened, healed
+        breaker = next(e["breaker"] for e in body["chaos"]["log"]
+                       if e.get("action") == "spawn_fail")
+        assert breaker["state_after_window"] == "open"
+        assert breaker["bounded"] is True
+        assert breaker["recovered_state"] == "closed"
+        assert breaker["n_alive_after"] == 3
+        assert breaker["degraded_mode_ticks"] >= 1
+        # the successor inherits the journaled quarantine
+        rec = body["recovery"]
+        if rec.get("quarantine_inherited") is not None:
+            assert rec["quarantine_inherited"] is True
+        assert body["accounting"]["balanced"] is True
+        for entry in body["chaos"]["log"]:
+            assert entry["all_resolved"] is True
+            assert entry["bit_identical"] is True
+        # the retry budget held: a bounded-chaos run never drains it
+        assert body["cascade"]["retry_budget"]["tokens_remaining"] > 0
+        # compaction fired mid-run (the threshold is set to guarantee
+        # it) and the rotated journal replays from its snapshot record
+        assert body["cascade"]["journal_compacted"] is True
+        # the digest pins the cascade schedule: builder args alone
+        # (require= rewrite included) replay THIS timeline
+        from deeplearning4j_tpu.serving import build_chaos_schedule
+        again = build_chaos_schedule(
+            duration_s=4.0, n_events=4, seed=0,
+            actions=("sever_submit", "sever_stream", "poison",
+                     "spawn_fail", "manager_kill"),
+            require=("poison", "spawn_fail", "manager_kill"))
+        assert body["chaos"]["digest"] == again.digest()
+        # seed 0 ordering: the poison fires BEFORE the manager kill,
+        # so the quarantine-inheritance pin above was exercised
+        acts = again.actions()
+        assert acts.index("poison") < acts.index("manager_kill")
+        assert os.path.exists(out + ".json")
+        assert os.path.exists(out + ".txt")

@@ -24,6 +24,7 @@
       `require_manager_kill` guarantees at least one manager kill,
       offsets stay inside the middle 80% of the duration.
 """
+import os
 import struct
 import threading
 
@@ -288,6 +289,91 @@ class TestFold:
         assert fold_records(recs)["params_version"] == 3
 
 
+class TestCompaction:
+    """compact() changes the file's SIZE, never its MEANING: one
+    snapshot record replaces the whole history, and a crash at any
+    point leaves exactly one authoritative file."""
+
+    RECS = (
+        ("epoch", {"epoch": 1}),
+        ("spawn", {"name": "i0", "seq": 0, "host": "h", "port": 7,
+                   "pid": 11, "start_time": 1.5}),
+        ("spawn", {"name": "i1", "seq": 1}),
+        ("spawn", {"name": "i2", "seq": 2}),
+        ("drain_begin", {"name": "i1"}),
+        ("replica_dead", {"name": "i2"}),
+        ("params", {"version": 4}),
+        ("canary_begin", {"name": "i0", "version": 5}),
+        ("quarantine", {"fingerprint": "aa" * 32}),
+        ("breaker", {"state": "open", "strikes": 3,
+                     "backoff_s": 0.8}),
+    )
+
+    def test_fold_identical_before_and_after(self, jpath):
+        with FleetJournal(jpath) as j:
+            for kind, fields in self.RECS:
+                j.append(kind, **fields)
+            before = fold_records(replay_journal(jpath))
+            size_before = j.size()
+            j.compact()
+            after = fold_records(replay_journal(jpath))
+            assert after == before
+            # the file really shrank to one record, and size() tracks
+            # the rotated file
+            recs = replay_journal(jpath)
+            assert [r["kind"] for r in recs] == ["snapshot"]
+            assert j.size() < size_before
+
+    def test_appends_after_compaction_fold_on_top(self, jpath):
+        with FleetJournal(jpath) as j:
+            for kind, fields in self.RECS:
+                j.append(kind, **fields)
+            j.compact()
+            j.append("replica_dead", name="i0")
+            j.append("spawn", name="i3", seq=3)
+        intent = fold_records(replay_journal(jpath))
+        assert set(intent["roster"]) == {"i1", "i3"}
+        assert intent["max_id"] == 3
+        assert intent["quarantine"] == ["aa" * 32]
+        assert intent["breaker"]["state"] == "open"
+
+    def test_crash_before_commit_keeps_old_file(self, jpath):
+        with FleetJournal(jpath) as j:
+            for kind, fields in self.RECS:
+                j.append(kind, **fields)
+        before = fold_records(replay_journal(jpath))
+        # a compaction that died before its os.replace commit point:
+        # the half-written snapshot sits in the .compacting sibling
+        with open(jpath + ".compacting", "wb") as fh:
+            fh.write(b"half a snapshot reco")
+        assert fold_records(replay_journal(jpath)) == before
+        # the next open removes the stale sibling and appends continue
+        # on the intact original
+        with FleetJournal(jpath) as j:
+            j.append("epoch", epoch=2)
+        assert not os.path.exists(jpath + ".compacting")
+        assert fold_records(replay_journal(jpath))["epoch"] == 2
+
+    def test_counts_into_sink(self, jpath):
+        m = ServingMetrics()
+        with FleetJournal(jpath, counters=m) as j:
+            j.append("epoch", epoch=1)
+            j.compact()
+        assert m.count_value("journal_records") == 2
+
+    def test_refuses_after_close_and_broken(self, jpath):
+        j = FleetJournal(jpath)
+        j.append("epoch", epoch=1)
+        j.close()
+        with pytest.raises(JournalBrokenError):
+            j.compact()
+        j2 = FleetJournal(jpath)
+        j2._broken = True
+        with pytest.raises(JournalBrokenError):
+            j2.compact()
+        j2.close()
+
+
 class TestChaosSchedule:
     ACTIONS = ("sever_submit", "sever_stream", "replica_crash",
                "manager_kill")
@@ -327,3 +413,69 @@ class TestChaosSchedule:
         # missing offset surfaces as a KeyError from the sort key
         with pytest.raises(ValueError):
             ChaosSchedule([{"action": "manager_kill"}], duration_s=5.0)
+
+
+class TestChaosScheduleEdges:
+    """ISSUE 17 satellite: the hand-built-schedule corners the seeded
+    builder never produces."""
+
+    def test_t_zero_event_is_valid(self):
+        sched = ChaosSchedule([{"t": 0.0, "action": "manager_kill"}],
+                              duration_s=5.0)
+        assert sched.events[0]["t"] == 0.0
+        assert sched.actions() == ("manager_kill",)
+
+    def test_duplicate_timestamps_keep_stable_order(self):
+        events = [{"t": 1.0, "action": "sever_submit"},
+                  {"t": 1.0, "action": "manager_kill"},
+                  {"t": 1.0, "action": "sever_stream"}]
+        a = ChaosSchedule(events, duration_s=5.0)
+        b = ChaosSchedule(list(events), duration_s=5.0)
+        # the sort is STABLE: insertion order among equal offsets is
+        # part of the timeline, and the digest pins it
+        assert a.actions() == ("sever_submit", "manager_kill",
+                               "sever_stream")
+        assert a.digest() == b.digest()
+        flipped = ChaosSchedule([events[1], events[0], events[2]],
+                                duration_s=5.0)
+        assert flipped.digest() != a.digest()
+
+    def test_unknown_action_names_the_action(self):
+        with pytest.raises(ValueError, match="reboot_rack"):
+            ChaosSchedule([{"t": 1.0, "action": "reboot_rack"}],
+                          duration_s=5.0)
+
+    def test_empty_schedule_is_a_valid_no_op(self):
+        sched = ChaosSchedule([], duration_s=5.0)
+        assert sched.n == 0
+        assert sched.actions() == ()
+        assert sched.digest() == ChaosSchedule([], 5.0).digest()
+
+    def test_require_fills_missing_actions_deterministically(self):
+        req = ("poison", "spawn_fail", "manager_kill")
+        a = build_chaos_schedule(8.0, 6, seed=1,
+                                 actions=("sever_submit",
+                                          "sever_stream"),
+                                 require=req)
+        b = build_chaos_schedule(8.0, 6, seed=1,
+                                 actions=("sever_submit",
+                                          "sever_stream"),
+                                 require=req)
+        assert a.digest() == b.digest()
+        for action in req:
+            assert action in a.actions()
+
+    def test_require_legacy_digest_unchanged(self):
+        # require=("manager_kill",) IS the legacy
+        # require_manager_kill rewrite — byte-identical timelines
+        pool = ("sever_submit", "sever_stream", "manager_kill")
+        legacy = build_chaos_schedule(10.0, 5, seed=3, actions=pool)
+        explicit = build_chaos_schedule(10.0, 5, seed=3, actions=pool,
+                                        require=("manager_kill",))
+        assert legacy.digest() == explicit.digest()
+
+    def test_require_overflow_refuses(self):
+        with pytest.raises(ValueError):
+            build_chaos_schedule(5.0, 2, seed=0,
+                                 require=("poison", "spawn_fail",
+                                          "manager_kill"))

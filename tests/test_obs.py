@@ -559,6 +559,16 @@ class TestMetricsPins:
         # restarted scrapes zero, not absence)
         "manager_epoch", "replicas_adopted", "fenced_ops",
         "journal_records",
+        # blast-radius containment (serving/fleet.py, ISSUE 17):
+        # poison-pill quarantine verdicts, the spawn circuit breaker
+        # (open events + live state gauge), fleet retry-budget denials,
+        # degraded-mode time, infant deaths — consumed by
+        # tools/fleet_report.py's containment section and the
+        # load_sweep --cascade record (eagerly created: a fleet that
+        # never contained anything scrapes zero, not absence)
+        "requests_quarantined", "breaker_open_total", "breaker_state",
+        "retry_budget_exhausted", "degraded_mode_ticks",
+        "infant_deaths",
         "admission_error_ms_p50", "admission_error_ms_p99",
         "admission_error_ms_mean", "admission_error_ms_count",
         "slo_total", "slo_met", "slo_tokens_met", "slo_attainment",
@@ -591,6 +601,13 @@ class TestMetricsPins:
         # live by FleetManager.fleet_snapshot()
         "fleet_manager_epoch", "fleet_replicas_adopted",
         "fleet_fenced_ops", "fleet_journal_records",
+        # blast-radius containment counters (serving/fleet.py): summed
+        # the same way; fleet_breaker_state is the per-instance MAX of
+        # the breaker gauge (any open breaker reads open) until
+        # FleetManager.fleet_snapshot() overlays its live state
+        "fleet_requests_quarantined", "fleet_breaker_open_total",
+        "fleet_retry_budget_exhausted", "fleet_degraded_mode_ticks",
+        "fleet_infant_deaths", "fleet_breaker_state",
     )
 
     def test_fleet_snapshot_keys_pinned(self):

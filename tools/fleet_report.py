@@ -119,6 +119,14 @@ CONTROL_KEYS = ("fleet_replica_spawned", "fleet_replica_drained",
                 "fleet_manager_epoch", "fleet_replicas_adopted",
                 "fleet_fenced_ops", "fleet_journal_records")
 
+# blast-radius containment (serving/fleet.py ISSUE 17): quarantine
+# verdicts, the spawn circuit breaker, the shared retry budget, and
+# degraded-mode time — the "how contained was the damage" read-out
+CONTAINMENT_KEYS = ("fleet_requests_quarantined",
+                    "fleet_breaker_open_total", "fleet_breaker_state",
+                    "fleet_retry_budget_exhausted",
+                    "fleet_degraded_mode_ticks", "fleet_infant_deaths")
+
 
 def format_fleet_report(report, top=20):
     """Human-readable rendering: per-instance table, fleet-control
@@ -134,9 +142,13 @@ def format_fleet_report(report, top=20):
     lines.append("== fleet control ==")
     for k in CONTROL_KEYS:
         lines.append(f"  {k} = {fleet.get(k, 0)}")
+    lines.append("== containment ==")
+    for k in CONTAINMENT_KEYS:
+        lines.append(f"  {k} = {fleet.get(k, 0)}")
     lines.append("== fleet aggregates ==")
     for k in sorted(fleet):
-        if k == "fleet_shed_share" or k in CONTROL_KEYS:
+        if k == "fleet_shed_share" or k in CONTROL_KEYS \
+                or k in CONTAINMENT_KEYS:
             continue        # rendered above
         v = fleet[k]
         lines.append(f"  {k} = {fmt(v, 4) if isinstance(v, float) else v}")

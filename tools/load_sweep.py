@@ -24,7 +24,7 @@ Run (CPU backend, no chip needed):
         [--chunked-prefill C] [--admission] [--overload-ab] \
         [--paged] [--speculate K] [--preempt] [--fleet N]
         [--fleet-control [--fleet-min A --fleet-max B]]
-        [--fleet-procs N [--chaos [--chaos-events E]]]
+        [--fleet-procs N [--chaos [--chaos-events E] [--cascade]]]
 
 `--process onoff` keeps the same MEAN rate but bursts at 2x with a 50%
 duty cycle (the p99 stressor); `--process closed` reinterprets each
@@ -34,6 +34,10 @@ baseline AND a chunked-prefill + deadline-admission arm (PR 9) and
 appends a comparison record: per-rate goodput/TTFT both arms, the
 controlled arm's shed-reason breakdown, and the monotonicity verdict
 (goodput must not collapse past the knee).
+`--cascade` (with `--chaos`, `--fleet-procs` >= 3) runs the
+blast-radius-containment arm: poison-pill quarantine, the spawn
+circuit breaker's factory-failure window, and the shared retry budget
+composed with the manager kill (ISSUE 17).
 `bench.py`'s `load_sweep` config pins one sweep point per record;
 tests/test_loadgen.py runs the smoke version in tier-1 and CI uploads
 its report JSON.
@@ -762,7 +766,7 @@ def sweep_fleet_procs(rates, n_replicas=2, n_req=64, slo_ms=250.0,
 
 def sweep_fleet_chaos(rates, n_replicas=2, n_req=48, slo_ms=250.0,
                       seed=0, process="poisson", trace=False, slots=2,
-                      chaos_events=5, slice_s=0.2):
+                      chaos_events=5, slice_s=0.2, cascade=False):
     """The DURABLE-CONTROL-PLANE arm (`--chaos`, needs
     `--fleet-procs N`): the same replica-process fleet as
     `sweep_fleet_procs`, but the manager journals every state
@@ -785,24 +789,45 @@ def sweep_fleet_chaos(rates, n_replicas=2, n_req=48, slo_ms=250.0,
     lost. The schedule digest makes the whole run replayable from
     (seed, chaos_events) alone.
 
+    `cascade=True` is the BLAST-RADIUS-CONTAINMENT arm (`--cascade`,
+    ISSUE 17): the schedule adds the `poison` action (a request whose
+    decode deterministically kills the replica it lands on, via the
+    manager's kill hook — two kills convict it, `PoisonPillError`,
+    quarantine) and `spawn_fail` (a factory-failure window — the spawn
+    circuit breaker opens after K consecutive infant strikes and the
+    fleet serves DEGRADED on its survivors instead of crash-looping),
+    both composed with the manager kill above. The record pins the
+    cascade: the poison request is the ONLY request lost (typed
+    verdict), its two kills are the only deaths it causes,
+    re-submissions shed at the door (before AND after manager
+    recovery — the quarantine is journaled), spawn attempts in the
+    breaker window stay <= K, and the accounting still balances.
+
     Returns (body, per_instance_snaps, merged_trace_or_None)."""
     import concurrent.futures as cf
     import subprocess
     import tempfile
 
     from deeplearning4j_tpu.common.resilience import (FaultInjector,
+                                                      RetryBudget,
                                                       RetryPolicy)
     from deeplearning4j_tpu.obs.fleet import merge_traces
     from deeplearning4j_tpu.serving import (CHAOS_ACTIONS, DecodeSizeMix,
-                                            FleetManager, RemoteReplica,
+                                            FleetManager, PoisonPillError,
+                                            RemoteReplica,
                                             ServerClosedError,
                                             ServingMetrics,
                                             StaleEpochError,
                                             build_chaos_schedule,
                                             build_schedule, run_load)
     injector = FaultInjector()
+    # cascade: a generous shared budget — wire resends, reconnects and
+    # failover replays all spend from it; sized so the seeded storm
+    # never exhausts it (exhaustion is a unit-tested verdict, the sweep
+    # pins that the machinery runs end-to-end without changing outcomes)
+    budget = RetryBudget(capacity=512, initial=512) if cascade else None
     retry = RetryPolicy(max_retries=4, base_delay=0.05, max_delay=0.5,
-                        jitter=0.0)
+                        jitter=0.0, budget=budget)
     tmpdir = tempfile.mkdtemp(prefix="fleet_chaos_")
     jpath = os.path.join(tmpdir, "fleet.journal")
     here = os.path.abspath(__file__)
@@ -838,7 +863,14 @@ def sweep_fleet_chaos(rates, n_replicas=2, n_req=48, slo_ms=250.0,
     names = [f"i{k}" for k in range(int(n_replicas))]
     ports = {n: launch(n) for n in names}
 
+    spawn_calls = {"n": 0}          # every factory invocation
+    spawn_fail_arm = {"on": False}  # the chaos spawn_fail window
+
     def factory(name):
+        spawn_calls["n"] += 1
+        if spawn_fail_arm["on"]:
+            raise RuntimeError(
+                "chaos spawn_fail window: factory refused to spawn")
         port_file = ports.pop(name, None)
         if port_file is None:
             port_file = launch(name)        # backfill / crash respawn
@@ -861,22 +893,50 @@ def sweep_fleet_chaos(rates, n_replicas=2, n_req=48, slo_ms=250.0,
         for p in ([1, 2, 3, 4], list(range(1, 13))):
             srv.generate(p, 4, deadline_ms=600_000, timeout=300)
 
-    schedule = build_chaos_schedule(
-        duration_s=max(1.0, float(chaos_events)),
-        n_events=int(chaos_events), seed=seed,
-        actions=("sever_submit", "sever_stream", "sever_heartbeat",
-                 "replica_crash", "manager_kill"))
+    if cascade:
+        # the containment pool: poison + spawn_fail ride along with
+        # wire severs and the guaranteed manager kill (replica_crash
+        # stays out — the poison's own kills are the deaths this arm
+        # measures). require= fills any action the draw missed, inside
+        # the builder, so the digest still pins the timeline.
+        schedule = build_chaos_schedule(
+            duration_s=max(1.0, float(chaos_events)),
+            n_events=max(int(chaos_events), 3), seed=seed,
+            actions=("sever_submit", "sever_stream", "poison",
+                     "spawn_fail", "manager_kill"),
+            require=("poison", "spawn_fail", "manager_kill"))
+    else:
+        schedule = build_chaos_schedule(
+            duration_s=max(1.0, float(chaos_events)),
+            n_events=int(chaos_events), seed=seed,
+            actions=("sever_submit", "sever_stream", "sever_heartbeat",
+                     "replica_crash", "manager_kill"))
     mix = DecodeSizeMix(((0.8, (3, 12), (4, 24)),
                          (0.2, (8, 16), (24, 44))), vocab=96)
     prompts = [[1, 2, 3]] + [[4 + j, 5, 6] for j in range(5)]
+    poison_prompt = [13, 13, 13]    # never among the reference prompts
+
+    def kill_hook(prompt, replica_name):
+        return list(prompt) == poison_prompt
+
+    # cascade containment knobs: short infancy + backoff so the breaker
+    # opens, probes, and closes inside the smoke budget; a journal
+    # compaction threshold small enough that the chaos run's record
+    # volume actually triggers a fold+rotate before the manager kill
+    containment_kw = dict(
+        kill_hook=kill_hook, retry_budget=budget,
+        infant_mortality_s=0.4, breaker_backoff_s=0.3,
+        journal_compact_bytes=768) if cascade else {}
     mgr = FleetManager(factory, n_replicas=n_replicas, warmup=warmup,
                        heartbeat_timeout=2.0, fault_injector=injector,
                        metrics=ServingMetrics(name="fleet"),
-                       journal=jpath)
+                       journal=jpath, **containment_kw)
     stale = None
     admitted = completed = failed = 0
     chaos_log = []
     recovery_rec = None
+    poison_fired = False
+    cascade_rec = {}
 
     def fault_batch(tag):
         # plant-then-drive: a planted sever only matters to traffic
@@ -936,7 +996,8 @@ def sweep_fleet_chaos(rates, n_replicas=2, n_req=48, slo_ms=250.0,
                     factory, jpath, redial=redial, identity_dir=tmpdir,
                     n_replicas=n_replicas, warmup=warmup,
                     heartbeat_timeout=2.0, fault_injector=injector,
-                    metrics=ServingMetrics(name="fleet"))
+                    metrics=ServingMetrics(name="fleet"),
+                    **containment_kw)
                 snap = mgr.fleet_snapshot()
                 post_fv = mgr.fleet_view()
                 monotone = all(
@@ -976,8 +1037,110 @@ def sweep_fleet_chaos(rates, n_replicas=2, n_req=48, slo_ms=250.0,
                         "fleet_fenced_ops"],
                     "counters_monotone_across_restart": monotone,
                 }
+                if cascade:
+                    # the quarantine is journaled: a successor built
+                    # from the journal must keep shedding the convicted
+                    # prompt at the door, NOT resurrect it onto the
+                    # fresh fleet (where its decode would kill again)
+                    inherited = None
+                    if poison_fired:
+                        try:
+                            f = mgr.submit(poison_prompt, 12,
+                                           deadline_ms=600_000)
+                            admitted += 1
+                            inherited = False
+                            try:
+                                f.result(300)
+                                completed += 1
+                            except Exception:   # noqa: BLE001
+                                failed += 1
+                        except PoisonPillError:
+                            inherited = True
+                    recovery_rec["quarantine_inherited"] = inherited
+                    recovery_rec["breaker_state_inherited"] = \
+                        mgr.breaker_state
                 rec["recovery"] = recovery_rec
                 rec.update(fault_batch("post_recovery"))
+            elif action == "poison":
+                # the poison pill: its decode kills the replica it
+                # lands on (kill hook), its replay kills the next one,
+                # the second death convicts it — PoisonPillError on the
+                # outer future, fingerprint quarantined + journaled
+                pre_dead = mgr.fleet_snapshot()["fleet_replica_dead"]
+                pf = mgr.submit(poison_prompt, 12, deadline_ms=600_000)
+                admitted += 1
+                try:
+                    pf.result(300)
+                    verdict = "completed"   # unacceptable — recorded
+                    completed += 1
+                except PoisonPillError:
+                    verdict = "poison_pill"
+                    failed += 1
+                except Exception as e:      # noqa: BLE001
+                    verdict = f"wrong error: {type(e).__name__}"
+                    failed += 1
+                # a re-submission of the convicted prompt sheds at the
+                # door — it must never reach (and kill) a third replica
+                reshed = None
+                try:
+                    f2 = mgr.submit(poison_prompt, 12,
+                                    deadline_ms=600_000)
+                    admitted += 1
+                    reshed = False
+                    try:
+                        f2.result(300)
+                        completed += 1
+                    except Exception:       # noqa: BLE001
+                        failed += 1
+                except PoisonPillError:
+                    reshed = True
+                mgr.control_tick()  # backfill past the poison's kills
+                poison_fired = True
+                fsnap = mgr.fleet_snapshot()
+                rec["poison"] = {
+                    "verdict": verdict,
+                    "deaths": fsnap["fleet_replica_dead"] - pre_dead,
+                    "resubmission_shed": reshed,
+                    "quarantined_counter":
+                        fsnap["fleet_requests_quarantined"]}
+                rec.update(fault_batch("post_poison"))
+            elif action == "spawn_fail":
+                # factory-failure window: crash one replica so the
+                # control loop must backfill, with every spawn attempt
+                # refused — K consecutive strikes OPEN the breaker and
+                # the fleet serves degraded on its survivors instead of
+                # crash-looping one spawn per tick
+                attempts0 = spawn_calls["n"]
+                spawn_fail_arm["on"] = True
+                victim = mgr.replicas[0]
+                mgr._crash(victim, reason="chaos: spawn_fail window")
+                mgr.control_tick()  # strikes accumulate; breaker opens
+                opened = mgr.breaker_state
+                mgr.control_tick()  # OPEN: these ticks may not spawn
+                mgr.control_tick()
+                attempts = spawn_calls["n"] - attempts0
+                rec["breaker"] = {
+                    "state_after_window": opened,
+                    "spawn_attempts_in_window": attempts,
+                    "bounded": attempts <= mgr.breaker_strikes}
+                rec.update(fault_batch("degraded"))
+                # heal: the window closes, the half-open probe spawns
+                # after the backoff, survives infancy, and the breaker
+                # closes with the fleet restored to full strength
+                spawn_fail_arm["on"] = False
+                deadline = time.monotonic() + 60.0
+                while (mgr.breaker_state != "closed"
+                       or mgr.n_alive() < n_replicas) \
+                        and time.monotonic() < deadline:
+                    mgr.control_tick()
+                    time.sleep(0.05)
+                fsnap = mgr.fleet_snapshot()
+                rec["breaker"]["recovered_state"] = mgr.breaker_state
+                rec["breaker"]["n_alive_after"] = mgr.n_alive()
+                rec["breaker"]["breaker_open_total"] = \
+                    fsnap["fleet_breaker_open_total"]
+                rec["breaker"]["degraded_mode_ticks"] = \
+                    fsnap["fleet_degraded_mode_ticks"]
             elif action == "replica_crash":
                 injector.plan("fleet.replica",
                               on_call=injector.calls("fleet.replica"),
@@ -999,6 +1162,17 @@ def sweep_fleet_chaos(rates, n_replicas=2, n_req=48, slo_ms=250.0,
         snaps = {n: mgr.replica(n).metrics.snapshot()
                  for n in mgr.replicas}
         pids = {n: procs[n].pid for n in procs}
+        if cascade:
+            # journal facts read BEFORE the tmpdir vanishes: a
+            # `snapshot` record means compact() folded + rotated the
+            # file mid-run (the compaction threshold is set low enough
+            # that the chaos run's record volume crosses it)
+            from deeplearning4j_tpu.serving import replay_journal
+            cascade_rec = {
+                "journal_bytes": os.path.getsize(jpath),
+                "journal_compacted": any(
+                    r.get("kind") == "snapshot"
+                    for r in replay_journal(jpath))}
     finally:
         if mgr is not None:
             mgr.stop(timeout=120)
@@ -1029,7 +1203,10 @@ def sweep_fleet_chaos(rates, n_replicas=2, n_req=48, slo_ms=250.0,
                       f"replica PROCESSES, slots={slots}, seeded chaos "
                       f"schedule ({schedule.n} events, digest "
                       f"{schedule.digest()[:12]}), one manager "
-                      f"kill+recover, admission deadline={slo_ms:g}ms",
+                      f"kill+recover, admission deadline={slo_ms:g}ms"
+                      + (", CASCADE containment arm (poison + "
+                         "spawn_fail + shared retry budget)"
+                         if cascade else ""),
             "unit": "resolved futures under chaos",
             "chaos": {"seed": seed, "n_events": schedule.n,
                       "digest": schedule.digest(),
@@ -1040,6 +1217,15 @@ def sweep_fleet_chaos(rates, n_replicas=2, n_req=48, slo_ms=250.0,
             "recovery": recovery_rec,
             "fleet": final_snap,
             "replica_pids": pids}
+    if cascade:
+        body["cascade"] = dict(
+            cascade_rec,
+            poison_prompt=poison_prompt,
+            spawn_attempts_total=spawn_calls["n"],
+            retry_budget={
+                "capacity": budget.capacity,
+                "tokens_remaining": budget.tokens,
+                "denied": budget.denied})
     return body, snaps, merged
 
 
@@ -1162,7 +1348,7 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
               fleet_obs_per_rate=6, fleet_slice_s=0.25,
               fleet_control=False, fleet_injector=None,
               fleet_min=None, fleet_max=None, fleet_procs=0,
-              chaos=False, chaos_events=5):
+              chaos=False, chaos_events=5, cascade=False):
     """Drive the sweep(s) and (optionally) write the combined
     obs_report (JSON + text + Chrome trace). Returns the results list.
     The tier-1 smoke test calls this with tiny parameters (and once
@@ -1191,6 +1377,15 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
                          "manager of a replica-PROCESS fleet — "
                          "silently running without it would discard "
                          "the flag")
+    if cascade and not chaos:
+        raise ValueError("--cascade extends the --chaos schedule with "
+                         "poison + spawn_fail: add --chaos (and "
+                         "--fleet-procs N >= 3)")
+    if cascade and fleet_procs < 3:
+        raise ValueError("--cascade needs --fleet-procs N (>= 3): the "
+                         "poison pill kills TWO replicas before it is "
+                         "convicted, and a survivor must keep serving "
+                         "the co-victims it failed over")
     if fleet_procs and server not in ("decode", "both"):
         raise ValueError("--fleet-procs needs --server decode (or "
                          "both): the wire fleet drives DECODE replica "
@@ -1221,7 +1416,7 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
         body, inst_snaps, fleet_trace = sweep_fleet_chaos(
             rates, n_replicas=fleet_procs, n_req=n_req, slo_ms=slo_ms,
             seed=seed, process=process, trace=trace,
-            chaos_events=chaos_events)
+            chaos_events=chaos_events, cascade=cascade)
         results.append(body)
         snaps.update({f"fleet_{n}": s for n, s in inst_snaps.items()})
     elif fleet_procs >= 2:
@@ -1404,6 +1599,17 @@ def main():
     ap.add_argument("--chaos-events", type=int, default=5, metavar="E",
                     help="chaos schedule length (>= 1; one is always "
                          "a manager kill)")
+    ap.add_argument("--cascade", action="store_true",
+                    help="BLAST-RADIUS-CONTAINMENT arm (needs --chaos "
+                         "and --fleet-procs N >= 3): the schedule adds "
+                         "a poison request (its decode kills the "
+                         "replica it lands on; two kills convict it — "
+                         "typed PoisonPillError + journaled "
+                         "quarantine) and a spawn_fail factory window "
+                         "(the spawn circuit breaker opens after K "
+                         "strikes; the fleet serves degraded instead "
+                         "of crash-looping), with a shared fleet-wide "
+                         "retry budget gating resends and replays")
     ap.add_argument("--preempt", action="store_true",
                     help="durable-KV preemption (implies --paged): the "
                          "mix's long tail submits as a spillable batch "
@@ -1443,7 +1649,8 @@ def main():
                         fleet_max=args.fleet_max,
                         fleet_procs=args.fleet_procs,
                         chaos=args.chaos,
-                        chaos_events=args.chaos_events)
+                        chaos_events=args.chaos_events,
+                        cascade=args.cascade)
     for r in results:
         print(json.dumps(r))
     print(json.dumps({"elapsed_s": fmt(time.perf_counter() - t0, 1),
