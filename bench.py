@@ -1063,6 +1063,118 @@ def bench_paged_speculative(rng, small=False):
     return rec
 
 
+def bench_fused_decode(rng, small=False):
+    """Fused decode windows vs per-iteration dispatch (ISSUE 18:
+    `fused_serve=K` — `lax.scan` runs K serve iterations on-device in
+    ONE dispatch, static slot membership inside the window;
+    tools/serve_ab.py `fused_serve_vs_plain` is the richer standalone).
+    BOTH arms run the identical paged server config; only the fused arm
+    scans K=4 iterations per dispatch. Streams are pinned bit-identical
+    (tests/test_fused_serve.py) and there is no model-dependence
+    (unlike speculation there is no acceptance rate), so the headline
+    is the pure dispatch amortization: dispatches/token at 1/K of the
+    unfused baseline (decode lengths ≡ 1 mod K keep every window full)
+    next to tokens/s. On a remote-attached chip every saved dispatch is
+    a tunnel round-trip — the regime the on-chip re-measure probes."""
+    import numpy as np
+
+    from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            ServingMetrics)
+
+    K = 4
+    V, L, D, H = (96, 2, 32, 2) if small else (256, 4, 256, 8)
+    max_len = 64 if small else 160
+    slots = 16
+    bs = 8 if small else 16
+    n_blocks = 48 if small else 80
+    n_req = 16 if small else 24
+    # every choice ≡ 1 (mod K): prefill emits token 1, the remaining
+    # n_new - 1 iterations divide evenly into full K-windows
+    dec_choices = (17, 21, 25, 29, 33) if small else (33, 41, 49, 57)
+    lm = TransformerLM(V, d_model=D, n_heads=H, n_layers=L,
+                       max_len=max_len, seed=5)
+    sys_prefix = np.random.default_rng(7).integers(1, V, 16).tolist()
+
+    def workload(seed, n):
+        rr = np.random.default_rng(seed)
+        out = []
+        for _ in range(n):
+            own = rr.integers(1, V, int(rr.integers(1, 8))).tolist()
+            out.append((sys_prefix + own, int(rr.choice(dec_choices))))
+        return out
+
+    slo_ms = 100.0
+    paged_kw = dict(slots=slots, prompt_buckets=(24,),
+                    max_queue=4 * n_req, paged=True, block_size=bs,
+                    n_blocks=n_blocks)
+    servers = {
+        "fused": ContinuousDecodeServer(
+            lm, fused_serve=K,
+            metrics=ServingMetrics(slo_target_ms=slo_ms),
+            **paged_kw).start(),
+        "plain": ContinuousDecodeServer(
+            lm, metrics=ServingMetrics(slo_target_ms=slo_ms),
+            **paged_kw).start(),
+    }
+    for srv in servers.values():       # compile off the clock
+        for p, n in workload(0, 4):
+            srv.generate(p, n, timeout=300)
+    base = {n: servers[n].metrics.snapshot() for n in servers}
+
+    seg_idx = {name: [0] for name in servers}
+
+    def seg(name):
+        srv = servers[name]
+
+        def run():
+            work = workload(100 + seg_idx[name][0], n_req)
+            seg_idx[name][0] += 1
+            toks = sum(n for _, n in work)
+            t0 = time.perf_counter()
+            for f in [srv.submit(p, n) for p, n in work]:
+                f.result(600)
+            return toks / (time.perf_counter() - t0)
+        return run
+
+    ab = _interleaved_median({n: seg(n) for n in servers},
+                             segments=3 if small else 5)
+    snaps = {n: servers[n].metrics.snapshot() for n in servers}
+    for srv in servers.values():
+        srv.stop()
+    dpt = {n: snaps[n]["dispatches_per_token"] for n in snaps}
+    rec = {"value": ab["fused"]["median"], "unit": "tokens/sec",
+           "config": f"ContinuousDecodeServer L={L} d={D}, BOTH arms "
+                     f"paged {n_blocks} blocks x {bs} (slots={slots} "
+                     f"scheduling width), 16-token shared prefix, "
+                     f"decode lengths ≡1 mod {K}, fused_serve={K} on "
+                     f"the fused arm, {n_req} reqs/seg (streams "
+                     f"bit-identical)",
+           "fused_ab": ab,
+           "speedup_fused_over_plain": round(
+               ab["fused"]["median"] / ab["plain"]["median"], 3),
+           "dispatches_per_token_ratio": round(
+               dpt["fused"] / dpt["plain"], 3) if dpt["plain"] else None,
+           "target_ratio": round(1.0 / K, 3),
+           "fused_windows": snaps["fused"]["fused_windows"],
+           "vs_baseline": round(ab["fused"]["median"]
+                                / BASELINE_DECODE_TOKENS_PER_SEC, 3)}
+    from deeplearning4j_tpu.obs.registry import fmt
+    from deeplearning4j_tpu.serving.metrics import slo_view
+    for n, snp in snaps.items():
+        rec[f"dispatches_per_token_{n}"] = fmt(dpt[n], 4)
+        rec[f"iterations_per_dispatch_{n}"] = fmt(
+            snp["iterations_per_dispatch"], 3)
+        rec[f"p50_request_ms_{n}"] = fmt(snp["latency_ms_p50"])
+        rec[f"p99_request_ms_{n}"] = fmt(snp["latency_ms_p99"])
+        view = slo_view(snp, ab[n]["median"], base[n])
+        rec[f"slo_attainment_{n}"] = view["attainment"]
+        rec[f"goodput_tokens_per_sec_{n}"] = view.get(
+            "goodput_tokens_per_sec")
+    rec["slo_ms"] = slo_ms
+    return rec
+
+
 def bench_preempt_vs_shed(rng, small=False):
     """Durable-KV preemption A/B (ISSUE 11): at FULL block occupancy,
     interactive-class goodput-under-deadline with preemption (batch
@@ -1241,6 +1353,11 @@ SECONDARY_CONFIGS = {
     # tokens/s vs the paged baseline — the PR 5 amortization on the
     # PR 8 memory model (the production configuration)
     "paged_speculative_decode": (bench_paged_speculative, 120),
+    # fused decode windows (ISSUE 18): K serve iterations scanned into
+    # one dispatch — dispatches/token at 1/K of the unfused paged
+    # baseline; the second-probe on-chip backlog re-measures where each
+    # dispatch is a tunnel hop
+    "fused_decode": (bench_fused_decode, 110),
     # durable-KV preemption (ISSUE 11): interactive goodput-under-
     # deadline at full block occupancy, preempt vs shed-only — the
     # robustness lever queue-depth admission cannot supply

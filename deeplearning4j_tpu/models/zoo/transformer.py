@@ -664,6 +664,113 @@ def make_paged_decode_fn(n_heads, block_size):
     return step
 
 
+def make_fused_decode_fn(n_heads, k):
+    """K iterations of continuous-batching decode scanned into ONE device
+    dispatch — `nn/fused.py`'s fused_steps applied to serving. The scan
+    body IS `make_slot_decode_fn`'s step (same block program, same embed,
+    same f32 argmax), so each unrolled iteration computes bit-identical
+    values to one host-scheduled dispatch; the only new machinery is the
+    per-slot step BUDGET.
+
+    window(aux, blocks, cache, pos [S], tok [S], active [S], steps [S])
+      -> (toks [K, S] i32, new cache, new pos)
+
+    Slot membership is STATIC inside the window (the scheduler admits,
+    evicts, and sweeps deadlines only at window boundaries), but slots
+    finish at different times, so step i gates each slot on
+    `active & (i < steps)`: once a slot's budget is spent it behaves
+    exactly like an inactive slot — frozen tok/pos, write-back-gated
+    cache rows — which is the SAME device state a host scheduler leaves
+    when it frees the slot between iterations and keeps dispatching its
+    neighbours (stale host-side tok/pos, gated writes). Per-row
+    independence (the continuous-decode determinism pin) then makes
+    every live slot's bits equal to the host-scheduled stream's.
+    toks[i, s] is garbage for i >= steps[s]; the host consumes
+    toks[:steps[s], s] only. K is static (ONE compiled program per
+    (slot count, K)); k < 2 is refused because a 1-step window is the
+    plain program with scan overhead — use `make_slot_decode_fn`."""
+    block_decode = make_slot_decode_block_fn(n_heads)
+    k = int(k)
+    if k < 2:
+        raise ValueError(f"fused window k must be >= 2 (k=1 is the "
+                         f"plain decode program), got {k}")
+
+    def window(aux, blocks, cache, pos, tok, active, steps):
+        def body(carry, i):
+            cache, pos, tok = carry
+            act = active & (i < steps)
+            x = aux["tok"][tok] + aux["pos"][pos]       # [S, D]
+            new_cache = []
+            for p, c in zip(blocks, cache):
+                x, c = block_decode(p, x, c, pos, act)
+                new_cache.append(c)
+            logits = logits_fn(aux, x).astype(jnp.float32)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            tok = jnp.where(act, nxt, tok)
+            pos = pos + act.astype(pos.dtype)
+            return (new_cache, pos, tok), nxt
+
+        (cache, pos, tok), toks = jax.lax.scan(
+            body, (cache, pos, tok), jnp.arange(k))
+        return toks, cache, pos
+
+    return window
+
+
+def make_paged_fused_decode_fn(n_heads, block_size, k):
+    """`make_fused_decode_fn` re-addressed through the block table: K
+    paged decode iterations in one dispatch. The scan body is
+    `make_paged_decode_fn`'s step (same block program), and the block
+    table stays STATIC across the window — only `pos` rides the carry,
+    and the frontier row `btab[s, pos // bs] * bs + pos % bs` is
+    recomputed from it each step, so the write pointer advances through
+    the table without any host round-trip.
+
+    window(aux, blocks, cache, btabs [S, NB], pos [S], tok [S],
+           active [S], steps [S], wto [S])
+      -> (toks [K, S] i32, new cache, new pos)
+
+    Step gating adds `pos < wto` (the slot's reserved row capacity,
+    `BlockPool.writable_rows`) to the fixed window's budget gate: a
+    window is CLAMPED by the scheduler so it never crosses an
+    unreserved block, and the in-program gate makes an overshoot write
+    impossible anyway — past wto the frontier would resolve through a
+    zeroed table entry into block 0 and corrupt whichever stream owns
+    it (the same hazard the K-wide verify window gates against). A
+    CoW-shared partial block must be materialized BEFORE the window's
+    dispatch, exactly as before a 1-wide append — the first scanned
+    step writes at the frontier, inside that block. Budget-spent and
+    capacity-capped slots freeze like inactive ones (index-gated
+    writes, frozen tok/pos), preserving the host-scheduled bits for
+    every neighbour."""
+    block_decode = make_paged_decode_block_fn(n_heads, block_size)
+    k = int(k)
+    if k < 2:
+        raise ValueError(f"fused window k must be >= 2 (k=1 is the "
+                         f"plain decode program), got {k}")
+
+    def window(aux, blocks, cache, btabs, pos, tok, active, steps, wto):
+        def body(carry, i):
+            cache, pos, tok = carry
+            act = active & (i < steps) & (pos < wto)
+            x = aux["tok"][tok] + aux["pos"][pos]       # [S, D]
+            new_cache = []
+            for p, c in zip(blocks, cache):
+                x, c = block_decode(p, x, c, btabs, pos, act)
+                new_cache.append(c)
+            logits = logits_fn(aux, x).astype(jnp.float32)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            tok = jnp.where(act, nxt, tok)
+            pos = pos + act.astype(pos.dtype)
+            return (new_cache, pos, tok), nxt
+
+        (cache, pos, tok), toks = jax.lax.scan(
+            body, (cache, pos, tok), jnp.arange(k))
+        return toks, cache, pos
+
+    return window
+
+
 def make_paged_prefill_fn(n_heads):
     """Serving prefill for ONE request over the PAGED cache — the pure
     COMPUTE half: the forward runs over the whole padded prompt through

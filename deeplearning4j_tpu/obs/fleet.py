@@ -440,8 +440,16 @@ class FleetView:
                     "replicas_adopted", "fenced_ops",
                     "journal_records", "requests_quarantined",
                     "breaker_open_total", "retry_budget_exhausted",
-                    "degraded_mode_ticks", "infant_deaths"):
+                    "degraded_mode_ticks", "infant_deaths",
+                    "fused_windows", "decode_iterations"):
             out["fleet_" + key] = counters.get(key, 0)
+        # fleet-wide dispatch amortization (fused decode windows): the
+        # same ratio each instance derives, recomputed from the MERGED
+        # counters so it weights instances by their dispatch volume
+        disp = counters.get("dispatches", 0)
+        out["fleet_iterations_per_dispatch"] = (
+            counters.get("decode_iterations", 0) / disp
+            if disp else None)
         # the breaker's live state is a GAUGE — federation can't sum
         # it; the manager's fleet_snapshot() overlays its own. Here the
         # per-instance max stands in (any open breaker reads open).

@@ -319,11 +319,14 @@ class ContinuousDecodeServer(_RequestLoop):
                  max_blocks_per_slot=None, chunked_prefill=None,
                  admission=None, brownout=None,
                  default_deadline_ms=None, prefix_priority=True,
-                 preempt=False, prefix_cache_dir=None, instance=None):
+                 preempt=False, prefix_cache_dir=None, instance=None,
+                 fused_serve=None):
         from ..models.zoo.transformer import (make_block_copy_fn,
                                               make_block_extract_fn,
                                               make_chunked_prefill_fn,
+                                              make_fused_decode_fn,
                                               make_paged_decode_fn,
+                                              make_paged_fused_decode_fn,
                                               make_paged_install_fn,
                                               make_paged_prefill_fn,
                                               make_paged_verify_fn,
@@ -519,6 +522,49 @@ class ContinuousDecodeServer(_RequestLoop):
                 donate_argnums=(2, 4))
         else:
             self._verify = lm._spec_verify(self._spec.k)
+        # fused decode windows (module docstring; ISSUE 18): scan K
+        # decode iterations into ONE device dispatch — nn/fused.py's
+        # fused_steps applied to serving. K=1 is the plain path exactly
+        # (no window program is even built), so the flag defaults to
+        # zero behavior change. Slot membership is static inside a
+        # window: admissions, evictions, chunked-prefill transitions,
+        # and deadline sweeps all land at window boundaries
+        # (_loop_once runs them once per pass, and one fused pass IS
+        # one window). Cache and pos are donated exactly like the
+        # 1-wide step's — same device state, same terminal-failure
+        # reset contract.
+        self._fused = 1 if fused_serve is None else int(fused_serve)
+        if self._fused < 1:
+            raise ValueError(f"fused_serve must be >= 1, got "
+                             f"{fused_serve}")
+        if self._fused > 1 and self._spec is not None:
+            # the PR 8 composition precedent: refuse LOUDLY at the
+            # constructor instead of silently picking one mode — a
+            # fused window advances every slot one token per scanned
+            # step, while speculation needs fresh host-side drafts
+            # every iteration; the two cannot share a dispatch yet
+            raise ValueError(
+                "fused_serve > 1 does not compose with speculate= "
+                "(a fused window cannot take fresh drafts mid-scan); "
+                "configure one or the other")
+        if self._fused > 1:
+            if self._paged:
+                # (aux, blocks, cache, btabs, pos, tok, active, steps,
+                #  wto)
+                self._window_step = jax.jit(
+                    make_paged_fused_decode_fn(
+                        n_heads, self._block_size, self._fused),
+                    donate_argnums=(2, 4))
+            else:
+                # (aux, blocks, cache, pos, tok, active, steps)
+                self._window_step = jax.jit(
+                    make_fused_decode_fn(n_heads, self._fused),
+                    donate_argnums=(2, 3))
+        else:
+            self._window_step = None
+        # per-iteration wall-time EWMA: the fused deadline clamp's rate
+        # estimate (None until the first token-bearing iteration)
+        self._iter_ewma = None
         self._prefills = {}                      # bucket -> jitted program
         # Paged prefill mirrors the fixed path's two-program shape:
         # a pure-compute prefill returning panels (no arena argument —
@@ -2321,6 +2367,17 @@ class ContinuousDecodeServer(_RequestLoop):
         if tps is not None:
             self.metrics.record_service_rate(tps)
 
+    def _note_iter_time(self, dt):
+        """Fold one decode iteration's wall time into the EWMA the
+        fused deadline clamp divides by (`_fused_window_ok`). Fed by
+        the plain path per iteration and by the fused path per window
+        (window wall / K) — so the estimate tracks the PER-ITERATION
+        cost in both modes and the clamp's horizon arithmetic stays in
+        one unit."""
+        a = 0.2
+        self._iter_ewma = (dt if self._iter_ewma is None
+                           else a * dt + (1 - a) * self._iter_ewma)
+
     def _chunk_iteration(self, pf):
         """Advance every PREFILLING slot one chunk (C prompt rows): one
         chunk dispatch per live param version, active mask restricted to
@@ -2428,6 +2485,171 @@ class ContinuousDecodeServer(_RequestLoop):
         if done_any:
             self._gc_versions()
 
+    def _fused_window_ok(self, dec):
+        """The mid-window deadline clamp: deadline sweeps run only at
+        window boundaries, so a window may start ONLY when the tightest
+        live deadline has at least K iterations of headroom — otherwise
+        this round falls back to the plain per-iteration path, which
+        sweeps (and evicts) at exactly the K=1 cadence. Clamping the
+        per-slot `steps` budget instead would NOT help: a scanned step
+        still pays its compute when gated off, so a steps-clamped
+        window's wall time is still ~K iterations — the boundary has to
+        move, and the only shorter window program is the 1-wide step
+        (the same ragged-tail argument behind nn/fused.py's single-step
+        fallback). No rate estimate yet (cold EWMA) is treated as no
+        headroom: conservative, and the plain rounds it forces are
+        exactly what warms the estimate. Net pin: a tight-deadline
+        request under fused_serve=K is evicted no later than at K=1
+        plus one iteration of slack (the round in flight when its
+        headroom first dropped below the horizon)."""
+        tightest = None
+        now = time.monotonic()
+        for _, r in dec:
+            if r.deadline is not None:
+                rem = r.deadline - now
+                tightest = rem if tightest is None else min(tightest,
+                                                            rem)
+        if tightest is None:
+            return True
+        if self._iter_ewma is None:
+            return False
+        return tightest >= self._fused * self._iter_ewma
+
+    def _fused_iteration(self, dec, t_iter_start, n_occ):
+        """One fused WINDOW: K decode iterations scanned into one
+        device dispatch per live param version (`make_fused_decode_fn`
+        / its paged twin), K tokens-per-slot read back in ONE transfer,
+        then the host replays the window — budgets, completions,
+        metrics — exactly as K plain iterations would have.
+
+        Per-slot `steps` clamps the window to each request's remaining
+        token budget (a finished slot freezes on device exactly like an
+        inactive one, so neighbours' bits never see the difference);
+        the paged path additionally clamps to the reservation's
+        writable rows (`BlockPool.writable_rows`) and passes the bound
+        as the in-program write gate `wto` — no window crosses an
+        unreserved block. CoW materializes BEFORE the dispatch (the
+        first scanned write lands at the frontier, inside a
+        still-shared partial block — the 1-wide rule, once per window).
+        Tokens past a slot's steps budget are garbage by contract and
+        never consumed (`toks[:steps[s], s]` only), so nothing needs
+        replaying: unconsumed scan work is discarded with the buffer.
+
+        Observability stays PER-ITERATION: the admission estimator is
+        fed K samples of (tokens at step i, window wall / K) — one
+        K-sized sample would inflate its rolling median ~K-fold and
+        shed feasible work — and `decode_iterations` advances by the
+        window's realized iteration count while `dispatches` advances
+        once per version, which is what makes `iterations_per_dispatch`
+        the scraped amortization number."""
+        import jax.numpy as jnp
+        K = self._fused
+        tr = self._tracer
+        t_iter0 = time.monotonic_ns() if tr.enabled else None
+        if self._paged:
+            self._materialize_cow(dec)
+            self.metrics.record_pool(self._pool.blocks_in_use,
+                                     self._pool.capacity)
+        steps = np.zeros((self.slots,), np.int32)
+        wto = np.zeros((self.slots,), np.int32)
+        for s, r in dec:
+            n = min(K, r.max_new - len(r.generated))
+            if self._paged:
+                # frontier row is len(prompt) + len(generated) - 1 (the
+                # final emitted token is never written back — the
+                # blocks_needed sizing rule); never scan past the
+                # reservation
+                wto[s] = self._pool.writable_rows(r.alloc)
+                n = min(n, int(wto[s]) - (len(r.prompt)
+                                          + len(r.generated) - 1))
+            steps[s] = max(n, 0)
+        versions = sorted({r.version for _, r in dec})
+        win_tok = {}
+        for v in versions:
+            active = np.zeros((self.slots,), bool)
+            for s, r in dec:
+                if r.version == v:
+                    active[s] = True
+            aux, blocks = self._versions[v]
+
+            def dispatch():
+                if self._injector is not None:
+                    self._injector.fire("serve.batch")
+                if self._paged:
+                    return self._window_step(
+                        aux, blocks, self._cache,
+                        jnp.asarray(self._btabs), self._pos,
+                        jnp.asarray(self._tok), jnp.asarray(active),
+                        jnp.asarray(steps), jnp.asarray(wto))
+                return self._window_step(
+                    aux, blocks, self._cache, self._pos,
+                    jnp.asarray(self._tok), jnp.asarray(active),
+                    jnp.asarray(steps))
+
+            # same donated-buffer retry contract as the plain step: the
+            # injector site sits BEFORE the compiled call; a failure
+            # inside it is terminal here (loop resets device state)
+            with tr.span("decode.window", cat="serve", track="server",
+                         version=v, k=K):
+                if self._retry is not None:
+                    toks, self._cache, self._pos = self._retry.call(
+                        dispatch,
+                        on_retry=lambda a, e, d: self.metrics.count(
+                            "retries"))
+                else:
+                    toks, self._cache, self._pos = dispatch()
+            self.metrics.count("dispatches")
+            self.metrics.count("fused_windows")
+            toks = np.asarray(toks)             # [K, S]
+            for s, r in dec:
+                if r.version == v:
+                    win_tok[s] = toks[:, s]
+        n_iters = int(steps.max())
+        total = 0
+        done_any = False
+        t_now = time.monotonic()
+        for s, r in dec:
+            n = int(steps[s])
+            if n <= 0:
+                continue
+            got = [int(t) for t in win_tok[s][:n]]
+            r.generated.extend(got)
+            self._tok[s] = got[-1]
+            total += n
+            self._spend_work(r, n)
+            # the window lands n tokens at once: record the PER-TOKEN
+            # stream rate, one sample per window per slot (the
+            # speculative path's convention)
+            if r.t_last_tok is not None:
+                self.metrics.record_inter_token(
+                    (t_now - r.t_last_tok) * 1e3 / n)
+            r.t_last_tok = t_now
+            if len(r.generated) >= r.max_new:
+                r.generated = r.generated[:r.max_new]
+                self._complete(r, t_now)
+                self._free_slot(s)
+                done_any = True
+        self.metrics.count("tokens_out", total)
+        self.metrics.count("decode_iterations", n_iters)
+        if t_iter0 is not None:
+            tr.emit("decode.iteration", t_iter0,
+                    time.monotonic_ns() - t_iter0, cat="serve",
+                    track="server",
+                    args={"slot_occupancy": n_occ / self.slots,
+                          "accepted": total, "fused_k": K,
+                          "iterations": n_iters})
+        # per-window metrics fan-out: K per-iteration samples, NOT one
+        # K-sized sample — see the estimator note in the docstring
+        window_dt = time.monotonic() - t_iter_start
+        self._note_iter_time(window_dt / K)
+        for i in range(K):
+            t_i = int(np.sum(steps > i))
+            self._observe_rate(t_i, window_dt / K, t_i)
+        if done_any:
+            self._gc_versions()
+        self._after_iteration()
+        return True
+
     def _decode_iteration(self):
         """One scheduling iteration: advance PREFILLING slots one chunk
         each (chunked mode, `_chunk_iteration`), then one decode
@@ -2466,6 +2688,8 @@ class ContinuousDecodeServer(_RequestLoop):
             return True
         if self._spec is not None:
             return self._spec_iteration(dec, t_iter_start)
+        if self._fused > 1 and self._fused_window_ok(dec):
+            return self._fused_iteration(dec, t_iter_start, n_occ)
         tr = self._tracer
         t_iter0 = time.monotonic_ns() if tr.enabled else None
         if self._paged:
@@ -2514,6 +2738,7 @@ class ContinuousDecodeServer(_RequestLoop):
                 if r.version == v:
                     new_tok[s] = int(nxt[s])
         self.metrics.count("tokens_out", len(dec))
+        self.metrics.count("decode_iterations")
         for s, r in dec:
             self._spend_work(r)
         done_any = False
@@ -2542,8 +2767,9 @@ class ContinuousDecodeServer(_RequestLoop):
                     track="server",
                     args={"slot_occupancy": n_occ / self.slots,
                           "accepted": len(dec)})
-        self._observe_rate(len(dec), time.monotonic() - t_iter_start,
-                           len(dec))
+        dt_iter = time.monotonic() - t_iter_start
+        self._note_iter_time(dt_iter)
+        self._observe_rate(len(dec), dt_iter, len(dec))
         if done_any:
             self._gc_versions()
         self._after_iteration()
@@ -2679,6 +2905,7 @@ class ContinuousDecodeServer(_RequestLoop):
                     args={"slot_occupancy": len(live) / self.slots,
                           "accepted": n_accepted,
                           "draft_dispatches": dd})
+        self.metrics.count("decode_iterations")
         self._observe_rate(n_accepted, time.monotonic() - t_iter_start,
                            len(live))
         if done_any:

@@ -422,6 +422,14 @@ class ServingMetrics:
         out["dispatches_per_token"] = (d / t) if t else None
         out["device_dispatches_per_token"] = (
             (d + out.get("draft_dispatches", 0)) / t) if t else None
+        # fused decode windows (serving/decode.py fused_serve=K): how
+        # many scheduling iterations each device dispatch amortized —
+        # ~1.0 unfused, ~K fused; always-present with the window count
+        # so the amortization win is a scraped number on any server
+        out.setdefault("fused_windows", 0)
+        out.setdefault("decode_iterations", 0)
+        out["iterations_per_dispatch"] = (
+            out["decode_iterations"] / d) if d else None
         # paged KV-cache pool view: always-present keys (zeros/None on a
         # fixed-slot or idle server) so dashboards and the paged A/Bs
         # read one stable surface. prefix_hit_rate is ROW-weighted —

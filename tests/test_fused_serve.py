@@ -1,0 +1,403 @@
+"""Fused decode windows (ISSUE 18 acceptance criteria).
+
+  (a) Bit-identity: a `fused_serve=K` server's greedy token stream is
+      IDENTICAL to K host-scheduled iterations — solo, co-batched
+      (joining a running window'd batch), both cache layouts
+      (fixed-slot and paged block-table), across a mid-stream hot
+      swap, for K in {2, 4, 8}. fused_serve=1 is the plain path
+      exactly: no window program is even built.
+  (b) Amortization: exactly ceil(iterations / K) decode dispatches on
+      a solo stream, and the `iterations_per_dispatch` /
+      `fused_windows` snapshot keys record the win.
+  (c) Window boundaries: admissions land between windows and still
+      produce the solo stream; the mid-window deadline clamp falls
+      back to the plain per-iteration path whenever the tightest live
+      deadline lacks K iterations of headroom, so a tight-deadline
+      request is evicted at the K=1 sweep cadence (+ one iteration of
+      slack), never K-1 iterations late.
+  (d) Composition: speculate= is refused LOUDLY at the constructor
+      (the PR 8 precedent — no silent mode pick); chunked prefill
+      composes (transitions happen at window boundaries).
+  (e) Faults: a terminal fault at `serve.batch` mid-window fails the
+      occupied slots LOUDLY and resets device state (the server keeps
+      serving); a retried transient keeps the stream bit-identical.
+  (f) Estimator fan-out: a fused window feeds the admission estimator
+      K per-iteration samples (window wall / K), not one K-sized
+      sample — the rolling median stays per-iteration instead of
+      inflating ~K-fold and shedding feasible work.
+"""
+import math
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.resilience import (FaultInjected,
+                                                  FaultInjector,
+                                                  RetryPolicy)
+from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+from deeplearning4j_tpu.serving import (AdmissionController,
+                                        ContinuousDecodeServer,
+                                        DeadlineExceededError, NGramDraft,
+                                        ServiceRateEstimator, Speculator)
+
+
+def _lm(seed=3, max_len=64):
+    return TransformerLM(64, d_model=32, n_heads=2, n_layers=2,
+                         max_len=max_len, seed=seed)
+
+
+def _prompt(seed=4, n=5):
+    return np.random.default_rng(seed).integers(1, 64, n).tolist()
+
+
+# ---------------------------------------------------------------------------
+# device programs
+# ---------------------------------------------------------------------------
+class TestFusedPrograms:
+    def test_window_k_floor(self):
+        """k=1 is the plain decode program — the factories refuse it
+        (scan overhead for nothing), mirroring the chunk-size floor's
+        loud-constructor style."""
+        from deeplearning4j_tpu.models.zoo.transformer import (
+            make_fused_decode_fn, make_paged_fused_decode_fn)
+        with pytest.raises(ValueError, match=">= 2"):
+            make_fused_decode_fn(2, 1)
+        with pytest.raises(ValueError, match=">= 2"):
+            make_paged_fused_decode_fn(2, 8, 1)
+
+    def test_server_flag_validation(self):
+        with pytest.raises(ValueError, match="fused_serve"):
+            ContinuousDecodeServer(_lm(), slots=2, prompt_buckets=(8,),
+                                   fused_serve=0)
+
+
+# ---------------------------------------------------------------------------
+# (a) bit-identity
+# ---------------------------------------------------------------------------
+class TestFusedBitIdentity:
+    def test_solo_and_join_bit_identical_across_k(self):
+        """For K in {2,4,8}: a fused solo stream matches plain decode,
+        and a request JOINING a running fused batch (admitted at a
+        window boundary) matches its own solo stream — the
+        continuous-decode pin under windowed advance."""
+        lm = _lm()
+        rng = np.random.default_rng(4)
+        pa = rng.integers(1, 64, 5).tolist()
+        pb = rng.integers(1, 64, 8).tolist()
+        plain = lm.generate(pa, 10, use_cache=True)
+        for k in (2, 4, 8):
+            with ContinuousDecodeServer(
+                    lm, slots=4, prompt_buckets=(4, 8),
+                    fused_serve=k) as srv:
+                solo = srv.generate(pa, 10, timeout=60)
+                flong = srv.submit(pb, 24)      # running fused batch
+                time.sleep(0.05)
+                fa = srv.submit(pa, 10)         # joins at a boundary
+                joined = fa.result(60)
+                flong.result(60)
+            assert solo == plain
+            assert joined == solo
+
+    def test_paged_bit_identical_across_k(self):
+        """Same pin over the PAGED layout: the scanned window threads
+        the block-table frontier through the carry and never crosses
+        the reservation (pool fully drains after)."""
+        lm = _lm()
+        p = _prompt()
+        plain = lm.generate(p, 14, use_cache=True)
+        for k in (2, 4, 8):
+            with ContinuousDecodeServer(
+                    lm, slots=2, prompt_buckets=(8,), paged=True,
+                    block_size=4, n_blocks=40, fused_serve=k) as srv:
+                got = srv.generate(p, 14, timeout=60)
+                flong = srv.submit(_prompt(9, 6), 18)
+                fa = srv.submit(p, 14)
+                joined = fa.result(60)
+                flong.result(60)
+                assert srv._pool.blocks_in_use == 0
+            assert got == plain
+            assert joined == plain
+
+    def test_swap_drain_fused(self):
+        """Dual-version drain under fused windows: one fused window
+        per live version per pass — the in-flight stream finishes on
+        pre-swap params bit-identical to a pre-swap solo run while a
+        post-swap request decodes the new params."""
+        lm1, lm2 = _lm(3), _lm(11)
+        rng = np.random.default_rng(10)
+        pa = rng.integers(1, 64, 4).tolist()
+        pb = rng.integers(1, 64, 4).tolist()
+        with ContinuousDecodeServer(
+                lm1, slots=2, prompt_buckets=(4,),
+                fused_serve=4) as srv:
+            solo_old = srv.generate(pa, 14, timeout=60)
+            fa = srv.submit(pa, 14)
+            time.sleep(0.03)                  # pa decoding on v0
+            srv.swap(lm2)
+            fb = srv.submit(pb, 5)            # admitted on v1
+            ra, rb = fa.result(60), fb.result(60)
+        assert ra == solo_old
+        expect_new = lm2.generate_batch(np.asarray([pb], np.int32),
+                                        max_new_tokens=5)
+        assert rb == expect_new[0].tolist()
+        assert srv.metrics.snapshot().get("failed", 0) == 0
+
+    def test_k1_is_zero_behavior_change(self):
+        """fused_serve=1 (and the default None) build NO window
+        program and count NO windows — the plain path, untouched."""
+        lm = _lm()
+        p = _prompt()
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                    fused_serve=1) as srv:
+            assert srv._window_step is None
+            got = srv.generate(p, 10, timeout=60)
+        snap = srv.metrics.snapshot()
+        assert got == lm.generate(p, 10, use_cache=True)
+        assert snap["fused_windows"] == 0
+        assert snap["iterations_per_dispatch"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# (b) amortization accounting
+# ---------------------------------------------------------------------------
+class TestFusedDispatchCount:
+    @pytest.mark.parametrize("max_new,k", [(13, 4), (12, 4), (17, 8)])
+    def test_exactly_ceil_iters_over_k_dispatches(self, max_new, k):
+        """Solo stream: max_new-1 decode iterations (the first token
+        comes from prefill) in exactly ceil((max_new-1)/K) decode
+        dispatches — the A/B the amortization claim rests on."""
+        lm = _lm()
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                    fused_serve=k) as srv:
+            got = srv.generate(_prompt(), max_new, timeout=60)
+        assert got == lm.generate(_prompt(), max_new, use_cache=True)
+        snap = srv.metrics.snapshot()
+        iters = max_new - 1
+        assert snap["decode_iterations"] == iters
+        assert snap["dispatches"] == math.ceil(iters / k)
+        assert snap["fused_windows"] == snap["dispatches"]
+        assert snap["iterations_per_dispatch"] == pytest.approx(
+            iters / math.ceil(iters / k))
+
+
+# ---------------------------------------------------------------------------
+# (c) window boundaries: deadline clamp
+# ---------------------------------------------------------------------------
+class TestFusedDeadlines:
+    def test_window_ok_gate(self):
+        """The clamp's decision table, directly: no deadlines -> fused;
+        any deadline + cold EWMA -> plain (conservative warm-up); ample
+        headroom -> fused; headroom under K iterations -> plain."""
+
+        class R:
+            def __init__(self, deadline):
+                self.deadline = deadline
+
+        srv = ContinuousDecodeServer(_lm(), slots=2, prompt_buckets=(8,),
+                                     fused_serve=4)
+        try:
+            now = time.monotonic()
+            assert srv._fused_window_ok([(0, R(None))])
+            assert not srv._fused_window_ok([(0, R(now + 60.0))])  # cold
+            srv._iter_ewma = 0.01
+            assert srv._fused_window_ok([(0, R(now + 60.0))])
+            assert not srv._fused_window_ok([(0, R(now + 0.02))])
+            # the TIGHTEST deadline governs a mixed batch
+            assert not srv._fused_window_ok(
+                [(0, R(now + 60.0)), (1, R(now + 0.02))])
+        finally:
+            srv.stop()
+
+    def test_tight_horizon_falls_back_to_plain(self):
+        """With the EWMA seeded at 10 s/iteration, a 4 s-deadline
+        request can never afford an 8-iteration window (the EWMA
+        decays by at most 0.8^11 over the stream's 11 iterations, so
+        the horizon stays above the headroom throughout): every round
+        takes the plain path (fused_windows stays 0) and the stream
+        still completes bit-identical."""
+        lm = _lm()
+        p = _prompt()
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                    fused_serve=8) as srv:
+            srv._iter_ewma = 10.0
+            got = srv.generate(p, 12, deadline_ms=4_000, timeout=60)
+        assert got == lm.generate(p, 12, use_cache=True)
+        assert srv.metrics.snapshot()["fused_windows"] == 0
+
+    def test_tight_deadline_evicted_at_plain_cadence(self):
+        """A request whose token budget outlives its latency budget
+        under fused_serve=8 is evicted by the boundary sweep no later
+        than the K=1 cadence + one iteration of slack — the clamp
+        forces plain rounds (per-iteration sweeps) once headroom drops
+        below the window horizon, so eviction lateness is iteration
+        granularity, not K-1 iterations of overshoot. Delay-only
+        faults pace every dispatch at 20 ms so the cadences are
+        distinguishable on wall clock: a mis-clamped window would
+        overshoot by ~8 x 20 ms; the clamp keeps lateness under half
+        a window."""
+        lm = _lm()
+        inj = FaultInjector(seed=6).plan(
+            "serve.batch", on_calls=range(1, 300), times=300,
+            delay=0.02, exc=None)
+        with ContinuousDecodeServer(lm, slots=1, prompt_buckets=(8,),
+                                    fault_injector=inj,
+                                    fused_serve=8) as srv:
+            # warm-up compiles BOTH decode programs off-clock (a loose
+            # deadline starts plain while the EWMA is cold, then fuses
+            # once it warms), so the doomed request's lateness measures
+            # cadence, not first-dispatch compilation
+            srv.generate(_prompt(), 12, deadline_ms=60_000, timeout=60)
+            t0 = time.monotonic()
+            f = srv.submit(_prompt(), 40, deadline_ms=100)
+            with pytest.raises(DeadlineExceededError,
+                               match="mid-decode"):
+                f.result(60)
+            late = (time.monotonic() - t0) - 0.1
+        snap = srv.metrics.snapshot()
+        assert snap["evicted_mid_decode"] == 1
+        assert late < 0.1
+
+
+# ---------------------------------------------------------------------------
+# (d) composition
+# ---------------------------------------------------------------------------
+class TestFusedComposition:
+    def test_speculate_refused_loudly(self):
+        """fused_serve > 1 + speculate= is a constructor ValueError
+        (the PR 8 precedent): a window cannot take fresh host drafts
+        mid-scan, and silently picking one mode would lie about the
+        other."""
+        with pytest.raises(ValueError, match="speculate"):
+            ContinuousDecodeServer(
+                _lm(), slots=2, prompt_buckets=(8,), fused_serve=4,
+                speculate=Speculator(NGramDraft(), k=4))
+        # fused_serve=1 (the plain path) composes fine
+        srv = ContinuousDecodeServer(
+            _lm(), slots=2, prompt_buckets=(8,), fused_serve=1,
+            speculate=Speculator(NGramDraft(), k=4))
+        srv.stop()
+
+    def test_chunked_prefill_composes(self):
+        """Chunk transitions land at window boundaries: a long prompt
+        prefills chunk-at-a-time while a co-resident stream decodes in
+        fused windows, and both streams stay bit-identical."""
+        lm = _lm()
+        rng = np.random.default_rng(7)
+        long_p = rng.integers(1, 64, 24).tolist()
+        short_p = rng.integers(1, 64, 4).tolist()
+        with ContinuousDecodeServer(
+                lm, slots=2, prompt_buckets=(4, 8, 32),
+                chunked_prefill=8, fused_serve=4) as srv:
+            fs = srv.submit(short_p, 16)
+            time.sleep(0.03)                  # decoding mid-window
+            fl = srv.submit(long_p, 8)        # chunked joiner
+            rs, rl = fs.result(60), fl.result(60)
+        assert rs == lm.generate(short_p, 16, use_cache=True)
+        assert rl == lm.generate(long_p, 8, use_cache=True)
+
+
+# ---------------------------------------------------------------------------
+# (e) faults
+# ---------------------------------------------------------------------------
+class TestFusedFaults:
+    def test_terminal_fault_mid_window_fails_loudly_and_recovers(self):
+        """Terminal fault at `serve.batch` on the first WINDOW dispatch
+        (call 0 is the admission prefill): the occupied slot fails
+        LOUDLY, device state resets, and the server serves the next
+        request bit-identically — the PR 4 contract under windows."""
+        lm = _lm()
+        p = _prompt()
+        inj = FaultInjector(seed=2).plan("serve.batch", on_call=1,
+                                         exc=FaultInjected)
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                    fault_injector=inj,
+                                    fused_serve=4) as srv:
+            f = srv.submit(p, 6)
+            with pytest.raises(FaultInjected):
+                f.result(60)
+            got = srv.generate(p, 6, timeout=60)
+        assert got == lm.generate(p, 6, use_cache=True)
+        assert srv.metrics.snapshot().get("failed") == 1
+
+    def test_retry_keeps_stream_bit_identical(self):
+        """Transient fault before the first window dispatch: the retry
+        re-runs the whole window (the injector site sits before the
+        compiled call — donated buffers are untouched) and the stream
+        is unchanged."""
+        lm = _lm()
+        p = _prompt()
+        inj = FaultInjector(seed=1).plan("serve.batch", on_call=1,
+                                         exc=FaultInjected)
+        rp = RetryPolicy(max_retries=3, base_delay=0.001,
+                         retryable=(ConnectionError,))
+        with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                    fault_injector=inj, retry_policy=rp,
+                                    fused_serve=4) as srv:
+            got = srv.generate(p, 10, timeout=60)
+        snap = srv.metrics.snapshot()
+        assert got == lm.generate(p, 10, use_cache=True)
+        assert snap.get("retries") == 1 and snap.get("failed", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# (f) estimator fan-out
+# ---------------------------------------------------------------------------
+class TestFusedEstimator:
+    def test_window_feeds_k_per_iteration_samples(self):
+        """The fan-out contract, deterministically: a K=8 window of
+        0.8 s with 2 slots at full budget feeds 8 samples of
+        (2 tokens, 0.1 s) — the median reads the PER-ITERATION time
+        and readiness arrives after one window. One K-sized sample
+        (the bug this satellite fixes) would leave the estimator cold
+        for 8x longer AND inflate its median ~K-fold, shedding
+        feasible work."""
+        window_dt, k = 0.8, 8
+        steps = np.asarray([8, 8, 0, 0])
+        est = ServiceRateEstimator(slots=4)
+        for i in range(k):
+            t_i = int(np.sum(steps > i))
+            est.observe(t_i, window_dt / k, t_i)
+        assert est.samples == 8 and est.ready
+        assert est.seconds_per_iteration == pytest.approx(0.1)
+        assert est.tokens_per_slot_conservative == pytest.approx(1.0)
+        bad = ServiceRateEstimator(slots=4)
+        bad.observe(16, window_dt, 2)        # the one-sample mistake
+        assert bad.samples == 1 and not bad.ready
+        assert bad._s_iter == pytest.approx(0.8)   # ~K-fold inflation
+
+    def test_ragged_window_tail_feeds_partial_samples(self):
+        """A slot that exhausts its budget mid-window stops counting
+        toward later per-iteration samples — token totals across the
+        fan-out equal the window's realized tokens exactly."""
+        steps = np.asarray([4, 2, 0])
+        est = ServiceRateEstimator(slots=3, min_samples=1)
+        for i in range(4):
+            t_i = int(np.sum(steps > i))
+            est.observe(t_i, 0.05, t_i)
+        # samples only count token-bearing iterations: steps 0..3 all
+        # carry tokens here (2, 2, 1, 1)
+        assert est.samples == 4
+        tok = sum(t for t, _ in est._win)
+        assert tok == int(steps.sum())
+
+    def test_server_estimator_stays_per_iteration_under_fused(self):
+        """Integration: a fused K=8 server's admission estimator reads
+        a per-iteration median comparable to a plain server's on the
+        same workload — not ~8x it."""
+        lm = _lm()
+        p = _prompt()
+
+        def run(**kw):
+            adm = AdmissionController()
+            with ContinuousDecodeServer(lm, slots=2, prompt_buckets=(8,),
+                                        admission=adm, **kw) as srv:
+                srv.generate(p, 20, timeout=60)     # warm-up/compile
+                srv.generate(p, 20, timeout=60)
+            return adm.estimator
+
+        plain = run()
+        fused = run(fused_serve=8)
+        assert fused.ready
+        assert fused.seconds_per_iteration < \
+            4 * max(plain.seconds_per_iteration, 1e-4)

@@ -453,6 +453,36 @@ class TestSmokeSweep:
         assert snap["spec_tokens"] == snap["tokens_out"] > 0
         assert snap["dispatches_per_token"] <= 1.0
 
+    def test_smoke_sweep_fused_serve(self):
+        """One FUSED-WINDOW sweep rate in tier-1 (ISSUE 18): the same
+        loadgen arrivals through `ContinuousDecodeServer(fused_serve=4)`
+        so every CI run exercises the scanned K-iteration decode
+        program under real traffic — window dispatch, boundary
+        admission, and the per-iteration estimator fan-out all in one
+        pass. Its report uploads next to the other smoke sweeps
+        (tier1.yml)."""
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        mod = importlib.import_module("load_sweep")
+        out = os.path.join(
+            os.environ.get("SMOKE_REPORT_DIR") or tempfile.gettempdir(),
+            "load_sweep_smoke_fused")
+        res = mod.run_sweep(server="decode", rates=(40.0,), n_req=8,
+                            slo_ms=250.0, seed=0, trace=False,
+                            report_path=out, fused_serve=4)
+        (decode,) = res
+        assert decode["fused_serve"] == 4
+        (pt,) = decode["curve"]
+        assert pt["completed"] == 8
+        assert pt["tokens_per_sec"] > 0
+        snap = json.load(open(out + ".json"))["metrics"]["decode"]
+        # the fused program really carried the decode traffic: windows
+        # were dispatched and each one retired >1 iteration on average
+        assert snap["fused_windows"] > 0
+        assert snap["iterations_per_dispatch"] > 1.0
+
     def test_smoke_sweep_preempt_mode(self):
         """One PREEMPTION-enabled sweep rate in tier-1 (ISSUE 11:
         durable KV state): the same loadgen arrivals through

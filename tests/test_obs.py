@@ -511,6 +511,12 @@ class TestMetricsPins:
         "batch_occupancy_mean", "batch_size_mean",
         "spec_accepted_per_dispatch_mean", "spec_acceptance_rate_mean",
         "dispatches_per_token", "device_dispatches_per_token",
+        # fused decode windows (serving/decode.py fused_serve=K,
+        # ISSUE 18): window count, realized decode iterations, and the
+        # amortization ratio (~1.0 unfused, ~K fused) — consumed by
+        # tools/serve_ab.py's fused_serve_vs_plain arm, bench.py's
+        # fused_decode config, and the Prometheus route
+        "fused_windows", "decode_iterations", "iterations_per_dispatch",
         # paged KV-cache pool view (serving/kvpool.py): arena pressure,
         # measured concurrency, prefix-cache hit rate, CoW and
         # memory-gate accounting — consumed by tools/serve_ab.py's
@@ -608,6 +614,12 @@ class TestMetricsPins:
         "fleet_requests_quarantined", "fleet_breaker_open_total",
         "fleet_retry_budget_exhausted", "fleet_degraded_mode_ticks",
         "fleet_infant_deaths", "fleet_breaker_state",
+        # fused decode windows (serving/decode.py fused_serve=K):
+        # window/iteration counters summed like any counter; the
+        # amortization ratio is re-derived from the MERGED counters so
+        # it weights instances by dispatch volume
+        "fleet_fused_windows", "fleet_decode_iterations",
+        "fleet_iterations_per_dispatch",
     )
 
     def test_fleet_snapshot_keys_pinned(self):

@@ -124,7 +124,7 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
                  process="poisson", tracer=None, lm=None, slots=4,
                  paged=False, block_size=8, chunked_prefill=None,
                  admission=None, brownout=None, deadline_ms=None,
-                 speculate_k=None, preempt=False):
+                 speculate_k=None, preempt=False, fused_serve=None):
     """Rate ladder over the ContinuousDecodeServer. One server serves
     every rate (compile once); per-point accounting is delta-based
     (loadgen baselines at entry), so points never contaminate each
@@ -140,6 +140,12 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
     layouts — paged speculation is the ISSUE 10 composition; the
     tier-1 smoke sweep runs one paged+speculate rate so CI exercises
     the block-table verify program under real arrivals).
+
+    `fused_serve=K` scans K decode iterations into one device dispatch
+    (ISSUE 18 — both layouts; excludes speculate_k, the server refuses
+    the combination loudly). The tier-1 smoke sweep runs one
+    fused_serve=4 rate so CI exercises the windowed scheduler under
+    real arrivals, deadlines included.
 
     `n_req` may be a sequence (one count per rate): the overload A/B
     scales requests WITH rate so every rung offers the same DURATION of
@@ -177,7 +183,7 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
         metrics=metrics, tracer=tracer, paged=paged,
         block_size=block_size, chunked_prefill=chunked_prefill,
         admission=admission, brownout=brownout, speculate=spec,
-        preempt=preempt,
+        preempt=preempt, fused_serve=fused_serve,
         default_deadline_ms=(deadline_ms if deadline_ms is not None
                              else (slo_ms if admission else None))
         ).start()
@@ -225,9 +231,12 @@ def sweep_decode(rates, n_req=64, slo_ms=150.0, seed=0,
         ctrl += f", speculate k={spec.k} (n-gram)"
     if preempt:
         ctrl += ", preempt=on (batch class spillable)"
+    if fused_serve is not None and int(fused_serve) > 1:
+        ctrl += f", fused_serve={int(fused_serve)}"
     return {"server": "decode", "process": process, "paged": bool(paged),
             "overload_control": bool(controlled),
             "speculate_k": speculate_k, "preempt": bool(preempt),
+            "fused_serve": fused_serve,
             "config": f"TransformerLM L={len(lm.blocks)} d={d_model} "
                       f"slots={slots} cache={cache}, mix 80% "
                       f"short(p3-11/n4-23) + 20% long(p8-15/n24-43), "
@@ -1344,7 +1353,8 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
               process="poisson", n_req=64, slo_ms=150.0, seed=0,
               trace=True, report_path=None, paged=False,
               chunked_prefill=None, admission=None, overload_ab=False,
-              speculate_k=None, preempt=False, fleet=0,
+              speculate_k=None, preempt=False, fused_serve=None,
+              fleet=0,
               fleet_obs_per_rate=6, fleet_slice_s=0.25,
               fleet_control=False, fleet_injector=None,
               fleet_min=None, fleet_max=None, fleet_procs=0,
@@ -1481,7 +1491,8 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
                                   chunked_prefill=chunked_prefill,
                                   admission=admission,
                                   speculate_k=speculate_k,
-                                  preempt=preempt)
+                                  preempt=preempt,
+                                  fused_serve=fused_serve)
         results.append(body)
         snaps["decode"] = snap
     if server in ("microbatch", "both"):
@@ -1557,6 +1568,12 @@ def main():
                     help="K-wide n-gram speculative decode on the "
                          "decode server (composes with --paged: the "
                          "block-table verify program)")
+    ap.add_argument("--fused-serve", type=int, default=None,
+                    metavar="K",
+                    help="scan K decode iterations into one device "
+                         "dispatch on the decode server (composes "
+                         "with --paged; excludes --speculate — the "
+                         "server refuses the combination)")
     ap.add_argument("--fleet", type=int, default=0, metavar="N",
                     help="drive N in-process decode replicas behind a "
                          "round-robin splitter (named instances, "
@@ -1643,6 +1660,7 @@ def main():
                         admission=args.admission,
                         overload_ab=args.overload_ab,
                         speculate_k=args.speculate,
+                        fused_serve=args.fused_serve,
                         preempt=args.preempt, fleet=args.fleet,
                         fleet_control=args.fleet_control,
                         fleet_min=args.fleet_min,

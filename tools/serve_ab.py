@@ -35,6 +35,16 @@ equally):
     bit-identical; the A/B isolates dispatch amortization on the paged
     layout (dispatches/token vs the paged baseline, acceptance, and the
     equal-arena concurrency class that must survive speculation).
+  * fused_serve_vs_plain — the SAME paged continuous-decode scheduler
+    with and without fused decode windows (ISSUE 18: `fused_serve=4` —
+    `lax.scan` runs K=4 serve iterations on-device in ONE dispatch,
+    static slot membership inside the window, admissions/evictions at
+    window boundaries). Streams are pinned bit-identical
+    (tests/test_fused_serve.py); the A/B isolates pure dispatch
+    amortization on the dispatch-bound config: decode lengths are
+    chosen ≡ 1 (mod K) so every window retires exactly K iterations
+    and dispatches/token lands at 1/K of the unfused paged baseline,
+    with tokens/s at parity or better even on compute-bound CPU.
   * preempt_vs_shed — durable-KV preemption (ISSUE 11: serving/
     kvstate.py) vs shed-only overload handling at FULL BLOCK OCCUPANCY:
     both arms run the same paged server with a brownout class ranking
@@ -508,6 +518,115 @@ def bench_paged_spec_ab(segments, reqs_per_seg=16, slo_ms=100.0):
     }, snaps, None
 
 
+def bench_fused_serve_ab(segments, reqs_per_seg=16, slo_ms=100.0):
+    """fused windows vs plain iteration dispatch (ISSUE 18): the SAME
+    paged server config — block-table arena, 16-token shared system
+    prefix, slots a pure scheduling width — with and without
+    `fused_serve=4` (K serve iterations scanned into one device
+    dispatch, static slot membership inside the window). Streams are
+    pinned bit-identical (tests/test_fused_serve.py), so the A/B
+    isolates dispatch amortization with NO model-dependence (unlike
+    speculation there is no acceptance rate: the win is purely
+    dispatches/token). Decode lengths are all ≡ 1 (mod 4) so every
+    request's post-prefill iteration count is a multiple of K and every
+    window retires exactly K iterations — the measured
+    dispatches/token ratio is the clean 1/K floor, not a
+    ragged-tail approximation. Watch dispatches/token fused vs plain
+    (target <= 1/K) and tokens/s (>= parity on compute-bound CPU; the
+    on-chip backlog re-measures where each dispatch is a tunnel hop)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            ServingMetrics)
+
+    K = 4
+    lm = _lm()                          # max_len=64
+    sys_prefix = np.random.default_rng(7).integers(1, 96, 16).tolist()
+
+    def workload(rng, n):
+        # prompt 16+1..7 = 17..23 rows; decode lengths 17/21/25/29/33
+        # (all ≡ 1 mod K: prefill emits token 1, the remaining
+        # n_new - 1 iterations divide evenly into full K-windows)
+        out = []
+        for _ in range(n):
+            own = rng.integers(1, 96, int(rng.integers(1, 8))).tolist()
+            n_new = int(rng.choice((17, 21, 25, 29, 33)))
+            out.append((sys_prefix + own, n_new))
+        return out
+
+    paged_kw = dict(slots=16, prompt_buckets=(24,), max_queue=256,
+                    paged=True, block_size=8, n_blocks=48)
+    servers = {
+        "fused": ContinuousDecodeServer(
+            lm, fused_serve=K,
+            metrics=ServingMetrics(slo_target_ms=slo_ms),
+            **paged_kw).start(),
+        "plain": ContinuousDecodeServer(
+            lm, metrics=ServingMetrics(slo_target_ms=slo_ms),
+            **paged_kw).start(),
+    }
+    warm = workload(np.random.default_rng(0), 6)
+    for srv in servers.values():        # compile off the clock
+        for p, n in warm:
+            srv.generate(p, n, timeout=120)
+    # SLO baseline after warm-up: compile-latency misses stay off the books
+    base = {n: servers[n].metrics.snapshot() for n in servers}
+
+    seg_idx = {name: [0] for name in servers}
+
+    def seg(name):
+        srv = servers[name]
+
+        def run():
+            rng = np.random.default_rng(100 + seg_idx[name][0])
+            seg_idx[name][0] += 1
+            work = workload(rng, reqs_per_seg)
+            toks = sum(n for _, n in work)
+            t0 = time.perf_counter()
+            futs = [srv.submit(p, n) for p, n in work]
+            for f in futs:
+                f.result(300)
+            return toks / (time.perf_counter() - t0)
+        return run
+
+    ab = _interleaved({n: seg(n) for n in servers}, segments=segments)
+    snaps = {n: servers[n].metrics.snapshot() for n in servers}
+    for srv in servers.values():
+        srv.stop()
+    dpt = {n: snaps[n]["dispatches_per_token"] for n in snaps}
+    return {
+        "config": f"TransformerLM L=2 d=32, BOTH arms paged 48 blocks "
+                  f"x 8 rows (slots=16 scheduling width), 16-token "
+                  f"shared system prefix + mixed own prompts 1-7 / "
+                  f"decode 17-33 (≡1 mod {K}), fused_serve={K} on the "
+                  f"fused arm, {reqs_per_seg} reqs/segment, greedy",
+        "unit": "generated tokens/sec",
+        "ab": ab,
+        "speedup_fused_over_plain": round(
+            ab["fused"]["median"] / ab["plain"]["median"], 3),
+        "fused_k": K,
+        "dispatches_per_token": {n: fmt(dpt[n], 4) for n in dpt},
+        # the acceptance pin: fused dpt at or below 1/K of unfused
+        "dispatches_per_token_ratio": round(dpt["fused"] / dpt["plain"],
+                                            4) if dpt["plain"] else None,
+        "target_ratio": round(1.0 / K, 4),
+        "fused_windows": snaps["fused"]["fused_windows"],
+        "iterations_per_dispatch": {
+            n: fmt(snaps[n]["iterations_per_dispatch"], 3)
+            for n in snaps},
+        "max_concurrent_streams": {
+            n: snaps[n]["live_streams_max"] for n in snaps},
+        "blocked_on_memory": {
+            n: snaps[n]["blocked_on_memory"] for n in snaps},
+        "request_latency_ms": {
+            n: {"p50": fmt(snaps[n]["latency_ms_p50"]),
+                "p99": fmt(snaps[n]["latency_ms_p99"])} for n in snaps},
+        "slo_ms": slo_ms,
+        "slo": {n: _slo_view(snaps[n], ab[n]["median"], base[n])
+                for n in snaps},
+    }, snaps, None
+
+
 def bench_preempt_ab(segments, reqs_per_seg=12, slo_ms=60.0):
     """Preemption vs shed-only at full block occupancy (module
     docstring). Per segment: 3 batch-class requests of 14 blocks each
@@ -884,6 +1003,7 @@ def main():
                ("overload_vs_baseline", bench_overload_ab),
                ("speculative_vs_plain", bench_speculative_ab),
                ("paged_spec_vs_paged", bench_paged_spec_ab),
+               ("fused_serve_vs_plain", bench_fused_serve_ab),
                ("microbatch_vs_per_request", bench_microbatch_ab),
                ("tracing_on_vs_off", bench_tracing_ab))
     for name, fn in benches:
