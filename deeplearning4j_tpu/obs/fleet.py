@@ -441,7 +441,10 @@ class FleetView:
                     "journal_records", "requests_quarantined",
                     "breaker_open_total", "retry_budget_exhausted",
                     "degraded_mode_ticks", "infant_deaths",
-                    "fused_windows", "decode_iterations"):
+                    "fused_windows", "decode_iterations",
+                    "routed_affinity", "routed_spill",
+                    "prefix_pull_hits", "prefix_pull_refused",
+                    "prefix_pull_bytes"):
             out["fleet_" + key] = counters.get(key, 0)
         # fleet-wide dispatch amortization (fused decode windows): the
         # same ratio each instance derives, recomputed from the MERGED

@@ -77,8 +77,8 @@ from .kvstate import (KVStateError, KVStateVersionError,
                       PrefixCacheArtifact, RequestArtifact)
 from .loadgen import (CHAOS_ACTIONS, ChaosSchedule, ClosedLoop,
                       DecodeSizeMix, InferenceSizeMix, OnOffProcess,
-                      PoissonProcess, Schedule, build_chaos_schedule,
-                      build_schedule, run_load)
+                      PoissonProcess, Schedule, SharedPrefixMix,
+                      build_chaos_schedule, build_schedule, run_load)
 from .speculate import DraftSource, ModelDraft, NGramDraft, Speculator
 from .wire import (RemoteReplica, ReplicaServer, StaleEpochError,
                    WireProtocolError, WireRemoteError,
@@ -96,7 +96,7 @@ __all__ = [
     "AdmissionController", "BrownoutPolicy", "ServiceRateEstimator",
     "Speculator", "DraftSource", "NGramDraft", "ModelDraft",
     "PoissonProcess", "OnOffProcess", "ClosedLoop",
-    "DecodeSizeMix", "InferenceSizeMix", "Schedule",
+    "DecodeSizeMix", "SharedPrefixMix", "InferenceSizeMix", "Schedule",
     "build_schedule", "run_load",
     "ChaosSchedule", "CHAOS_ACTIONS", "build_chaos_schedule",
     "ReplicaServer", "RemoteReplica", "WireProtocolError",

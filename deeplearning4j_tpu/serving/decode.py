@@ -309,6 +309,11 @@ class ContinuousDecodeServer(_RequestLoop):
     # never starve cold prompts outright (the hit line is a goodput
     # preference, not an SLA inversion)
     _PRIO_BURST = 4
+    # fleet prefix tier: max artifact bytes serviced per scheduling
+    # iteration by _service_prefix_ops (at least one command always
+    # runs) — bounds the extract/install work a burst of peer pulls can
+    # steal from one iteration, so the tier can never stall serving
+    _PREFIX_IO_BUDGET = 4 << 20
 
     def __init__(self, lm, slots=4, prompt_buckets=(8, 16, 32),
                  max_queue=64, fault_injector=None, retry_policy=None,
@@ -450,6 +455,11 @@ class ContinuousDecodeServer(_RequestLoop):
         #   staging for migrate_in (drained into _resume_q by the loop
         #   so _resume_q never races a client append)
         self._migrate_cmds = collections.deque()  # (future, reply)
+        self._prefix_cmds = collections.deque()  # fleet prefix tier:
+        #   ("export", key, max_bytes, reply) | ("adopt", art, reply) —
+        #   serviced at the iteration boundary under a per-iteration
+        #   bytes budget so the tier can never stall serving
+        self._prefix_io_budget = self._PREFIX_IO_BUDGET
         self._drain_cmds = collections.deque()   # (migrate, reply):
         #   the fleet drain verb — serve thread hands back EVERY
         #   admitted request in one pass (see drain())
@@ -1385,6 +1395,32 @@ class ContinuousDecodeServer(_RequestLoop):
             else:
                 reply.set_result(art)
 
+    def _service_prefix_ops(self):
+        """Serve-thread half of `prefix_export`/`prefix_adopt`: answer
+        queued fleet-prefix-tier commands at the iteration boundary,
+        bounded by a per-iteration BYTES budget — at least one command
+        always runs (progress), but a burst of peer pulls spreads over
+        iterations instead of stalling one (the tier is a goodput
+        optimization; it must never cost the current batch a beat)."""
+        spent = 0
+        while self._prefix_cmds and (
+                spent == 0 or spent < self._prefix_io_budget):
+            verb, arg, max_bytes, reply = self._prefix_cmds.popleft()
+            try:
+                if verb == "export":
+                    art = self._prefix_export_now(arg, max_bytes)
+                    spent += art.nbytes if art is not None else 0
+                    out = art
+                else:
+                    spent += arg.nbytes
+                    out = self._prefix_adopt_now(arg)
+            except BaseException as e:  # noqa: BLE001 — reply carries it
+                if not reply.done():
+                    reply.set_exception(e)
+            else:
+                if not reply.done():
+                    reply.set_result(out)
+
     def _mark_migrate_out(self, r):
         """Instant marker closing the request's lane on THIS instance:
         in the merged fleet trace it reads as the spill point between
@@ -1767,6 +1803,126 @@ class ContinuousDecodeServer(_RequestLoop):
         if adopted:
             log.info("restored %d prefix-cache blocks under tag %s",
                      len(adopted), art.tag)
+        return len(adopted)
+
+    def prefix_export(self, key, max_bytes=None, timeout=30.0):
+        """Export the resident prefix-cache chain covering `key` (the
+        leading block-aligned prompt tokens) as a `PrefixCacheArtifact`
+        under the NEWEST param version's tag — the fleet prefix tier's
+        SOURCE seam (serving/wire.py OP_PREFIX_PULL): a peer missing a
+        hot prefix adopts this instead of recomputing it. Valid on a
+        RUNNING server: the serve thread performs the gather between
+        scheduling iterations (this call blocks until it has), and
+        indexed rows are immutable once committed, so live sharers are
+        unaffected. Non-destructive — the blocks stay resident here.
+        `max_bytes` truncates the chain parent-first (a partial chain
+        is still matchable from the front). Returns None when nothing
+        indexed under the newest version covers `key`."""
+        if not (self._paged and self._prefix_cache):
+            raise ValueError("prefix_export requires paged=True with "
+                             "prefix_cache=True")
+        if not self._running:
+            raise ServerClosedError("server is not running")
+        reply = cf.Future()
+        self._prefix_cmds.append(("export", tuple(key), max_bytes,
+                                  reply))
+        try:        # nudge an idle-blocked loop
+            self._q.put_nowait(_Wake())
+        except queue.Full:
+            pass
+        return reply.result(timeout)
+
+    def prefix_adopt(self, artifact, timeout=30.0):
+        """Adopt a peer's exported prefix chain into the running pool —
+        the fleet prefix tier's SINK seam. Tag-checked FIRST against
+        the newest param version (`KVStateVersionError` on mismatch,
+        zero blocks adopted, `prefix_pull_refused` counted — the caller
+        degrades to cold compute); adoption never evicts resident state
+        (a full pool adopts a prefix of the chain). Returns the number
+        of blocks adopted; counts `prefix_pull_hits` (blocks) and
+        `prefix_pull_bytes` for the fleet books."""
+        if not (self._paged and self._prefix_cache):
+            raise ValueError("prefix_adopt requires paged=True with "
+                             "prefix_cache=True")
+        if not self._running:
+            raise ServerClosedError("server is not running")
+        reply = cf.Future()
+        self._prefix_cmds.append(("adopt", artifact, None, reply))
+        try:        # nudge an idle-blocked loop
+            self._q.put_nowait(_Wake())
+        except queue.Full:
+            pass
+        return reply.result(timeout)
+
+    def _prefix_export_now(self, key, max_bytes):
+        """Serve-thread half of `prefix_export`: walk the pool's index
+        chain under the newest version and pull the rows to host
+        through the SAME batched [NB]-table gather the persistent
+        prefix cache uses."""
+        with self._swap_lock:
+            vidx = len(self._versions) - 1
+        chain = self._pool.indexed_chain(key, tag=vidx)
+        bs = self._block_size
+        if max_bytes is not None and chain:
+            # fixed per-block payload: truncate parent-first BEFORE
+            # extracting (no device work for bytes that won't ship)
+            per_block = (2 * self._n_layers * bs * self._n_heads
+                         * (self._d_model // self._n_heads)
+                         * np.dtype(self._cache_dtype).itemsize)
+            chain = chain[:int(max_bytes) // per_block]
+        if not chain:
+            return None
+        import jax.numpy as jnp
+        ids = [bid for bid, _ in chain]
+        panels_by_bid = {}
+        for at in range(0, len(ids), self._nb_slot):
+            group = ids[at:at + self._nb_slot]
+            tab = np.zeros((self._nb_slot,), np.int32)
+            tab[:len(group)] = group
+            panels = self._extract(self._cache, jnp.asarray(tab))
+            panels = [(np.asarray(k), np.asarray(v))
+                      for k, v in panels]
+            for i, bid in enumerate(group):
+                panels_by_bid[bid] = [
+                    (k[i * bs:(i + 1) * bs].copy(),
+                     v[i * bs:(i + 1) * bs].copy()) for k, v in panels]
+        return PrefixCacheArtifact(
+            self._version_tag(vidx), bs,
+            [(prefix, panels_by_bid[bid]) for bid, prefix in chain])
+
+    def _prefix_adopt_now(self, art):
+        """Serve-thread half of `prefix_adopt`: `restore_prefix_cache`
+        at the iteration boundary — tag check FIRST (the loud-refusal
+        rule, counted), then adopt + grouped install, parent-first."""
+        with self._swap_lock:
+            vidx = len(self._versions) - 1
+        try:
+            art.require_tag(self._version_tag(vidx),
+                            what="pulled prefix blocks")
+        except KVStateVersionError:
+            self.metrics.count("prefix_pull_refused")
+            raise
+        if art.entries:
+            self._check_artifact_panels(art)
+        adopted = []
+        nbytes = 0
+        for prefix, panels in art.entries:
+            bid = self._pool.adopt((vidx, prefix))
+            if bid is None:
+                continue
+            adopted.append((bid, panels))
+            nbytes += sum(k.nbytes + v.nbytes for k, v in panels)
+        bs = self._block_size
+        for at in range(0, len(adopted), self._nb_slot):
+            group = adopted[at:at + self._nb_slot]
+            ids = [bid for bid, _ in group]
+            rows = [(np.concatenate([p[li][0] for _, p in group]),
+                     np.concatenate([p[li][1] for _, p in group]))
+                    for li in range(self._n_layers)]
+            self._install_panel(ids, rows, len(ids) * bs, 0)
+        if adopted:
+            self.metrics.count("prefix_pull_hits", len(adopted))
+            self.metrics.count("prefix_pull_bytes", nbytes)
         return len(adopted)
 
     def _check_artifact_panels(self, art):
@@ -2331,6 +2487,13 @@ class ContinuousDecodeServer(_RequestLoop):
         while self._migrate_cmds:
             try:
                 _, reply = self._migrate_cmds.popleft()
+            except IndexError:
+                break
+            if not reply.done():
+                reply.set_exception(exc)
+        while self._prefix_cmds:
+            try:
+                *_ignored, reply = self._prefix_cmds.popleft()
             except IndexError:
                 break
             if not reply.done():
@@ -2942,7 +3105,7 @@ class ContinuousDecodeServer(_RequestLoop):
             or bool(self._mem_wait) or bool(self._prio_q) \
             or bool(self._defer_q) or bool(self._resume_q) \
             or bool(self._migrate_in_q) or bool(self._migrate_cmds) \
-            or bool(self._drain_cmds)
+            or bool(self._prefix_cmds) or bool(self._drain_cmds)
 
     def _loop_once(self):
         if self._killed:
@@ -2959,6 +3122,7 @@ class ContinuousDecodeServer(_RequestLoop):
             while self._migrate_in_q:
                 self._resume_q.append(self._migrate_in_q.popleft())
             self._service_migrations()
+            self._service_prefix_ops()
         # evict deadline-expired slots FIRST so the admit below can refill
         # them in the same iteration
         self._evict_expired()

@@ -20,6 +20,21 @@ behind a router and ACTS on what the sensors say.
     dispatches per token over N bare servers (dispatch-counter A/B,
     tests/test_fleet_manager.py).
 
+  * **Prefix-affinity routing + the fleet prefix tier** — the
+    `affinity` policy consistent-hashes each request's block-aligned
+    leading prompt tokens over alive replicas (vnode ring, ~1/N keys
+    remap per replica churned) so a shared-system-prompt family keeps
+    hitting ONE replica's warm prefix cache at any fleet size, with a
+    load-aware spill rule (`spill_factor`/`spill_slack`) that falls to
+    the least-backlog survivor — counted `routed_affinity` /
+    `routed_spill` — before stickiness becomes a hotspot. When a key
+    routes somewhere the manager believes cold while a peer is warm,
+    an async PREFIX_PULL ships the peer's resident chain
+    (`PrefixCacheArtifact` over the existing wire frames, tag-checked
+    at adoption) into the cold replica instead of recomputing it —
+    off the dispatch path, budget-bounded at both ends, so the
+    no-pull path adds ZERO device dispatches per token.
+
   * **Closed autoscale loop** — each `control_tick()` federates every
     replica's `kind_snapshot()` into one fleet snapshot, feeds it to
     the `AutoscaleSignal`, and ACTS: `scale_up` spawns a fresh replica
@@ -101,6 +116,7 @@ other endpoint) and overlays them onto `fleet_snapshot()` as
 """
 from __future__ import annotations
 
+import bisect
 import collections
 import concurrent.futures as cf
 import hashlib
@@ -114,7 +130,7 @@ from ..common.resilience import (RetryBudgetExhaustedError, RetryPolicy)
 from ..obs.fleet import SHED_KEYS, AutoscaleSignal, FleetView
 from .admission import SHED as BROWNOUT_SHED
 from .fleetjournal import FleetJournal, fold_records, replay_journal
-from .kvstate import KVStateError
+from .kvstate import KVStateError, KVStateVersionError
 from .metrics import ServingMetrics
 from .server import (DeadlineExceededError, PoisonPillError,
                      ReplicaDeadError, ServerClosedError,
@@ -159,6 +175,48 @@ def _fingerprint(prompt, params_version):
     return hashlib.sha256(payload).hexdigest()
 
 
+# consistent-hash ring (the affinity policy): each replica owns
+# `_RING_VNODES` pseudo-random points on a 64-bit circle; a key routes
+# to the first replica point clockwise of its own hash. Adding or
+# removing ONE replica moves only the arcs adjacent to its points —
+# ~1/N of the key space — so fleet churn never reshuffles (and thereby
+# cold-starts) every replica's warm prefix cache at once. Module-level
+# and stdlib-pure so the ring-stability property test drives them
+# directly.
+_RING_VNODES = 64
+
+
+def _ring_hash(data):
+    """Stable 64-bit point on the ring (sha256, never `hash()` — the
+    per-process randomization would reshuffle placement every run)."""
+    if not isinstance(data, bytes):
+        data = repr(data).encode()
+    return int.from_bytes(hashlib.sha256(data).digest()[:8], "big")
+
+
+def _build_ring(names, vnodes=_RING_VNODES):
+    """Sorted (point, name) list over `names`, `vnodes` points each."""
+    ring = []
+    for name in names:
+        for v in range(vnodes):
+            ring.append((_ring_hash(f"{name}:{v}".encode()), name))
+    ring.sort()
+    return ring
+
+
+def _ring_lookup(ring, keyhash, exclude=()):
+    """First owner clockwise of `keyhash` whose name is not excluded
+    (None on an empty/fully-excluded ring)."""
+    if not ring:
+        return None
+    i = bisect.bisect_left(ring, (keyhash, ""))
+    for off in range(len(ring)):
+        _, name = ring[(i + off) % len(ring)]
+        if name not in exclude:
+            return name
+    return None
+
+
 class RoundRobinSplitter:
     """The PR 12 fleet front door, promoted from tools/load_sweep.py:
     submit() rotates over N replicas. Deliberately dumb — observability
@@ -197,7 +255,7 @@ class _FleetRequest:
     OUTER future plus everything a failover replay needs."""
 
     __slots__ = ("prompt", "max_new", "deadline", "klass", "outer",
-                 "attempts", "replica", "deaths", "fp")
+                 "attempts", "replica", "deaths", "fp", "akey")
 
     def __init__(self, prompt, max_new, deadline, klass, fp=None):
         self.prompt = [int(t) for t in prompt]
@@ -209,11 +267,12 @@ class _FleetRequest:
         self.replica = None             # current replica name
         self.deaths = set()             # replica deaths it was aboard for
         self.fp = fp                    # quarantine fingerprint
+        self.akey = None                # block-aligned affinity key
 
 
 class _Replica:
     __slots__ = ("name", "server", "state", "seq", "inflight",
-                 "probe_sheds", "probe_failed", "born")
+                 "probe_sheds", "probe_failed", "born", "keys_seen")
 
     def __init__(self, name, server, seq, born=None):
         self.name = name
@@ -223,6 +282,9 @@ class _Replica:
         self.inflight = 0               # manager-tracked live requests
         self.probe_sheds = 0            # health probe baselines
         self.probe_failed = 0
+        self.keys_seen = collections.OrderedDict()  # affinity keys
+        #   routed here (bounded) — the manager's believed-warm set
+        #   that decides when a prefix pull is worth scheduling
         self.born = born                # spawn monotonic (None: adopted
         #                                 — an adoptee's age is unknown,
         #                                 so it can never strike the
@@ -243,7 +305,20 @@ class FleetManager:
     `signal` is the `AutoscaleSignal` `control_tick()` consults (None:
     no autoscaling — the manager is a router + failover only, which is
     exactly what the observe-only sweeps want). `policy` is
-    "least_backlog" (default) or "round_robin" (the A/B arm).
+    "least_backlog" (default), "round_robin" (the A/B arm), or
+    "affinity": consistent-hash the request's block-aligned leading
+    prompt tokens (`affinity_blocks` x `affinity_block` of them — the
+    shared-system-prompt identity) over alive replicas so one prompt
+    family always lands on one replica's warm prefix cache, with a
+    load-aware SPILL — when the affine replica's backlog exceeds
+    `spill_factor` x the fleet minimum + `spill_slack`, the request
+    falls to the least-backlog survivor instead (`routed_spill`
+    counted; the sticky choice must never become a hotspot SLO leak).
+    With `prefix_pull` (default), routing a key to a replica the
+    manager believes cold while a peer is warm schedules an async
+    PREFIX_PULL of the peer's resident chain (off the dispatch path,
+    bounded fleet-wide by `prefix_pull_budget_bytes`) — the spilled/
+    remapped replica adopts the blocks instead of recomputing them.
     """
 
     # request-level VERDICTS settle the outer future as-is; everything
@@ -268,8 +343,10 @@ class FleetManager:
                  kill_hook=None, infant_mortality_s=5.0,
                  breaker_strikes=3, breaker_backoff_s=0.5,
                  breaker_max_backoff_s=30.0, quarantine_capacity=256,
-                 journal_compact_bytes=None):
-        if policy not in ("least_backlog", "round_robin"):
+                 journal_compact_bytes=None, affinity_block=8,
+                 affinity_blocks=1, spill_factor=2.0, spill_slack=4,
+                 prefix_pull=True, prefix_pull_budget_bytes=64 << 20):
+        if policy not in ("least_backlog", "round_robin", "affinity"):
             raise ValueError(f"unknown routing policy {policy!r}")
         if int(n_replicas) < 1:
             raise ValueError("need n_replicas >= 1")
@@ -318,6 +395,26 @@ class FleetManager:
         self._name_prefix = str(name_prefix)
         self._seq = itertools.count()
         self._rr = 0                # round-robin rotation
+        # prefix-affinity routing (module docstring): the consistent-
+        # hash ring over alive replicas, the block geometry of the
+        # affinity key, the load-aware spill rule, and the fleet
+        # prefix tier's pull budget/in-flight dedup
+        self.affinity_block = int(affinity_block)
+        self.affinity_blocks = int(affinity_blocks)
+        if self.affinity_block < 1 or self.affinity_blocks < 1:
+            raise ValueError("need affinity_block >= 1 and "
+                             "affinity_blocks >= 1")
+        self.spill_factor = float(spill_factor)
+        self.spill_slack = int(spill_slack)
+        if self.spill_factor < 1.0 or self.spill_slack < 0:
+            raise ValueError("need spill_factor >= 1.0 and "
+                             "spill_slack >= 0")
+        self.prefix_pull = bool(prefix_pull)
+        self._pull_budget_left = int(prefix_pull_budget_bytes)
+        self._pulls_inflight = set()    # (dst name, key) being pulled
+        self._ring = []                 # sorted (point, name)
+        self._ring_names = ()           # roster the ring was built for
+        self._keys_seen_cap = 512       # per-replica believed-warm cap
         self._running = False
         self._rolling = False       # a rollout is mid-probation:
         #                             control_tick holds scale actions
@@ -744,7 +841,19 @@ class FleetManager:
                            deadline_ms=deadline_ms).result(timeout)
 
     # -- routing -------------------------------------------------------
-    def _pick(self, tried=()):
+    def _affinity_key(self, prompt):
+        """The request's routing identity: its leading
+        `affinity_blocks` x `affinity_block` tokens, floored to a
+        block boundary (the paged pool shares whole blocks, so only
+        whole blocks are placement-worthy). A prompt shorter than one
+        block is its own key — short prompts still route stably."""
+        bs = self.affinity_block
+        n = min(len(prompt), self.affinity_blocks * bs)
+        if n >= bs:
+            n -= n % bs
+        return tuple(int(t) for t in prompt[:n])
+
+    def _pick(self, tried=(), key=None):
         with self._lock:
             cands = [r for r in self._replicas.values()
                      if r.state in (HEALTHY, DEGRADED)
@@ -755,9 +864,47 @@ class FleetManager:
                 rec = cands[self._rr % len(cands)]
                 self._rr += 1
                 return rec
+            least = min(cands, key=lambda r: (r.state != HEALTHY,
+                                              r.inflight, r.seq))
+            if self._policy == "affinity" and key is not None:
+                home = self._pick_affine(cands, tried, key)
+                if home is None or home is least:
+                    # the affine replica IS the least-backlog one (or
+                    # the ring routed around every candidate): sticky
+                    # and cheap at once
+                    self.metrics.count("routed_affinity")
+                    return home if home is not None else least
+                floor = min(r.inflight for r in cands)
+                if home.inflight > self.spill_factor * floor \
+                        + self.spill_slack:
+                    # load-aware spill: stickiness is a goodput
+                    # preference, never a hotspot — fall to the
+                    # least-backlog survivor and count it
+                    self.metrics.count("routed_spill")
+                    return least
+                self.metrics.count("routed_affinity")
+                return home
             # least backlog; healthy beats degraded; spawn order ties
-            return min(cands, key=lambda r: (r.state != HEALTHY,
-                                             r.inflight, r.seq))
+            return least
+
+    def _pick_affine(self, cands, tried, key):
+        """Ring owner of `key` among routable candidates (callers hold
+        `self._lock`). The ring is (re)built only when the ALIVE
+        roster changes — its stability across unrelated churn is the
+        point (~1/N keys remap per replica added/removed)."""
+        names = tuple(r.name for r in self._replicas.values()
+                      if r.state in (HEALTHY, DEGRADED)
+                      and r.server.alive)
+        if names != self._ring_names:
+            self._ring = _build_ring(names)
+            self._ring_names = names
+        routable = {r.name for r in cands}
+        owner = _ring_lookup(
+            self._ring, _ring_hash(key),
+            exclude=frozenset(tried) | (set(names) - routable))
+        if owner is None:
+            return None
+        return self._replicas.get(owner)
 
     def _dispatch(self, req):
         """Route `req` to a replica. Raises on request-level sheds and
@@ -765,8 +912,10 @@ class FleetManager:
         choice and submit retries the next survivor."""
         tried = set()
         last = None
+        if self._policy == "affinity" and req.akey is None:
+            req.akey = self._affinity_key(req.prompt)
         while True:
-            rec = self._pick(tried)
+            rec = self._pick(tried, key=req.akey)
             if rec is None:
                 raise last if last is not None else ReplicaDeadError(
                     "no alive replicas to route to")
@@ -789,6 +938,8 @@ class FleetManager:
                 last = e
                 continue
             self._register(rec, req, inner)
+            if self._policy == "affinity" and req.akey:
+                self._maybe_pull(rec, req.akey)
             if self._kill_hook is not None:
                 # the poison chaos seam: a truthy hook verdict models
                 # a decode that deterministically kills its replica —
@@ -811,6 +962,126 @@ class FleetManager:
             self._live[inner] = req
             rec.inflight += 1
         inner.add_done_callback(self._on_inner_done)
+
+    # -- fleet prefix tier ---------------------------------------------
+    def _maybe_pull(self, rec, key):
+        """Schedule an async prefix pull for `key` into `rec` when the
+        manager believes `rec` is cold on it and a peer is warm —
+        spilled/remapped traffic adopts the peer's blocks instead of
+        recomputing them. OFF the dispatch hot path: this method only
+        consults host-side sets and (at most) starts a daemon thread —
+        the no-pull affinity path stays at ZERO added device
+        dispatches per token (the fleet A/B pin)."""
+        with self._lock:
+            if key in rec.keys_seen:
+                rec.keys_seen.move_to_end(key)  # LRU touch
+                return
+            src = None
+            if self.prefix_pull and self._pull_budget_left > 0 \
+                    and (rec.name, key) not in self._pulls_inflight:
+                src = self._pull_source(rec, key)
+            # believed warm from here on: the request just routed here
+            # will prefill (or adopt) the chain itself
+            rec.keys_seen[key] = True
+            while len(rec.keys_seen) > self._keys_seen_cap:
+                rec.keys_seen.popitem(last=False)
+            if src is None:
+                return
+            self._pulls_inflight.add((rec.name, key))
+            budget = self._pull_budget_left
+        t = threading.Thread(target=self._do_pull,
+                             args=(src, rec.name, key, budget),
+                             daemon=True, name=f"prefix-pull-{rec.name}")
+        t.start()
+
+    def _pull_source(self, rec, key):
+        """Locked helper: the first alive peer the manager believes
+        warm on `key` that speaks the pull protocol, or None."""
+        for peer in self._replicas.values():
+            if peer is not rec and key in peer.keys_seen \
+                    and peer.state in (HEALTHY, DEGRADED) \
+                    and peer.server.alive \
+                    and getattr(peer.server, "prefix_export",
+                                None) is not None:
+                return peer.name
+        return None
+
+    def prefetch(self, prompt):
+        """Synchronously re-warm `prompt`'s affinity key on its
+        current ring owner by pulling a warm peer's resident blocks —
+        the scale-up companion: after the ring remaps keys onto a
+        freshly spawned replica, prefetch moves the cached prefix
+        there AHEAD of traffic. (The dispatch-time pull exists too,
+        but it races the triggering request's own prefill and concedes
+        when local compute wins — correct either way; prefetch is for
+        warming before the traffic arrives.) Spends the same fleet
+        pull budget and counts through the same `prefix_pull_*`
+        counters. Returns blocks adopted (0 when the owner is already
+        believed warm, no warm peer exists, the budget is spent, or
+        the pull was refused — refusals count at the adopting
+        replica)."""
+        key = self._affinity_key(tuple(int(t) for t in prompt))
+        if not key:
+            return 0
+        with self._lock:
+            cands = [r for r in self._replicas.values()
+                     if r.state in (HEALTHY, DEGRADED)
+                     and r.server.alive]
+            if not cands:
+                return 0
+            dst = self._pick_affine(cands, (), key)
+            if dst is None or key in dst.keys_seen:
+                return 0
+            if not self.prefix_pull or self._pull_budget_left <= 0 \
+                    or (dst.name, key) in self._pulls_inflight:
+                return 0
+            src = self._pull_source(dst, key)
+            if src is None:
+                return 0
+            dst.keys_seen[key] = True
+            while len(dst.keys_seen) > self._keys_seen_cap:
+                dst.keys_seen.popitem(last=False)
+            self._pulls_inflight.add((dst.name, key))
+            budget = self._pull_budget_left
+        return self._do_pull(src, dst.name, key, budget)
+
+    def _do_pull(self, src_name, dst_name, key, budget):
+        """One pull, source -> destination, on its own daemon thread
+        (both ends service it at their serve loops' iteration
+        boundaries under their own bytes budgets). Failures are
+        logged, never raised: the tier is an optimization — the worst
+        outcome of a failed pull is the cold compute that would have
+        happened anyway. Version refusals are counted by the ADOPTING
+        replica (`prefix_pull_refused`), where the tag check runs.
+        Returns blocks adopted (0 on any miss/refusal/failure)."""
+        try:
+            with self._lock:
+                src = self._replicas.get(src_name)
+                dst = self._replicas.get(dst_name)
+            if src is None or dst is None or not src.server.alive \
+                    or not dst.server.alive:
+                return 0
+            art = src.server.prefix_export(key, max_bytes=budget)
+            if art is None:
+                return 0
+            adopt = getattr(dst.server, "prefix_adopt", None)
+            if adopt is None:
+                return 0
+            n = adopt(art)
+            with self._lock:
+                self._pull_budget_left = max(
+                    0, self._pull_budget_left - art.nbytes)
+            return int(n or 0)
+        except KVStateVersionError:
+            return 0    # refusal counted at the adopting replica;
+            #             the request decodes cold — correct, just slower
+        except Exception:   # noqa: BLE001 — the tier must never raise
+            log.debug("prefix pull %s -> %s failed", src_name,
+                      dst_name, exc_info=True)
+            return 0
+        finally:
+            with self._lock:
+                self._pulls_inflight.discard((dst_name, key))
 
     def _on_inner_done(self, fut):
         with self._lock:
@@ -1428,8 +1699,11 @@ class FleetManager:
                     "replicas_adopted", "journal_records",
                     "requests_quarantined", "breaker_open_total",
                     "retry_budget_exhausted", "degraded_mode_ticks",
-                    "infant_deaths"):
+                    "infant_deaths", "routed_affinity", "routed_spill"):
             snap["fleet_" + key] = self.metrics.count_value(key)
+        # prefix_pull_* stay FEDERATED (like fenced_ops): the ADOPTING
+        # replica counts hits/bytes/refusals — the manager only
+        # schedules pulls, it never adopts
         # the breaker gauge overlays LIVE manager state (a gauge, not a
         # counter — federation can't sum it; the manager owns it)
         snap["fleet_breaker_state"] = _BREAKER_GAUGE[self._breaker]

@@ -296,6 +296,30 @@ class BlockPool:
         return [(bid, key[1]) for bid, key in self._cached.items()
                 if key[0] == tag]
 
+    def indexed_chain(self, key, tag=None):
+        """Parent-first (block id, prefix tokens) chain of INDEXED
+        blocks covering `key`'s leading full blocks under `tag` — the
+        shippable set the fleet prefix tier exports (PREFIX_PULL).
+        Unlike `cached_entries`, the chain is NOT restricted to the
+        refcount-0 cached tier: a hot prefix is, by definition, held by
+        live requests, and an indexed block's rows are immutable once
+        committed (commit-after-prefill + the CoW discipline), so the
+        exporter may extract them while they are still referenced.
+        Pure lookup, like `match_prefix`: takes no references."""
+        if not self.prefix_cache:
+            return []
+        key = tuple(int(t) for t in key)
+        bs = self.block_size
+        out, rows = [], 0
+        while rows + bs <= len(key):
+            prefix = key[:rows + bs]
+            bid = self._index.get((tag, prefix))
+            if bid is None:
+                break
+            out.append((bid, prefix))
+            rows += bs
+        return out
+
     def adopt(self, key):
         """Allocate a block for an EXTERNALLY-RESTORED prefix entry
         (serving/kvstate.py `PrefixCacheArtifact`): take a physical

@@ -61,9 +61,9 @@ import time
 from .server import ServerOverloadedError, ServingError
 
 __all__ = ["PoissonProcess", "OnOffProcess", "ClosedLoop",
-           "DecodeSizeMix", "InferenceSizeMix", "Schedule",
-           "ChaosSchedule", "CHAOS_ACTIONS", "build_schedule",
-           "build_chaos_schedule", "run_load"]
+           "DecodeSizeMix", "SharedPrefixMix", "InferenceSizeMix",
+           "Schedule", "ChaosSchedule", "CHAOS_ACTIONS",
+           "build_schedule", "build_chaos_schedule", "run_load"]
 
 
 class PoissonProcess:
@@ -164,6 +164,57 @@ class DecodeSizeMix:
         out = {"prompt": prompt, "max_new": rng.randrange(nlo, nhi)}
         if klass is not None:
             out["klass"] = klass
+        return out
+
+
+class SharedPrefixMix:
+    """Shared-system-prompt sessions: every request is one of
+    `n_prefixes` SYSTEM PROMPTS followed by a per-request suffix — the
+    production prompt shape where prefix caching pays (vLLM's dominant
+    mix) and the one a prefix-blind fleet router destroys (N replicas
+    each see every prompt ~1/N of the time, so nobody's cache stays
+    warm). The system prompts are drawn ONCE, in the constructor, on an
+    INDEPENDENT string-seeded stream (``loadgen.prefixes:{seed}``) —
+    `build_schedule`'s size stream then only picks WHICH prompt each
+    request uses plus its suffix, so the same mix object replayed under
+    different schedule seeds keeps the identical prompt population.
+    Prefix lengths are BLOCK-ALIGNED (`prefix_blocks` x `block_size`
+    tokens): a shared prefix that ends mid-block would leave its tail
+    row unsharable in the paged pool AND unhashable by the fleet
+    router's block-aligned affinity key."""
+
+    def __init__(self, n_prefixes=4, prefix_blocks=(1, 3), block_size=8,
+                 suffix=(1, 9), new=(4, 16), vocab=96, seed=0,
+                 klass=None):
+        self.n_prefixes = int(n_prefixes)
+        self.block_size = int(block_size)
+        self.suffix = (int(suffix[0]), int(suffix[1]))
+        self.new = (int(new[0]), int(new[1]))
+        self.vocab = int(vocab)
+        self.klass = str(klass) if klass is not None else None
+        if self.n_prefixes < 1:
+            raise ValueError("need n_prefixes >= 1")
+        if self.block_size < 1:
+            raise ValueError("need block_size >= 1")
+        blo, bhi = int(prefix_blocks[0]), int(prefix_blocks[1])
+        if blo < 1 or bhi <= blo:
+            raise ValueError("prefix_blocks must be a (lo, hi) "
+                             "randrange pair with lo >= 1")
+        rng_p = random.Random(f"loadgen.prefixes:{seed}")
+        self.prefixes = tuple(
+            tuple(rng_p.randrange(1, self.vocab)
+                  for _ in range(rng_p.randrange(blo, bhi)
+                                 * self.block_size))
+            for _ in range(self.n_prefixes))
+
+    def sample(self, rng):
+        prefix = self.prefixes[rng.randrange(self.n_prefixes)]
+        tail = tuple(rng.randrange(1, self.vocab)
+                     for _ in range(rng.randrange(*self.suffix)))
+        out = {"prompt": prefix + tail,
+               "max_new": rng.randrange(*self.new)}
+        if self.klass is not None:
+            out["klass"] = self.klass
         return out
 
 

@@ -216,6 +216,15 @@ class ServingMetrics:
                     "retry_budget_exhausted", "degraded_mode_ticks",
                     "infant_deaths"):
             self.count(key, 0)
+        # prefix-affinity routing + the fleet prefix tier
+        # (serving/fleet.py affinity policy, serving/decode.py
+        # prefix_export/prefix_adopt, serving/wire.py PREFIX ops): same
+        # eager rule — a fleet that never spilled or pulled must scrape
+        # zero, not absence, on its routing verdicts and tier traffic
+        for key in ("routed_affinity", "routed_spill",
+                    "prefix_pull_hits", "prefix_pull_refused",
+                    "prefix_pull_bytes"):
+            self.count(key, 0)
         self._breaker_state = self.registry.gauge(p + "breaker_state")
         self._breaker_state.set(0.0)    # a fresh endpoint reads CLOSED
 
@@ -497,6 +506,14 @@ class ServingMetrics:
         out.setdefault("retry_budget_exhausted", 0)
         out.setdefault("degraded_mode_ticks", 0)
         out.setdefault("infant_deaths", 0)
+        # prefix-affinity routing + fleet prefix tier (serving/fleet.py
+        # affinity policy + serving/wire.py PREFIX ops): routing
+        # verdicts and cross-replica block traffic — always present
+        out.setdefault("routed_affinity", 0)
+        out.setdefault("routed_spill", 0)
+        out.setdefault("prefix_pull_hits", 0)
+        out.setdefault("prefix_pull_refused", 0)
+        out.setdefault("prefix_pull_bytes", 0)
         out["breaker_state"] = self._breaker_state.value
         out["service_rate_tokens_per_sec"] = self._service_rate.value
         out["prefix_hit_rate"] = (

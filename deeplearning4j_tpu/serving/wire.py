@@ -103,7 +103,8 @@ import time
 
 from ..common.resilience import (FaultInjected, RetryBudgetExhaustedError,
                                  RetryPolicy)
-from .kvstate import (KVStateError, KVStateVersionError, RequestArtifact)
+from .kvstate import (KVStateError, KVStateVersionError,
+                      PrefixCacheArtifact, RequestArtifact)
 from .server import (DeadlineExceededError, ReplicaDeadError,
                      RequestDrainedError, RequestMigratedError,
                      ServerClosedError, ServerOverloadedError,
@@ -127,12 +128,17 @@ OP_SWAP = 9
 OP_HEARTBEAT = 10
 OP_STOP = 11
 OP_KILL = 12
+OP_PREFIX_PULL = 13     # fleet prefix tier: export a resident chain
+OP_PREFIX_PUSH = 14     # fleet prefix tier: adopt a peer's chain
 
 # control-plane ops a stale-epoch manager is fenced out of (tentpole
 # piece 3, ISSUE 16): everything that mutates the replica's lifecycle
-# or params. Data-plane ops (SUBMIT/CANCEL/SNAPSHOT/MIGRATE_IN) stay
-# open — a zombie manager's in-flight REQUESTS still resolve; only
-# its authority over the replica is revoked.
+# or params. Data-plane ops (SUBMIT/CANCEL/SNAPSHOT/MIGRATE_IN, the
+# PREFIX tier) stay open — a zombie manager's in-flight REQUESTS still
+# resolve; only its authority over the replica is revoked. The prefix
+# ops are data-plane by the same rule: a pull moves CACHE bytes, never
+# lifecycle or params, and a stale artifact is refused by its version
+# tag, not by epoch fencing.
 _FENCED_OPS = frozenset((OP_DRAIN, OP_MIGRATE_OUT, OP_SWAP,
                          OP_STOP, OP_KILL))
 
@@ -630,6 +636,42 @@ class ReplicaServer:
                               {"id": rid, "ok": True}, data)
             conn.send(OP_MIGRATE_OUT, {"id": rid, "ok": True}, data)
             return True
+        if op == OP_PREFIX_PULL:
+            # fleet prefix tier, SOURCE side: ship the resident chain
+            # covering the requested key. Idempotent and side-effect
+            # free on this replica (the blocks stay resident), so no
+            # reply cache — a retried pull just re-extracts.
+            try:
+                art = srv.prefix_export(
+                    tuple(hdr.get("key") or ()),
+                    max_bytes=hdr.get("max_bytes"),
+                    timeout=hdr.get("timeout", 30.0))
+            except BaseException as e:  # noqa: BLE001 — verdict crosses
+                conn.send(OP_PREFIX_PULL, dict(_exc_to_hdr(e), id=rid))
+                return True
+            if art is None:
+                conn.send(OP_PREFIX_PULL,
+                          {"id": rid, "ok": True, "found": False})
+                return True
+            conn.send(OP_PREFIX_PULL,
+                      {"id": rid, "ok": True, "found": True},
+                      art.to_bytes())
+            return True
+        if op == OP_PREFIX_PUSH:
+            # fleet prefix tier, SINK side: adopt a peer's exported
+            # chain. Idempotent too — an already-indexed key adopts
+            # zero blocks — and the refusal verdict (version tag) is
+            # recomputed identically on a retry, so no reply cache.
+            try:
+                art = PrefixCacheArtifact.from_bytes(blob)
+                n = srv.prefix_adopt(art,
+                                     timeout=hdr.get("timeout", 30.0))
+            except BaseException as e:  # noqa: BLE001 — verdict crosses
+                conn.send(OP_PREFIX_PUSH, dict(_exc_to_hdr(e), id=rid))
+                return True
+            conn.send(OP_PREFIX_PUSH,
+                      {"id": rid, "ok": True, "adopted": int(n)})
+            return True
         if op == OP_SNAPSHOT:
             conn.send(OP_SNAPSHOT, {
                 "id": rid,
@@ -1054,6 +1096,46 @@ class RemoteReplica:
             self._forget(oid)
             raise
         return RequestArtifact.from_bytes(blob)
+
+    def prefix_export(self, key, max_bytes=None, timeout=30.0):
+        """Pull the replica's resident prefix chain covering `key` as
+        a `PrefixCacheArtifact` (None when nothing is resident) — the
+        wire twin of `ContinuousDecodeServer.prefix_export`, so the
+        fleet prefix tier drives in-process and remote replicas
+        through one seam."""
+        self._check_usable()
+        rid = self._mint()
+        p = _PendingOp(rid, OP_PREFIX_PULL,
+                       {"id": rid, "key": [int(t) for t in key],
+                        "max_bytes": max_bytes, "timeout": timeout})
+        try:
+            self._send_op(p, site="serve.wire.migrate")
+            hdr, blob = self._await_ack(p, timeout + self._op_timeout)
+        except BaseException:
+            self._forget(rid)
+            raise
+        if not hdr.get("found"):
+            return None
+        return PrefixCacheArtifact.from_bytes(blob)
+
+    def prefix_adopt(self, artifact, timeout=30.0):
+        """Ship a peer's exported prefix chain into this replica
+        (`to_bytes` over the wire, tag-checked at the far end — a
+        `KVStateVersionError` refusal re-raises here with its real
+        type so the manager can count it and fall back to cold
+        compute). Returns the number of blocks adopted."""
+        self._check_usable()
+        rid = self._mint()
+        p = _PendingOp(rid, OP_PREFIX_PUSH, {"id": rid,
+                                             "timeout": timeout},
+                       blob=artifact.to_bytes())
+        try:
+            self._send_op(p, site="serve.wire.migrate")
+            hdr, _blob = self._await_ack(p, timeout + self._op_timeout)
+        except BaseException:
+            self._forget(rid)
+            raise
+        return int(hdr.get("adopted", 0))
 
     def drain(self, migrate=None, timeout=60.0):
         """The fleet drain verb over the wire: returns ``(migrated,

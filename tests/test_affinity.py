@@ -1,0 +1,414 @@
+"""Prefix-affinity routing + fleet prefix tier pins (ISSUE 20
+acceptance criteria).
+
+  (a) Ring stability: the consistent-hash ring remaps ~1/N of the key
+      space when one replica is added — and every moved key moves TO
+      the newcomer; removing a replica moves ONLY the keys it owned.
+      Exclusion walks clockwise to the next owner; placement is
+      process-stable (sha256, never `hash()`).
+  (b) Routing: `policy="affinity"` keeps a shared prefix on ONE
+      replica (`routed_affinity` counted) while distinct prefixes
+      spread; a hot home spills to least-backlog (`routed_spill`
+      counted) instead of hotspotting.
+  (c) Adoption correctness: a stream served from PULLED blocks
+      (`prefix_export` -> `prefix_adopt`) is bit-identical to cold
+      compute — solo and co-batched — and the adopter really reuses
+      the rows (`prefix_rows_hit`); a STALE pull across a hot swap is
+      refused loudly (`KVStateVersionError`, `prefix_pull_refused`
+      counted, zero adopted) and the cold path stays correct.
+  (d) Fleet tier: the same export/adopt verbs round-trip over a REAL
+      loopback socket (OP_PREFIX_PULL / OP_PREFIX_PUSH artifact
+      frames, refusals re-raised with their real type); after a
+      scale_up remaps keys, `FleetManager.prefetch` re-warms the new
+      owner from a warm peer and follow-up traffic hits the adopted
+      rows.
+
+The N-replica hit-rate retention + zero-added-dispatch A/B runs as
+the tier-1 smoke (`tools/load_sweep.py --affinity`,
+tests/test_loadgen.py).
+"""
+import time
+
+import pytest
+
+from deeplearning4j_tpu.models.zoo.transformer import TransformerLM
+from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                        FleetManager,
+                                        KVStateVersionError,
+                                        PrefixCacheArtifact,
+                                        RemoteReplica, ReplicaServer,
+                                        ServingMetrics)
+from deeplearning4j_tpu.serving.fleet import (_build_ring, _ring_hash,
+                                              _ring_lookup)
+
+
+def _lm(seed=3):
+    return TransformerLM(64, d_model=32, n_heads=2, n_layers=2,
+                         max_len=64, seed=seed)
+
+
+def _lm_small(seed=3):
+    return TransformerLM(64, d_model=16, n_heads=2, n_layers=1,
+                         max_len=64, seed=seed)
+
+
+def _paged(lm, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("prompt_buckets", (8, 16))
+    kw.setdefault("block_size", 4)
+    kw.setdefault("n_blocks", 40)
+    return ContinuousDecodeServer(lm, paged=True, **kw)
+
+
+def _factory(lm, **kw):
+    def make(name):
+        return ContinuousDecodeServer(
+            lm, slots=2, prompt_buckets=(8, 16),
+            metrics=ServingMetrics(name=name), instance=name, **kw)
+    return make
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.002)
+    raise TimeoutError(f"never reached: {msg}")
+
+
+SYS = list(range(1, 13))    # 3 full blocks at block_size 4
+
+
+# ---------------------------------------------------------------------------
+# (a) ring stability
+# ---------------------------------------------------------------------------
+class TestRingStability:
+    KEYS = [(i, i + 1, i % 7) for i in range(2000)]
+
+    def _owners(self, names):
+        ring = _build_ring(names)
+        return {k: _ring_lookup(ring, _ring_hash(k))
+                for k in self.KEYS}
+
+    def test_add_one_replica_remaps_about_one_over_n(self):
+        """The property the policy exists for: growing 8 -> 9 replicas
+        moves ~1/9 of the key space — and every moved key moves TO the
+        newcomer (an old replica never steals another's arc), so at
+        most one replica's worth of cache goes cold per spawn."""
+        names = [f"i{j}" for j in range(8)]
+        before = self._owners(names)
+        after = self._owners(names + ["i8"])
+        moved = [k for k in self.KEYS if before[k] != after[k]]
+        frac = len(moved) / len(self.KEYS)
+        # expectation 1/9 ~ 0.111; wide tolerance for vnode variance
+        assert 0.03 < frac < 0.30, frac
+        assert all(after[k] == "i8" for k in moved)
+
+    def test_remove_one_replica_remaps_only_its_keys(self):
+        """Shrinking moves ONLY the dead replica's keys: every other
+        replica's warm set survives untouched."""
+        names = [f"i{j}" for j in range(8)]
+        before = self._owners(names)
+        after = self._owners([n for n in names if n != "i3"])
+        for k in self.KEYS:
+            if before[k] == "i3":
+                assert after[k] != "i3"
+            else:
+                assert after[k] == before[k]
+
+    def test_lookup_walks_past_excluded_owners(self):
+        names = ["a", "b", "c"]
+        ring = _build_ring(names)
+        kh = _ring_hash((1, 2, 3))
+        home = _ring_lookup(ring, kh)
+        alt = _ring_lookup(ring, kh, exclude={home})
+        assert alt in names and alt != home
+        assert _ring_lookup(ring, kh, exclude=set(names)) is None
+        assert _ring_lookup([], kh) is None
+
+    def test_placement_is_process_stable(self):
+        """Non-bytes keys hash via repr — never `hash()`, whose
+        per-process randomization would reshuffle placement (and
+        thereby cold-start the fleet) on every restart."""
+        key = (4, 5, 6)
+        assert _ring_hash(key) == _ring_hash(repr(key).encode())
+        ring = _build_ring(["a", "b", "c"])
+        assert ring == _build_ring(["a", "b", "c"])
+
+
+# ---------------------------------------------------------------------------
+# (b) routing
+# ---------------------------------------------------------------------------
+class TestAffinityRouting:
+    def test_affinity_key_floors_to_block_boundary(self):
+        mgr = FleetManager(lambda name: None, n_replicas=1,
+                           affinity_block=4, affinity_blocks=2)
+        assert mgr._affinity_key([1, 2, 3]) == (1, 2, 3)
+        assert mgr._affinity_key([1, 2, 3, 4, 5]) == (1, 2, 3, 4)
+        assert mgr._affinity_key(range(1, 12)) == tuple(range(1, 9))
+        # never started: nothing to stop
+
+    def test_same_prefix_sticks_to_one_replica(self):
+        lm = _lm_small()
+        with FleetManager(_factory(lm), n_replicas=3,
+                          policy="affinity", prefix_pull=False,
+                          affinity_block=4) as mgr:
+            for n in mgr.replicas:
+                mgr.replica(n).generate([1, 2, 3], 2, timeout=120)
+            base = {n: mgr.replica(n).metrics.count_value("received")
+                    for n in mgr.replicas}
+            for i in range(6):
+                mgr.generate([7, 8, 9, 11, 20 + i], 3, timeout=120)
+            recv = sorted(
+                mgr.replica(n).metrics.count_value("received")
+                - base[n] for n in mgr.replicas)
+            assert recv == [0, 0, 6]
+            snap = mgr.fleet_snapshot()
+            assert snap["fleet_routed_affinity"] >= 6
+            assert snap["fleet_routed_spill"] == 0
+
+    def test_distinct_prefixes_spread_across_replicas(self):
+        lm = _lm_small()
+        with FleetManager(_factory(lm), n_replicas=3,
+                          policy="affinity", prefix_pull=False,
+                          affinity_block=4) as mgr:
+            for n in mgr.replicas:
+                mgr.replica(n).generate([1, 2, 3], 2, timeout=120)
+            base = {n: mgr.replica(n).metrics.count_value("received")
+                    for n in mgr.replicas}
+            for i in range(16):
+                mgr.generate([3 * i + 1, 3 * i + 2, 3 * i + 3,
+                              3 * i + 4], 2, timeout=120)
+            recv = [mgr.replica(n).metrics.count_value("received")
+                    - base[n] for n in mgr.replicas]
+            assert sum(recv) == 16
+            assert sum(1 for r in recv if r > 0) >= 2
+
+    def test_hot_home_spills_to_least_backlog(self):
+        """Stickiness is a goodput preference, never a hotspot: with
+        the spill threshold at zero slack, a second same-prefix
+        request arriving while the home decodes routes to the idle
+        peer and is COUNTED as a spill."""
+        lm = _lm_small()
+        with FleetManager(_factory(lm), n_replicas=2,
+                          policy="affinity", prefix_pull=False,
+                          affinity_block=4, spill_factor=1.0,
+                          spill_slack=0) as mgr:
+            for n in mgr.replicas:
+                mgr.replica(n).generate([1, 2, 3], 2, timeout=120)
+            f1 = mgr.submit([5, 6, 7, 8, 30], 32)
+            _wait(lambda: any(r.inflight
+                              for r in mgr._replicas.values()),
+                  msg="first request in flight")
+            f2 = mgr.submit([5, 6, 7, 8, 31], 4)
+            f1.result(120)
+            f2.result(120)
+            snap = mgr.fleet_snapshot()
+            assert snap["fleet_routed_affinity"] >= 1
+            assert snap["fleet_routed_spill"] == 1
+
+
+# ---------------------------------------------------------------------------
+# (c) adoption correctness
+# ---------------------------------------------------------------------------
+class TestAdoptionCorrectness:
+    def _warm_source(self, lm):
+        a = _paged(lm, slots=2, prompt_buckets=(16,)).start()
+        a.generate(SYS + [20, 21], 8, timeout=120)
+        return a
+
+    def test_pulled_stream_bit_identical_to_cold_compute(self):
+        lm = _lm()
+        prompt = SYS + [22, 23]
+        ref = list(lm.generate(prompt, 8))
+        a = self._warm_source(lm)
+        b = _paged(lm, slots=2, prompt_buckets=(16,)).start()
+        try:
+            art = a.prefix_export(tuple(SYS))
+            assert art is not None and len(art.entries) == 3
+            adopted = b.prefix_adopt(art)
+            assert adopted == 3
+            snap = b.metrics.snapshot()
+            assert snap["prefix_pull_hits"] == 3
+            assert snap["prefix_pull_bytes"] > 0
+            assert snap["prefix_pull_refused"] == 0
+            pre = b.metrics.snapshot()
+            assert b.generate(prompt, 8, timeout=120) == ref
+            post = b.metrics.snapshot()
+            # the adopter really SERVED from the pulled rows: all 3
+            # blocks (12 rows) matched out of the pool, not recomputed
+            assert post["prefix_rows_hit"] - pre["prefix_rows_hit"] \
+                >= 12
+        finally:
+            a.stop(timeout=120)
+            b.stop(timeout=120)
+        b._pool.check()
+
+    def test_pulled_stream_bit_identical_co_batched(self):
+        """The pulled-prefix request decodes CO-BATCHED with unrelated
+        traffic on the adopter — sharing the adopted blocks in the
+        same scheduling iterations — and every stream stays
+        bit-identical to its solo reference."""
+        lm = _lm()
+        prompts = [SYS + [24, 25], [40, 41, 42], [50, 51, 52, 53]]
+        refs = [list(lm.generate(p, 10)) for p in prompts]
+        a = self._warm_source(lm)
+        b = _paged(lm, slots=4, prompt_buckets=(8, 16)).start()
+        try:
+            assert b.prefix_adopt(a.prefix_export(tuple(SYS))) == 3
+            futs = [b.submit(p, 10) for p in prompts]
+            for f, ref in zip(futs, refs):
+                assert list(f.result(120)) == ref
+            assert b.metrics.snapshot()["prefix_rows_hit"] >= 12
+        finally:
+            a.stop(timeout=120)
+            b.stop(timeout=120)
+        b._pool.check()
+
+    def test_stale_pull_refused_across_hot_swap(self):
+        """A pull exported under v0 params adopted AFTER the adopter
+        hot-swapped to v1 is refused loudly — `KVStateVersionError`,
+        `prefix_pull_refused` counted, ZERO blocks adopted — and the
+        request degrades to cold compute under the NEW params."""
+        lm, lm2 = _lm(), _lm(seed=9)
+        prompt = SYS + [26, 27]
+        a = self._warm_source(lm)
+        b = _paged(lm, slots=2, prompt_buckets=(16,)).start()
+        try:
+            art = a.prefix_export(tuple(SYS))
+            b.swap(lm2)
+            with pytest.raises(KVStateVersionError):
+                b.prefix_adopt(art)
+            snap = b.metrics.snapshot()
+            assert snap["prefix_pull_refused"] == 1
+            assert snap["prefix_pull_hits"] == 0
+            # cold compute under the new params stays correct
+            assert b.generate(prompt, 8, timeout=120) \
+                == list(lm2.generate(prompt, 8))
+        finally:
+            a.stop(timeout=120)
+            b.stop(timeout=120)
+        b._pool.check()
+
+    def test_export_unknown_key_returns_none(self):
+        lm = _lm()
+        a = self._warm_source(lm)
+        try:
+            assert a.prefix_export((60, 61, 62, 63)) is None
+        finally:
+            a.stop(timeout=120)
+
+    def test_export_max_bytes_truncates_parent_first(self):
+        """A budgeted export ships a PREFIX of the chain (parent-
+        first) — still matchable from the front, never a torn tail."""
+        lm = _lm()
+        a = self._warm_source(lm)
+        try:
+            full = a.prefix_export(tuple(SYS))
+            assert len(full.entries) == 3
+            per_block = full.nbytes // 3
+            part = a.prefix_export(tuple(SYS),
+                                   max_bytes=2 * per_block)
+            assert len(part.entries) == 2
+            assert [p for p, _ in part.entries] \
+                == [p for p, _ in full.entries[:2]]
+        finally:
+            a.stop(timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# (d) fleet tier: the wire seam + manager prefetch
+# ---------------------------------------------------------------------------
+class TestWirePrefixPull:
+    def test_pull_round_trips_over_real_socket(self):
+        """OP_PREFIX_PULL / OP_PREFIX_PUSH over a REAL loopback
+        socket: the artifact ships as `to_bytes` frames, the adopter
+        serves the pulled prefix bit-identically, and a stale push
+        after a remote hot swap re-raises `KVStateVersionError` with
+        its real type (and is counted at the far end)."""
+        lm, lm2 = _lm(), _lm(seed=9)
+        prompt = SYS + [28, 29]
+        ref = list(lm.generate(prompt, 8))
+        sa = _paged(lm, slots=2, prompt_buckets=(16,)).start()
+        sb = _paged(lm, slots=2, prompt_buckets=(16,)).start()
+        rsa, rsb = ReplicaServer(sa), ReplicaServer(sb)
+        ra = RemoteReplica("127.0.0.1", rsa.port, name="wa",
+                           heartbeat_interval=0.05)
+        rb = RemoteReplica("127.0.0.1", rsb.port, name="wb",
+                           heartbeat_interval=0.05)
+        try:
+            ra.generate(SYS + [20, 21], 8, timeout=120)
+            art = ra.prefix_export(tuple(SYS))
+            assert isinstance(art, PrefixCacheArtifact)
+            assert ra.prefix_export((60, 61, 62, 63)) is None
+            assert rb.prefix_adopt(art) == 3
+            assert list(rb.generate(prompt, 8, timeout=120)) == ref
+            assert rb.metrics.snapshot()["prefix_rows_hit"] >= 12
+            rb.swap(lm2)
+            with pytest.raises(KVStateVersionError):
+                rb.prefix_adopt(art)
+            assert rb.metrics.snapshot()["prefix_pull_refused"] == 1
+        finally:
+            ra.stop(timeout=60)     # graceful OP_STOP stops sa/sb too
+            rb.stop(timeout=60)
+            rsa.close(stop_server=False)
+            rsb.close(stop_server=False)
+
+
+class TestManagerPrefetch:
+    def test_prefetch_rewarms_moved_keys_after_scale_up(self):
+        """The scale-up companion: after a spawn remaps ~1/N keys,
+        `prefetch` synchronously pulls a moved key's blocks from the
+        warm old owner into the NEW ring owner (budget + counters
+        shared with dispatch-time pulls), so the first routed request
+        hits adopted rows instead of recomputing."""
+        lm = _lm()
+        cands = [[3 * i + 1, 3 * i + 2, 3 * i + 3, 3 * i + 4]
+                 for i in range(12)]
+        with FleetManager(
+                _factory(lm, paged=True, block_size=4, n_blocks=40),
+                n_replicas=1, policy="affinity", affinity_block=4,
+                max_replicas=4) as mgr:
+            for n in mgr.replicas:
+                mgr.replica(n).generate([1, 2, 3], 2, timeout=120)
+            for c in cands:
+                mgr.generate(c + [30, 31], 3, timeout=120)
+            # single replica: every owner is already warm -> no-op
+            assert mgr.prefetch(cands[0] + [30]) == 0
+            assert mgr.prefetch([]) == 0
+            old = set(mgr.replicas)
+            moved = []
+            for _ in range(3):          # ring churn: spawn until a
+                mgr.scale_up()          # key provably remaps
+                new = [n for n in mgr.replicas if n not in old]
+                ring = _build_ring(tuple(mgr.replicas))
+                moved = [c for c in cands
+                         if _ring_lookup(ring, _ring_hash(tuple(c)))
+                         in new]
+                if moved:
+                    break
+                old = set(mgr.replicas)
+            assert moved, "no key remapped after 3 spawns"
+            for n in new:
+                mgr.replica(n).generate([1, 2, 3], 2, timeout=120)
+            c = moved[0]
+            base = mgr.fleet_snapshot()
+            assert mgr.prefetch(c + [33]) >= 1
+            snap = mgr.fleet_snapshot()
+            assert snap["fleet_prefix_pull_hits"] \
+                - base["fleet_prefix_pull_hits"] >= 1
+            assert snap["fleet_prefix_pull_bytes"] \
+                - base["fleet_prefix_pull_bytes"] > 0
+            # already pulled: the second prefetch is a no-op
+            assert mgr.prefetch(c + [34]) == 0
+            # the re-routed request SERVES from the pulled rows,
+            # bit-identical to solo
+            owner = _ring_lookup(_build_ring(tuple(mgr.replicas)),
+                                 _ring_hash(tuple(c)))
+            pre = mgr.replica(owner).metrics.snapshot()
+            assert mgr.generate(c + [40], 3, timeout=120) \
+                == list(lm.generate(c + [40], 3))
+            post = mgr.replica(owner).metrics.snapshot()
+            assert post["prefix_rows_hit"] - pre["prefix_rows_hit"] \
+                >= 4

@@ -37,7 +37,8 @@ from deeplearning4j_tpu.obs import MetricsRegistry, Tracer, decompose
 from deeplearning4j_tpu.serving import (ClosedLoop, ContinuousDecodeServer,
                                         DecodeSizeMix, OnOffProcess,
                                         PoissonProcess, ServingMetrics,
-                                        build_schedule, run_load)
+                                        SharedPrefixMix, build_schedule,
+                                        run_load)
 
 
 def _lm(seed=3):
@@ -96,6 +97,36 @@ class TestScheduleDeterminism:
         s2 = build_schedule(PoissonProcess(50.0), other, 16, seed=7)
         assert s1.arrivals == s2.arrivals
         assert s1.items != s2.items
+
+    def test_shared_prefix_mix_digest_byte_identical(self):
+        """ISSUE 20 satellite: the shared-system-prompt mix is as
+        deterministic as the size mixes — same seed, byte-identical
+        schedule (prefix population + suffixes + digest)."""
+        s1 = build_schedule(PoissonProcess(80.0),
+                            SharedPrefixMix(n_prefixes=3, seed=5),
+                            32, seed=11)
+        s2 = build_schedule(PoissonProcess(80.0),
+                            SharedPrefixMix(n_prefixes=3, seed=5),
+                            32, seed=11)
+        assert repr(s1.items) == repr(s2.items)
+        assert s1.digest() == s2.digest()
+        assert s1.digest() != build_schedule(
+            PoissonProcess(80.0), SharedPrefixMix(n_prefixes=3, seed=6),
+            32, seed=11).digest()
+
+    def test_shared_prefix_population_stable_across_seeds(self):
+        """The prefixes are drawn ONCE on their own string-seeded
+        stream: different SCHEDULE seeds keep the identical (block-
+        aligned) prompt population — every prompt opens with one of
+        the mix's system prompts."""
+        mix = SharedPrefixMix(n_prefixes=3, block_size=8, seed=5)
+        for p in mix.prefixes:
+            assert len(p) >= 8 and len(p) % 8 == 0
+        for seed in (1, 2):
+            s = build_schedule(PoissonProcess(80.0), mix, 24, seed=seed)
+            for item in s.items:
+                prompt = item["prompt"]
+                assert any(prompt[:len(p)] == p for p in mix.prefixes)
 
 
 # ---------------------------------------------------------------------------
@@ -697,3 +728,68 @@ class TestSmokeSweep:
                   if e.get("ph") == "M"
                   and e.get("name") == "process_name"}
         assert {"i0", "i1"} <= pnames and len(pnames) >= 3
+
+    def test_smoke_sweep_affinity(self):
+        """The PREFIX-AFFINITY fleet smoke (ISSUE 20): `load_sweep
+        --fleet-procs 2 --affinity` — solo vs affinity vs least-
+        backlog on one seeded shared-system-prompt workload, the two
+        fleet arms as REAL replica processes (block pulls travel as
+        PREFIX_PULL/PREFIX_PUSH artifact frames). Pins the
+        acceptance: fleet hit rate retained at >= 0.9x the solo
+        ceiling (the prefix-blind baseline recorded alongside), ZERO
+        lost requests in every arm, the no-pull affinity path at ZERO
+        added device dispatches per token (dispatch-counter A/B), and
+        the ring-churn phase really pulling blocks over the wire
+        after a scale_up remaps keys. Artifacts upload next to the
+        other fleet smokes (tier1.yml)."""
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        mod = importlib.import_module("load_sweep")
+        out = os.path.join(
+            os.environ.get("SMOKE_REPORT_DIR") or tempfile.gettempdir(),
+            "load_sweep_smoke_affinity")
+        res = mod.run_sweep(server="decode", rates=(30.0,), n_req=12,
+                            slo_ms=400.0, seed=0, trace=False,
+                            report_path=out, affinity=True,
+                            fleet_procs=2, fleet_obs_per_rate=2,
+                            fleet_slice_s=0.2)
+        (body,) = res
+        assert body["server"] == "fleet_affinity"
+        assert body["procs"] == 2
+        # the acceptance pin: affinity keeps the solo hit-rate ceiling
+        # while the prefix-blind baseline is recorded alongside
+        assert body["solo"]["hit_rate"] > 0
+        assert body["least_backlog"]["hit_rate"] is not None
+        assert body["hit_rate_ratio_vs_solo"] >= 0.9
+        assert body["hit_rate_retained_09"] is True
+        # zero lost requests: every admitted future resolved, all arms
+        for arm in ("solo", "affinity", "least_backlog"):
+            rec = body[arm]
+            assert rec["lost"] == 0
+            for pt in rec["curve"]:
+                assert pt["admitted"] == pt["completed"] + pt["failed"]
+        assert body["affinity"]["routed_affinity"] > 0
+        # the dispatch A/B: consistent-hash routing is host-side work —
+        # the same fixed request list through a fleet-of-one under each
+        # policy dispatches IDENTICALLY (zero added per token)
+        dab = body["dispatch_ab"]
+        assert dab["zero_added_dispatches"] is True
+        assert dab["affinity_dispatches"] \
+            == dab["least_backlog_dispatches"]
+        assert dab["affinity_tokens"] == dab["least_backlog_tokens"]
+        # ring churn: scale_up remapped >= 1 prefix and the prefetch
+        # pulled its blocks over the REAL wire into the newcomer; the
+        # re-routed requests then hit the adopted rows
+        churn = body["affinity"]["ring_churn"]
+        assert churn is not None
+        assert churn["keys_moved"] >= 1
+        assert churn["pulled_blocks"] >= 1
+        assert churn["prefix_pull_hits"] >= 1
+        assert churn["prefix_pull_bytes"] > 0
+        assert churn["rehit_rows_after_pull"] > 0
+        # artifacts for tier1.yml
+        rep = json.load(open(out + ".json"))
+        assert rep["sweep"][0]["server"] == "fleet_affinity"
+        assert os.path.exists(out + ".txt")

@@ -575,6 +575,15 @@ class TestMetricsPins:
         "requests_quarantined", "breaker_open_total", "breaker_state",
         "retry_budget_exhausted", "degraded_mode_ticks",
         "infant_deaths",
+        # prefix-affinity routing + fleet prefix tier (serving/fleet.py
+        # affinity policy, serving/decode.py prefix_export/prefix_adopt,
+        # serving/wire.py PREFIX ops, ISSUE 20): routing verdicts and
+        # cross-replica block traffic — consumed by
+        # tools/fleet_report.py's control section and the load_sweep
+        # --affinity record (eagerly created: a fleet that never
+        # spilled or pulled scrapes zero, not absence)
+        "routed_affinity", "routed_spill", "prefix_pull_hits",
+        "prefix_pull_refused", "prefix_pull_bytes",
         "admission_error_ms_p50", "admission_error_ms_p99",
         "admission_error_ms_mean", "admission_error_ms_count",
         "slo_total", "slo_met", "slo_tokens_met", "slo_attainment",
@@ -620,6 +629,13 @@ class TestMetricsPins:
         # it weights instances by dispatch volume
         "fleet_fused_windows", "fleet_decode_iterations",
         "fleet_iterations_per_dispatch",
+        # prefix-affinity routing + fleet prefix tier (ISSUE 20):
+        # routed_* summed then overlaid live by the manager (its own
+        # verbs); prefix_pull_* stay federated — the ADOPTING replica
+        # counts hits/bytes/refusals
+        "fleet_routed_affinity", "fleet_routed_spill",
+        "fleet_prefix_pull_hits", "fleet_prefix_pull_refused",
+        "fleet_prefix_pull_bytes",
     )
 
     def test_fleet_snapshot_keys_pinned(self):

@@ -117,7 +117,13 @@ CONTROL_KEYS = ("fleet_replica_spawned", "fleet_replica_drained",
                 "fleet_canary_rollbacks", "fleet_wire_reconnects",
                 "fleet_wire_retries", "fleet_migrate_refused",
                 "fleet_manager_epoch", "fleet_replicas_adopted",
-                "fleet_fenced_ops", "fleet_journal_records")
+                "fleet_fenced_ops", "fleet_journal_records",
+                # prefix-affinity routing + the fleet prefix tier
+                # (serving/fleet.py affinity policy, ISSUE 20):
+                # routing verdicts and cross-replica block traffic
+                "fleet_routed_affinity", "fleet_routed_spill",
+                "fleet_prefix_pull_hits", "fleet_prefix_pull_refused",
+                "fleet_prefix_pull_bytes")
 
 # blast-radius containment (serving/fleet.py ISSUE 17): quarantine
 # verdicts, the spawn circuit breaker, the shared retry budget, and

@@ -25,6 +25,7 @@ Run (CPU backend, no chip needed):
         [--paged] [--speculate K] [--preempt] [--fleet N]
         [--fleet-control [--fleet-min A --fleet-max B]]
         [--fleet-procs N [--chaos [--chaos-events E] [--cascade]]]
+        [--affinity [--fleet-procs N]]
 
 `--process onoff` keeps the same MEAN rate but bursts at 2x with a 50%
 duty cycle (the p99 stressor); `--process closed` reinterprets each
@@ -555,6 +556,10 @@ def _replica_serve_main(argv):
     ap.add_argument("--slo-ms", type=float, default=250.0)
     ap.add_argument("--slots", type=int, default=2)
     ap.add_argument("--paged", action="store_true")
+    ap.add_argument("--prompt-buckets", default="8,16",
+                    help="comma-separated prefill bucket rows (the "
+                         "affinity arm's shared-prefix prompts need "
+                         "16,32)")
     args = ap.parse_args(argv)
     from deeplearning4j_tpu.obs import Tracer
     from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
@@ -563,8 +568,9 @@ def _replica_serve_main(argv):
     lm = _lm()
     tr = Tracer(capacity=1 << 15, enabled=args.trace_out is not None,
                 instance=args.instance)
+    buckets = tuple(int(b) for b in args.prompt_buckets.split(","))
     srv = ContinuousDecodeServer(
-        lm, slots=args.slots, prompt_buckets=(8, 16), max_queue=1024,
+        lm, slots=args.slots, prompt_buckets=buckets, max_queue=1024,
         metrics=ServingMetrics(slo_target_ms=args.slo_ms,
                                name=args.instance),
         tracer=tr, instance=args.instance, admission=True,
@@ -771,6 +777,361 @@ def sweep_fleet_procs(rates, n_replicas=2, n_req=64, slo_ms=250.0,
             "replica_pids": pids,
             "wire_fault": fault_rec}
     return body, snaps, merged
+
+
+def sweep_fleet_affinity(rates, n_replicas=3, n_req=48, slo_ms=250.0,
+                         seed=0, process="poisson", trace=False,
+                         slots=2, lm=None, obs_per_rate=2,
+                         slice_s=0.25, procs=0, n_prefixes=4,
+                         dispatch_reqs=10):
+    """The PREFIX-AFFINITY arm (`--affinity`, ISSUE 20): a seeded
+    shared-system-prompt workload (`serving.loadgen.SharedPrefixMix` —
+    P block-aligned prefixes drawn on their own stream) over paged
+    replicas, served three ways on IDENTICAL schedules:
+
+      * **solo reference** — ONE paged replica; its prefix hit rate is
+        the ceiling any router can retain;
+      * **affinity** — `FleetManager(policy="affinity")`: consistent-
+        hash routing of the block-aligned prefix key with load-aware
+        spill, plus the fleet prefix tier (a spilled/missing replica
+        PULLS a peer's resident blocks over `prefix_export`/
+        `prefix_adopt` instead of recomputing);
+      * **least_backlog** — the prefix-blind baseline whose fleet hit
+        rate decays toward ~1/N as replicas dilute the cache.
+
+    The record carries the per-arm fleet hit rate (counter DELTAS over
+    the measured rungs — warmup traffic excluded), the routing
+    verdicts (`routed_affinity`/`routed_spill`), the prefix-tier
+    traffic (`prefix_pull_hits`/`_refused`/`_bytes`), goodput per arm,
+    and `hit_rate_ratio_vs_solo` — the ISSUE 20 acceptance pins it
+    >= 0.9 at 3 replicas.
+
+    The DISPATCH A/B pins the no-pull affinity path at ZERO added
+    device dispatches per token: the same fixed request list is served
+    one-at-a-time through two fleets-of-one — `policy="affinity"`
+    (prefix_pull off) vs `policy="least_backlog"` — and the
+    `dispatches`+`chunk_dispatches` deltas must match exactly (routing
+    is host-side hashing; nothing touches the device).
+
+    `procs=N` (the `--fleet-procs N --affinity` spelling) runs the two
+    FLEET arms as N real replica PROCESSES behind the serving wire —
+    block pulls become PREFIX_PULL/PREFIX_PUSH artifact frames — while
+    the solo reference and dispatch A/B stay in-process (they measure
+    cache/compute properties the wire cannot change). Span tracing is
+    not wired through this arm (`trace` is accepted for signature
+    parity); the counters are the record. Returns
+    (body, per_instance_snaps, None)."""
+    import random
+    import subprocess
+    import tempfile
+
+    from deeplearning4j_tpu.common.resilience import RetryPolicy
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            FleetManager, RemoteReplica,
+                                            ServingMetrics,
+                                            SharedPrefixMix,
+                                            build_schedule, run_load)
+    del trace
+    lm = lm if lm is not None else _lm()
+    bs = 8
+    mix = SharedPrefixMix(n_prefixes=n_prefixes, prefix_blocks=(1, 3),
+                          block_size=bs, suffix=(1, 9), new=(4, 16),
+                          vocab=96, seed=seed)
+    buckets = (16, 32)
+    here = os.path.abspath(__file__)
+    # the dispatch-A/B request list: drawn ONCE, replayed verbatim
+    # through both fleets-of-one (identical work is the whole point)
+    rng = random.Random(f"load_sweep.affinity.dispatch:{seed}")
+    ab_reqs = [mix.sample(rng) for _ in range(int(dispatch_reqs))]
+
+    def local_factory(name):
+        return ContinuousDecodeServer(
+            lm, slots=slots, prompt_buckets=buckets, max_queue=1024,
+            metrics=ServingMetrics(slo_target_ms=slo_ms, name=name),
+            instance=name, admission=True, default_deadline_ms=slo_ms,
+            paged=True, block_size=bs)
+
+    def warmup(srv):
+        # compile BOTH prefill buckets + the decode step off the
+        # serving clock (the shared-prefix prompts span 9..32 rows)
+        for p in ([1, 2, 3, 4], list(range(1, 25))):
+            srv.generate(p, 4, deadline_ms=600_000, timeout=300)
+
+    TIER_KEYS = ("prefix_rows_hit", "prefix_rows_total",
+                 "prefix_pull_hits", "prefix_pull_refused",
+                 "prefix_pull_bytes")
+
+    def tier_counters(mgr):
+        out = dict.fromkeys(TIER_KEYS, 0)
+        for n in list(mgr.replicas):
+            snap = mgr.replica(n).metrics.snapshot()
+            for k in TIER_KEYS:
+                out[k] += int(snap.get(k) or 0)
+        return out
+
+    def run_arm(policy, n, use_procs, pull, tag, do_rungs=True,
+                do_dispatch=False):
+        procs_map, tmpdir = {}, None
+        if use_procs:
+            tmpdir = tempfile.mkdtemp(prefix=f"fleet_affinity_{tag}_")
+
+            def launch(name):
+                port_file = os.path.join(tmpdir, f"{name}.port")
+                cmd = [sys.executable, here, "--replica-serve",
+                       "--instance", name, "--port-file", port_file,
+                       "--slo-ms", str(slo_ms), "--slots", str(slots),
+                       "--paged", "--prompt-buckets",
+                       ",".join(str(b) for b in buckets)]
+                env = dict(os.environ, JAX_PLATFORMS="cpu")
+                procs_map[name] = subprocess.Popen(cmd, env=env)
+                return port_file
+
+            def wait_port(name, port_file, timeout=300.0):
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < timeout:
+                    if os.path.exists(port_file):
+                        return int(open(port_file).read().strip())
+                    if procs_map[name].poll() is not None:
+                        raise RuntimeError(
+                            f"replica process {name} exited rc="
+                            f"{procs_map[name].returncode} before "
+                            f"binding")
+                    time.sleep(0.05)
+                raise TimeoutError(
+                    f"replica {name} never published its port")
+
+            ports = {f"i{k}": None for k in range(int(n))}
+            for name in ports:
+                ports[name] = launch(name)
+
+            def factory(name):
+                port_file = ports.pop(name, None) or launch(name)
+                port = wait_port(name, port_file)
+                return RemoteReplica(
+                    "127.0.0.1", port, name=name,
+                    retry_policy=RetryPolicy(max_retries=4,
+                                             base_delay=0.05,
+                                             max_delay=0.5, jitter=0.0),
+                    heartbeat_interval=0.1, process=procs_map[name])
+        else:
+            factory = local_factory
+        mgr = FleetManager(factory, n_replicas=n, policy=policy,
+                           prefix_pull=pull, warmup=warmup,
+                           heartbeat_timeout=2.0 if use_procs else None,
+                           metrics=ServingMetrics(name="fleet"))
+        try:
+            mgr.start()
+            dispatch_rec = None
+            if do_dispatch:
+                fv0 = mgr.fleet_view()
+                d0 = (fv0.counter("dispatches")
+                      + fv0.counter("chunk_dispatches"))
+                toks = 0
+                for r in ab_reqs:
+                    toks += len(mgr.generate(r["prompt"], r["max_new"],
+                                             deadline_ms=600_000,
+                                             timeout=300))
+                fv1 = mgr.fleet_view()
+                d1 = (fv1.counter("dispatches")
+                      + fv1.counter("chunk_dispatches"))
+                dispatch_rec = {"dispatches": d1 - d0, "tokens": toks}
+            # steady-state preload: route one request per shared
+            # prefix through THIS arm's own policy before the
+            # measurement baseline, so every arm measures its steady
+            # state rather than its cold start (the dispatch A/B above
+            # already warmed the solo arm's single replica — without
+            # this the hit-rate comparison would be rigged against the
+            # fleet arms, which pay one cold miss per prefix per home)
+            for p in mix.prefixes:
+                mgr.generate(list(p) + [1, 2], 4, deadline_ms=600_000,
+                             timeout=300)
+            curve = []
+            base = tier_counters(mgr)
+            base_fleet = mgr.fleet_snapshot()
+            toks_all, dur_all = 0, 0.0
+            admitted = completed = failed = 0
+            if do_rungs:
+                for i, rate in enumerate(rates):
+                    slice_n = max(2, int(n_req) // int(obs_per_rate),
+                                  min(int(rate * slice_s), 400))
+                    toks, dur, offered = 0, 0.0, None
+                    adm = com = fai = 0
+                    for k in range(int(obs_per_rate)):
+                        sched = build_schedule(
+                            _process_for(process, rate), mix, slice_n,
+                            seed=seed + i * 1000 + k)
+                        if offered is None:
+                            offered = sched.offered_tokens_per_sec()
+                        pt = run_load(mgr, sched, metrics=None)
+                        toks += pt["tokens_out"]
+                        dur += float(pt["duration_s"])
+                        adm += pt["admitted"]
+                        com += pt["completed"]
+                        fai += pt["failed"]
+                    curve.append({
+                        "offered_rate_target": rate,
+                        "tokens_per_sec": fmt(toks / dur if dur
+                                              else 0.0, 1),
+                        "tokens_out": toks,
+                        "admitted": adm, "completed": com,
+                        "failed": fai,
+                        "_offered": offered,
+                        "_achieved": toks / dur if dur else 0.0,
+                    })
+                    toks_all += toks
+                    dur_all += dur
+                    admitted += adm
+                    completed += com
+                    failed += fai
+            tier = tier_counters(mgr)
+            fleet_snap = mgr.fleet_snapshot()
+            # -- RING-CHURN phase (affinity + pull arms only): spawn
+            # replicas until the ring remaps at least one shared
+            # prefix onto a newcomer, PREFETCH the moved keys (the
+            # fleet tier pulls the warm blocks from their old homes —
+            # synchronously, through the same budget and counters the
+            # dispatch-time pull uses), then request the moved
+            # prefixes: they must HIT on the adopted rows without the
+            # newcomer ever recomputing them. Measured AFTER the
+            # steady-state counters above so the rung hit rates stay
+            # churn-free.
+            churn_rec = None
+            if policy == "affinity" and pull and do_rungs and n >= 2:
+                from deeplearning4j_tpu.serving.fleet import (
+                    _build_ring, _ring_hash, _ring_lookup)
+                nb = mgr.affinity_block * mgr.affinity_blocks
+                keys = [tuple(p[:nb]) for p in mix.prefixes]
+                owner0 = {
+                    k: _ring_lookup(_build_ring(list(mgr.replicas)),
+                                    _ring_hash(k)) for k in keys}
+                added, moved = [], []
+                for _ in range(4):
+                    added.append(mgr.scale_up())
+                    ring = _build_ring(list(mgr.replicas))
+                    moved = [i for i, k in enumerate(keys)
+                             if _ring_lookup(ring, _ring_hash(k))
+                             != owner0[k]]
+                    if moved:
+                        break
+                pre = tier_counters(mgr)
+                pulled_blocks = sum(
+                    mgr.prefetch(list(mix.prefixes[i])) for i in moved)
+                h0 = tier_counters(mgr)
+                for i in moved:
+                    mgr.generate(list(mix.prefixes[i]) + [3, 4], 4,
+                                 deadline_ms=600_000, timeout=300)
+                post = tier_counters(mgr)
+                churn_rec = {
+                    "replicas_added": added,
+                    "keys_moved": len(moved),
+                    "pulled_blocks": pulled_blocks,
+                    "prefix_pull_hits": post["prefix_pull_hits"]
+                    - pre["prefix_pull_hits"],
+                    "prefix_pull_refused": post["prefix_pull_refused"]
+                    - pre["prefix_pull_refused"],
+                    "prefix_pull_bytes": post["prefix_pull_bytes"]
+                    - pre["prefix_pull_bytes"],
+                    "rehit_rows_after_pull":
+                        post["prefix_rows_hit"] - h0["prefix_rows_hit"],
+                }
+            snaps = {f"{tag}_{n}": mgr.replica(n).metrics.snapshot()
+                     for n in list(mgr.replicas)}
+        finally:
+            mgr.stop(timeout=120)
+            for p in procs_map.values():        # belt and braces
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs_map.values():
+                try:
+                    p.wait(timeout=30)
+                except Exception:   # noqa: BLE001
+                    p.kill()
+            if tmpdir:
+                shutil.rmtree(tmpdir, ignore_errors=True)
+        hit = tier["prefix_rows_hit"] - base["prefix_rows_hit"]
+        tot = tier["prefix_rows_total"] - base["prefix_rows_total"]
+        rec = {
+            "policy": policy, "n_replicas": int(n),
+            "procs": bool(use_procs), "curve": curve,
+            "tokens_per_sec": fmt(toks_all / dur_all if dur_all
+                                  else 0.0, 1),
+            "admitted": admitted, "completed": completed,
+            "failed": failed, "lost": admitted - completed - failed,
+            "prefix_rows_hit": hit, "prefix_rows_total": tot,
+            "hit_rate": fmt(hit / tot if tot else None, 4),
+            "routed_affinity": fleet_snap["fleet_routed_affinity"]
+            - base_fleet["fleet_routed_affinity"],
+            "routed_spill": fleet_snap["fleet_routed_spill"]
+            - base_fleet["fleet_routed_spill"],
+            "prefix_pull_hits": tier["prefix_pull_hits"]
+            - base["prefix_pull_hits"],
+            "prefix_pull_refused": tier["prefix_pull_refused"]
+            - base["prefix_pull_refused"],
+            "prefix_pull_bytes": tier["prefix_pull_bytes"]
+            - base["prefix_pull_bytes"],
+            "ring_churn": churn_rec,
+            "_achieved": toks_all / dur_all if dur_all else 0.0,
+        }
+        return rec, snaps, dispatch_rec, fleet_snap
+
+    use_procs = int(procs) >= 2
+    n_fleet = int(procs) if use_procs else int(n_replicas)
+    # solo reference doubles as the AFFINITY side of the dispatch A/B
+    # (a fleet of one routed by the affinity policy IS the solo server,
+    # plus the routing code under test)
+    solo_rec, solo_snaps, ab_aff, _ = run_arm(
+        "affinity", 1, False, False, "solo", do_dispatch=True)
+    _, _, ab_base, _ = run_arm(
+        "least_backlog", 1, False, False, "dispatch_baseline",
+        do_rungs=False, do_dispatch=True)
+    aff_rec, aff_snaps, _, aff_fleet = run_arm(
+        "affinity", n_fleet, use_procs, True, "affinity")
+    lb_rec, lb_snaps, _, _ = run_arm(
+        "least_backlog", n_fleet, use_procs, False, "least_backlog")
+
+    def per_tok(rec):
+        return rec["dispatches"] / rec["tokens"] if rec["tokens"] \
+            else None
+    apt, bpt = per_tok(ab_aff), per_tok(ab_base)
+    dispatch_ab = {
+        "affinity_dispatches": ab_aff["dispatches"],
+        "affinity_tokens": ab_aff["tokens"],
+        "affinity_dispatches_per_token": fmt(apt, 4),
+        "least_backlog_dispatches": ab_base["dispatches"],
+        "least_backlog_tokens": ab_base["tokens"],
+        "least_backlog_dispatches_per_token": fmt(bpt, 4),
+        # the acceptance pin: routing by hash is host-side work — the
+        # no-pull affinity path must not add a single device dispatch
+        "zero_added_dispatches": (apt is not None and bpt is not None
+                                  and apt <= bpt + 1e-9),
+    }
+    solo_hr = solo_rec["hit_rate"]
+    aff_hr = aff_rec["hit_rate"]
+    ratio = (aff_hr / solo_hr if solo_hr else None)
+    lb_tps = lb_rec["_achieved"]
+    goodput_ratio = (aff_rec["_achieved"] / lb_tps if lb_tps else None)
+    snaps = {}
+    for s in (solo_snaps, aff_snaps, lb_snaps):
+        snaps.update(s)
+    body = {"server": "fleet_affinity", "n_replicas": n_fleet,
+            "process": process, "procs": int(procs),
+            "config": f"{n_fleet}x paged bs={bs} "
+                      f"{'replica PROCESSES' if use_procs else 'in-process replicas'}"
+                      f", SharedPrefixMix P={n_prefixes} "
+                      f"blocks=1..2, affinity vs least_backlog vs "
+                      f"solo on identical seeded schedules, "
+                      f"admission deadline={slo_ms:g}ms",
+            "unit": "generated tokens/sec (fleet)",
+            "solo": solo_rec, "affinity": aff_rec,
+            "least_backlog": lb_rec,
+            "hit_rate_ratio_vs_solo": fmt(ratio, 3),
+            "hit_rate_retained_09": (ratio is not None
+                                     and ratio >= 0.9),
+            "goodput_ratio_vs_least_backlog": fmt(goodput_ratio, 3),
+            "dispatch_ab": dispatch_ab,
+            "curve": aff_rec["curve"], "knee": _knee(aff_rec["curve"]),
+            "fleet": aff_fleet}
+    return body, snaps, None
 
 
 def sweep_fleet_chaos(rates, n_replicas=2, n_req=48, slo_ms=250.0,
@@ -1358,7 +1719,8 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
               fleet_obs_per_rate=6, fleet_slice_s=0.25,
               fleet_control=False, fleet_injector=None,
               fleet_min=None, fleet_max=None, fleet_procs=0,
-              chaos=False, chaos_events=5, cascade=False):
+              chaos=False, chaos_events=5, cascade=False,
+              affinity=False):
     """Drive the sweep(s) and (optionally) write the combined
     obs_report (JSON + text + Chrome trace). Returns the results list.
     The tier-1 smoke test calls this with tiny parameters (and once
@@ -1381,6 +1743,15 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
     if fleet_procs and (fleet or fleet_control or overload_ab):
         raise ValueError("--fleet-procs is its own scenario: drop "
                          "--fleet/--fleet-control/--overload-ab")
+    if affinity and (fleet or fleet_control or overload_ab or chaos):
+        raise ValueError("--affinity is its own scenario (solo vs "
+                         "affinity vs least_backlog on one shared-"
+                         "prefix workload): drop --fleet/"
+                         "--fleet-control/--overload-ab/--chaos")
+    if affinity and server not in ("decode", "both"):
+        raise ValueError("--affinity needs --server decode (or both): "
+                         "the prefix-affinity arm drives paged DECODE "
+                         "replicas")
     if chaos and fleet_procs < 2:
         raise ValueError("--chaos needs --fleet-procs N (>= 2): the "
                          "chaos schedule kills and recovers the "
@@ -1419,10 +1790,18 @@ def run_sweep(server="both", rates=(50, 100, 200, 400, 800),
                          "controlled server against one baseline — "
                          "run them as separate sweeps")
     tracer = (Tracer(capacity=1 << 16, enabled=True)
-              if trace and not (fleet_mode or fleet_procs) else None)
+              if trace and not (fleet_mode or fleet_procs or affinity)
+              else None)
     fleet_trace = None
     results, snaps = [], {}
-    if fleet_procs >= 2 and chaos:
+    if affinity:
+        body, inst_snaps, fleet_trace = sweep_fleet_affinity(
+            rates, n_replicas=3, n_req=n_req, slo_ms=slo_ms, seed=seed,
+            process=process, trace=trace, procs=fleet_procs,
+            obs_per_rate=fleet_obs_per_rate, slice_s=fleet_slice_s)
+        results.append(body)
+        snaps.update({f"fleet_{n}": s for n, s in inst_snaps.items()})
+    elif fleet_procs >= 2 and chaos:
         body, inst_snaps, fleet_trace = sweep_fleet_chaos(
             rates, n_replicas=fleet_procs, n_req=n_req, slo_ms=slo_ms,
             seed=seed, process=process, trace=trace,
@@ -1616,6 +1995,17 @@ def main():
     ap.add_argument("--chaos-events", type=int, default=5, metavar="E",
                     help="chaos schedule length (>= 1; one is always "
                          "a manager kill)")
+    ap.add_argument("--affinity", action="store_true",
+                    help="PREFIX-AFFINITY arm: a seeded shared-system-"
+                         "prompt workload (SharedPrefixMix) over 3 "
+                         "paged replicas (or --fleet-procs N replica "
+                         "PROCESSES) three ways — solo reference, "
+                         "consistent-hash affinity routing with the "
+                         "fleet prefix tier (cross-replica block "
+                         "pulls), least-backlog baseline — recording "
+                         "fleet hit rate vs solo, pull counts/bytes, "
+                         "goodput vs baseline, and the zero-added-"
+                         "dispatch A/B for the no-pull path")
     ap.add_argument("--cascade", action="store_true",
                     help="BLAST-RADIUS-CONTAINMENT arm (needs --chaos "
                          "and --fleet-procs N >= 3): the schedule adds "
@@ -1668,7 +2058,8 @@ def main():
                         fleet_procs=args.fleet_procs,
                         chaos=args.chaos,
                         chaos_events=args.chaos_events,
-                        cascade=args.cascade)
+                        cascade=args.cascade,
+                        affinity=args.affinity)
     for r in results:
         print(json.dumps(r))
     print(json.dumps({"elapsed_s": fmt(time.perf_counter() - t0, 1),

@@ -58,6 +58,14 @@ equally):
     what preemption buys: INTERACTIVE-class goodput-under-deadline and
     completion p99 (a tight TTFT bound — interactive requests are 4
     tokens) at the occupancy regime queue-depth admission cannot help.
+  * affinity_vs_least_backlog — the SAME seeded shared-system-prompt
+    schedule (SharedPrefixMix) through two 2-replica paged fleets:
+    FleetManager prefix-affinity routing (consistent-hash the block-
+    aligned prefix key, load-aware spill, fleet prefix tier pulls) vs
+    the least-backlog baseline (ISSUE 20). The A/B isolates what
+    stickiness buys — fleet prefix hit rate (baseline decays toward
+    ~1/N) at goodput parity or better; routing verdicts and pull
+    counters reported alongside.
   * overload_vs_baseline — the SAME seeded past-knee arrival schedule
     (serving/loadgen.py, NOT a backlog: overload is a queueing
     phenomenon) through an uncontrolled decode server vs one with
@@ -766,6 +774,133 @@ def bench_preempt_ab(segments, reqs_per_seg=12, slo_ms=60.0):
     }, snaps, None
 
 
+def bench_affinity_ab(segments, reqs_per_seg=24, slo_ms=250.0):
+    """Prefix-affinity routing A/B (ISSUE 20): the SAME seeded
+    shared-system-prompt schedule (`serving.loadgen.SharedPrefixMix`)
+    replayed per segment through two 2-replica paged fleets —
+    `FleetManager(policy="affinity")` (consistent-hash prefix routing
+    with load-aware spill + the fleet prefix tier) vs
+    `policy="least_backlog"` (the prefix-blind baseline). Per-segment
+    metric: fleet goodput-under-SLO. The record carries each arm's
+    fleet prefix HIT RATE over the measured segments (counter deltas —
+    warmup and the per-arm steady-state preload excluded) and the
+    affinity arm's routing/pull counters: stickiness must BUY reuse
+    (hit rate above the baseline's) without costing goodput."""
+    from deeplearning4j_tpu.serving import (ContinuousDecodeServer,
+                                            FleetManager,
+                                            PoissonProcess,
+                                            ServingMetrics,
+                                            SharedPrefixMix,
+                                            build_schedule, run_load)
+
+    lm = _lm()
+    mix = SharedPrefixMix(n_prefixes=4, prefix_blocks=(1, 3),
+                          block_size=8, suffix=(1, 9), new=(4, 16),
+                          vocab=96, seed=11)
+    rate = 40.0     # near the 2-replica knee: enough concurrency that
+    # routing placement matters, while goodput-under-SLO stays nonzero
+    # (far past it every arm's goodput is 0 and the A/B reads nothing)
+
+    def factory(name):
+        return ContinuousDecodeServer(
+            lm, slots=2, prompt_buckets=(16, 32), max_queue=1024,
+            metrics=ServingMetrics(slo_target_ms=slo_ms, name=name),
+            instance=name, admission=True, default_deadline_ms=slo_ms,
+            paged=True, block_size=8)
+
+    def warmup(srv):
+        for p in ([1, 2, 3, 4], list(range(1, 25))):
+            srv.generate(p, 4, deadline_ms=600_000, timeout=300)
+
+    mgrs = {
+        "affinity": FleetManager(
+            factory, n_replicas=2, policy="affinity", warmup=warmup,
+            metrics=ServingMetrics(name="fleet")),
+        "least_backlog": FleetManager(
+            factory, n_replicas=2, policy="least_backlog",
+            warmup=warmup, metrics=ServingMetrics(name="fleet")),
+    }
+    for m in mgrs.values():
+        m.start()
+        # steady-state preload through the arm's OWN router: cold
+        # first-touch misses are placement noise, not policy signal
+        for p in mix.prefixes:
+            m.generate(list(p) + [1, 2], 4, deadline_ms=600_000,
+                       timeout=300)
+
+    def tier(m):
+        out = {"hit": 0, "total": 0}
+        for n in list(m.replicas):
+            s = m.replica(n).metrics.snapshot()
+            out["hit"] += int(s.get("prefix_rows_hit") or 0)
+            out["total"] += int(s.get("prefix_rows_total") or 0)
+        return out
+
+    base = {n: tier(m) for n, m in mgrs.items()}
+    base_fleet = {n: m.fleet_snapshot() for n, m in mgrs.items()}
+    seg_idx = {n: [0] for n in mgrs}
+    last = {n: None for n in mgrs}
+
+    def seg(name):
+        m = mgrs[name]
+
+        def run():
+            sched = build_schedule(PoissonProcess(rate), mix,
+                                   reqs_per_seg,
+                                   seed=70 + seg_idx[name][0])
+            seg_idx[name][0] += 1
+            # fleet goodput = FEDERATED within-SLO tokens over the
+            # segment (run_load's own slo view reads the MANAGER's
+            # metrics, which never see the replicas' slo counters)
+            g0 = m.fleet_view().counter("slo_tokens_met")
+            pt = run_load(m, sched)
+            last[name] = pt
+            g1 = m.fleet_view().counter("slo_tokens_met")
+            return (g1 - g0) / max(float(pt["duration_s"]), 1e-9)
+        return run
+
+    ab = _interleaved({n: seg(n) for n in mgrs}, segments=segments)
+    tiers = {n: tier(m) for n, m in mgrs.items()}
+    fleets = {n: m.fleet_snapshot() for n, m in mgrs.items()}
+    snaps = {}
+    for n, m in mgrs.items():
+        for rn in list(m.replicas):
+            snaps[f"{n}.{rn}"] = m.replica(rn).metrics.snapshot()
+    for m in mgrs.values():
+        m.stop(timeout=120)
+    hr = {}
+    for n in mgrs:
+        h = tiers[n]["hit"] - base[n]["hit"]
+        t = tiers[n]["total"] - base[n]["total"]
+        hr[n] = (h / t) if t else None
+    ga, gb = ab["affinity"]["median"], ab["least_backlog"]["median"]
+    af, bf = fleets["affinity"], base_fleet["affinity"]
+    return {
+        "config": f"2x FleetManager over 2 paged (bs=8) replicas "
+                  f"each, SharedPrefixMix P=4, Poisson {rate:g} rps, "
+                  f"{reqs_per_seg} reqs/segment, slo={slo_ms:g}ms; "
+                  f"affinity = consistent-hash prefix routing + "
+                  f"fleet prefix tier vs least-backlog",
+        "unit": "goodput tokens/sec (within-SLO, fleet)",
+        "ab": ab,
+        "goodput_affinity_over_least_backlog": round(ga / gb, 3)
+        if gb else None,
+        "fleet_prefix_hit_rate": {n: fmt(hr[n], 4) for n in hr},
+        "routing": {
+            "routed_affinity": af["fleet_routed_affinity"]
+            - bf["fleet_routed_affinity"],
+            "routed_spill": af["fleet_routed_spill"]
+            - bf["fleet_routed_spill"],
+            "prefix_pull_hits": af["fleet_prefix_pull_hits"]
+            - bf["fleet_prefix_pull_hits"],
+            "prefix_pull_bytes": af["fleet_prefix_pull_bytes"]
+            - bf["fleet_prefix_pull_bytes"]},
+        "tokens_per_sec_last_segment": {
+            n: last[n] and last[n]["tokens_per_sec"] for n in last},
+        "slo_ms": slo_ms,
+    }, snaps, None
+
+
 def bench_overload_ab(segments, reqs_per_seg=320, slo_ms=120.0):
     """Overload robustness A/B (PR 9): the SAME seeded Poisson schedule,
     offered well past the tiny model's saturation knee, replayed per
@@ -1004,6 +1139,7 @@ def main():
                ("speculative_vs_plain", bench_speculative_ab),
                ("paged_spec_vs_paged", bench_paged_spec_ab),
                ("fused_serve_vs_plain", bench_fused_serve_ab),
+               ("affinity_vs_least_backlog", bench_affinity_ab),
                ("microbatch_vs_per_request", bench_microbatch_ab),
                ("tracing_on_vs_off", bench_tracing_ab))
     for name, fn in benches:
